@@ -1,0 +1,32 @@
+"""bflc_demo_tpu — a TPU-native federated-learning framework with committee consensus.
+
+A ground-up re-design of the capability surface of iammcy/BFLC-demo
+(blockchain-based decentralized federated learning with committee consensus):
+
+- clients train locally and upload model *deltas*;
+- an elected committee scores every candidate update on its own data shard;
+- a replicated, deterministic coordinator (the "ledger") ranks updates by the
+  median committee score, aggregates the top-k by sample-weighted FedAvg,
+  advances the epoch and re-elects the committee.
+
+Where the reference runs a C++ precompiled contract inside a FISCO-BCOS PBFT
+chain (reference: FISCO-BCOS/libprecompiled/extension/CommitteePrecompiled.cpp)
+with TensorFlow-1 clients exchanging JSON strings (reference:
+python-sdk/main.py), this framework is TPU-first:
+
+- the FL math (local SGD, candidate scoring, top-k aggregation) is pure JAX,
+  jit/pjit-compiled onto the MXU (`bflc_demo_tpu.core`);
+- aggregation across clients is an ICI collective — a masked, sample-weighted
+  `psum` under `shard_map` over a client-sharded `jax.sharding.Mesh`
+  (`bflc_demo_tpu.parallel`);
+- the coordinator is a native C++ deterministic state machine with a
+  hash-chained append-only op log; the ledger stores update *hashes* and
+  committee scores while tensors stay in device memory
+  (`bflc_demo_tpu.ledger`);
+- model payloads move as typed device arrays, never JSON
+  (`bflc_demo_tpu.utils.serialization`).
+"""
+
+__version__ = "0.1.0"
+
+from bflc_demo_tpu.protocol import constants as protocol_constants  # noqa: F401

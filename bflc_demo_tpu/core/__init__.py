@@ -1,0 +1,17 @@
+"""Pure FL math — jit/pjit-compiled, no distribution, no transport.
+
+This is step 1 of the build plan (SURVEY.md §7): the train / score / aggregate
+triangle the whole protocol rotates through, as pure JAX functions with static
+shapes so XLA tiles them onto the MXU.
+"""
+
+from bflc_demo_tpu.core.losses import softmax_cross_entropy, accuracy  # noqa: F401
+from bflc_demo_tpu.core.local_train import local_train, evaluate  # noqa: F401
+from bflc_demo_tpu.core.scoring import score_candidates  # noqa: F401
+from bflc_demo_tpu.core.aggregate import (  # noqa: F401
+    median_scores,
+    rank_desc_stable,
+    topk_selection_mask,
+    aggregate,
+    elect_committee,
+)

@@ -1,0 +1,86 @@
+"""Local SGD on one client shard — the trainer hot loop, as one XLA program.
+
+Reference behavior being reproduced (python-sdk/main.py:103-169):
+- download global model, run `local_epochs` passes of minibatch SGD with plain
+  gradient descent at lr (GradientDescentOptimizer(0.001), main.py:131-148);
+- batch count = floor(shard_size / batch_size), remainder dropped
+  (main.py:140);
+- report delta = (params_before - params_after) / lr and
+  meta = (n_samples = shard_size, avg_cost = mean minibatch loss)
+  (main.py:151-158).
+
+Where the reference rebuilds a TF1 graph and opens a fresh Session every round
+(main.py:109-136), here the whole local round — every minibatch step included —
+is a single jitted function: the minibatch loop is a `lax.scan` (no Python
+control flow under jit), shapes are static, and the delta never leaves device
+memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bflc_demo_tpu.core.losses import softmax_cross_entropy, accuracy as _accuracy
+
+Pytree = Any
+ApplyFn = Callable[[Pytree, jax.Array], jax.Array]
+
+
+def _num_batches(n: int, batch_size: int) -> int:
+    nb = n // batch_size
+    if nb == 0:
+        raise ValueError(f"shard of {n} examples < batch_size {batch_size}")
+    return nb
+
+
+@functools.partial(jax.jit, static_argnames=("apply_fn", "batch_size",
+                                             "local_epochs"))
+def local_train(apply_fn: ApplyFn, params: Pytree, x: jax.Array, y: jax.Array,
+                lr: float, batch_size: int, local_epochs: int = 1,
+                ) -> Tuple[Pytree, jax.Array]:
+    """Run local SGD; return (delta, avg_cost).
+
+    delta is (params_in - params_out) / lr — the wire format of the reference
+    (main.py:153-155), chosen so the coordinator's
+    ``global -= lr * weighted_mean(delta)`` equals the sample-weighted mean of
+    client post-training models (exact FedAvg, SURVEY.md §2c).
+
+    x: (n, *feature_dims), y: (n, num_classes) one-hot.  The first
+    floor(n/batch_size)*batch_size examples are used, like the reference.
+    """
+    n = x.shape[0]
+    nb = _num_batches(n, batch_size)
+    xb = x[: nb * batch_size].reshape((nb, batch_size) + x.shape[1:])
+    yb = y[: nb * batch_size].reshape((nb, batch_size) + y.shape[1:])
+
+    def loss_fn(p, bx, by):
+        return softmax_cross_entropy(apply_fn(p, bx), by)
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def sgd_step(p, batch):
+        bx, by = batch
+        cost, g = grad_fn(p, bx, by)
+        p = jax.tree_util.tree_map(lambda w, gw: w - lr * gw, p, g)
+        return p, cost
+
+    def one_epoch(p, _):
+        p, costs = jax.lax.scan(sgd_step, p, (xb, yb))
+        return p, jnp.mean(costs)
+
+    trained, epoch_costs = jax.lax.scan(one_epoch, params, None,
+                                        length=local_epochs)
+    delta = jax.tree_util.tree_map(lambda a, b: (a - b) / lr, params, trained)
+    return delta, jnp.mean(epoch_costs)
+
+
+@functools.partial(jax.jit, static_argnames=("apply_fn",))
+def evaluate(apply_fn: ApplyFn, params: Pytree, x: jax.Array, y: jax.Array,
+             ) -> jax.Array:
+    """Accuracy of ``params`` on (x, y) — the reference's only quality metric
+    (local_testing main.py:172-193; global_testing main.py:285-306)."""
+    return _accuracy(apply_fn(params, x), y)

@@ -1,0 +1,25 @@
+"""Loss / metric primitives shared by every model in the zoo.
+
+Reference semantics: mean softmax cross-entropy over the batch
+(main.py:125-127, reduce_mean of -sum(y*log(softmax))), accuracy as argmax
+match rate (main.py:189-191, 301-304).  Computed from logits so XLA fuses the
+softmax into the preceding matmul's epilogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels_onehot: jax.Array) -> jax.Array:
+    """Mean CE over the batch; labels are one-hot (reference main.py:43-44)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels_onehot * logp, axis=-1))
+
+
+def accuracy(logits: jax.Array, labels_onehot: jax.Array) -> jax.Array:
+    """Fraction of argmax matches (reference main.py:189-191)."""
+    pred = jnp.argmax(logits, axis=-1)
+    true = jnp.argmax(labels_onehot, axis=-1)
+    return jnp.mean((pred == true).astype(jnp.float32))

@@ -1,0 +1,46 @@
+"""Committee scoring — evaluate every candidate update in one batched program.
+
+Reference behavior (python-sdk/main.py:196-228): each committee member, for
+each of the K=10 collected updates, materialises that trainer's candidate model
+``candidate = global - lr * delta`` and measures its accuracy on the committee
+member's OWN shard (main.py:212-217) — rebuilding a TF graph per candidate,
+flagged in SURVEY.md §3 as the most wasteful client loop.
+
+TPU-native version: one `vmap` over the stacked candidate axis.  All K
+candidate models are materialised and evaluated in a single XLA program —
+the per-candidate matmuls batch into one larger MXU matmul.  This is the
+"batched multi-model evaluation" requirement of SURVEY.md §7 (Byzantine-defense
+fidelity at scale).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from bflc_demo_tpu.core.losses import accuracy
+
+Pytree = Any
+ApplyFn = Callable[[Pytree, jax.Array], jax.Array]
+
+
+@functools.partial(jax.jit, static_argnames=("apply_fn",))
+def score_candidates(apply_fn: ApplyFn, global_params: Pytree,
+                     deltas: Pytree, lr: float,
+                     x: jax.Array, y: jax.Array) -> jax.Array:
+    """Score all K candidates on one shard; returns (K,) accuracies.
+
+    deltas: pytree with a stacked leading axis K (one slice per collected
+    update).  candidate_k = global - lr * delta_k, exactly the reconstruction
+    the reference does per-candidate (main.py:212-216).
+    """
+    candidates = jax.tree_util.tree_map(
+        lambda g, d: g[None] - lr * d, global_params, deltas)
+
+    def eval_one(candidate: Pytree) -> jax.Array:
+        return accuracy(apply_fn(candidate, x), y)
+
+    return jax.vmap(eval_one)(candidates)

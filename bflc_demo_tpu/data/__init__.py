@@ -1,0 +1,12 @@
+"""Datasets and client partitioning.
+
+The reference's data layer (SURVEY.md §1 L4) is the UCI Occupancy Detection
+CSV, split 75/25 and sharded contiguously over 20 clients
+(python-sdk/main.py:33-53).  This package reproduces that pipeline in numpy
+(host side; shards are device_put once and stay in HBM) and adds the
+partitioners the scale-out configs need (Dirichlet non-IID, per-round client
+sampling).
+"""
+
+from bflc_demo_tpu.data.occupancy import load_occupancy, synthesize_occupancy  # noqa: F401
+from bflc_demo_tpu.data.partition import iid_shards, dirichlet_shards, one_hot  # noqa: F401

@@ -1,0 +1,14 @@
+"""Model zoo.
+
+Every model is a `base.Model`: a named pair of pure functions (init, apply)
+plus metadata, so the whole FL stack (local SGD, committee scoring, sharded
+aggregation) is generic over architectures.  The reference hardcodes a single
+5->2 softmax regression in two places (client graph main.py:109-133, contract
+structs CommitteePrecompiled.h:24-52); here the same protocol drives every
+entry in the zoo.
+"""
+
+from bflc_demo_tpu.models.base import Model  # noqa: F401
+from bflc_demo_tpu.models.softmax_regression import make_softmax_regression  # noqa: F401
+
+__all__ = ["Model", "make_softmax_regression"]

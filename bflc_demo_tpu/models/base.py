@@ -1,0 +1,37 @@
+"""The model contract the rest of the framework builds against.
+
+A model is a pytree of parameters plus two pure functions.  Everything else —
+loss, accuracy, local SGD, candidate scoring, aggregation — is generic code in
+`core/` that closes over these.  This keeps `jax.vmap` / `shard_map` free to
+batch over *models* (committee scoring evaluates many candidate models at once,
+the reference instead rebuilds a TF graph per candidate, main.py:212-217).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """A pure-functional model.
+
+    init:  rng -> params            (params: pytree of arrays)
+    apply: (params, x) -> logits    (pure; no state, no rng — dropout-free
+                                     eval path; training-time stochasticity is
+                                     handled by passing rng through `extra`)
+    """
+
+    name: str
+    init: Callable[[jax.Array], Pytree]
+    apply: Callable[[Pytree, jax.Array], jax.Array]
+    input_shape: Tuple[int, ...] = ()   # per-example shape, e.g. (5,)
+    num_classes: int = 2
+
+    def init_params(self, seed: int = 0) -> Pytree:
+        return self.init(jax.random.PRNGKey(seed))

@@ -1,0 +1,40 @@
+"""Softmax regression — the reference's one and only model, config-1 parity.
+
+Reference: a single dense layer, 5 features -> 2 classes, zero-initialised
+(client graph main.py:113-120; contract-side zero model
+CommitteePrecompiled.cpp:329-337 via Model struct .h:24-52).  Zero init is
+load-bearing for parity: the contract's genesis global model is all-zeros and
+clients always start from the downloaded global model, so we default to zeros
+too (an rng-keyed init is still accepted to satisfy the Model contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from bflc_demo_tpu.models.base import Model
+
+
+def make_softmax_regression(n_features: int = 5, n_class: int = 2,
+                            dtype=jnp.float32) -> Model:
+    def init(rng: jax.Array) -> Dict[str, jax.Array]:
+        del rng  # zero init, matching the reference genesis model
+        return {
+            "W": jnp.zeros((n_features, n_class), dtype=dtype),
+            "b": jnp.zeros((n_class,), dtype=dtype),
+        }
+
+    def apply(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        # logits; softmax + CE live in core.losses so they fuse under jit
+        return x.astype(dtype) @ params["W"] + params["b"]
+
+    return Model(
+        name="softmax_regression",
+        init=init,
+        apply=apply,
+        input_shape=(n_features,),
+        num_classes=n_class,
+    )

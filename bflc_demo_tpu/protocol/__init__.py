@@ -1,0 +1,16 @@
+"""Protocol layer: the committee-consensus FL protocol, independent of transport.
+
+The reference splits the protocol between C++ macros
+(CommitteePrecompiled.h:7-19) and Python module constants (main.py:52-88) with
+no consistency check.  Here the protocol genome lives in exactly one place
+(`constants.ProtocolConfig`) and every other layer imports it.
+"""
+
+from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL  # noqa: F401
+from bflc_demo_tpu.protocol.types import (  # noqa: F401
+    Role,
+    UpdateMeta,
+    LocalUpdate,
+    ScoreVector,
+    RoundResult,
+)

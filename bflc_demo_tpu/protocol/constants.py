@@ -1,0 +1,78 @@
+"""The protocol genome — every constant that client and coordinator must agree on.
+
+Reference parity (values must match exactly, see SURVEY.md §2d):
+- n_features=5, n_class=2      — CommitteePrecompiled.h:7-8
+- COMM_COUNT=4                 — CommitteePrecompiled.h:11 (aggregation fires at
+                                 score_count == COMM_COUNT, .cpp:296-297)
+- AGGREGATE_COUNT=6            — CommitteePrecompiled.h:13 (top-k merged, .cpp:374)
+- NEEDED_UPDATE_COUNT=10       — CommitteePrecompiled.h:15 (per-round cap,
+                                 .cpp:239-244; QueryAllUpdates gate .cpp:304-311)
+- CLIENT_NUM=20                — CommitteePrecompiled.h:17 (FL start trigger,
+                                 .cpp:175-186)
+- learning_rate=0.001          — CommitteePrecompiled.h:19 (server step, .cpp:407)
+                                 and python-sdk/main.py:88 (client step)
+- batch_size=100               — python-sdk/main.py:87
+- MAX_EPOCH=1000               — python-sdk/main.py:65 (50 * CLIENT_NUM)
+- GENESIS_EPOCH=-999           — CommitteePrecompiled.cpp:322 (pre-start sentinel)
+- client trained_epoch=-1      — python-sdk/main.py:89
+
+The reference duplicates these across a C++ header and a Python module with no
+schema check (SURVEY.md §1 cross-layer invariant).  Here there is one source of
+truth; the native ledger receives them through its init call and the JAX compute
+plane reads them as static jit arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolConfig:
+    """Committee-consensus FL protocol parameters.
+
+    Frozen + hashable so instances can be passed as static args to jitted
+    functions.  ``validate()`` enforces the structural invariants the reference
+    assumes implicitly (e.g. aggregate_count <= needed_update_count).
+    """
+
+    # population / round structure
+    client_num: int = 20          # registrations that start FL
+    comm_count: int = 4           # committee size; scores needed per round
+    aggregate_count: int = 6      # top-k updates merged per round
+    needed_update_count: int = 10  # updates accepted per round (first-come cap)
+
+    # optimisation
+    learning_rate: float = 0.001  # server-side step; clients reuse it
+    batch_size: int = 100
+    local_epochs: int = 1         # passes over the local shard per round
+
+    # run control
+    max_epoch: int = 1000
+    genesis_epoch: int = -999     # epoch value before CLIENT_NUM registrations
+    initial_trained_epoch: int = -1
+
+    def validate(self) -> "ProtocolConfig":
+        if not (0 < self.comm_count < self.client_num):
+            raise ValueError(
+                f"comm_count must be in (0, client_num): {self.comm_count} vs "
+                f"{self.client_num}")
+        if not (0 < self.aggregate_count <= self.needed_update_count):
+            raise ValueError(
+                f"aggregate_count must be in (0, needed_update_count]: "
+                f"{self.aggregate_count} vs {self.needed_update_count}")
+        if self.needed_update_count > self.client_num - self.comm_count:
+            raise ValueError(
+                "needed_update_count exceeds trainer population "
+                f"({self.needed_update_count} > "
+                f"{self.client_num - self.comm_count})")
+        if self.learning_rate <= 0 or self.batch_size <= 0:
+            raise ValueError("learning_rate and batch_size must be positive")
+        return self
+
+    @property
+    def trainer_count(self) -> int:
+        return self.client_num - self.comm_count
+
+
+DEFAULT_PROTOCOL = ProtocolConfig().validate()

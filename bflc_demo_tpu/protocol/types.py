@@ -1,0 +1,84 @@
+"""Typed protocol messages — the schema the reference never had.
+
+The reference moves every payload as JSON strings nested inside ABI strings
+(LocalUpdate.to_json_string double-nests delta/meta as JSON *strings* inside a
+JSON object, CommitteePrecompiled.h:101-106; client side main.py:155-158), with
+the model schema defined twice and unchecked.  Here messages are typed
+dataclasses; tensor payloads are pytrees of arrays that stay on device, and
+what crosses the coordinator boundary is their content hash plus small typed
+metadata (see ledger/ and utils/serialization.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, Optional
+
+# A model / delta is any JAX pytree of arrays.  We alias it for readability.
+Pytree = Any
+
+
+class Role(str, enum.Enum):
+    """On-chain role of a client (reference: roles map, .cpp:168-190).
+
+    The reference stores roles as strings "trainer"/"comm" in a JSON map;
+    unknown addresses default to trainer on query (.cpp:191-205) without being
+    persisted — we reproduce that read semantic in the ledger.
+    """
+
+    TRAINER = "trainer"
+    COMMITTEE = "comm"
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateMeta:
+    """Side information accompanying a delta (reference Meta struct, .h:54-77).
+
+    n_samples weights the FedAvg mean (.cpp:374-400); avg_cost feeds the global
+    loss print (.cpp:416-425).
+    """
+
+    n_samples: int
+    avg_cost: float
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalUpdate:
+    """A trainer's contribution for one round (reference LocalUpdate, .h:79-107).
+
+    ``delta`` is (params_before - params_after) / lr, so applying
+    ``global -= lr * weighted_mean(delta)`` is exactly the sample-weighted mean
+    of client post-training models (FedAvg; main.py:153-158 + .cpp:403-414).
+    ``payload_hash`` is what the ledger records; the tensor pytree itself lives
+    in the off-ledger update store (HBM / host memory).
+    """
+
+    sender: str
+    epoch: int
+    meta: UpdateMeta
+    delta: Optional[Pytree] = None      # device pytree; None once detached
+    payload_hash: bytes = b""
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreVector:
+    """One committee member's scores for all candidate updates.
+
+    Reference: map<address_hex, float> as JSON (main.py:211-219, .cpp:354-357).
+    """
+
+    scorer: str
+    epoch: int
+    scores: Dict[str, float]            # trainer address -> accuracy
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundResult:
+    """Outcome of one aggregation (reference Aggregate, .cpp:349-456)."""
+
+    epoch: int                          # epoch just completed
+    global_loss: float                  # sum(top-k avg_cost)/k (.cpp:416-425)
+    selected: tuple                     # trainer addresses aggregated (top-k)
+    new_committee: tuple                # addresses elected for next round
+    model_hash: bytes = b""             # hash of the post-update global model

@@ -1,0 +1,29 @@
+"""Test env: force JAX onto CPU with 8 virtual devices before backend init.
+
+Multi-chip hardware is not available in CI; all sharding/collective tests run
+on a virtual 8-device CPU mesh (the same mechanism the driver uses for the
+multichip dryrun).  This mirrors the reference's own answer to "test
+distributed behavior on one box": loopback multi-process with real identities
+(SURVEY.md §4) — here, loopback multi-device with real shardings.
+
+Env vars take effect at XLA backend creation, not jax import, so this works
+even though some pytest plugins import jax early; we additionally poke
+jax.config when jax is already in sys.modules.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+if "jax" in sys.modules:
+    import jax
+    assert not jax._src.xla_bridge._backends, (
+        "XLA backend initialised before conftest could set "
+        "JAX_PLATFORMS/XLA_FLAGS; run pytest from the repo root")
+    jax.config.update("jax_platforms", "cpu")

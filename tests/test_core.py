@@ -1,0 +1,222 @@
+"""Unit tests for the core FL math against hand-computed values and the
+reference semantics documented in SURVEY.md §3.2-3.4."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bflc_demo_tpu.core import (
+    softmax_cross_entropy, accuracy, local_train, evaluate, score_candidates,
+    median_scores, rank_desc_stable, topk_selection_mask, aggregate,
+    elect_committee,
+)
+from bflc_demo_tpu.models import make_softmax_regression
+
+
+MODEL = make_softmax_regression()
+
+
+def _rand_batch(rng, n=100):
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), rng.integers(0, 2, n)] = 1.0
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+class TestLosses:
+    def test_ce_uniform_logits(self):
+        logits = jnp.zeros((4, 2))
+        y = jnp.eye(2)[jnp.array([0, 1, 0, 1])]
+        np.testing.assert_allclose(softmax_cross_entropy(logits, y),
+                                   np.log(2.0), rtol=1e-6)
+
+    def test_accuracy(self):
+        logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]])
+        y = jnp.eye(2)[jnp.array([0, 1, 1])]
+        np.testing.assert_allclose(accuracy(logits, y), 2.0 / 3.0, rtol=1e-6)
+
+
+class TestLocalTrain:
+    def test_delta_encodes_sgd_path(self):
+        """delta == (params_in - params_out)/lr exactly (main.py:153-155)."""
+        rng = np.random.default_rng(0)
+        x, y = _rand_batch(rng, 300)
+        params = MODEL.init_params()
+        delta, cost = local_train(MODEL.apply, params, x, y,
+                                  lr=0.001, batch_size=100)
+        # recompute by hand: 3 plain SGD steps
+        p = params
+        costs = []
+        for b in range(3):
+            bx, by = x[b * 100:(b + 1) * 100], y[b * 100:(b + 1) * 100]
+            c, g = jax.value_and_grad(
+                lambda q: softmax_cross_entropy(MODEL.apply(q, bx), by))(p), None
+            cost_b, grads = c[0], jax.grad(
+                lambda q: softmax_cross_entropy(MODEL.apply(q, bx), by))(p)
+            costs.append(cost_b)
+            p = jax.tree_util.tree_map(lambda w, gw: w - 0.001 * gw, p, grads)
+        expect_delta = jax.tree_util.tree_map(
+            lambda a, b_: (a - b_) / 0.001, params, p)
+        for k in ("W", "b"):
+            np.testing.assert_allclose(delta[k], expect_delta[k],
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(cost, np.mean(costs), rtol=1e-5)
+
+    def test_remainder_dropped(self):
+        """floor(n/bs) batches, remainder unused (main.py:140)."""
+        rng = np.random.default_rng(1)
+        x, y = _rand_batch(rng, 305)
+        params = MODEL.init_params()
+        d305, _ = local_train(MODEL.apply, params, x, y, lr=0.001, batch_size=100)
+        d300, _ = local_train(MODEL.apply, params, x[:300], y[:300],
+                              lr=0.001, batch_size=100)
+        np.testing.assert_allclose(d305["W"], d300["W"], rtol=1e-6)
+
+    def test_training_reduces_loss(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((400, 5)).astype(np.float32)
+        w_true = rng.standard_normal((5, 2)).astype(np.float32)
+        y_id = np.argmax(x @ w_true, axis=1)
+        y = jnp.eye(2)[y_id]
+        x = jnp.asarray(x)
+        params = MODEL.init_params()
+        before = softmax_cross_entropy(MODEL.apply(params, x), y)
+        delta, _ = local_train(MODEL.apply, params, x, y, lr=0.05,
+                               batch_size=100, local_epochs=20)
+        trained = jax.tree_util.tree_map(lambda p, d: p - 0.05 * d,
+                                         params, delta)
+        after = softmax_cross_entropy(MODEL.apply(trained, x), y)
+        assert float(after) < float(before)
+        assert float(evaluate(MODEL.apply, trained, x, y)) > 0.8
+
+
+class TestScoring:
+    def test_matches_sequential_eval(self):
+        """vmap-batched scoring == per-candidate loop (main.py:212-217)."""
+        rng = np.random.default_rng(3)
+        x, y = _rand_batch(rng, 200)
+        params = MODEL.init_params(1)
+        k = 10
+        deltas = {
+            "W": jnp.asarray(rng.standard_normal((k, 5, 2)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((k, 2)), jnp.float32),
+        }
+        scores = score_candidates(MODEL.apply, params, deltas, 0.001, x, y)
+        assert scores.shape == (k,)
+        for i in range(k):
+            cand = jax.tree_util.tree_map(
+                lambda g, d: g - 0.001 * d[i], params, deltas)
+            np.testing.assert_allclose(
+                scores[i], evaluate(MODEL.apply, cand, x, y), rtol=1e-6)
+
+
+class TestMedianRank:
+    def test_median_odd_even(self):
+        m = jnp.array([[1.0, 5.0], [3.0, 1.0], [2.0, 3.0], [10.0, 7.0]])
+        mask = jnp.array([True, True, True, False])
+        np.testing.assert_allclose(median_scores(m, mask), [2.0, 3.0])
+        mask4 = jnp.ones(4, bool)
+        np.testing.assert_allclose(median_scores(m, mask4), [2.5, 4.0])
+
+    def test_rank_stable_tiebreak(self):
+        s = jnp.array([0.5, 0.9, 0.5, 0.1])
+        v = jnp.ones(4, bool)
+        np.testing.assert_array_equal(rank_desc_stable(s, v), [1, 0, 2, 3])
+
+    def test_topk_mask_respects_validity(self):
+        s = jnp.array([0.9, 0.8, 0.7, 0.6, 0.5])
+        v = jnp.array([True, False, True, True, True])
+        mask = topk_selection_mask(s, v, 3)
+        np.testing.assert_array_equal(mask, [True, False, True, True, False])
+
+
+class TestAggregate:
+    def _setup(self, k=10, c=4, seed=4):
+        rng = np.random.default_rng(seed)
+        g = {"W": jnp.asarray(rng.standard_normal((5, 2)), jnp.float32),
+             "b": jnp.asarray(rng.standard_normal((2,)), jnp.float32)}
+        deltas = {
+            "W": jnp.asarray(rng.standard_normal((k, 5, 2)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((k, 2)), jnp.float32)}
+        n = jnp.asarray(rng.integers(100, 400, k), jnp.int32)
+        costs = jnp.asarray(rng.random(k), jnp.float32)
+        scores = jnp.asarray(rng.random((c, k)), jnp.float32)
+        return g, deltas, n, costs, scores
+
+    def test_weighted_fedavg_exact(self):
+        """Reproduces .cpp:369-414 arithmetic by hand."""
+        g, deltas, n, costs, scores = self._setup()
+        res = aggregate(g, deltas, n, costs, scores,
+                        jnp.ones(4, bool), jnp.ones(10, bool), 0.001, 6)
+        med = np.median(np.asarray(scores), axis=0)
+        top6 = np.argsort(-med, kind="stable")[:6]
+        w = np.zeros(10); w[top6] = np.asarray(n)[top6]
+        expect_W = np.asarray(g["W"]) - 0.001 * (
+            np.tensordot(w, np.asarray(deltas["W"]), axes=1) / w.sum())
+        np.testing.assert_allclose(res.params["W"], expect_W, rtol=1e-5)
+        np.testing.assert_allclose(
+            res.global_loss, np.asarray(costs)[top6].sum() / 6, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(res.selected)[top6],
+                                      np.ones(6, bool))
+
+    def test_fedavg_of_client_models_identity(self):
+        """global -= lr*wmean(delta) == sample-weighted mean of client models
+        when every client starts from global (SURVEY.md §2c DP row)."""
+        g, _, n, costs, scores = self._setup()
+        rng = np.random.default_rng(5)
+        k = 10
+        # client post-training models
+        client_W = np.asarray(g["W"])[None] + rng.standard_normal(
+            (k, 5, 2)).astype(np.float32)
+        client_b = np.asarray(g["b"])[None] + rng.standard_normal(
+            (k, 2)).astype(np.float32)
+        deltas = {
+            "W": jnp.asarray((np.asarray(g["W"])[None] - client_W) / 0.001),
+            "b": jnp.asarray((np.asarray(g["b"])[None] - client_b) / 0.001)}
+        res = aggregate(g, deltas, n, costs, scores,
+                        jnp.ones(4, bool), jnp.ones(k, bool), 0.001, k)
+        w = np.asarray(n, np.float32)
+        expect = np.tensordot(w, client_W, axes=1) / w.sum()
+        np.testing.assert_allclose(res.params["W"], expect, rtol=1e-4)
+
+    def test_election_top4(self):
+        g, deltas, n, costs, scores = self._setup()
+        res = aggregate(g, deltas, n, costs, scores,
+                        jnp.ones(4, bool), jnp.ones(10, bool), 0.001, 6)
+        med = np.median(np.asarray(scores), axis=0)
+        expect = np.argsort(-med, kind="stable")[:4]
+        electees, emask = elect_committee(res.order, jnp.ones(10, bool), 4)
+        np.testing.assert_array_equal(electees, expect)
+        assert np.all(np.asarray(emask))
+
+    def test_election_masks_invalid_slots(self):
+        """Fewer valid updates than comm_count -> invalid electees flagged so
+        a dead slot can never gain the committee role."""
+        g, deltas, n, costs, scores = self._setup()
+        valid = jnp.array([True, True, True] + [False] * 7)
+        res = aggregate(g, deltas, n, costs, scores,
+                        jnp.ones(4, bool), valid, 0.001, 6)
+        electees, emask = elect_committee(res.order, valid, 4)
+        assert np.asarray(emask).sum() == 3
+        assert np.all(np.asarray(valid)[np.asarray(electees)[np.asarray(emask)]])
+
+    def test_invalid_updates_excluded(self):
+        g, deltas, n, costs, scores = self._setup()
+        valid = jnp.array([True] * 5 + [False] * 5)
+        res = aggregate(g, deltas, n, costs, scores,
+                        jnp.ones(4, bool), valid, 0.001, 6)
+        assert not np.any(np.asarray(res.selected)[5:])
+        # only the 5 valid ones can be selected
+        assert np.asarray(res.selected).sum() == 5
+
+
+class TestJitStability:
+    def test_aggregate_jit_cache(self):
+        """Same static shapes -> no retrace (static-shape requirement)."""
+        g, deltas, n, costs, scores = TestAggregate()._setup()
+        r1 = aggregate(g, deltas, n, costs, scores, jnp.ones(4, bool),
+                       jnp.ones(10, bool), 0.001, 6)
+        r2 = aggregate(g, deltas, n, costs, scores, jnp.ones(4, bool),
+                       jnp.ones(10, bool), 0.001, 6)
+        np.testing.assert_allclose(r1.params["W"], r2.params["W"])

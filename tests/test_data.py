@@ -1,0 +1,59 @@
+"""Data pipeline tests (reference semantics: main.py:33-53)."""
+
+import numpy as np
+
+from bflc_demo_tpu.data import (load_occupancy, synthesize_occupancy,
+                                iid_shards, dirichlet_shards, one_hot)
+
+
+def test_occupancy_shapes_and_split():
+    xtr, ytr, xte, yte = load_occupancy()
+    n = len(xtr) + len(xte)
+    assert xtr.shape[1] == 5
+    assert set(np.unique(ytr)) <= {0, 1}
+    # 75/25 split like train_test_split(test_size=.25) (main.py:41-42)
+    assert abs(len(xte) / n - 0.25) < 0.01
+
+
+def test_synthetic_matches_schema():
+    x, y = synthesize_occupancy(n=1000, seed=3)
+    assert x.shape == (1000, 5) and y.shape == (1000,)
+    assert 0.1 < y.mean() < 0.35  # imbalance like 1729/8143
+
+
+def test_iid_shards_cover_all():
+    x, y = synthesize_occupancy(n=1001, seed=0)
+    shards = iid_shards(x, y, 20)
+    assert len(shards) == 20
+    assert sum(len(sx) for sx, _ in shards) == 1001
+    # np.array_split near-equality (main.py:47-48)
+    sizes = [len(sx) for sx, _ in shards]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_dirichlet_skew_and_coverage():
+    x, y = synthesize_occupancy(n=4000, seed=1)
+    shards = dirichlet_shards(x, y, 10, alpha=0.3, seed=1)
+    assert sum(len(sx) for sx, _ in shards) == 4000
+    assert all(len(sx) >= 2 for sx, _ in shards)
+    # skew: per-client positive rates should vary much more than iid
+    rates = np.array([sy.mean() for _, sy in shards])
+    assert rates.std() > 0.05
+
+
+def test_explicit_missing_path_raises():
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        load_occupancy(path="/nonexistent/datatraining.txt")
+
+
+def test_dirichlet_impossible_split_raises():
+    import pytest
+    x, y = synthesize_occupancy(n=30, seed=2)
+    with pytest.raises(ValueError):
+        dirichlet_shards(x, y, num_clients=25, alpha=0.05, seed=0, min_size=5)
+
+
+def test_one_hot():
+    oh = one_hot(np.array([0, 1, 1]), 2)
+    np.testing.assert_array_equal(oh, [[1, 0], [0, 1], [0, 1]])

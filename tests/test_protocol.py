@@ -1,0 +1,34 @@
+"""Protocol genome tests — the constants the reference duplicates unchecked."""
+
+import pytest
+
+from bflc_demo_tpu.protocol import DEFAULT_PROTOCOL, ProtocolConfig
+
+
+def test_reference_parity_constants():
+    # SURVEY.md §2d — CommitteePrecompiled.h:7-19 and main.py:52-88
+    p = DEFAULT_PROTOCOL
+    assert p.client_num == 20
+    assert p.comm_count == 4
+    assert p.aggregate_count == 6
+    assert p.needed_update_count == 10
+    assert p.learning_rate == 0.001
+    assert p.batch_size == 100
+    assert p.max_epoch == 1000
+    assert p.genesis_epoch == -999
+    assert p.initial_trained_epoch == -1
+    assert p.trainer_count == 16
+
+
+@pytest.mark.parametrize("kw", [
+    dict(comm_count=0),
+    dict(comm_count=20),
+    dict(aggregate_count=11),
+    dict(aggregate_count=0),
+    dict(needed_update_count=17),  # > client_num - comm_count
+    dict(learning_rate=0.0),
+    dict(batch_size=0),
+])
+def test_invalid_configs_rejected(kw):
+    with pytest.raises(ValueError):
+        ProtocolConfig(**kw).validate()
