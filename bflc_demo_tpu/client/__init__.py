@@ -1,0 +1,11 @@
+"""Client runtime: the per-node state machine and the in-process simulation.
+
+Reference equivalent: run_one_node / main_loop (python-sdk/main.py:84-276) —
+one OS process per client, polling the chain every 10-30 s.  Here the state
+machine is event-driven (the ledger's state transitions drive the schedule —
+no polling, SURVEY.md §7 step 4), and N logical clients multiplex over the
+available chips instead of owning a process each.
+"""
+
+from bflc_demo_tpu.client.runtime import FLNode, ComputePlane, Sponsor  # noqa: F401
+from bflc_demo_tpu.client.simulation import run_federated, SimulationResult  # noqa: F401

@@ -1,0 +1,153 @@
+"""The per-client state machine, the compute plane, and the sponsor.
+
+Maps 1:1 onto the reference's actors:
+- FLNode.step        <- main_loop's role switch (main.py:236-271): trainer ->
+                        local_training (main.py:103-169), comm -> local_scoring
+                        (main.py:196-228); one upload per client per round
+                        (trained_epoch gate, main.py:162-163, 221-222).
+- ComputePlane       <- the on-chain Aggregate (.cpp:349-456), split: the
+                        ledger decides (medians/rank/election), the compute
+                        plane applies the selected weighted mean on TPU and
+                        commits the new model's hash.
+- Sponsor            <- run_sponsor/global_testing (main.py:280-340): held-out
+                        test accuracy per epoch, the system's quality metric.
+
+Event-driven: step() is called when the ledger state may have advanced; there
+is no 10-30 s polling loop (SURVEY.md §6 shows polling dominates the
+reference's round time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bflc_demo_tpu.comm.store import UpdateStore
+from bflc_demo_tpu.core import (local_train, evaluate, score_candidates,
+                                apply_selection)
+from bflc_demo_tpu.ledger.base import LedgerStatus
+from bflc_demo_tpu.models.base import Model
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import hash_pytree
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class FLNode:
+    """One logical client: address, local shard, round bookkeeping."""
+
+    address: str
+    x: jax.Array                 # local shard features
+    y: jax.Array                 # local shard labels, one-hot
+    model: Model
+    cfg: ProtocolConfig
+    trained_epoch: int = -1      # main.py:89
+    scored_epoch: int = -1
+
+    def register(self, ledger) -> LedgerStatus:
+        return ledger.register_node(self.address)
+
+    def step(self, ledger, store: UpdateStore,
+             global_params: Pytree) -> Optional[str]:
+        """One event-driven turn; returns the action taken or None.
+
+        The reference's main_loop gates: stop past max_epoch (main.py:251-252),
+        skip if already served this epoch (main.py:253-257), else act by role
+        (main.py:258-263).
+        """
+        role, epoch = ledger.query_state(self.address)
+        if epoch == self.cfg.genesis_epoch or epoch > self.cfg.max_epoch:
+            return None
+        if role == "trainer":
+            if epoch <= self.trained_epoch:
+                return None
+            return self._train(ledger, store, global_params, epoch)
+        # committee: score once the round's updates are all collected
+        if epoch <= self.scored_epoch:
+            return None
+        return self._score(ledger, store, global_params, epoch)
+
+    def _train(self, ledger, store, global_params, epoch) -> Optional[str]:
+        delta, avg_cost = local_train(
+            self.model.apply, global_params, self.x, self.y,
+            lr=self.cfg.learning_rate, batch_size=self.cfg.batch_size,
+            local_epochs=self.cfg.local_epochs)
+        payload_hash = store.put(delta)
+        st = ledger.upload_local_update(
+            self.address, payload_hash, int(self.x.shape[0]),
+            float(avg_cost), epoch)
+        if st == LedgerStatus.OK:
+            self.trained_epoch = epoch      # main.py:162-163
+            return "train:OK"
+        store.drop(payload_hash)
+        if st in (LedgerStatus.CAP_REACHED, LedgerStatus.DUPLICATE):
+            # round didn't need us — the reference's first-come-10 semantics
+            # (.cpp:239-244); done for this epoch anyway
+            self.trained_epoch = epoch
+            return f"train:{st.name}"
+        # e.g. WRONG_EPOCH: the ledger advanced mid-step; leave trained_epoch
+        # so the next event retrains against the fresh global model
+        return None
+
+    def _score(self, ledger, store, global_params, epoch) -> Optional[str]:
+        updates = ledger.query_all_updates()
+        if not updates:     # round not full yet (QueryAllUpdates gate)
+            return None
+        deltas = [store.get(u.payload_hash) for u in updates]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *deltas)
+        scores = score_candidates(self.model.apply, global_params, stacked,
+                                  self.cfg.learning_rate, self.x, self.y)
+        st = ledger.upload_scores(self.address, epoch,
+                                  [float(s) for s in np.asarray(scores)])
+        self.scored_epoch = epoch
+        return f"score:{st.name}" if st == LedgerStatus.OK else None
+
+
+class ComputePlane:
+    """Applies ledger-decided aggregations on device and commits the hash."""
+
+    def __init__(self, cfg: ProtocolConfig):
+        self.cfg = cfg
+
+    def maybe_aggregate(self, ledger, store: UpdateStore,
+                        global_params: Pytree) -> Optional[Pytree]:
+        if not ledger.aggregate_ready():
+            return None
+        pending = ledger.pending()
+        updates = ledger.query_all_updates()
+        epoch = ledger.epoch
+        deltas = [store.get(u.payload_hash) for u in updates]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *deltas)
+        n_samples = jnp.asarray([u.n_samples for u in updates], jnp.int32)
+        sel = np.zeros(len(updates), bool)
+        sel[np.asarray(pending.selected)] = True
+        new_params = apply_selection(global_params, stacked, n_samples,
+                                     jnp.asarray(sel),
+                                     self.cfg.learning_rate)
+        st = ledger.commit_model(hash_pytree(new_params), epoch)
+        if st != LedgerStatus.OK:
+            raise RuntimeError(f"model commit rejected: {st.name}")
+        for u in updates:   # round payloads are dead after aggregation
+            store.drop(u.payload_hash)
+        return new_params
+
+
+class Sponsor:
+    """Held-out global eval — the reference's progress meter
+    (run_sponsor, main.py:280-340)."""
+
+    def __init__(self, model: Model, x_test: jax.Array, y_test: jax.Array):
+        self.model = model
+        self.x = x_test
+        self.y = y_test
+        self.history: List[tuple] = []       # (epoch, accuracy)
+
+    def observe(self, epoch: int, global_params: Pytree) -> float:
+        acc = float(evaluate(self.model.apply, global_params, self.x, self.y))
+        self.history.append((epoch, acc))
+        return acc

@@ -1,0 +1,127 @@
+"""In-process federated simulation — the reference demo minus the chain.
+
+The minimum end-to-end slice of SURVEY.md §7: coordinator in-process, N
+logical clients time-multiplexed on one host, full committee protocol, sponsor
+eval.  Deterministic by construction (fixed client visit order per round;
+the ledger serializes everything), unlike the reference's 21 OS processes with
+randomized 10-30 s polls (main.py:231-233, 343-358).
+
+Client visit order is shuffled per round with a seeded rng — the reference's
+process scheduling also makes upload order arbitrary; seeding makes runs
+reproducible while still exercising the first-come-10 cap path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bflc_demo_tpu.client.runtime import FLNode, ComputePlane, Sponsor
+from bflc_demo_tpu.comm.store import UpdateStore
+from bflc_demo_tpu.data.partition import one_hot
+from bflc_demo_tpu.ledger import make_ledger
+from bflc_demo_tpu.models.base import Model
+from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class SimulationResult:
+    accuracy_history: List[Tuple[int, float]]   # sponsor (epoch, test_acc)
+    loss_history: List[Tuple[int, float]]       # ledger (epoch, global_loss)
+    final_params: Pytree
+    rounds_completed: int
+    wall_time_s: float
+    round_times_s: List[float]
+    ledger_log_head: bytes
+    ledger_log_size: int
+
+    @property
+    def final_accuracy(self) -> float:
+        return self.accuracy_history[-1][1] if self.accuracy_history else 0.0
+
+    def best_accuracy(self) -> float:
+        return max((a for _, a in self.accuracy_history), default=0.0)
+
+
+def run_federated(model: Model,
+                  shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+                  test_set: Tuple[np.ndarray, np.ndarray],
+                  cfg: ProtocolConfig = DEFAULT_PROTOCOL,
+                  rounds: int = 10,
+                  ledger_backend: str = "auto",
+                  seed: int = 0,
+                  init_seed: int = 0,
+                  verbose: bool = False) -> SimulationResult:
+    """Run the full committee-consensus protocol for `rounds` aggregations.
+
+    shards: per-client (x, y) with integer class labels; test_set likewise.
+    """
+    cfg.validate()
+    if len(shards) != cfg.client_num:
+        raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+
+    nc = model.num_classes
+    nodes = [
+        FLNode(address=f"0x{i:040x}",
+               x=jnp.asarray(sx), y=jnp.asarray(one_hot(sy, nc)),
+               model=model, cfg=cfg,
+               trained_epoch=cfg.initial_trained_epoch)
+        for i, (sx, sy) in enumerate(shards)
+    ]
+    xte, yte = test_set
+    sponsor = Sponsor(model, jnp.asarray(xte), jnp.asarray(one_hot(yte, nc)))
+    ledger = make_ledger(cfg, backend=ledger_backend)
+    store = UpdateStore()
+    plane = ComputePlane(cfg)
+    rng = np.random.default_rng(seed)
+
+    global_params = model.init_params(init_seed)
+    for node in nodes:
+        node.register(ledger)
+    if ledger.epoch != 0:
+        raise RuntimeError("registration did not start FL "
+                           f"(epoch={ledger.epoch})")
+
+    loss_history: List[Tuple[int, float]] = []
+    round_times: List[float] = []
+    t0 = time.perf_counter()
+    completed = 0
+    while completed < rounds and ledger.epoch <= cfg.max_epoch:
+        rt0 = time.perf_counter()
+        epoch = ledger.epoch
+        # trainers act in a seeded arbitrary order (first-come-10 cap)
+        order = rng.permutation(len(nodes))
+        for i in order:
+            nodes[i].step(ledger, store, global_params)
+        # committee scores (they see the full round now)
+        for i in order:
+            nodes[i].step(ledger, store, global_params)
+        new_params = plane.maybe_aggregate(ledger, store, global_params)
+        if new_params is None:
+            raise RuntimeError(
+                f"round {epoch} stalled: updates={ledger.update_count} "
+                f"scores={ledger.score_count}")
+        global_params = new_params
+        loss_history.append((epoch, ledger.last_global_loss))
+        acc = sponsor.observe(epoch, global_params)
+        round_times.append(time.perf_counter() - rt0)
+        if verbose:
+            print(f"Epoch: {epoch:03d}, test_acc: {acc:.4f}, "
+                  f"global_loss: {ledger.last_global_loss:.5f}")
+        completed += 1
+
+    return SimulationResult(
+        accuracy_history=sponsor.history,
+        loss_history=loss_history,
+        final_params=global_params,
+        rounds_completed=completed,
+        wall_time_s=time.perf_counter() - t0,
+        round_times_s=round_times,
+        ledger_log_head=ledger.log_head(),
+        ledger_log_size=ledger.log_size())

@@ -1,0 +1,14 @@
+"""Transport split: control plane vs data plane (SURVEY.md §7 step 3).
+
+The reference's fabric is Channel-TLS RPC + PBFT carrying JSON-in-ABI strings
+(SURVEY.md §2c).  Here the planes are separated:
+
+- control plane: small typed messages to the ledger (register / state /
+  hashes / scores) — in-process today, socket/DCN later; every mutation is a
+  ledger op, so the transport only needs ordered delivery to the log writer.
+- data plane: tensor payloads keyed by content hash in an `UpdateStore`
+  (HBM/host memory), aggregated on device via the collectives in
+  `bflc_demo_tpu.parallel` — tensors never transit the control plane.
+"""
+
+from bflc_demo_tpu.comm.store import UpdateStore  # noqa: F401

@@ -1,0 +1,45 @@
+"""Content-addressed tensor store — the off-ledger payload plane.
+
+Where the reference writes whole models as JSON strings into the replicated
+chain table (local_updates map, CommitteePrecompiled.cpp:246-253), this store
+keeps tensor pytrees in device/host memory keyed by their content hash; only
+the 32-byte keys go into the ledger.  `get` verifies integrity by rehashing on
+request (cheap at these sizes; gated for large payloads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from bflc_demo_tpu.utils.serialization import hash_pytree
+
+Pytree = Any
+
+
+class UpdateStore:
+    def __init__(self, verify_on_get: bool = True):
+        self._blobs: Dict[bytes, Pytree] = {}
+        self._verify = verify_on_get
+
+    def put(self, tree: Pytree) -> bytes:
+        h = hash_pytree(tree)
+        self._blobs[h] = tree
+        return h
+
+    def get(self, h: bytes) -> Pytree:
+        tree = self._blobs[h]
+        if self._verify and hash_pytree(tree) != h:
+            raise ValueError(f"payload integrity failure for {h.hex()[:16]}…")
+        return tree
+
+    def contains(self, h: bytes) -> bool:
+        return h in self._blobs
+
+    def drop(self, h: bytes) -> None:
+        self._blobs.pop(h, None)
+
+    def clear(self) -> None:
+        self._blobs.clear()
+
+    def __len__(self) -> int:
+        return len(self._blobs)

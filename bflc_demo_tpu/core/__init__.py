@@ -13,5 +13,6 @@ from bflc_demo_tpu.core.aggregate import (  # noqa: F401
     rank_desc_stable,
     topk_selection_mask,
     aggregate,
+    apply_selection,
     elect_committee,
 )

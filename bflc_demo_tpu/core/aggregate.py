@@ -103,17 +103,7 @@ def aggregate(global_params: Pytree, deltas: Pytree, n_samples: jax.Array,
     order = rank_desc_stable(med, valid)
     rank_of = jnp.argsort(order, stable=True)
     sel = (rank_of < k) & valid        # == topk_selection_mask, one sort only
-
-    w = n_samples.astype(jnp.float32) * sel.astype(jnp.float32)   # (K,)
-    wsum = jnp.maximum(jnp.sum(w), 1e-12)
-
-    def wmean(d):
-        wb = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
-        return jnp.sum(d * wb, axis=0) / wsum.astype(d.dtype)
-
-    mean_delta = jax.tree_util.tree_map(wmean, deltas)
-    new_params = jax.tree_util.tree_map(
-        lambda g, m: g - jnp.asarray(lr, g.dtype) * m, global_params, mean_delta)
+    new_params = apply_selection(global_params, deltas, n_samples, sel, lr)
 
     # .cpp:416-425: loss printed is sum of the merged updates' avg_cost / k.
     # On a full round n_sel == k (reference parity); on a straggler round the
@@ -121,6 +111,31 @@ def aggregate(global_params: Pytree, deltas: Pytree, n_samples: jax.Array,
     n_sel = jnp.maximum(jnp.sum(sel.astype(avg_costs.dtype)), 1.0)
     global_loss = jnp.sum(avg_costs * sel.astype(avg_costs.dtype)) / n_sel
     return AggregateResult(new_params, global_loss, med, sel, order)
+
+
+@jax.jit
+def apply_selection(global_params: Pytree, deltas: Pytree,
+                    n_samples: jax.Array, sel_mask: jax.Array,
+                    lr: jax.Array) -> Pytree:
+    """Apply a ledger-decided selection: global -= lr * wmean(selected deltas).
+
+    Split of responsibilities in the runtime: the *ledger* decides which slots
+    merge (deterministic, replicated — medians/order/selected in its op log),
+    the *compute plane* does the tensor math on device.  This is the
+    .cpp:369-414 arithmetic with the selection taken as input instead of
+    recomputed, so ledger and TPU can never disagree about membership.
+    """
+    w = n_samples.astype(jnp.float32) * sel_mask.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w), 1e-12)
+
+    def wmean(d):
+        wb = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
+        return jnp.sum(d * wb, axis=0) / wsum.astype(d.dtype)
+
+    mean_delta = jax.tree_util.tree_map(wmean, deltas)
+    return jax.tree_util.tree_map(
+        lambda g, m: g - jnp.asarray(lr, g.dtype) * m, global_params,
+        mean_delta)
 
 
 def elect_committee(order: jax.Array, valid: jax.Array, comm_count: int,

@@ -1,0 +1,3 @@
+"""Evaluation & benchmark harnesses (SURVEY.md §7 step 5)."""
+
+from bflc_demo_tpu.eval.benchmarks import bench_config1  # noqa: F401

@@ -1,0 +1,49 @@
+"""Benchmark harnesses on the BASELINE.md axes:
+FL round time (s), global test-acc, samples/sec/chip.
+
+Config 1 is the reference-equivalence run (SURVEY.md §6): softmax regression
+on occupancy data, 20 clients / committee 4 / top-6, target ≈0.92 test-acc by
+round ~10.  The reference's wall-clock per round is dominated by 10-30 s
+polling sleeps (main.py:231-233); ours is actual compute + coordination, so
+round time is the headline win.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from bflc_demo_tpu.client.simulation import run_federated
+from bflc_demo_tpu.data import load_occupancy, iid_shards
+from bflc_demo_tpu.models import make_softmax_regression
+from bflc_demo_tpu.protocol.constants import DEFAULT_PROTOCOL
+
+
+def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
+                  seed: int = 0, verbose: bool = False) -> Dict:
+    cfg = DEFAULT_PROTOCOL
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    model = make_softmax_regression()
+    res = run_federated(model, shards, (xte, yte), cfg, rounds=rounds,
+                        ledger_backend=ledger_backend, seed=seed,
+                        verbose=verbose)
+    # samples/sec/chip: per round, 10 trainers each process
+    # floor(shard/bs)*bs*local_epochs training samples on one chip
+    samples_per_round = 0
+    for sx, _ in shards[:cfg.needed_update_count]:
+        nb = len(sx) // cfg.batch_size
+        samples_per_round += nb * cfg.batch_size * cfg.local_epochs
+    mean_round = (sum(res.round_times_s) / len(res.round_times_s)
+                  if res.round_times_s else float("inf"))
+    return {
+        "rounds": res.rounds_completed,
+        "final_acc": res.final_accuracy,
+        "best_acc": res.best_accuracy(),
+        "mean_round_time_s": mean_round,
+        "min_round_time_s": min(res.round_times_s, default=float("inf")),
+        "wall_time_s": res.wall_time_s,
+        "train_samples_per_sec_per_chip": samples_per_round / mean_round,
+        "accuracy_history": res.accuracy_history,
+        "loss_history": res.loss_history,
+        "ledger_log_size": res.ledger_log_size,
+    }

@@ -1,0 +1,30 @@
+"""The native coordinator — C1's TPU-native replacement (SURVEY.md §7 step 2).
+
+`make_ledger()` returns the C++ ledger when libbflc_ledger.so is present
+(building it on first use), else the pure-Python mirror.  Both expose the same
+surface and produce byte-identical op logs; replicas replay op streams with
+`apply_op` and agree via `log_head()`.
+"""
+
+from __future__ import annotations
+
+from bflc_demo_tpu.ledger.base import (  # noqa: F401
+    LedgerStatus, UpdateInfo, PendingInfo, ADDR_CAP)
+from bflc_demo_tpu.ledger.pyledger import PyLedger  # noqa: F401
+from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
+
+
+def make_ledger(cfg: ProtocolConfig = DEFAULT_PROTOCOL, *,
+                backend: str = "auto"):
+    """Create a committee ledger. backend: 'auto' | 'native' | 'python'."""
+    cfg.validate()
+    args = (cfg.client_num, cfg.comm_count, cfg.aggregate_count,
+            cfg.needed_update_count, cfg.genesis_epoch)
+    if backend in ("auto", "native"):
+        from bflc_demo_tpu.ledger import bindings
+        if bindings.native_available():
+            return bindings.NativeLedger(*args)
+        if backend == "native":
+            raise RuntimeError("native ledger requested but "
+                               "libbflc_ledger.so could not be built/loaded")
+    return PyLedger(*args)

@@ -1,0 +1,46 @@
+"""Shared ledger types: status codes, record views, constants.
+
+Status codes mirror the guard set of the reference contract
+(CommitteePrecompiled.cpp:215-297) — where the contract silently drops a bad
+transaction after a clog line, this ledger returns a typed status.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List
+
+import numpy as np
+
+ADDR_CAP = 128   # max address string length crossing the C ABI (incl. NUL)
+
+
+class LedgerStatus(enum.IntEnum):
+    OK = 0
+    NOT_STARTED = 1        # registration phase (epoch at genesis sentinel)
+    WRONG_EPOCH = 2        # stale upload (.cpp:225-226, 266-269)
+    DUPLICATE = 3          # same sender re-upload (.cpp:232-233)
+    CAP_REACHED = 4        # needed_update_count hit (.cpp:239-244)
+    NOT_COMMITTEE = 5      # scores from non-committee (.cpp:272-275)
+    ALREADY_REGISTERED = 6
+    NOT_READY = 7
+    BAD_ARG = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateInfo:
+    """Ledger view of one collected update — hash + meta, no tensors."""
+    sender: str
+    payload_hash: bytes
+    n_samples: int
+    avg_cost: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PendingInfo:
+    """Outcome of a completed scoring phase, awaiting model commit."""
+    medians: np.ndarray        # (update_count,)
+    order: List[int]           # slots best-first (median desc, slot asc)
+    selected: List[int]        # top-aggregate_count slots
+    global_loss: float

@@ -1,0 +1,273 @@
+"""ctypes bindings for the native committee ledger (libbflc_ledger.so).
+
+pybind11 is not available in this image; the C ABI (src/capi.cpp) is flat —
+ints, floats, char*, 32-byte digests — so ctypes is sufficient and zero-dep.
+`NativeLedger` exposes the same Python surface as `pyledger.PyLedger`; pick via
+`ledger.make_ledger(...)` which prefers native and falls back to Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.ledger.base import (LedgerStatus, UpdateInfo, PendingInfo,
+                                       ADDR_CAP)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libbflc_ledger.so")
+
+
+def _try_build() -> bool:
+    """Best-effort `make` so a fresh checkout self-builds (g++ is baked in)."""
+    try:
+        subprocess.run(["make", "-C", _DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None:
+        return _LIB
+    if _LOAD_FAILED:    # don't re-run make / re-raise on every construction
+        return None
+    if not os.path.exists(_SO) and not _try_build():
+        _LOAD_FAILED = True
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        # stale or wrong-arch .so — fall back to the Python mirror
+        _LOAD_FAILED = True
+        return None
+    i64, i32, f32 = ctypes.c_int64, ctypes.c_int32, ctypes.c_float
+    p = ctypes.c_void_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.bflc_ledger_new.restype = p
+    lib.bflc_ledger_new.argtypes = [i64] * 5
+    lib.bflc_ledger_free.argtypes = [p]
+    lib.bflc_register_node.restype = i32
+    lib.bflc_register_node.argtypes = [p, ctypes.c_char_p]
+    lib.bflc_query_state.argtypes = [p, ctypes.c_char_p,
+                                     ctypes.POINTER(i32), ctypes.POINTER(i64)]
+    lib.bflc_query_global_model.argtypes = [p, u8p, ctypes.POINTER(i64)]
+    lib.bflc_upload_local_update.restype = i32
+    lib.bflc_upload_local_update.argtypes = [p, ctypes.c_char_p, u8p, i64,
+                                             f32, i64]
+    lib.bflc_upload_scores.restype = i32
+    lib.bflc_upload_scores.argtypes = [p, ctypes.c_char_p, i64,
+                                       ctypes.POINTER(f32), i64]
+    lib.bflc_query_all_updates.restype = i64
+    lib.bflc_query_all_updates.argtypes = [p, ctypes.c_char_p, i64, u8p,
+                                           ctypes.POINTER(i64),
+                                           ctypes.POINTER(f32)]
+    lib.bflc_aggregate_ready.restype = i32
+    lib.bflc_aggregate_ready.argtypes = [p]
+    lib.bflc_pending.restype = i64
+    lib.bflc_pending.argtypes = [p, ctypes.POINTER(f32), ctypes.POINTER(i32),
+                                 ctypes.POINTER(i32), ctypes.POINTER(f32)]
+    lib.bflc_pending_selected_count.restype = i64
+    lib.bflc_pending_selected_count.argtypes = [p]
+    lib.bflc_commit_model.restype = i32
+    lib.bflc_commit_model.argtypes = [p, u8p, i64]
+    for name in ("bflc_epoch", "bflc_num_registered", "bflc_update_count",
+                 "bflc_score_count", "bflc_log_size"):
+        getattr(lib, name).restype = i64
+        getattr(lib, name).argtypes = [p]
+    lib.bflc_last_global_loss.restype = f32
+    lib.bflc_last_global_loss.argtypes = [p]
+    lib.bflc_committee.restype = i64
+    lib.bflc_committee.argtypes = [p, ctypes.c_char_p, i64, i64]
+    lib.bflc_log_head.argtypes = [p, u8p]
+    lib.bflc_verify_log.restype = i32
+    lib.bflc_verify_log.argtypes = [p]
+    lib.bflc_log_op_size.restype = i64
+    lib.bflc_log_op_size.argtypes = [p, i64]
+    lib.bflc_log_op.restype = i32
+    lib.bflc_log_op.argtypes = [p, i64, u8p, i64]
+    lib.bflc_apply_op.restype = i32
+    lib.bflc_apply_op.argtypes = [p, u8p, i64]
+    lib.bflc_sha256.argtypes = [u8p, i64, u8p]
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _digest_buf(data: bytes = b"\0" * 32):
+    return (ctypes.c_uint8 * 32)(*data)
+
+
+def sha256_native(data: bytes) -> bytes:
+    lib = load_library()
+    assert lib is not None
+    out = (ctypes.c_uint8 * 32)()
+    buf = (ctypes.c_uint8 * max(len(data), 1))(*data)
+    lib.bflc_sha256(buf, len(data), out)
+    return bytes(out)
+
+
+class NativeLedger:
+    """Thin, GIL-serialized wrapper over the C++ CommitteeLedger."""
+
+    backend = "native"
+
+    def __init__(self, client_num: int, comm_count: int, aggregate_count: int,
+                 needed_update_count: int, genesis_epoch: int = -999):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("libbflc_ledger.so unavailable; "
+                               "use ledger.make_ledger() for fallback")
+        self._lib = lib
+        self._h = lib.bflc_ledger_new(client_num, comm_count, aggregate_count,
+                                      needed_update_count, genesis_epoch)
+        self._needed = needed_update_count
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.bflc_ledger_free(h)
+            self._h = None
+
+    # --- protocol surface ---
+    def register_node(self, addr: str) -> LedgerStatus:
+        return LedgerStatus(self._lib.bflc_register_node(
+            self._h, addr.encode()))
+
+    def query_state(self, addr: str) -> Tuple[str, int]:
+        role = ctypes.c_int32()
+        ep = ctypes.c_int64()
+        self._lib.bflc_query_state(self._h, addr.encode(),
+                                   ctypes.byref(role), ctypes.byref(ep))
+        return ("comm" if role.value == 1 else "trainer", ep.value)
+
+    def query_global_model(self) -> Tuple[bytes, int]:
+        out = (ctypes.c_uint8 * 32)()
+        ep = ctypes.c_int64()
+        self._lib.bflc_query_global_model(self._h, out, ctypes.byref(ep))
+        return bytes(out), ep.value
+
+    def upload_local_update(self, sender: str, payload_hash: bytes,
+                            n_samples: int, avg_cost: float,
+                            epoch: int) -> LedgerStatus:
+        return LedgerStatus(self._lib.bflc_upload_local_update(
+            self._h, sender.encode(), _digest_buf(payload_hash), n_samples,
+            avg_cost, epoch))
+
+    def upload_scores(self, sender: str, epoch: int,
+                      scores: Sequence[float]) -> LedgerStatus:
+        arr = (ctypes.c_float * len(scores))(*[float(s) for s in scores])
+        return LedgerStatus(self._lib.bflc_upload_scores(
+            self._h, sender.encode(), epoch, arr, len(scores)))
+
+    def query_all_updates(self) -> List[UpdateInfo]:
+        k = self._needed
+        addr_buf = ctypes.create_string_buffer(k * ADDR_CAP)
+        hashes = (ctypes.c_uint8 * (32 * k))()
+        ns = (ctypes.c_int64 * k)()
+        costs = (ctypes.c_float * k)()
+        n = self._lib.bflc_query_all_updates(
+            self._h, addr_buf, ADDR_CAP, hashes, ns, costs)
+        out = []
+        for i in range(n):
+            addr = addr_buf.raw[i * ADDR_CAP:(i + 1) * ADDR_CAP]
+            out.append(UpdateInfo(
+                sender=addr.split(b"\0", 1)[0].decode(),
+                payload_hash=bytes(hashes[32 * i:32 * (i + 1)]),
+                n_samples=ns[i], avg_cost=costs[i]))
+        return out
+
+    # --- aggregation handshake ---
+    def aggregate_ready(self) -> bool:
+        return bool(self._lib.bflc_aggregate_ready(self._h))
+
+    def pending(self) -> Optional[PendingInfo]:
+        k = self._needed
+        med = (ctypes.c_float * k)()
+        order = (ctypes.c_int32 * k)()
+        sel_n = self._lib.bflc_pending_selected_count(self._h)
+        if sel_n < 0:
+            return None
+        sel = (ctypes.c_int32 * max(int(sel_n), 1))()
+        loss = ctypes.c_float()
+        n = self._lib.bflc_pending(self._h, med, order, sel,
+                                   ctypes.byref(loss))
+        return PendingInfo(
+            medians=np.ctypeslib.as_array(med)[:n].copy(),
+            order=list(order[:n]),
+            selected=list(sel[:sel_n]),
+            global_loss=loss.value)
+
+    def commit_model(self, new_model_hash: bytes, epoch: int) -> LedgerStatus:
+        return LedgerStatus(self._lib.bflc_commit_model(
+            self._h, _digest_buf(new_model_hash), epoch))
+
+    # --- inspection ---
+    @property
+    def epoch(self) -> int:
+        return self._lib.bflc_epoch(self._h)
+
+    @property
+    def num_registered(self) -> int:
+        return self._lib.bflc_num_registered(self._h)
+
+    @property
+    def update_count(self) -> int:
+        return self._lib.bflc_update_count(self._h)
+
+    @property
+    def score_count(self) -> int:
+        return self._lib.bflc_score_count(self._h)
+
+    @property
+    def last_global_loss(self) -> float:
+        return self._lib.bflc_last_global_loss(self._h)
+
+    def committee(self) -> List[str]:
+        cap = 64
+        while True:
+            buf = ctypes.create_string_buffer(cap * ADDR_CAP)
+            n = self._lib.bflc_committee(self._h, buf, ADDR_CAP, cap)
+            if n <= cap:
+                return [buf.raw[i * ADDR_CAP:(i + 1) * ADDR_CAP]
+                        .split(b"\0", 1)[0].decode() for i in range(n)]
+            cap = int(n)
+
+    # --- op log ---
+    def log_size(self) -> int:
+        return self._lib.bflc_log_size(self._h)
+
+    def log_head(self) -> bytes:
+        out = (ctypes.c_uint8 * 32)()
+        self._lib.bflc_log_head(self._h, out)
+        return bytes(out)
+
+    def verify_log(self) -> bool:
+        return bool(self._lib.bflc_verify_log(self._h))
+
+    def log_op(self, i: int) -> bytes:
+        size = self._lib.bflc_log_op_size(self._h, i)
+        if size < 0:
+            raise IndexError(i)
+        buf = (ctypes.c_uint8 * int(size))()
+        rc = self._lib.bflc_log_op(self._h, i, buf, size)
+        if rc != 0:
+            raise RuntimeError(f"log_op failed: {rc}")
+        return bytes(buf)
+
+    def apply_op(self, op: bytes) -> LedgerStatus:
+        buf = (ctypes.c_uint8 * len(op))(*op)
+        return LedgerStatus(self._lib.bflc_apply_op(self._h, buf, len(op)))

@@ -1,0 +1,268 @@
+"""Pure-Python mirror of the native CommitteeLedger.
+
+Byte-for-byte compatible with the C++ implementation: same op serialization,
+same SHA-256 hash chain (hashlib vs the C++ from-scratch implementation — both
+FIPS 180-4, differential-tested), same status codes, same election/ranking
+order.  Serves as (a) fallback when the .so is absent, (b) the differential
+oracle in tests, (c) readable documentation of the protocol.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.ledger.base import LedgerStatus, UpdateInfo, PendingInfo
+
+_OP_REGISTER, _OP_UPLOAD, _OP_SCORES, _OP_COMMIT = 1, 2, 3, 4
+
+
+def _put_str(b: bytearray, s: str) -> None:
+    raw = s.encode()
+    b += struct.pack("<q", len(raw)) + raw
+
+
+class PyLedger:
+    backend = "python"
+
+    def __init__(self, client_num: int, comm_count: int, aggregate_count: int,
+                 needed_update_count: int, genesis_epoch: int = -999):
+        self.client_num = client_num
+        self.comm_count = comm_count
+        self.aggregate_count = aggregate_count
+        self.needed_update_count = needed_update_count
+        self.genesis_epoch = genesis_epoch
+
+        self._epoch = genesis_epoch
+        self._model_hash = b"\0" * 32
+        self._last_loss = 0.0
+        self._reg_order: List[str] = []
+        self._roles: Dict[str, str] = {}
+        self._updates: List[UpdateInfo] = []
+        self._update_slot: Dict[str, int] = {}
+        self._scores: Dict[str, List[float]] = {}
+        self._pending: Optional[PendingInfo] = None
+        self._ops: List[bytes] = []
+        self._log: List[bytes] = []
+
+    # --- log plumbing (must match ledger.cpp append_log exactly) ---
+    def _append_log(self, op: bytes) -> None:
+        h = hashlib.sha256()
+        if self._log:
+            h.update(self._log[-1])
+        h.update(op)
+        self._ops.append(op)
+        self._log.append(h.digest())
+
+    # --- protocol surface ---
+    def register_node(self, addr: str) -> LedgerStatus:
+        if not addr:
+            return LedgerStatus.BAD_ARG
+        if addr in self._roles:
+            return LedgerStatus.ALREADY_REGISTERED
+        self._roles[addr] = "trainer"
+        self._reg_order.append(addr)
+        op = bytearray([_OP_REGISTER])
+        _put_str(op, addr)
+        self._append_log(bytes(op))
+        if (len(self._reg_order) == self.client_num
+                and self._epoch == self.genesis_epoch):
+            for a in self._reg_order[: self.comm_count]:
+                self._roles[a] = "comm"
+            self._epoch = 0
+        return LedgerStatus.OK
+
+    def query_state(self, addr: str) -> Tuple[str, int]:
+        return self._roles.get(addr, "trainer"), self._epoch
+
+    def query_global_model(self) -> Tuple[bytes, int]:
+        return self._model_hash, self._epoch
+
+    def upload_local_update(self, sender: str, payload_hash: bytes,
+                            n_samples: int, avg_cost: float,
+                            epoch: int) -> LedgerStatus:
+        if not sender or n_samples <= 0:
+            return LedgerStatus.BAD_ARG
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if epoch != self._epoch:
+            return LedgerStatus.WRONG_EPOCH
+        if sender in self._update_slot:
+            return LedgerStatus.DUPLICATE
+        if len(self._updates) >= self.needed_update_count:
+            return LedgerStatus.CAP_REACHED
+        self._update_slot[sender] = len(self._updates)
+        self._updates.append(UpdateInfo(sender, bytes(payload_hash),
+                                        n_samples, float(avg_cost)))
+        op = bytearray([_OP_UPLOAD])
+        _put_str(op, sender)
+        op += bytes(payload_hash)
+        op += struct.pack("<q", n_samples)
+        op += struct.pack("<f", np.float32(avg_cost))
+        op += struct.pack("<q", epoch)
+        self._append_log(bytes(op))
+        return LedgerStatus.OK
+
+    def upload_scores(self, sender: str, epoch: int,
+                      scores: Sequence[float]) -> LedgerStatus:
+        if not sender:
+            return LedgerStatus.BAD_ARG
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if epoch != self._epoch:
+            return LedgerStatus.WRONG_EPOCH
+        if self._roles.get(sender) != "comm":
+            return LedgerStatus.NOT_COMMITTEE
+        if len(scores) != len(self._updates):
+            return LedgerStatus.BAD_ARG
+        if len(self._updates) < self.needed_update_count:
+            return LedgerStatus.NOT_READY
+        # outcome frozen once scoring completed (matches ledger.cpp)
+        if self._pending is not None:
+            return LedgerStatus.NOT_READY
+        self._scores[sender] = [float(np.float32(s)) for s in scores]
+        op = bytearray([_OP_SCORES])
+        _put_str(op, sender)
+        op += struct.pack("<q", epoch)
+        op += struct.pack("<q", len(scores))
+        for s in scores:
+            op += struct.pack("<f", np.float32(s))
+        self._append_log(bytes(op))
+        if len(self._scores) == self.comm_count:
+            self._finish_scoring()
+        return LedgerStatus.OK
+
+    def _finish_scoring(self) -> None:
+        k = len(self._updates)
+        # scorer iteration in address order (C++ std::map key order == bytewise
+        # string order == Python sorted() on str for ASCII addresses)
+        rows = [self._scores[a] for a in sorted(self._scores)]
+        cols = np.asarray(rows, np.float32)          # (C, k)
+        srt = np.sort(cols, axis=0)
+        n = cols.shape[0]
+        medians = 0.5 * (srt[(n - 1) // 2] + srt[n // 2])
+        order = sorted(range(k), key=lambda s: (-medians[s], s))
+        take = min(self.aggregate_count, k)
+        selected = order[:take]
+        loss = (sum(self._updates[s].avg_cost for s in selected) / take
+                if take else 0.0)
+        self._pending = PendingInfo(medians=medians.astype(np.float32),
+                                    order=order, selected=selected,
+                                    global_loss=float(np.float32(loss)))
+
+    def query_all_updates(self) -> List[UpdateInfo]:
+        if len(self._updates) < self.needed_update_count:
+            return []
+        return list(self._updates)
+
+    # --- aggregation handshake ---
+    def aggregate_ready(self) -> bool:
+        return self._pending is not None
+
+    def pending(self) -> Optional[PendingInfo]:
+        return self._pending
+
+    def commit_model(self, new_model_hash: bytes, epoch: int) -> LedgerStatus:
+        if self._pending is None:
+            return LedgerStatus.NOT_READY
+        if epoch != self._epoch:
+            return LedgerStatus.WRONG_EPOCH
+        self._model_hash = bytes(new_model_hash)
+        self._last_loss = self._pending.global_loss
+        for a in self._roles:
+            self._roles[a] = "trainer"
+        for s in self._pending.order[: self.comm_count]:
+            self._roles[self._updates[s].sender] = "comm"
+        self._updates = []
+        self._update_slot = {}
+        self._scores = {}
+        self._pending = None
+        self._epoch += 1
+        op = bytearray([_OP_COMMIT])
+        op += bytes(new_model_hash)
+        op += struct.pack("<q", epoch)
+        self._append_log(bytes(op))
+        return LedgerStatus.OK
+
+    # --- inspection ---
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def num_registered(self) -> int:
+        return len(self._roles)
+
+    @property
+    def update_count(self) -> int:
+        return len(self._updates)
+
+    @property
+    def score_count(self) -> int:
+        return len(self._scores)
+
+    @property
+    def last_global_loss(self) -> float:
+        return self._last_loss
+
+    def committee(self) -> List[str]:
+        return [a for a in self._reg_order if self._roles.get(a) == "comm"]
+
+    # --- op log ---
+    def log_size(self) -> int:
+        return len(self._log)
+
+    def log_head(self) -> bytes:
+        return self._log[-1] if self._log else b"\0" * 32
+
+    def verify_log(self) -> bool:
+        prev = b""
+        for op, dig in zip(self._ops, self._log):
+            h = hashlib.sha256()
+            if prev:
+                h.update(prev)
+            h.update(op)
+            prev = h.digest()
+            if prev != dig:
+                return False
+        return True
+
+    def log_op(self, i: int) -> bytes:
+        return self._ops[i]
+
+    def apply_op(self, op: bytes) -> LedgerStatus:
+        """Deterministic replay of a serialized op (replica path)."""
+        if not op:
+            return LedgerStatus.BAD_ARG
+        code, body = op[0], op[1:]
+        try:
+            if code == _OP_REGISTER:
+                (n,) = struct.unpack_from("<q", body, 0)
+                return self.register_node(body[8:8 + n].decode())
+            if code == _OP_UPLOAD:
+                (n,) = struct.unpack_from("<q", body, 0)
+                off = 8 + n
+                sender = body[8:off].decode()
+                payload = body[off:off + 32]
+                ns, = struct.unpack_from("<q", body, off + 32)
+                cost, = struct.unpack_from("<f", body, off + 40)
+                ep, = struct.unpack_from("<q", body, off + 44)
+                return self.upload_local_update(sender, payload, ns, cost, ep)
+            if code == _OP_SCORES:
+                (n,) = struct.unpack_from("<q", body, 0)
+                off = 8 + n
+                sender = body[8:off].decode()
+                ep, = struct.unpack_from("<q", body, off)
+                cnt, = struct.unpack_from("<q", body, off + 8)
+                scores = list(struct.unpack_from(f"<{cnt}f", body, off + 16))
+                return self.upload_scores(sender, ep, scores)
+            if code == _OP_COMMIT:
+                payload = body[:32]
+                ep, = struct.unpack_from("<q", body, 32)
+                return self.commit_model(payload, ep)
+        except (struct.error, UnicodeDecodeError, IndexError):
+            return LedgerStatus.BAD_ARG
+        return LedgerStatus.BAD_ARG

@@ -1,0 +1,4 @@
+"""Cross-cutting utilities: serialization/hashing, tracing, config."""
+
+from bflc_demo_tpu.utils.serialization import (  # noqa: F401
+    canonical_bytes, hash_pytree, pack_pytree, unpack_pytree)

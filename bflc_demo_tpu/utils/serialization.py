@@ -1,0 +1,110 @@
+"""Canonical tensor serialization + content hashing.
+
+The reference moves models as double-nested JSON strings (serialize/deserialize
+main.py:23-30; LocalUpdate.to_json_string CommitteePrecompiled.h:101-106) and
+stores them on-chain.  Here tensors stay on device; what crosses the ledger
+boundary is a 32-byte content hash over a *canonical* encoding:
+
+    for each leaf in key-path order:
+        path string | dtype name | ndim | shape | raw little-endian bytes
+
+Canonicalisation makes the hash identity meaningful: two pytrees hash equal
+iff they have the same structure, dtypes, shapes and bytes.  The same encoding
+doubles as the wire/checkpoint format (`pack_pytree`/`unpack_pytree`) — a
+flat, self-describing binary layout (the flatbuffer/DLPack role in the
+BASELINE.json north star) with zero JSON anywhere.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+_MAGIC = b"BFLCT\x01"
+
+
+def _leaf_entries(tree: Pytree) -> List[Tuple[str, np.ndarray]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    entries = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        entries.append((key, np.asarray(leaf)))
+    # tree_flatten_with_path is deterministic for a fixed structure; sort by
+    # path anyway so dict insertion order can never leak into the hash
+    entries.sort(key=lambda kv: kv[0])
+    return entries
+
+
+def canonical_bytes(tree: Pytree) -> bytes:
+    out = [_MAGIC]
+    entries = _leaf_entries(tree)
+    out.append(struct.pack("<q", len(entries)))
+    for key, arr in entries:
+        kb = key.encode()
+        # '<f4' style codes carry endianness; extension dtypes (bfloat16,
+        # float8_*) stringify as opaque '<V2' so use their registered name,
+        # which np.dtype() resolves via ml_dtypes
+        ds = arr.dtype.str
+        db = (arr.dtype.name if ds.endswith(f"V{arr.dtype.itemsize}")
+              else ds).encode()
+        out.append(struct.pack("<q", len(kb)))
+        out.append(kb)
+        out.append(struct.pack("<q", len(db)))
+        out.append(db)
+        out.append(struct.pack("<q", arr.ndim))
+        out.append(struct.pack(f"<{arr.ndim}q", *arr.shape))
+        raw = np.ascontiguousarray(arr).tobytes()
+        out.append(struct.pack("<q", len(raw)))
+        out.append(raw)
+    return b"".join(out)
+
+
+def hash_pytree(tree: Pytree) -> bytes:
+    """32-byte content hash — the ledger's view of a tensor payload."""
+    return hashlib.sha256(canonical_bytes(tree)).digest()
+
+
+def pack_pytree(tree: Pytree) -> bytes:
+    """Self-describing binary encoding (also the checkpoint leaf format)."""
+    return canonical_bytes(tree)
+
+
+def unpack_pytree(data: bytes) -> Dict[str, np.ndarray]:
+    """Decode pack_pytree output to {path: array}.
+
+    Structure is returned flat (path-keyed); callers that need the original
+    pytree shape restore it with their own tree-def (models know theirs).
+    """
+    if not data.startswith(_MAGIC):
+        raise ValueError("not a bflc tensor blob (bad magic)")
+    off = len(_MAGIC)
+
+    def take(fmt):
+        nonlocal off
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, data, off)
+        off += size
+        return vals
+
+    (n_entries,) = take("<q")
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(n_entries):
+        (klen,) = take("<q")
+        key = data[off:off + klen].decode()
+        off += klen
+        (dlen,) = take("<q")
+        dtype = np.dtype(data[off:off + dlen].decode())
+        off += dlen
+        (ndim,) = take("<q")
+        shape = take(f"<{ndim}q") if ndim else ()
+        (rawlen,) = take("<q")
+        arr = np.frombuffer(data[off:off + rawlen], dtype=dtype).reshape(shape)
+        off += rawlen
+        out[key] = arr
+    return out

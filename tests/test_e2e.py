@@ -1,0 +1,59 @@
+"""End-to-end integration: the reference demo's acceptance run, deterministic.
+
+Reproduces SURVEY.md §6's reproduction target (test-acc ≈ 0.92 by round ~10 on
+config 1) as an automated test — the reference verified this by reading
+screenshots (SURVEY.md §4); we assert it.
+"""
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.client import run_federated
+from bflc_demo_tpu.data import load_occupancy, iid_shards
+from bflc_demo_tpu.ledger import bindings
+from bflc_demo_tpu.models import make_softmax_regression
+from bflc_demo_tpu.protocol import DEFAULT_PROTOCOL
+
+BACKENDS = ["python"] + (["native"] if bindings.native_available() else [])
+
+
+@pytest.fixture(scope="module")
+def occupancy():
+    xtr, ytr, xte, yte = load_occupancy()
+    return iid_shards(xtr, ytr, DEFAULT_PROTOCOL.client_num), (xte, yte)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_config1_reaches_reference_accuracy(occupancy, backend):
+    shards, test_set = occupancy
+    res = run_federated(make_softmax_regression(), shards, test_set,
+                        DEFAULT_PROTOCOL, rounds=10,
+                        ledger_backend=backend, seed=0)
+    assert res.rounds_completed == 10
+    # reference: 0.9214 at sponsor epoch 009 (imgs/runtime.jpg)
+    assert res.best_accuracy() >= 0.90, res.accuracy_history
+    # ledger log covers: 20 registers + 10*(10 uploads + 4 scores + 1 commit)
+    assert res.ledger_log_size == 20 + 10 * 15
+
+
+def test_deterministic_replay(occupancy):
+    """Same seed -> identical ledger log head (scores, ranking, election and
+    committed model hashes all bit-equal across runs)."""
+    shards, test_set = occupancy
+    r1 = run_federated(make_softmax_regression(), shards, test_set,
+                       DEFAULT_PROTOCOL, rounds=3, seed=5)
+    r2 = run_federated(make_softmax_regression(), shards, test_set,
+                       DEFAULT_PROTOCOL, rounds=3, seed=5)
+    assert r1.ledger_log_head == r2.ledger_log_head
+    np.testing.assert_array_equal(
+        np.asarray(r1.final_params["W"]), np.asarray(r2.final_params["W"]))
+
+
+def test_different_seed_different_path(occupancy):
+    shards, test_set = occupancy
+    r1 = run_federated(make_softmax_regression(), shards, test_set,
+                       DEFAULT_PROTOCOL, rounds=2, seed=1)
+    r2 = run_federated(make_softmax_regression(), shards, test_set,
+                       DEFAULT_PROTOCOL, rounds=2, seed=2)
+    # visit order differs -> different first-come-10 sets -> different logs
+    assert r1.ledger_log_head != r2.ledger_log_head

@@ -1,0 +1,252 @@
+"""Ledger tests: protocol guards (reference .cpp:215-297), deterministic
+election, hash-chained log, and native<->python differential equivalence."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.ledger import (make_ledger, LedgerStatus, PyLedger)
+from bflc_demo_tpu.ledger import bindings
+from bflc_demo_tpu.protocol import ProtocolConfig
+
+CFG = ProtocolConfig()  # reference genome
+
+BACKENDS = ["python"] + (["native"] if bindings.native_available() else [])
+
+
+def addr(i):
+    return f"0x{i:040x}"
+
+
+def fill_registration(led, n=None):
+    for i in range(n or CFG.client_num):
+        assert led.register_node(addr(i)) == LedgerStatus.OK
+
+
+def run_upload_phase(led, epoch=0, n=None):
+    """Uploads from the 16 trainers; first 10 accepted."""
+    statuses = []
+    for i in range(CFG.comm_count, CFG.client_num):
+        h = hashlib.sha256(f"payload{i}@{epoch}".encode()).digest()
+        statuses.append(led.upload_local_update(addr(i), h, 300 + i,
+                                                1.5 + i * 0.1, epoch))
+        if n and sum(s == LedgerStatus.OK for s in statuses) >= n:
+            break
+    return statuses
+
+
+@pytest.fixture(params=BACKENDS)
+def led(request):
+    return make_ledger(CFG, backend=request.param)
+
+
+class TestRegistration:
+    def test_genesis_state(self, led):
+        assert led.epoch == CFG.genesis_epoch
+        model, ep = led.query_global_model()
+        assert model == b"\0" * 32 and ep == CFG.genesis_epoch
+
+    def test_start_trigger_and_committee(self, led):
+        """CLIENT_NUM registrations -> epoch 0, first 4 registrants comm
+        (.cpp:175-186; ordered-registration determinism is our spec)."""
+        fill_registration(led, CFG.client_num - 1)
+        assert led.epoch == CFG.genesis_epoch
+        led.register_node(addr(CFG.client_num - 1))
+        assert led.epoch == 0
+        assert led.committee() == [addr(i) for i in range(4)]
+        assert led.query_state(addr(0))[0] == "comm"
+        assert led.query_state(addr(7))[0] == "trainer"
+
+    def test_unknown_address_reads_trainer(self, led):
+        """QueryState defaults to trainer, unpersisted (.cpp:191-205)."""
+        role, _ = led.query_state("0xdeadbeef")
+        assert role == "trainer"
+        assert led.num_registered == 0
+
+    def test_duplicate_registration(self, led):
+        led.register_node(addr(1))
+        assert led.register_node(addr(1)) == LedgerStatus.ALREADY_REGISTERED
+        assert led.num_registered == 1
+
+
+class TestUploadGuards:
+    def test_before_start(self, led):
+        st = led.upload_local_update(addr(5), b"\1" * 32, 100, 1.0, 0)
+        assert st == LedgerStatus.NOT_STARTED
+
+    def test_wrong_epoch(self, led):
+        fill_registration(led)
+        st = led.upload_local_update(addr(5), b"\1" * 32, 100, 1.0, 7)
+        assert st == LedgerStatus.WRONG_EPOCH  # .cpp:225-226
+
+    def test_duplicate_and_cap(self, led):
+        fill_registration(led)
+        run_upload_phase(led)
+        # dup (.cpp:232-233)
+        st = led.upload_local_update(addr(4), b"\1" * 32, 100, 1.0, 0)
+        assert st == LedgerStatus.DUPLICATE
+        # cap: 10 accepted, the rest rejected (.cpp:239-244)
+        assert led.update_count == CFG.needed_update_count
+        st = led.upload_local_update(addr(19), b"\1" * 32, 100, 1.0, 0)
+        assert st == LedgerStatus.CAP_REACHED
+
+    def test_query_all_updates_gate(self, led):
+        """Empty until update_count >= NEEDED_UPDATE_COUNT (.cpp:304-311)."""
+        fill_registration(led)
+        run_upload_phase(led, n=9)
+        if led.update_count < 10:
+            assert led.query_all_updates() == []
+
+
+class TestScoringAndRound:
+    def _full_round(self, led, epoch=0):
+        run_upload_phase(led, epoch=epoch)
+        ups = led.query_all_updates()
+        assert len(ups) == 10
+        rng = np.random.default_rng(42 + epoch)
+        for c in led.committee():
+            scores = rng.random(10).astype(np.float32)
+            assert led.upload_scores(c, epoch, list(scores)) == LedgerStatus.OK
+        return ups
+
+    def test_score_guards(self, led):
+        fill_registration(led)
+        run_upload_phase(led)
+        # non-committee scorer (.cpp:272-275)
+        st = led.upload_scores(addr(10), 0, [0.5] * 10)
+        assert st == LedgerStatus.NOT_COMMITTEE
+        # wrong epoch (.cpp:266-269)
+        st = led.upload_scores(addr(0), 3, [0.5] * 10)
+        assert st == LedgerStatus.WRONG_EPOCH
+        # wrong length
+        st = led.upload_scores(addr(0), 0, [0.5] * 7)
+        assert st == LedgerStatus.BAD_ARG
+
+    def test_rescore_does_not_double_count(self, led):
+        """Spec'd divergence from the unconditional ++ at .cpp:285-289."""
+        fill_registration(led)
+        run_upload_phase(led)
+        led.upload_scores(addr(0), 0, [0.5] * 10)
+        led.upload_scores(addr(0), 0, [0.6] * 10)
+        assert led.score_count == 1
+        assert not led.aggregate_ready()
+
+    def test_pending_frozen_against_rescore(self, led):
+        """A late re-score after the committee completes must not mutate the
+        selection the compute plane is applying (reviewed race)."""
+        fill_registration(led)
+        self._full_round(led)
+        assert led.aggregate_ready()
+        before = led.pending()
+        scorer = led.committee()[0]
+        st = led.upload_scores(scorer, 0, [0.99] * 10)
+        assert st == LedgerStatus.NOT_READY
+        after = led.pending()
+        assert after.order == before.order
+        assert abs(after.global_loss - before.global_loss) < 1e-9
+
+    def test_aggregation_pipeline(self, led):
+        fill_registration(led)
+        ups = self._full_round(led)
+        assert led.aggregate_ready()
+        p = led.pending()
+        assert len(p.order) == 10 and len(p.selected) == 6
+        # loss = mean avg_cost of selected (.cpp:416-425)
+        expect = np.float32(np.mean([np.float32(ups[s].avg_cost)
+                                     for s in p.selected]))
+        assert abs(p.global_loss - expect) < 1e-5
+        # commit: epoch advances, committee re-elected from top-4 slots
+        new_comm_expect = {ups[s].sender for s in p.order[:4]}
+        assert led.commit_model(b"\2" * 32, 0) == LedgerStatus.OK
+        assert led.epoch == 1
+        assert set(led.committee()) == new_comm_expect
+        assert led.update_count == 0 and led.score_count == 0
+        model, ep = led.query_global_model()
+        assert model == b"\2" * 32 and ep == 1
+
+    def test_commit_guards(self, led):
+        fill_registration(led)
+        assert led.commit_model(b"\2" * 32, 0) == LedgerStatus.NOT_READY
+        self._full_round(led)
+        assert led.commit_model(b"\2" * 32, 5) == LedgerStatus.WRONG_EPOCH
+
+    def test_multi_round_epochs_monotonic(self, led):
+        fill_registration(led)
+        for ep in range(3):
+            self._full_round(led, epoch=ep)
+            assert led.commit_model(bytes([ep + 1] * 32), ep) == LedgerStatus.OK
+            assert led.epoch == ep + 1
+
+
+class TestLog:
+    def test_chain_verifies_and_rejects_tamper(self):
+        led = make_ledger(CFG, backend="python")
+        fill_registration(led)
+        run_upload_phase(led)
+        assert led.verify_log()
+        led._log[3] = b"\7" * 32   # tamper
+        assert not led.verify_log()
+
+    def test_rejected_ops_not_logged(self, led):
+        fill_registration(led)
+        size = led.log_size()
+        led.upload_local_update(addr(5), b"\1" * 32, 100, 1.0, 99)  # rejected
+        assert led.log_size() == size
+
+    def test_replay_reaches_same_head(self, led):
+        fill_registration(led)
+        run_upload_phase(led)
+        for c in led.committee():
+            led.upload_scores(c, 0, [0.5] * 10)
+        led.commit_model(b"\3" * 32, 0)
+        replica = make_ledger(CFG, backend="python")
+        for i in range(led.log_size()):
+            assert replica.apply_op(led.log_op(i)) == LedgerStatus.OK
+        assert replica.log_head() == led.log_head()
+        assert replica.epoch == led.epoch
+        assert replica.committee() == led.committee()
+
+
+@pytest.mark.skipif(not bindings.native_available(),
+                    reason="native ledger not built")
+class TestNativePythonEquivalence:
+    """The C++ ledger and the Python mirror must be indistinguishable."""
+
+    def test_sha256_matches_hashlib(self):
+        for payload in [b"", b"abc", b"x" * 1000, bytes(range(256)) * 5]:
+            assert (bindings.sha256_native(payload)
+                    == hashlib.sha256(payload).digest())
+
+    def test_full_session_identical(self):
+        nat = make_ledger(CFG, backend="native")
+        py = make_ledger(CFG, backend="python")
+        rng = np.random.default_rng(7)
+        for led in (nat, py):
+            fill_registration(led)
+        for ep in range(3):
+            scores_by_round = rng.random((4, 10)).astype(np.float32)
+            for led in (nat, py):
+                sts = run_upload_phase(led, epoch=ep)
+                comm = led.committee()
+                for ci, c in enumerate(comm):
+                    led.upload_scores(c, ep, list(scores_by_round[ci]))
+                led.commit_model(bytes([ep] * 32), ep)
+            assert nat.epoch == py.epoch
+            assert nat.committee() == py.committee()
+            assert abs(nat.last_global_loss - py.last_global_loss) < 1e-6
+            assert nat.log_head() == py.log_head(), f"log diverged at ep {ep}"
+        assert nat.verify_log() and py.verify_log()
+
+    def test_cross_replay(self):
+        """Ops recorded by the native ledger replay into a Python replica."""
+        nat = make_ledger(CFG, backend="native")
+        fill_registration(nat)
+        run_upload_phase(nat)
+        for c in nat.committee():
+            nat.upload_scores(c, 0, [0.25] * 10)
+        nat.commit_model(b"\x09" * 32, 0)
+        py = make_ledger(CFG, backend="python")
+        for i in range(nat.log_size()):
+            assert py.apply_op(nat.log_op(i)) == LedgerStatus.OK
+        assert py.log_head() == nat.log_head()

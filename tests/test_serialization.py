@@ -1,0 +1,66 @@
+"""Canonical serialization / hashing / store tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.comm import UpdateStore
+from bflc_demo_tpu.utils import (canonical_bytes, hash_pytree, pack_pytree,
+                                 unpack_pytree)
+
+
+def tree():
+    return {"W": jnp.arange(10, dtype=jnp.float32).reshape(5, 2),
+            "b": jnp.ones(2, jnp.float32)}
+
+
+def test_hash_deterministic_and_sensitive():
+    t = tree()
+    assert hash_pytree(t) == hash_pytree(tree())
+    t2 = {"W": t["W"].at[0, 0].set(99.0), "b": t["b"]}
+    assert hash_pytree(t2) != hash_pytree(t)
+    # dtype-sensitive
+    t3 = {"W": t["W"].astype(jnp.bfloat16), "b": t["b"]}
+    assert hash_pytree(t3) != hash_pytree(t)
+    # shape-sensitive beyond raw bytes
+    t4 = {"W": t["W"].reshape(2, 5), "b": t["b"]}
+    assert hash_pytree(t4) != hash_pytree(t)
+
+
+def test_hash_ignores_dict_insertion_order():
+    a = {"W": np.zeros((2, 2), np.float32), "b": np.ones(2, np.float32)}
+    b = dict(reversed(list(a.items())))
+    assert hash_pytree(a) == hash_pytree(b)
+
+
+def test_pack_unpack_roundtrip():
+    blob = pack_pytree(tree())
+    flat = unpack_pytree(blob)
+    assert set(flat) == {"['W']", "['b']"}
+    np.testing.assert_array_equal(flat["['W']"], np.asarray(tree()["W"]))
+    assert flat["['W']"].dtype == np.float32
+
+
+def test_unpack_rejects_garbage():
+    with pytest.raises(ValueError):
+        unpack_pytree(b"not a blob")
+
+
+def test_bfloat16_roundtrip():
+    t = {"W": jnp.full((4, 4), 1.5, jnp.bfloat16)}
+    flat = unpack_pytree(pack_pytree(t))
+    arr = flat["['W']"]
+    assert arr.dtype == np.asarray(t["W"]).dtype
+    np.testing.assert_array_equal(arr, np.asarray(t["W"]))
+
+
+def test_store_integrity():
+    s = UpdateStore()
+    h = s.put(tree())
+    assert s.contains(h)
+    got = s.get(h)
+    np.testing.assert_array_equal(np.asarray(got["W"]),
+                                  np.asarray(tree()["W"]))
+    s.drop(h)
+    assert not s.contains(h)
+    assert len(s) == 0
