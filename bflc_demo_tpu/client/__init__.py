@@ -9,3 +9,4 @@ available chips instead of owning a process each.
 
 from bflc_demo_tpu.client.runtime import FLNode, ComputePlane, Sponsor  # noqa: F401
 from bflc_demo_tpu.client.simulation import run_federated, SimulationResult  # noqa: F401
+from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh  # noqa: F401

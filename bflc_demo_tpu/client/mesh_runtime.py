@@ -1,0 +1,160 @@
+"""Mesh runtime: the protocol with a device-resident data plane.
+
+`client/simulation.py` is protocol-faithful but host-driven: every client
+training/scoring is its own dispatch and every payload is hashed on the host —
+fine on local CPU, ruinous over a TPU tunnel (SURVEY.md §3 "hot loops").
+This runtime is the TPU-first shape of the same protocol:
+
+- one XLA program per round (`parallel.make_sharded_protocol_round`): local
+  SGD for every client, ring committee scoring, replicated decision, masked
+  psum FedAvg, on-device payload fingerprints;
+- per round the host exchanges only: the committee's score rows (tiny), the
+  per-delta 32-byte fingerprints, and the commit hash — the ledger stays the
+  authoritative control plane exactly as in the host runtime;
+- the ledger's slot decision is cross-checked against the device decision
+  every round (a live differential check between the C++ coordinator and the
+  XLA decision procedure — replicas must agree, SURVEY.md §3.1 note).
+
+Uploader choice: the reference's "first come 10" (.cpp:239-244) is an
+asynchrony artifact; here a seeded permutation of the trainers picks the
+round's uploaders, then uploads run in ascending client order so ledger slot
+order equals the device's index-ascending tiebreak.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bflc_demo_tpu.client.runtime import Sponsor
+from bflc_demo_tpu.client.simulation import SimulationResult
+from bflc_demo_tpu.data.partition import one_hot
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.models.base import Model
+from bflc_demo_tpu.ops.fingerprint import fingerprint_to_bytes
+from bflc_demo_tpu.parallel.fedavg import make_sharded_protocol_round, AXIS
+from bflc_demo_tpu.parallel.mesh import client_axis_mesh
+from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
+
+
+def _addr(i: int) -> str:
+    return f"0x{i:040x}"
+
+
+def run_federated_mesh(model: Model,
+                       shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+                       test_set: Tuple[np.ndarray, np.ndarray],
+                       cfg: ProtocolConfig = DEFAULT_PROTOCOL,
+                       rounds: int = 10,
+                       mesh=None,
+                       ledger_backend: str = "auto",
+                       seed: int = 0,
+                       init_seed: int = 0,
+                       verbose: bool = False) -> SimulationResult:
+    cfg.validate()
+    n = cfg.client_num
+    if len(shards) != n:
+        raise ValueError(f"need {n} shards, got {len(shards)}")
+    if mesh is None:
+        # largest device count that divides the client population
+        nd = len(jax.devices())
+        while n % nd:
+            nd -= 1
+        mesh = client_axis_mesh(nd)
+
+    # uniform shard size for static shapes: truncate to the minimum
+    s_min = min(len(sx) for sx, _ in shards)
+    nc = model.num_classes
+    xs = np.stack([sx[:s_min] for sx, _ in shards]).astype(np.float32)
+    ys = np.stack([one_hot(sy[:s_min], nc) for _, sy in shards])
+    shard_sharding = NamedSharding(mesh, P(AXIS))
+    xs = jax.device_put(jnp.asarray(xs), shard_sharding)
+    ys = jax.device_put(jnp.asarray(ys), shard_sharding)
+    ns = jax.device_put(jnp.full((n,), s_min, jnp.int32), shard_sharding)
+
+    round_fn = make_sharded_protocol_round(
+        mesh, model.apply, client_num=n, lr=cfg.learning_rate,
+        batch_size=cfg.batch_size, local_epochs=cfg.local_epochs,
+        aggregate_count=cfg.aggregate_count)
+
+    xte, yte = test_set
+    sponsor = Sponsor(model, jnp.asarray(xte), jnp.asarray(one_hot(yte, nc)))
+    ledger = make_ledger(cfg, backend=ledger_backend)
+    rng = np.random.default_rng(seed)
+    params = model.init_params(init_seed)
+
+    for i in range(n):
+        ledger.register_node(_addr(i))
+    if ledger.epoch != 0:
+        raise RuntimeError(f"FL did not start (epoch={ledger.epoch})")
+
+    loss_history, round_times = [], []
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        rt0 = time.perf_counter()
+        epoch = ledger.epoch
+        committee_ids = sorted(
+            int(a, 16) for a in ledger.committee())
+        trainer_ids = [i for i in range(n) if i not in committee_ids]
+        pick = rng.permutation(len(trainer_ids))[: cfg.needed_update_count]
+        uploader_ids = sorted(trainer_ids[int(j)] for j in pick)
+
+        uploader_mask = np.zeros(n, bool)
+        uploader_mask[uploader_ids] = True
+        committee_mask = np.zeros(n, bool)
+        committee_mask[committee_ids] = True
+
+        res = round_fn(params, xs, ys, ns, jnp.asarray(uploader_mask),
+                       jnp.asarray(committee_mask))
+        params = res.params
+
+        # host side: tiny transfers only
+        delta_fps = np.asarray(res.delta_fps)          # (N, 8) uint32
+        score_rows = np.asarray(res.score_matrix)      # (N, N) float32
+        avg_costs = np.asarray(res.avg_costs)
+        sel_device = np.flatnonzero(np.asarray(res.selected))
+
+        for cid in uploader_ids:                       # ascending == slot order
+            st = ledger.upload_local_update(
+                _addr(cid), fingerprint_to_bytes(delta_fps[cid]),
+                s_min, float(avg_costs[cid]), epoch)
+            if st != LedgerStatus.OK:
+                raise RuntimeError(f"upload rejected: {st.name}")
+        for cid in committee_ids:
+            st = ledger.upload_scores(
+                _addr(cid), epoch,
+                [float(score_rows[cid, u]) for u in uploader_ids])
+            if st != LedgerStatus.OK:
+                raise RuntimeError(f"scores rejected: {st.name}")
+
+        pending = ledger.pending()
+        sel_ledger = np.sort([uploader_ids[s] for s in pending.selected])
+        if not np.array_equal(sel_ledger, sel_device):
+            raise RuntimeError(
+                "ledger/device decision divergence: "
+                f"ledger={sel_ledger} device={sel_device}")
+        st = ledger.commit_model(fingerprint_to_bytes(res.params_fp), epoch)
+        if st != LedgerStatus.OK:
+            raise RuntimeError(f"commit rejected: {st.name}")
+
+        loss_history.append((epoch, ledger.last_global_loss))
+        acc = sponsor.observe(epoch, params)
+        round_times.append(time.perf_counter() - rt0)
+        if verbose:
+            print(f"Epoch: {epoch:03d}, test_acc: {acc:.4f}, "
+                  f"global_loss: {ledger.last_global_loss:.5f}")
+
+    return SimulationResult(
+        accuracy_history=sponsor.history,
+        loss_history=loss_history,
+        final_params=params,
+        rounds_completed=rounds,
+        wall_time_s=time.perf_counter() - t0,
+        round_times_s=round_times,
+        ledger_log_head=ledger.log_head(),
+        ledger_log_size=ledger.log_size())

@@ -6,7 +6,8 @@ shapes so XLA tiles them onto the MXU.
 """
 
 from bflc_demo_tpu.core.losses import softmax_cross_entropy, accuracy  # noqa: F401
-from bflc_demo_tpu.core.local_train import local_train, evaluate  # noqa: F401
+from bflc_demo_tpu.core.local_train import (  # noqa: F401
+    local_train, local_train_impl, evaluate)
 from bflc_demo_tpu.core.scoring import score_candidates  # noqa: F401
 from bflc_demo_tpu.core.aggregate import (  # noqa: F401
     median_scores,

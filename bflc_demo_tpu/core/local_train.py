@@ -37,12 +37,12 @@ def _num_batches(n: int, batch_size: int) -> int:
     return nb
 
 
-@functools.partial(jax.jit, static_argnames=("apply_fn", "batch_size",
-                                             "local_epochs"))
-def local_train(apply_fn: ApplyFn, params: Pytree, x: jax.Array, y: jax.Array,
-                lr: float, batch_size: int, local_epochs: int = 1,
-                ) -> Tuple[Pytree, jax.Array]:
-    """Run local SGD; return (delta, avg_cost).
+def local_train_impl(apply_fn: ApplyFn, params: Pytree, x: jax.Array,
+                     y: jax.Array, lr: float, batch_size: int,
+                     local_epochs: int = 1) -> Tuple[Pytree, jax.Array]:
+    """Run local SGD; return (delta, avg_cost).  Unjitted implementation —
+    compose it under vmap/shard_map (nested jit inside shard_map drops
+    varying-axis metadata); call `local_train` for the jitted entry point.
 
     delta is (params_in - params_out) / lr — the wire format of the reference
     (main.py:153-155), chosen so the coordinator's
@@ -76,6 +76,11 @@ def local_train(apply_fn: ApplyFn, params: Pytree, x: jax.Array, y: jax.Array,
                                         length=local_epochs)
     delta = jax.tree_util.tree_map(lambda a, b: (a - b) / lr, params, trained)
     return delta, jnp.mean(epoch_costs)
+
+
+local_train = functools.partial(
+    jax.jit, static_argnames=("apply_fn", "batch_size", "local_epochs")
+)(local_train_impl)
 
 
 @functools.partial(jax.jit, static_argnames=("apply_fn",))

@@ -13,26 +13,44 @@ from __future__ import annotations
 from typing import Dict
 
 from bflc_demo_tpu.client.simulation import run_federated
+from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
 from bflc_demo_tpu.data import load_occupancy, iid_shards
 from bflc_demo_tpu.models import make_softmax_regression
 from bflc_demo_tpu.protocol.constants import DEFAULT_PROTOCOL
 
 
 def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
-                  seed: int = 0, verbose: bool = False) -> Dict:
+                  seed: int = 0, verbose: bool = False,
+                  runtime: str = "host") -> Dict:
+    """runtime: 'host' (per-client dispatches, reference-shaped) or 'mesh'
+    (one XLA program per round — the TPU-first data plane)."""
+    if runtime not in ("host", "mesh"):
+        raise ValueError(f"runtime must be 'host' or 'mesh', got {runtime!r}")
     cfg = DEFAULT_PROTOCOL
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(xtr, ytr, cfg.client_num)
     model = make_softmax_regression()
-    res = run_federated(model, shards, (xte, yte), cfg, rounds=rounds,
-                        ledger_backend=ledger_backend, seed=seed,
-                        verbose=verbose)
-    # samples/sec/chip: per round, 10 trainers each process
-    # floor(shard/bs)*bs*local_epochs training samples on one chip
-    samples_per_round = 0
-    for sx, _ in shards[:cfg.needed_update_count]:
-        nb = len(sx) // cfg.batch_size
-        samples_per_round += nb * cfg.batch_size * cfg.local_epochs
+    runner = run_federated if runtime == "host" else run_federated_mesh
+    res = runner(model, shards, (xte, yte), cfg, rounds=rounds,
+                 ledger_backend=ledger_backend, seed=seed,
+                 verbose=verbose)
+    # samples/sec/chip — count the work each runtime actually does:
+    # host: the K uploaders train their own (untruncated) shards, one chip;
+    # mesh: ALL clients train min-truncated shards, spread over n_chips
+    if runtime == "host":
+        n_chips = 1
+        samples_per_round = sum(
+            (len(sx) // cfg.batch_size) * cfg.batch_size * cfg.local_epochs
+            for sx, _ in shards[:cfg.needed_update_count])
+    else:
+        import jax
+        n_chips = len(jax.devices())
+        while cfg.client_num % n_chips:
+            n_chips -= 1        # mirror run_federated_mesh's mesh choice
+        s_min = min(len(sx) for sx, _ in shards)
+        samples_per_round = (cfg.client_num *
+                             (s_min // cfg.batch_size) * cfg.batch_size *
+                             cfg.local_epochs)
     mean_round = (sum(res.round_times_s) / len(res.round_times_s)
                   if res.round_times_s else float("inf"))
     return {
@@ -42,7 +60,8 @@ def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
         "mean_round_time_s": mean_round,
         "min_round_time_s": min(res.round_times_s, default=float("inf")),
         "wall_time_s": res.wall_time_s,
-        "train_samples_per_sec_per_chip": samples_per_round / mean_round,
+        "train_samples_per_sec_per_chip": (samples_per_round / n_chips
+                                           / mean_round),
         "accuracy_history": res.accuracy_history,
         "loss_history": res.loss_history,
         "ledger_log_size": res.ledger_log_size,

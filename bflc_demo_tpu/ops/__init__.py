@@ -1,0 +1,4 @@
+"""Device-side ops: fingerprints, (later) pallas kernels for hot paths."""
+
+from bflc_demo_tpu.ops.fingerprint import (  # noqa: F401
+    fingerprint_pytree, fingerprint_stacked, fingerprint_to_bytes)

@@ -1,0 +1,107 @@
+"""On-device tensor fingerprints — content hashes that never leave HBM.
+
+The reference identifies payloads implicitly (full JSON bodies on-chain); our
+ledger stores 32-byte content ids instead (SURVEY.md §7 "hashing of device
+buffers").  Pulling tensors to the host to SHA-256 them would reintroduce the
+host-boundary cost for every upload, so the mesh runtime fingerprints ON
+DEVICE: an FNV-1a-style 8-lane multiply-xor over the bitcast uint32 words of
+every leaf, salted with leaf index and word count.  Properties:
+
+- deterministic: same values/shapes/dtypes/leaf-order -> same 32 bytes, on
+  any backend and any mesh layout (pure integer arithmetic);
+- sensitive to value, dtype and shape changes (tested);
+- single streaming pass, memory-bandwidth bound, fuses under jit;
+- NOT cryptographic.  Integrity against accidental corruption comes from the
+  fingerprint; *tamper-evidence* comes from the ledger's SHA-256 op-log chain
+  over the recorded ids (ledger/src/sha256.cpp) — same split as the north
+  star's "blockchain records only update hashes".
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+_FNV_PRIME = np.uint32(16777619)
+_FNV_OFFSET = np.uint32(2166136261)
+_GOLDEN = np.uint32(0x9E3779B9)
+LANES = 8      # 8 x uint32 = 32 bytes, the ledger digest width
+
+
+def _to_words(leaf: jax.Array) -> jax.Array:
+    """Flatten any-dtype leaf to a 1-D uint32 word stream, losslessly.
+
+    Sub-32-bit types widen; 64-bit types bitcast to *pairs* of uint32 words
+    (bitcast_convert_type appends a trailing axis) so no bits are discarded.
+    """
+    x = jnp.asarray(leaf)
+    itemsize = jnp.dtype(x.dtype).itemsize
+    if x.dtype == jnp.uint32:
+        pass
+    elif itemsize == 2:       # bfloat16 / float16 / (u)int16
+        x = jax.lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    elif itemsize == 1:       # int8 / uint8 / bool / float8_*
+        x = jax.lax.bitcast_convert_type(
+            x.astype(jnp.uint8) if x.dtype == jnp.bool_ else x,
+            jnp.uint8).astype(jnp.uint32)
+    elif itemsize == 4:
+        x = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    elif itemsize == 8:       # float64 / int64 -> (..., 2) uint32 words
+        x = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    else:
+        raise TypeError(f"unsupported dtype for fingerprint: {x.dtype}")
+    return x.reshape(-1)
+
+
+def fingerprint_pytree(tree: Pytree) -> jax.Array:
+    """(8,) uint32 fingerprint of a pytree; jit/vmap/shard_map-composable."""
+    h = jnp.full((LANES,), _FNV_OFFSET, jnp.uint32)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        w = _to_words(leaf)
+        pad = (-w.size) % LANES
+        w = jnp.pad(w, (0, pad)).reshape(-1, LANES)
+        salt = (np.uint32(((i + 1) * int(_GOLDEN)) & 0xFFFFFFFF)
+                ^ np.uint32(w.shape[0]))          # leaf index + length salt
+        h = h ^ salt
+        # dtype salt (static): same bit pattern in different types must not
+        # collide (e.g. float32 1.0 vs the uint32 word 0x3f800000)
+        dtype_salt = np.uint32(int.from_bytes(
+            hashlib.sha256(
+                jnp.dtype(jnp.asarray(leaf).dtype).name.encode()
+            ).digest()[:4], "little"))
+        h = (h * _FNV_PRIME) ^ dtype_salt
+        # shape salt (static): distinguishes reshapes with identical bytes
+        shape = np.shape(leaf)
+        for d, s in enumerate(shape):
+            dim_salt = np.uint32(((s + 1) * int(_GOLDEN) + d) & 0xFFFFFFFF)
+            h = (h * _FNV_PRIME) ^ dim_salt
+
+        def step(acc, row):
+            return (acc * _FNV_PRIME) ^ row, None
+
+        h, _ = jax.lax.scan(step, h, w)
+    # final mixing so single-lane differences spread across the digest
+    mixed = h
+    for _ in range(2):
+        mixed = (mixed * _FNV_PRIME) ^ jnp.roll(mixed, 1)
+    return mixed
+
+
+def fingerprint_stacked(stacked: Pytree) -> jax.Array:
+    """(K, 8) fingerprints of a pytree with a stacked leading axis (one per
+    slice) — the per-candidate payload ids of a round, in one vmap."""
+    return jax.vmap(fingerprint_pytree)(stacked)
+
+
+def fingerprint_to_bytes(fp) -> bytes:
+    """uint32[8] -> canonical little-endian 32 bytes (the ledger digest)."""
+    arr = np.asarray(fp, dtype=np.uint32)
+    if arr.shape != (LANES,):
+        raise ValueError(f"expected ({LANES},) uint32, got {arr.shape}")
+    return arr.astype("<u4").tobytes()

@@ -1,0 +1,19 @@
+"""Distribution layer: meshes, shardings, and the FL collectives.
+
+The reference's "distributed backend" is Channel-TLS RPC + PBFT carrying JSON
+strings (SURVEY.md §2c).  The TPU-native data plane instead expresses the
+whole FL round as one SPMD program over a `jax.sharding.Mesh`:
+
+- clients are sharded over a mesh axis; local SGD runs vmapped per device;
+- committee scoring is a ring pipeline (`lax.ppermute` rotates candidate
+  delta blocks around the client axis while each device scores them on its
+  resident committee shards);
+- aggregation is a masked, sample-weighted `psum` — the FedAvg collective of
+  the BASELINE.json north star;
+- the ledger stays on the host control plane, recording hashes and scores.
+"""
+
+from bflc_demo_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, client_axis_mesh, local_device_count)
+from bflc_demo_tpu.parallel.fedavg import (  # noqa: F401
+    sharded_fedavg, ring_score_matrix, sharded_protocol_round)

@@ -1,0 +1,239 @@
+"""The FL round as one SPMD program: train → ring-score → rank → psum-FedAvg.
+
+This is the ICI data plane that replaces the reference's JSON-over-consensus
+round trip (UploadLocalUpdate / QueryAllUpdates / UploadScores,
+CommitteePrecompiled.cpp:215-311): tensors never leave the device mesh —
+
+- every device trains its resident clients (vmapped `core.local_train`);
+- committee scoring rotates candidate-delta blocks around the client axis
+  with `lax.ppermute` (a ring pipeline, so each device only ever holds one
+  block beyond its own — the same trick ring attention uses for KV blocks);
+- medians/ranking/selection are computed replicated from the all-gathered
+  (tiny) score matrix with the exact `core.aggregate` semantics;
+- the sample-weighted FedAvg of the selected deltas is a masked `psum`.
+
+The host ledger remains the control plane: it supplies the uploader/committee
+masks going in and records hashes + scores coming out, so the replicated
+decision procedure is identical whether a round ran on one chip or a pod.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from bflc_demo_tpu.core.aggregate import median_scores, rank_desc_stable
+from bflc_demo_tpu.core.local_train import local_train_impl
+from bflc_demo_tpu.core.losses import accuracy
+from bflc_demo_tpu.ops.fingerprint import (fingerprint_pytree,
+                                           fingerprint_stacked)
+
+Pytree = Any
+ApplyFn = Callable[[Pytree, jax.Array], jax.Array]
+
+AXIS = "clients"
+
+
+def _ensure_varying(tree: Pytree, axis: str = AXIS) -> Pytree:
+    """Mark leaves as device-varying if their type annotation says otherwise.
+
+    jax 0.9's scan keeps an unvarying carry annotation even when the body
+    mixes in varying data (observed on local_train_impl's parameter carry);
+    downstream ppermute/psum then fail the vma type check.  The annotation is
+    trace-time metadata, so normalising it here is purely a type-level fix.
+    """
+    def fix(leaf):
+        if axis not in jax.typeof(leaf).vma:
+            return jax.lax.pvary(leaf, (axis,))
+        return leaf
+    return jax.tree_util.tree_map(fix, tree)
+
+
+def _psum_fedavg_body(params: Pytree, deltas_local: Pytree,
+                      n_local: jax.Array, sel_local: jax.Array,
+                      lr) -> Pytree:
+    """Inside shard_map: masked sample-weighted FedAvg via psum over AXIS.
+
+    The single definition of the collective arithmetic — both the standalone
+    `sharded_fedavg` and the full-round program call this, so the two paths
+    cannot drift numerically.
+    """
+    w = n_local.astype(jnp.float32) * sel_local.astype(jnp.float32)
+    wsum = jnp.maximum(jax.lax.psum(jnp.sum(w), AXIS), 1e-12)
+
+    def wmean(leaf):
+        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+        return jax.lax.psum(jnp.sum(leaf * wb, axis=0), AXIS) / \
+            wsum.astype(leaf.dtype)
+
+    mean_delta = jax.tree_util.tree_map(wmean, deltas_local)
+    return jax.tree_util.tree_map(
+        lambda g, m: g - jnp.asarray(lr, g.dtype) * m, params, mean_delta)
+
+
+def sharded_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
+                   sel_mask: jax.Array, global_params: Pytree,
+                   lr: float) -> Pytree:
+    """Masked sample-weighted FedAvg as a psum collective.
+
+    deltas: pytree stacked (N, ...) and sharded over the client axis;
+    n_samples/sel_mask: (N,) sharded likewise; params replicated.
+    Semantically identical to `core.aggregate.apply_selection` (differential-
+    tested); physically a single all-reduce over ICI instead of host gathers.
+    """
+
+    def body(params, d, n, sel):
+        return _psum_fedavg_body(params, d, n, sel, lr)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P(AXIS), P(AXIS), P(AXIS)),
+                   out_specs=P())
+    return jax.jit(fn)(global_params, deltas, n_samples, sel_mask)
+
+
+def _score_block(apply_fn: ApplyFn, params: Pytree, block: Pytree, lr,
+                 xs: jax.Array, ys: jax.Array) -> jax.Array:
+    """(n_scorers, n_block) accuracies: candidate_k = params - lr*delta_k
+    evaluated on each local scorer shard (main.py:212-217 semantics)."""
+
+    def one_scorer(x, y):
+        def one_candidate(delta):
+            cand = jax.tree_util.tree_map(lambda g, d: g - lr * d,
+                                          params, delta)
+            return accuracy(apply_fn(cand, x), y)
+        return jax.vmap(one_candidate)(block)
+
+    return jax.vmap(one_scorer)(xs, ys)
+
+
+def ring_score_matrix(apply_fn: ApplyFn, params: Pytree, deltas_local: Pytree,
+                      lr, xs: jax.Array, ys: jax.Array,
+                      n_devices: int) -> jax.Array:
+    """Inside shard_map: full (n_local, N) score rows via a ppermute ring.
+
+    Each step evaluates the resident candidate block on the local scorer
+    shards, then passes the block to the next device; after n_devices steps
+    every (scorer, candidate) pair has met exactly once.  Peak memory per
+    device: own block + one transit block, independent of N.
+    """
+    n_local = xs.shape[0]
+    total = n_local * n_devices
+    my = jax.lax.axis_index(AXIS)
+
+    def step(s, carry):
+        rows, block = carry
+        src = (my - s) % n_devices          # owner of the resident block
+        part = _score_block(apply_fn, params, block, lr, xs, ys)
+        rows = jax.lax.dynamic_update_slice(rows, part, (0, src * n_local))
+        block = jax.lax.ppermute(
+            block, AXIS,
+            perm=[(j, (j + 1) % n_devices) for j in range(n_devices)])
+        return rows, block
+
+    # mark the fresh buffer as device-varying so the loop carry type matches
+    # what the body produces (jax>=0.8 shard_map varying-axis tracking)
+    rows0 = jax.lax.pvary(jnp.zeros((n_local, total), jnp.float32), (AXIS,))
+    rows, _ = jax.lax.fori_loop(0, n_devices, step, (rows0, deltas_local))
+    return rows
+
+
+class ShardedRoundResult(NamedTuple):
+    params: Pytree              # new global model (replicated)
+    score_matrix: jax.Array     # (N, N) scorer x candidate
+    medians: jax.Array          # (N,)
+    selected: jax.Array         # (N,) bool
+    order: jax.Array            # (N,) candidate slots best-first
+    avg_costs: jax.Array        # (N,) per-client mean local loss
+    global_loss: jax.Array      # mean avg_cost of selected (.cpp:416-425)
+    delta_fps: jax.Array        # (N, 8) uint32 on-device payload fingerprints
+    params_fp: jax.Array        # (8,) uint32 fingerprint of the new model
+
+
+def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
+                                client_num: int, lr: float, batch_size: int,
+                                local_epochs: int, aggregate_count: int,
+                                ) -> Callable[..., ShardedRoundResult]:
+    """Build the jitted full-round SPMD program for a fixed geometry.
+
+    Returned fn signature:
+        fn(params, xs, ys, n_samples, uploader_mask, committee_mask)
+    with xs: (N, S, *feat), ys: (N, S, C) sharded over the client axis;
+    masks/(N,) replicated.  Every client trains; `uploader_mask` picks which
+    slots constitute the round's K updates (the async first-come-10 of
+    .cpp:239-244 becomes a static mask), `committee_mask` picks scorer rows.
+    """
+    n_devices = mesh.shape[AXIS]
+    if client_num % n_devices:
+        raise ValueError(f"client_num {client_num} not divisible by mesh "
+                         f"axis {n_devices}")
+    k = aggregate_count
+
+    def body(params, xs, ys, n_samples, uploader_mask, committee_mask):
+        n_local = xs.shape[0]
+        my = jax.lax.axis_index(AXIS)
+
+        # 1. local training, vmapped over resident clients
+        def train_one(x, y):
+            return local_train_impl(apply_fn, params, x, y, lr=lr,
+                                    batch_size=batch_size,
+                                    local_epochs=local_epochs)
+        deltas_local, costs_local = jax.vmap(train_one)(xs, ys)
+        deltas_local = _ensure_varying(deltas_local)
+
+        # 2. ring committee scoring -> local rows, then gather the tiny
+        #    (N, N) matrix everywhere for the replicated decision
+        rows = ring_score_matrix(apply_fn, params, deltas_local, lr, xs, ys,
+                                 n_devices)
+        score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)   # (N, N)
+        costs = jax.lax.all_gather(costs_local, AXIS, tiled=True)   # (N,)
+
+        # 3. replicated decision: median over committee rows, spec'd total
+        #    order, top-k under the uploader mask (core.aggregate semantics)
+        med = median_scores(score_matrix, committee_mask)
+        order = rank_desc_stable(med, uploader_mask)
+        rank_of = jnp.argsort(order, stable=True)
+        sel = (rank_of < k) & uploader_mask
+        n_sel = jnp.maximum(jnp.sum(sel.astype(costs.dtype)), 1.0)
+        g_loss = jnp.sum(costs * sel.astype(costs.dtype)) / n_sel
+
+        # 4. masked weighted FedAvg as a psum over the client axis
+        sel_local = jax.lax.dynamic_slice(sel, (my * n_local,), (n_local,))
+        new_params = _psum_fedavg_body(params, deltas_local, n_samples,
+                                       sel_local, lr)
+
+        # 5. on-device payload ids: per-delta + new-model fingerprints, so the
+        #    host ledger records 32-byte hashes without any tensor transfer
+        fps_local = fingerprint_stacked(deltas_local)            # (n, 8)
+        delta_fps = jax.lax.all_gather(fps_local, AXIS, tiled=True)
+        params_fp = fingerprint_pytree(new_params)
+        return ShardedRoundResult(new_params, score_matrix, med, sel, order,
+                                  costs, g_loss, delta_fps, params_fp)
+
+    # Every output is replicated by construction (decision inputs come from
+    # all_gather, the model from psum); the vma checker can't infer that
+    # through dynamic_update_slice + fori_loop, so it is disabled here — the
+    # mesh-size-invariance test asserts the replication property instead.
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+def sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, params: Pytree,
+                           xs: jax.Array, ys: jax.Array,
+                           n_samples: jax.Array, uploader_mask: jax.Array,
+                           committee_mask: jax.Array, *, lr: float,
+                           batch_size: int, local_epochs: int,
+                           aggregate_count: int) -> ShardedRoundResult:
+    """One-shot convenience wrapper over `make_sharded_protocol_round`."""
+    fn = make_sharded_protocol_round(
+        mesh, apply_fn, client_num=int(xs.shape[0]), lr=lr,
+        batch_size=batch_size, local_epochs=local_epochs,
+        aggregate_count=aggregate_count)
+    return fn(params, xs, ys, n_samples, uploader_mask, committee_mask)

@@ -1,0 +1,53 @@
+"""Mesh construction helpers.
+
+One place decides how physical devices become logical axes.  Axis naming
+convention across the framework:
+
+- "clients": federated client parallelism (the reference's only axis —
+  20 processes on one box, main.py:343-358 — here a real device axis)
+- "dp" / "tp" / "sp" / "pp" / "ep": the standard within-model axes used by the
+  larger model families (transformer TP/SP shardings live with the models).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a mesh of the given logical shape from the first prod(shape)
+    devices (a sub-mesh is fine: e.g. 4 of 8 CPU devices)."""
+    need = int(np.prod(shape))
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices for mesh {tuple(shape)}, "
+                         f"have {len(devs)}")
+    arr = np.asarray(devs[:need]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def client_axis_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the client axis."""
+    n = n_devices or local_device_count()
+    return make_mesh((n,), ("clients",))
+
+
+def divide_clients(client_num: int, mesh: Mesh,
+                   axis: str = "clients") -> Tuple[int, int]:
+    """(clients_per_device, n_devices); client_num must divide evenly —
+    static shapes are a hard requirement of the SPMD round."""
+    n_dev = mesh.shape[axis]
+    if client_num % n_dev:
+        raise ValueError(
+            f"client_num {client_num} must be divisible by the '{axis}' axis "
+            f"size {n_dev}; pad the client set or resize the mesh")
+    return client_num // n_dev, n_dev

@@ -36,6 +36,17 @@ def test_config1_reaches_reference_accuracy(occupancy, backend):
     assert res.ledger_log_size == 20 + 10 * 15
 
 
+def test_mesh_runtime_reaches_reference_accuracy(occupancy):
+    """The device-resident round loop (one XLA program per round) hits the
+    same target, with ledger/device decisions cross-checked every round."""
+    from bflc_demo_tpu.client import run_federated_mesh
+    shards, test_set = occupancy
+    res = run_federated_mesh(make_softmax_regression(), shards, test_set,
+                             DEFAULT_PROTOCOL, rounds=10, seed=0)
+    assert res.best_accuracy() >= 0.90, res.accuracy_history
+    assert res.ledger_log_size == 20 + 10 * 15
+
+
 def test_deterministic_replay(occupancy):
     """Same seed -> identical ledger log head (scores, ranking, election and
     committed model hashes all bit-equal across runs)."""

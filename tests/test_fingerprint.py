@@ -1,0 +1,65 @@
+"""On-device fingerprint tests: determinism, sensitivity, composability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bflc_demo_tpu.ops import (fingerprint_pytree, fingerprint_stacked,
+                               fingerprint_to_bytes)
+
+
+def tree(v=1.0):
+    return {"W": jnp.full((5, 2), v, jnp.float32),
+            "b": jnp.arange(2, dtype=jnp.float32)}
+
+
+def test_deterministic():
+    a = np.asarray(fingerprint_pytree(tree()))
+    b = np.asarray(fingerprint_pytree(tree()))
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.uint32 and a.shape == (8,)
+
+
+def test_value_dtype_shape_sensitive():
+    base = np.asarray(fingerprint_pytree(tree()))
+    assert not np.array_equal(base, fingerprint_pytree(tree(1.0 + 1e-7)))
+    bf16 = {"W": tree()["W"].astype(jnp.bfloat16), "b": tree()["b"]}
+    assert not np.array_equal(base, np.asarray(fingerprint_pytree(bf16)))
+    reshaped = {"W": tree()["W"].reshape(2, 5), "b": tree()["b"]}
+    assert not np.array_equal(base, np.asarray(fingerprint_pytree(reshaped)))
+
+
+def test_leaf_boundary_sensitive():
+    """Moving a value across leaves must change the digest (length salt)."""
+    a = {"p": jnp.asarray([1.0, 2.0, 3.0]), "q": jnp.asarray([4.0])}
+    b = {"p": jnp.asarray([1.0, 2.0]), "q": jnp.asarray([3.0, 4.0])}
+    assert not np.array_equal(np.asarray(fingerprint_pytree(a)),
+                              np.asarray(fingerprint_pytree(b)))
+
+
+def test_stacked_matches_per_slice():
+    rng = np.random.default_rng(0)
+    stacked = {"W": jnp.asarray(rng.standard_normal((6, 5, 2)), jnp.float32),
+               "b": jnp.asarray(rng.standard_normal((6, 2)), jnp.float32)}
+    fps = np.asarray(fingerprint_stacked(stacked))
+    assert fps.shape == (6, 8)
+    for i in range(6):
+        one = {"W": stacked["W"][i], "b": stacked["b"][i]}
+        np.testing.assert_array_equal(fps[i],
+                                      np.asarray(fingerprint_pytree(one)))
+    # distinct slices -> distinct digests
+    assert len({fps[i].tobytes() for i in range(6)}) == 6
+
+
+def test_jit_consistency():
+    direct = np.asarray(fingerprint_pytree(tree()))
+    jitted = np.asarray(jax.jit(fingerprint_pytree)(tree()))
+    np.testing.assert_array_equal(direct, jitted)
+
+
+def test_to_bytes():
+    b = fingerprint_to_bytes(fingerprint_pytree(tree()))
+    assert isinstance(b, bytes) and len(b) == 32
+    import pytest
+    with pytest.raises(ValueError):
+        fingerprint_to_bytes(np.zeros(4, np.uint32))

@@ -1,0 +1,137 @@
+"""Sharded data-plane tests on the virtual 8-device CPU mesh.
+
+The invariant under test: the SPMD round (shard_map + ppermute ring + psum)
+computes bit-for-bit the same decision and numerically the same model as the
+single-device `core` path — distribution must be a pure implementation detail.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.core import (local_train, score_candidates, aggregate,
+                                apply_selection, median_scores,
+                                rank_desc_stable)
+from bflc_demo_tpu.models import make_softmax_regression
+from bflc_demo_tpu.parallel import (make_mesh, client_axis_mesh,
+                                    sharded_fedavg, sharded_protocol_round)
+from bflc_demo_tpu.parallel.mesh import divide_clients
+
+MODEL = make_softmax_regression()
+
+
+def _client_batch(rng, n_clients, shard, feat=5, classes=2):
+    xs = rng.standard_normal((n_clients, shard, feat)).astype(np.float32)
+    labels = rng.integers(0, classes, (n_clients, shard))
+    ys = np.eye(classes, dtype=np.float32)[labels]
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def test_mesh_helpers():
+    assert len(jax.devices()) == 8, "conftest should provide 8 CPU devices"
+    mesh = client_axis_mesh(4)
+    assert mesh.shape["clients"] == 4
+    assert divide_clients(20, mesh) == (5, 4)
+    with pytest.raises(ValueError):
+        divide_clients(21, mesh)
+    mesh2 = make_mesh((2, 4), ("dp", "tp"))
+    assert mesh2.shape == {"dp": 2, "tp": 4}
+
+
+def test_sharded_fedavg_matches_apply_selection():
+    rng = np.random.default_rng(0)
+    mesh = client_axis_mesh(8)
+    n = 16
+    params = MODEL.init_params(1)
+    deltas = {
+        "W": jnp.asarray(rng.standard_normal((n, 5, 2)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((n, 2)), jnp.float32)}
+    ns = jnp.asarray(rng.integers(100, 400, n), jnp.int32)
+    sel = jnp.asarray(rng.random(n) < 0.5)
+    got = sharded_fedavg(mesh, deltas, ns, sel, params, 0.001)
+    want = apply_selection(params, deltas, ns, sel, 0.001)
+    # psum reduces in tree order, the single-device sum sequentially — allow
+    # for float32 reassociation on near-zero elements
+    np.testing.assert_allclose(got["W"], want["W"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got["b"], want["b"], rtol=1e-5, atol=1e-6)
+
+
+class TestShardedProtocolRound:
+    def _run(self, n_clients=16, n_dev=8, shard=120, bs=40, k=6, seed=3):
+        rng = np.random.default_rng(seed)
+        mesh = client_axis_mesh(n_dev)
+        xs, ys = _client_batch(rng, n_clients, shard)
+        ns = jnp.full((n_clients,), shard, jnp.int32)
+        uploader = jnp.asarray([True] * 10 + [False] * (n_clients - 10))
+        committee = jnp.asarray(
+            [False] * 10 + [True] * 4 + [False] * (n_clients - 14))
+        res = sharded_protocol_round(
+            mesh, MODEL.apply, MODEL.init_params(0), xs, ys, ns,
+            uploader, committee, lr=0.01, batch_size=bs, local_epochs=1,
+            aggregate_count=k)
+        return rng, xs, ys, ns, uploader, committee, res
+
+    def test_matches_single_device_semantics(self):
+        _, xs, ys, ns, uploader, committee, res = self._run()
+        params = MODEL.init_params(0)
+        # reference: per-client local_train + score loop + core.aggregate
+        deltas, costs = [], []
+        for i in range(xs.shape[0]):
+            d, c = local_train(MODEL.apply, params, xs[i], ys[i],
+                               lr=0.01, batch_size=40)
+            deltas.append(d)
+            costs.append(float(c))
+        stacked = jax.tree_util.tree_map(lambda *t: jnp.stack(t), *deltas)
+        rows = []
+        for i in range(xs.shape[0]):
+            rows.append(score_candidates(MODEL.apply, params, stacked, 0.01,
+                                         xs[i], ys[i]))
+        want_matrix = jnp.stack(rows)
+        np.testing.assert_allclose(res.score_matrix, want_matrix,
+                                   rtol=0, atol=1e-6)
+        np.testing.assert_allclose(res.avg_costs, np.asarray(costs),
+                                   rtol=1e-6)
+        want = aggregate(params, stacked, ns, jnp.asarray(costs),
+                         want_matrix, committee, uploader, 0.01, 6)
+        np.testing.assert_allclose(res.medians, want.medians, atol=1e-6)
+        np.testing.assert_array_equal(res.selected, want.selected)
+        np.testing.assert_array_equal(res.order, want.order)
+        np.testing.assert_allclose(res.params["W"], want.params["W"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(res.global_loss, want.global_loss,
+                                   rtol=1e-5)
+
+    def test_committee_rows_only(self):
+        """Medians must depend only on committee rows of the matrix."""
+        _, xs, ys, ns, uploader, committee, res = self._run()
+        sub = res.score_matrix[np.asarray(committee)]
+        med = np.sort(np.asarray(sub), axis=0)
+        want = 0.5 * (med[1] + med[2])          # 4 rows -> mean of middle two
+        np.testing.assert_allclose(res.medians, want, atol=1e-6)
+
+    def test_selection_respects_uploader_mask(self):
+        _, _, _, _, uploader, _, res = self._run()
+        assert not np.any(np.asarray(res.selected)[~np.asarray(uploader)])
+        assert np.asarray(res.selected).sum() == 6
+
+    def test_mesh_size_invariance(self):
+        """Same round on 2-device and 8-device meshes -> same outputs (the
+        distribution is semantically invisible)."""
+        rng = np.random.default_rng(9)
+        xs, ys = _client_batch(rng, 16, 80)
+        ns = jnp.full((16,), 80, jnp.int32)
+        uploader = jnp.asarray([True] * 12 + [False] * 4)
+        committee = jnp.asarray([False] * 12 + [True] * 4)
+        outs = []
+        for nd in (2, 8):
+            res = sharded_protocol_round(
+                client_axis_mesh(nd), MODEL.apply, MODEL.init_params(0),
+                xs, ys, ns, uploader, committee, lr=0.01, batch_size=40,
+                local_epochs=1, aggregate_count=6)
+            outs.append(res)
+        np.testing.assert_allclose(outs[0].score_matrix, outs[1].score_matrix,
+                                   atol=1e-6)
+        np.testing.assert_array_equal(outs[0].selected, outs[1].selected)
+        np.testing.assert_allclose(outs[0].params["W"], outs[1].params["W"],
+                                   rtol=1e-5, atol=1e-6)
