@@ -55,32 +55,61 @@ def run_federated_mesh(model: Model,
                        ledger_backend: str = "auto",
                        seed: int = 0,
                        init_seed: int = 0,
+                       participation: str = "full",
+                       client_chunk: int = 0,
+                       remat: bool = False,
                        verbose: bool = False) -> SimulationResult:
+    """participation:
+    - 'full': every registered client trains each round (the reference's
+      behavior — all 16 trainers train, first 10 count, main.py:236-263);
+      device slots == client ids.
+    - 'active': only the round's participants (K uploaders + C committee)
+      occupy device slots — the sampled-clients regime of BASELINE config 3
+      (100 clients / 10 sampled).  Participant shards stream to the mesh
+      each round; masks are static so the XLA program never retraces.
+    """
     cfg.validate()
+    if participation not in ("full", "active"):
+        raise ValueError(f"participation must be 'full'|'active', "
+                         f"got {participation!r}")
     n = cfg.client_num
     if len(shards) != n:
         raise ValueError(f"need {n} shards, got {len(shards)}")
+    k, c = cfg.needed_update_count, cfg.comm_count
+    n_slots = n if participation == "full" else k + c
     if mesh is None:
-        # largest device count that divides the client population
+        # largest device count that divides the slot count
         nd = len(jax.devices())
-        while n % nd:
+        while n_slots % nd:
             nd -= 1
         mesh = client_axis_mesh(nd)
 
     # uniform shard size for static shapes: truncate to the minimum
     s_min = min(len(sx) for sx, _ in shards)
     nc = model.num_classes
-    xs = np.stack([sx[:s_min] for sx, _ in shards]).astype(np.float32)
-    ys = np.stack([one_hot(sy[:s_min], nc) for _, sy in shards])
+    xs_np = np.stack([sx[:s_min] for sx, _ in shards])
+    # preserve integer inputs (token ids index the embedding table);
+    # everything else runs float32
+    xs_np = (xs_np.astype(np.int32) if np.issubdtype(xs_np.dtype, np.integer)
+             else xs_np.astype(np.float32))
+    ys_np = np.stack([one_hot(sy[:s_min], nc) for _, sy in shards])
     shard_sharding = NamedSharding(mesh, P(AXIS))
-    xs = jax.device_put(jnp.asarray(xs), shard_sharding)
-    ys = jax.device_put(jnp.asarray(ys), shard_sharding)
-    ns = jax.device_put(jnp.full((n,), s_min, jnp.int32), shard_sharding)
+    ns = jax.device_put(jnp.full((n_slots,), s_min, jnp.int32),
+                        shard_sharding)
+    if participation == "full":
+        xs = jax.device_put(jnp.asarray(xs_np), shard_sharding)
+        ys = jax.device_put(jnp.asarray(ys_np), shard_sharding)
+        static_uploader = static_committee = None
+    else:
+        xs = ys = None
+        static_uploader = jnp.asarray([True] * k + [False] * c)
+        static_committee = jnp.asarray([False] * k + [True] * c)
 
     round_fn = make_sharded_protocol_round(
-        mesh, model.apply, client_num=n, lr=cfg.learning_rate,
+        mesh, model.apply, client_num=n_slots, lr=cfg.learning_rate,
         batch_size=cfg.batch_size, local_epochs=cfg.local_epochs,
-        aggregate_count=cfg.aggregate_count)
+        aggregate_count=cfg.aggregate_count, client_chunk=client_chunk,
+        remat=remat)
 
     xte, yte = test_set
     sponsor = Sponsor(model, jnp.asarray(xte), jnp.asarray(one_hot(yte, nc)))
@@ -101,39 +130,50 @@ def run_federated_mesh(model: Model,
         committee_ids = sorted(
             int(a, 16) for a in ledger.committee())
         trainer_ids = [i for i in range(n) if i not in committee_ids]
-        pick = rng.permutation(len(trainer_ids))[: cfg.needed_update_count]
+        pick = rng.permutation(len(trainer_ids))[: k]
         uploader_ids = sorted(trainer_ids[int(j)] for j in pick)
 
-        uploader_mask = np.zeros(n, bool)
-        uploader_mask[uploader_ids] = True
-        committee_mask = np.zeros(n, bool)
-        committee_mask[committee_ids] = True
-
-        res = round_fn(params, xs, ys, ns, jnp.asarray(uploader_mask),
-                       jnp.asarray(committee_mask))
+        if participation == "full":
+            uploader_mask = np.zeros(n, bool)
+            uploader_mask[uploader_ids] = True
+            committee_mask = np.zeros(n, bool)
+            committee_mask[committee_ids] = True
+            res = round_fn(params, xs, ys, ns, jnp.asarray(uploader_mask),
+                           jnp.asarray(committee_mask))
+            up_slots, comm_slots = uploader_ids, committee_ids
+        else:
+            # stream this round's participant shards onto the mesh;
+            # slots: [uploaders asc | committee asc] — masks stay static
+            active = uploader_ids + committee_ids
+            xs_a = jax.device_put(jnp.asarray(xs_np[active]), shard_sharding)
+            ys_a = jax.device_put(jnp.asarray(ys_np[active]), shard_sharding)
+            res = round_fn(params, xs_a, ys_a, ns, static_uploader,
+                           static_committee)
+            up_slots = list(range(k))
+            comm_slots = list(range(k, k + c))
         params = res.params
 
         # host side: tiny transfers only
-        delta_fps = np.asarray(res.delta_fps)          # (N, 8) uint32
-        score_rows = np.asarray(res.score_matrix)      # (N, N) float32
+        delta_fps = np.asarray(res.delta_fps)          # (slots, 8) uint32
+        score_rows = np.asarray(res.score_matrix)      # (slots, slots)
         avg_costs = np.asarray(res.avg_costs)
         sel_device = np.flatnonzero(np.asarray(res.selected))
 
-        for cid in uploader_ids:                       # ascending == slot order
+        for j, cid in enumerate(uploader_ids):         # ascending == slot order
             st = ledger.upload_local_update(
-                _addr(cid), fingerprint_to_bytes(delta_fps[cid]),
-                s_min, float(avg_costs[cid]), epoch)
+                _addr(cid), fingerprint_to_bytes(delta_fps[up_slots[j]]),
+                s_min, float(avg_costs[up_slots[j]]), epoch)
             if st != LedgerStatus.OK:
                 raise RuntimeError(f"upload rejected: {st.name}")
-        for cid in committee_ids:
+        for j, cid in enumerate(committee_ids):
             st = ledger.upload_scores(
                 _addr(cid), epoch,
-                [float(score_rows[cid, u]) for u in uploader_ids])
+                [float(score_rows[comm_slots[j], u]) for u in up_slots])
             if st != LedgerStatus.OK:
                 raise RuntimeError(f"scores rejected: {st.name}")
 
         pending = ledger.pending()
-        sel_ledger = np.sort([uploader_ids[s] for s in pending.selected])
+        sel_ledger = np.sort([up_slots[s] for s in pending.selected])
         if not np.array_equal(sel_ledger, sel_device):
             raise RuntimeError(
                 "ledger/device decision divergence: "
@@ -157,4 +197,5 @@ def run_federated_mesh(model: Model,
         wall_time_s=time.perf_counter() - t0,
         round_times_s=round_times,
         ledger_log_head=ledger.log_head(),
-        ledger_log_size=ledger.log_size())
+        ledger_log_size=ledger.log_size(),
+        n_devices=mesh.shape[AXIS])

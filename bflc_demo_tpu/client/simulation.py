@@ -40,6 +40,7 @@ class SimulationResult:
     round_times_s: List[float]
     ledger_log_head: bytes
     ledger_log_size: int
+    n_devices: int = 1          # devices the data plane actually used
 
     @property
     def final_accuracy(self) -> float:

@@ -1,0 +1,83 @@
+"""Seeded synthetic datasets shaped like the scale-out configs' benchmarks.
+
+This image has zero egress, so MNIST/CIFAR/FEMNIST/SST-2 cannot be
+downloaded; these generators produce learnable class-conditional data with
+the right shapes/cardinalities so every config's full protocol path (models,
+partitioners, committee scoring, aggregation) runs and converges for real.
+A run against the true datasets only requires pointing the loaders at files
+on disk (see `load_image_dataset`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+def synthetic_image_classification(n: int, shape: Tuple[int, ...],
+                                   num_classes: int, seed: int = 0,
+                                   noise: float = 0.35,
+                                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Class template + Gaussian noise images in [0, 1]; learnable by a
+    linear probe but not trivially (noise swamps individual pixels)."""
+    rng = np.random.default_rng(seed)
+    templates = rng.random((num_classes,) + tuple(shape), np.float32)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    x = templates[y] + rng.standard_normal((n,) + tuple(shape)).astype(
+        np.float32) * noise
+    return np.clip(x, 0.0, 1.0).astype(np.float32), y
+
+
+def synthetic_mnist(n: int = 6000, seed: int = 0):
+    return synthetic_image_classification(n, (28, 28, 1), 10, seed)
+
+
+def synthetic_cifar10(n: int = 6000, seed: int = 0):
+    return synthetic_image_classification(n, (32, 32, 3), 10, seed)
+
+
+def synthetic_cifar100(n: int = 6000, seed: int = 0):
+    return synthetic_image_classification(n, (32, 32, 3), 100, seed)
+
+
+def synthetic_femnist(n: int = 8000, seed: int = 0):
+    return synthetic_image_classification(n, (28, 28, 1), 62, seed)
+
+
+def synthetic_text_classification(n: int, seq_len: int = 64,
+                                  vocab_size: int = 1000,
+                                  num_classes: int = 2, seed: int = 0,
+                                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """SST-2-shaped token sequences: class-conditional unigram mixtures over
+    a shared background distribution (id 0 = PAD)."""
+    rng = np.random.default_rng(seed)
+    background = rng.dirichlet([0.1] * (vocab_size - 1))
+    class_dists = []
+    for _ in range(num_classes):
+        signal = rng.dirichlet([0.05] * (vocab_size - 1))
+        class_dists.append(0.7 * background + 0.3 * signal)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    x = np.zeros((n, seq_len), np.int32)
+    for c in range(num_classes):
+        idx = np.flatnonzero(y == c)
+        draws = rng.choice(vocab_size - 1, size=(len(idx), seq_len),
+                           p=class_dists[c]) + 1
+        x[idx] = draws.astype(np.int32)
+    # variable lengths: pad a random tail with 0
+    lengths = rng.integers(seq_len // 2, seq_len + 1, n)
+    for i in range(n):
+        x[i, lengths[i]:] = 0
+    return x, y
+
+
+def load_image_dataset(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Load a real dataset from an .npz with arrays 'x' (N,H,W,C in [0,1])
+    and 'y' (N,) int labels — the hook for running the benchmark configs on
+    true MNIST/CIFAR files when they are available on disk."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with np.load(path) as z:
+        return (np.asarray(z["x"], np.float32),
+                np.asarray(z["y"], np.int32))

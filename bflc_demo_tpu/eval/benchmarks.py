@@ -37,16 +37,12 @@ def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
     # samples/sec/chip — count the work each runtime actually does:
     # host: the K uploaders train their own (untruncated) shards, one chip;
     # mesh: ALL clients train min-truncated shards, spread over n_chips
+    n_chips = res.n_devices     # what the runtime actually used
     if runtime == "host":
-        n_chips = 1
         samples_per_round = sum(
             (len(sx) // cfg.batch_size) * cfg.batch_size * cfg.local_epochs
             for sx, _ in shards[:cfg.needed_update_count])
     else:
-        import jax
-        n_chips = len(jax.devices())
-        while cfg.client_num % n_chips:
-            n_chips -= 1        # mirror run_federated_mesh's mesh choice
         s_min = min(len(sx) for sx, _ in shards)
         samples_per_round = (cfg.client_num *
                              (s_min // cfg.batch_size) * cfg.batch_size *

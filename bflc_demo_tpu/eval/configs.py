@@ -1,0 +1,143 @@
+"""The five BASELINE.json benchmark configs as runnable presets.
+
+Each preset wires a model family + data pipeline + protocol geometry into the
+same committee-consensus protocol (the protocol itself never changes —
+SURVEY.md §7 step 6).  Data is synthetic-by-default (zero-egress image; see
+data/synthetic.py) with identical shapes/cardinalities to the published
+benchmarks; swap in real arrays via data.synthetic.load_image_dataset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+from bflc_demo_tpu.client.simulation import SimulationResult
+from bflc_demo_tpu.data import (load_occupancy, iid_shards, dirichlet_shards)
+from bflc_demo_tpu.data.synthetic import (
+    synthetic_mnist, synthetic_cifar10, synthetic_cifar100,
+    synthetic_femnist)
+from bflc_demo_tpu.models import (make_softmax_regression, make_mlp,
+                                  make_lenet5, make_femnist_cnn,
+                                  make_resnet18)
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchConfig:
+    name: str
+    description: str
+    build: Callable[..., SimulationResult]
+
+
+def _split(x, y, test_frac=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(x))
+    x, y = x[perm], y[perm]
+    n_test = int(len(x) * test_frac)
+    return x[n_test:], y[n_test:], x[:n_test], y[:n_test]
+
+
+def config1_occupancy(rounds: int = 10, seed: int = 0,
+                      **kw) -> SimulationResult:
+    """Reference-equivalence run: softmax regression, occupancy, 20 clients."""
+    cfg = ProtocolConfig().validate()
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    return run_federated_mesh(make_softmax_regression(), shards, (xte, yte),
+                              cfg, rounds=rounds, seed=seed, **kw)
+
+
+def config2_lenet_cifar10(rounds: int = 10, seed: int = 0, n_data: int = 6000,
+                          alpha: float = 0.5,
+                          cfg: Optional[ProtocolConfig] = None,
+                          **kw) -> SimulationResult:
+    """LeNet-5, CIFAR-10 shapes, 20-client Dirichlet(0.5) non-IID."""
+    cfg = (cfg or ProtocolConfig(learning_rate=0.05, batch_size=32,
+                                 local_epochs=2)).validate()
+    x, y = synthetic_cifar10(n_data, seed)
+    xtr, ytr, xte, yte = _split(x, y)
+    shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=alpha,
+                              seed=seed, min_size=cfg.batch_size)
+    return run_federated_mesh(make_lenet5(), shards, (xte, yte), cfg,
+                              rounds=rounds, seed=seed, **kw)
+
+
+def config3_femnist_sampled(rounds: int = 10, seed: int = 0,
+                            n_data: int = 20000,
+                            cfg: Optional[ProtocolConfig] = None,
+                            **kw) -> SimulationResult:
+    """FEMNIST CNN, 100 clients / 10 sampled per round (active participation);
+    committee scoring = the malicious-client defense, always on."""
+    cfg = (cfg or ProtocolConfig(
+        client_num=100, comm_count=4, aggregate_count=6,
+        needed_update_count=10, learning_rate=0.05,
+        batch_size=20, local_epochs=1)).validate()
+    x, y = synthetic_femnist(n_data, seed)
+    xtr, ytr, xte, yte = _split(x, y)
+    shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=1.0,
+                              seed=seed, min_size=cfg.batch_size)
+    return run_federated_mesh(make_femnist_cnn(), shards, (xte, yte), cfg,
+                              rounds=rounds, seed=seed,
+                              participation="active", **kw)
+
+
+def config4_resnet_cifar100(rounds: int = 5, seed: int = 0,
+                            n_data: int = 4000,
+                            cfg: Optional[ProtocolConfig] = None,
+                            **kw) -> SimulationResult:
+    """ResNet-18, CIFAR-100 shapes, 32-client cross-silo."""
+    cfg = (cfg or ProtocolConfig(
+        client_num=32, comm_count=4, aggregate_count=8,
+        needed_update_count=12, learning_rate=0.1,
+        batch_size=16, local_epochs=1)).validate()
+    x, y = synthetic_cifar100(n_data, seed)
+    xtr, ytr, xte, yte = _split(x, y)
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    # active participation + chunked/remat training: ResNet-18 x 32 clients
+    # on one chip would otherwise exceed HBM (activations scale with
+    # clients/device — measured 27G on 16G v5e without these controls)
+    kw.setdefault("participation", "active")
+    kw.setdefault("client_chunk", 4)
+    kw.setdefault("remat", True)
+    return run_federated_mesh(make_resnet18(), shards, (xte, yte), cfg,
+                              rounds=rounds, seed=seed, **kw)
+
+
+def config5_transformer_sst2(rounds: int = 5, seed: int = 0,
+                             n_data: int = 4000,
+                             cfg: Optional[ProtocolConfig] = None,
+                             **kw) -> SimulationResult:
+    """Transformer federated fine-tune on SST-2-shaped text (stretch)."""
+    from bflc_demo_tpu.data.synthetic import synthetic_text_classification
+    from bflc_demo_tpu.models.transformer import make_transformer_classifier
+    cfg = (cfg or ProtocolConfig(
+        client_num=20, comm_count=4, aggregate_count=6,
+        needed_update_count=10, learning_rate=0.05,
+        batch_size=16, local_epochs=1)).validate()
+    x, y = synthetic_text_classification(n_data, seq_len=64, vocab_size=1000,
+                                         num_classes=2, seed=seed)
+    xtr, ytr, xte, yte = _split(x, y)
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    model = make_transformer_classifier(vocab_size=1000, seq_len=64,
+                                        num_classes=2, dim=128, depth=2,
+                                        heads=4)
+    return run_federated_mesh(model, shards, (xte, yte), cfg,
+                              rounds=rounds, seed=seed, **kw)
+
+
+CONFIGS: Dict[str, BenchConfig] = {
+    "config1": BenchConfig("config1", "softmax/occupancy 20-client (parity)",
+                           config1_occupancy),
+    "config2": BenchConfig("config2", "LeNet-5/CIFAR-10 20-client non-IID",
+                           config2_lenet_cifar10),
+    "config3": BenchConfig("config3", "FEMNIST CNN 100/10 sampled",
+                           config3_femnist_sampled),
+    "config4": BenchConfig("config4", "ResNet-18/CIFAR-100 32-client",
+                           config4_resnet_cifar100),
+    "config5": BenchConfig("config5", "Transformer/SST-2 federated (stretch)",
+                           config5_transformer_sst2),
+}

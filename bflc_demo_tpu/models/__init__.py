@@ -10,5 +10,17 @@ entry in the zoo.
 
 from bflc_demo_tpu.models.base import Model  # noqa: F401
 from bflc_demo_tpu.models.softmax_regression import make_softmax_regression  # noqa: F401
+from bflc_demo_tpu.models.mlp import make_mlp  # noqa: F401
+from bflc_demo_tpu.models.cnn import make_lenet5, make_femnist_cnn  # noqa: F401
+from bflc_demo_tpu.models.resnet import make_resnet18  # noqa: F401
 
-__all__ = ["Model", "make_softmax_regression"]
+REGISTRY = {
+    "softmax_regression": make_softmax_regression,
+    "mlp": make_mlp,
+    "lenet5": make_lenet5,
+    "femnist_cnn": make_femnist_cnn,
+    "resnet18": make_resnet18,
+}
+
+__all__ = ["Model", "REGISTRY", "make_softmax_regression", "make_mlp",
+           "make_lenet5", "make_femnist_cnn", "make_resnet18"]

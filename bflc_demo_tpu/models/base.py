@@ -32,6 +32,9 @@ class Model:
     apply: Callable[[Pytree, jax.Array], jax.Array]
     input_shape: Tuple[int, ...] = ()   # per-example shape, e.g. (5,)
     num_classes: int = 2
+    config: Any = None                  # family-specific config (e.g. the
+                                        # TransformerConfig the parallel
+                                        # execution forms need)
 
     def init_params(self, seed: int = 0) -> Pytree:
         return self.init(jax.random.PRNGKey(seed))

@@ -1,0 +1,77 @@
+"""Convolutional models: LeNet-5 (config 2) and the LEAF FEMNIST CNN
+(config 3) — flax.linen, NHWC, stateless apply (no BatchNorm) so the whole
+FL stack (vmap over candidate models, shard_map over clients) composes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from bflc_demo_tpu.models.base import Model
+
+
+class _LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class _FemnistCNN(nn.Module):
+    """LEAF's FEMNIST CNN: two 5x5 conv blocks + 2048 dense + softmax head."""
+    num_classes: int = 62
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(2048, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def _wrap_flax(module: nn.Module, name: str,
+               input_shape: Tuple[int, ...], num_classes: int) -> Model:
+    def init(rng: jax.Array):
+        dummy = jnp.zeros((1,) + input_shape, jnp.float32)
+        return module.init(rng, dummy)["params"]
+
+    def apply(params, x):
+        return module.apply({"params": params}, x)
+
+    return Model(name=name, init=init, apply=apply,
+                 input_shape=input_shape, num_classes=num_classes)
+
+
+def make_lenet5(input_shape: Tuple[int, ...] = (32, 32, 3),
+                num_classes: int = 10, dtype=jnp.float32) -> Model:
+    return _wrap_flax(_LeNet5(num_classes=num_classes, dtype=dtype),
+                      "lenet5", tuple(input_shape), num_classes)
+
+
+def make_femnist_cnn(input_shape: Tuple[int, ...] = (28, 28, 1),
+                     num_classes: int = 62, dtype=jnp.float32) -> Model:
+    return _wrap_flax(_FemnistCNN(num_classes=num_classes, dtype=dtype),
+                      "femnist_cnn", tuple(input_shape), num_classes)

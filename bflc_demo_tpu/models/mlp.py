@@ -1,0 +1,40 @@
+"""2-layer MLP — BASELINE.json config 1's scale-up ("2-layer MLP on MNIST").
+
+Pure-jax dense stack; inputs are flattened images.  He-initialised hidden
+layer, zero-init output layer (so round 0 starts from uniform predictions,
+matching the zero-init convention of the reference genesis model).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bflc_demo_tpu.models.base import Model
+
+
+def make_mlp(input_shape: Tuple[int, ...] = (28, 28, 1),
+             hidden: int = 200, num_classes: int = 10,
+             dtype=jnp.float32) -> Model:
+    import numpy as np
+    in_dim = int(np.prod(input_shape))
+
+    def init(rng: jax.Array) -> Dict[str, jax.Array]:
+        k1, _ = jax.random.split(rng)
+        scale = jnp.sqrt(2.0 / in_dim).astype(dtype)
+        return {
+            "W1": jax.random.normal(k1, (in_dim, hidden), dtype) * scale,
+            "b1": jnp.zeros((hidden,), dtype),
+            "W2": jnp.zeros((hidden, num_classes), dtype),
+            "b2": jnp.zeros((num_classes,), dtype),
+        }
+
+    def apply(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        h = x.reshape((x.shape[0], -1)).astype(dtype)
+        h = jax.nn.relu(h @ params["W1"] + params["b1"])
+        return h @ params["W2"] + params["b2"]
+
+    return Model(name="mlp", init=init, apply=apply,
+                 input_shape=tuple(input_shape), num_classes=num_classes)

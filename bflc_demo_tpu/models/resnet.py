@@ -1,0 +1,81 @@
+"""ResNet-18 for the cross-silo config (BASELINE.json config 4).
+
+GroupNorm instead of BatchNorm: BN's running statistics are mutable state
+that breaks the stateless Model contract AND is known-poisonous in federated
+averaging (client batch statistics diverge); GroupNorm is the standard FL
+substitute and keeps `apply` pure so candidate models can be vmapped during
+committee scoring.  bfloat16 compute path available via `dtype` (MXU-native),
+params and logits stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from bflc_demo_tpu.models.base import Model
+
+
+class _BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.filters),
+                         dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters),
+                         dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.filters),
+                                    dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class _ResNet18(nn.Module):
+    num_classes: int = 100
+    dtype: jnp.dtype = jnp.float32
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        # CIFAR stem (3x3) rather than the ImageNet 7x7/stride-2 stem
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=32, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for stage, blocks in enumerate(self.stage_sizes):
+            filters = 64 * (2 ** stage)
+            for b in range(blocks):
+                strides = (2, 2) if stage > 0 and b == 0 else (1, 1)
+                x = _BasicBlock(filters, strides, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def make_resnet18(input_shape: Tuple[int, ...] = (32, 32, 3),
+                  num_classes: int = 100, dtype=jnp.float32) -> Model:
+    module = _ResNet18(num_classes=num_classes, dtype=dtype)
+
+    def init(rng: jax.Array):
+        dummy = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+        return module.init(rng, dummy)["params"]
+
+    def apply(params, x):
+        return module.apply({"params": params}, x)
+
+    return Model(name="resnet18", init=init, apply=apply,
+                 input_shape=tuple(input_shape), num_classes=num_classes)

@@ -1,0 +1,168 @@
+"""Encoder transformer classifier — the text/stretch config (BASELINE #5)
+and the long-context flagship.
+
+Pure-JAX with an explicit parameter pytree (no module framework) so the SAME
+parameters drive three execution forms, differential-tested against each
+other:
+
+- `Model.apply`: single-device forward (this file);
+- sequence-parallel forward with ring attention over an "sp" mesh axis
+  (`parallel/ring_attention.py`) for sequences longer than one chip's HBM;
+- tensor-parallel execution via GSPMD sharding specs
+  (`parallel/tp.transformer_partition_specs`) over a "tp" axis.
+
+TPU-first choices: stateless apply (vmappable for committee scoring), PAD=0
+key masking + padding-aware mean pooling, MXU-friendly dims (vocab padded to
+128; dim/heads multiples of 8), optional bfloat16 compute with float32
+params/logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bflc_demo_tpu.models.base import Model
+
+Pytree = Any
+NEG_INF = -1e30       # large-negative instead of -inf: keeps fully-masked
+                      # softmax rows finite (flash/ring numerics need this)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 1024          # padded to a multiple of 128
+    seq_len: int = 64
+    num_classes: int = 2
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def _round_up(v: int, m: int) -> int:
+    return ((v + m - 1) // m) * m
+
+
+def init_transformer_params(cfg: TransformerConfig, rng: jax.Array) -> Pytree:
+    keys = jax.random.split(rng, 4 + cfg.depth)
+    d, h = cfg.dim, cfg.mlp_ratio * cfg.dim
+    s = 0.02
+
+    def dense(key, shape):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    def block(key):
+        ks = jax.random.split(key, 6)
+        return {
+            "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d)),
+            "wv": dense(ks[2], (d, d)), "wo": dense(ks[3], (d, d)),
+            "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "w1": dense(ks[4], (d, h)), "b1": jnp.zeros((h,)),
+            "w2": dense(ks[5], (h, d)), "b2": jnp.zeros((d,)),
+        }
+
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, d)),
+        "pos": dense(keys[1], (cfg.seq_len, d)),
+        "blocks": tuple(block(keys[2 + i]) for i in range(cfg.depth)),
+        "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "head_w": jnp.zeros((d, cfg.num_classes)),
+        "head_b": jnp.zeros((cfg.num_classes,)),
+    }
+
+
+def layer_norm(x, p, dtype):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (out * p["scale"] + p["bias"]).astype(dtype)
+
+
+def attention(q, k, v, kv_mask, cfg: TransformerConfig):
+    """Standard masked MHA core. q,k,v: (B, S, H, Dh); kv_mask: (B, S) bool."""
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(kv_mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def block_forward(x, pad, bp, cfg: TransformerConfig, attn_fn=None):
+    """One encoder block; `attn_fn(q, k, v, kv_mask)` is pluggable so the
+    sequence-parallel path swaps in ring attention with the same params."""
+    b, s, d = x.shape
+    h, dh = cfg.heads, cfg.head_dim
+    dt = cfg.dtype
+    y = layer_norm(x, bp["ln1"], dt)
+    q = (y @ bp["wq"].astype(dt)).reshape(b, s, h, dh)
+    k = (y @ bp["wk"].astype(dt)).reshape(b, s, h, dh)
+    v = (y @ bp["wv"].astype(dt)).reshape(b, s, h, dh)
+    if attn_fn is None:
+        o = attention(q, k, v, pad, cfg)
+    else:
+        o = attn_fn(q, k, v, pad)
+    x = x + (o.reshape(b, s, d) @ bp["wo"].astype(dt))
+    y = layer_norm(x, bp["ln2"], dt)
+    y = jax.nn.gelu(y @ bp["w1"].astype(dt) + bp["b1"].astype(dt))
+    return x + (y @ bp["w2"].astype(dt) + bp["b2"].astype(dt))
+
+
+def transformer_forward(params: Pytree, tokens: jax.Array,
+                        cfg: TransformerConfig, attn_fn=None,
+                        pos_offset=0, pool_psum_axis=None) -> jax.Array:
+    """tokens: (B, S) int32, 0 = PAD. Returns (B, num_classes) float32.
+
+    With the defaults this is the single-device forward.  The
+    sequence-parallel runtime calls the SAME function per sequence-shard
+    with attn_fn = ring attention, pos_offset = shard offset, and
+    pool_psum_axis = the sp mesh axis (the padding-aware mean-pool then
+    reduces its numerator/denominator with a psum so every shard pools over
+    the full sequence).  One definition, every execution form.
+    """
+    dt = cfg.dtype
+    pad = tokens != 0
+    x = params["embed"].astype(dt)[tokens]
+    s = tokens.shape[1]
+    x = x + jax.lax.dynamic_slice_in_dim(
+        params["pos"].astype(dt), pos_offset, s, axis=0)[None]
+    for bp in params["blocks"]:
+        x = block_forward(x, pad, bp, cfg, attn_fn)
+    x = layer_norm(x, params["ln_f"], jnp.float32)
+    num = (x * pad[..., None]).sum(1)
+    den = pad.sum(-1, keepdims=True)
+    if pool_psum_axis is not None:
+        num = jax.lax.psum(num, pool_psum_axis)
+        den = jax.lax.psum(den, pool_psum_axis)
+    pooled = num / jnp.maximum(den, 1).astype(jnp.float32)
+    return pooled @ params["head_w"] + params["head_b"]
+
+
+def make_transformer_classifier(vocab_size: int = 1000, seq_len: int = 64,
+                                num_classes: int = 2, dim: int = 128,
+                                depth: int = 2, heads: int = 4,
+                                dtype=jnp.float32) -> Model:
+    cfg = TransformerConfig(
+        vocab_size=_round_up(vocab_size, 128), seq_len=seq_len,
+        num_classes=num_classes, dim=dim, depth=depth, heads=heads,
+        dtype=dtype)
+
+    def init(rng: jax.Array) -> Dict:
+        return init_transformer_params(cfg, rng)
+
+    def apply(params, tokens):
+        return transformer_forward(params, tokens, cfg)
+
+    return Model(name="transformer", init=init, apply=apply,
+                 input_shape=(seq_len,), num_classes=num_classes, config=cfg)

@@ -32,6 +32,7 @@ from bflc_demo_tpu.core.local_train import local_train_impl
 from bflc_demo_tpu.core.losses import accuracy
 from bflc_demo_tpu.ops.fingerprint import (fingerprint_pytree,
                                            fingerprint_stacked)
+from bflc_demo_tpu.parallel.mesh import pvary_compat
 
 Pytree = Any
 ApplyFn = Callable[[Pytree, jax.Array], jax.Array]
@@ -49,7 +50,7 @@ def _ensure_varying(tree: Pytree, axis: str = AXIS) -> Pytree:
     """
     def fix(leaf):
         if axis not in jax.typeof(leaf).vma:
-            return jax.lax.pvary(leaf, (axis,))
+            return pvary_compat(leaf, (axis,))
         return leaf
     return jax.tree_util.tree_map(fix, tree)
 
@@ -97,9 +98,10 @@ def sharded_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
 
 
 def _score_block(apply_fn: ApplyFn, params: Pytree, block: Pytree, lr,
-                 xs: jax.Array, ys: jax.Array) -> jax.Array:
+                 xs: jax.Array, ys: jax.Array, chunk: int = 0) -> jax.Array:
     """(n_scorers, n_block) accuracies: candidate_k = params - lr*delta_k
-    evaluated on each local scorer shard (main.py:212-217 semantics)."""
+    evaluated on each local scorer shard (main.py:212-217 semantics).
+    chunk > 0 evaluates scorers in sequential chunks (memory control)."""
 
     def one_scorer(x, y):
         def one_candidate(delta):
@@ -108,12 +110,20 @@ def _score_block(apply_fn: ApplyFn, params: Pytree, block: Pytree, lr,
             return accuracy(apply_fn(cand, x), y)
         return jax.vmap(one_candidate)(block)
 
+    n_scorers = xs.shape[0]
+    if chunk and chunk < n_scorers and n_scorers % chunk == 0:
+        nch = n_scorers // chunk
+        xs_c = xs.reshape((nch, chunk) + xs.shape[1:])
+        ys_c = ys.reshape((nch, chunk) + ys.shape[1:])
+        out = jax.lax.map(lambda a: jax.vmap(one_scorer)(a[0], a[1]),
+                          (xs_c, ys_c))
+        return out.reshape((n_scorers,) + out.shape[2:])
     return jax.vmap(one_scorer)(xs, ys)
 
 
 def ring_score_matrix(apply_fn: ApplyFn, params: Pytree, deltas_local: Pytree,
                       lr, xs: jax.Array, ys: jax.Array,
-                      n_devices: int) -> jax.Array:
+                      n_devices: int, chunk: int = 0) -> jax.Array:
     """Inside shard_map: full (n_local, N) score rows via a ppermute ring.
 
     Each step evaluates the resident candidate block on the local scorer
@@ -128,7 +138,7 @@ def ring_score_matrix(apply_fn: ApplyFn, params: Pytree, deltas_local: Pytree,
     def step(s, carry):
         rows, block = carry
         src = (my - s) % n_devices          # owner of the resident block
-        part = _score_block(apply_fn, params, block, lr, xs, ys)
+        part = _score_block(apply_fn, params, block, lr, xs, ys, chunk)
         rows = jax.lax.dynamic_update_slice(rows, part, (0, src * n_local))
         block = jax.lax.ppermute(
             block, AXIS,
@@ -137,7 +147,7 @@ def ring_score_matrix(apply_fn: ApplyFn, params: Pytree, deltas_local: Pytree,
 
     # mark the fresh buffer as device-varying so the loop carry type matches
     # what the body produces (jax>=0.8 shard_map varying-axis tracking)
-    rows0 = jax.lax.pvary(jnp.zeros((n_local, total), jnp.float32), (AXIS,))
+    rows0 = pvary_compat(jnp.zeros((n_local, total), jnp.float32), (AXIS,))
     rows, _ = jax.lax.fori_loop(0, n_devices, step, (rows0, deltas_local))
     return rows
 
@@ -157,6 +167,7 @@ class ShardedRoundResult(NamedTuple):
 def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                                 client_num: int, lr: float, batch_size: int,
                                 local_epochs: int, aggregate_count: int,
+                                client_chunk: int = 0, remat: bool = False,
                                 ) -> Callable[..., ShardedRoundResult]:
     """Build the jitted full-round SPMD program for a fixed geometry.
 
@@ -166,29 +177,58 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
     masks/(N,) replicated.  Every client trains; `uploader_mask` picks which
     slots constitute the round's K updates (the async first-come-10 of
     .cpp:239-244 becomes a static mask), `committee_mask` picks scorer rows.
+
+    Memory controls for big model families (one device hosting many logical
+    clients multiplies training-activation memory by clients/device):
+    - client_chunk: train (and score) clients in sequential chunks of this
+      size via lax.map — peak activations ∝ chunk, not clients/device;
+    - remat: jax.checkpoint the per-client training step (recompute forward
+      activations in the backward pass — the HBM<->FLOPs trade).
     """
     n_devices = mesh.shape[AXIS]
     if client_num % n_devices:
         raise ValueError(f"client_num {client_num} not divisible by mesh "
                          f"axis {n_devices}")
+    n_local_static = client_num // n_devices
+    if (client_chunk and client_chunk < n_local_static
+            and n_local_static % client_chunk):
+        raise ValueError(f"clients/device {n_local_static} not divisible by "
+                         f"client_chunk {client_chunk}")
     k = aggregate_count
 
     def body(params, xs, ys, n_samples, uploader_mask, committee_mask):
         n_local = xs.shape[0]
         my = jax.lax.axis_index(AXIS)
 
-        # 1. local training, vmapped over resident clients
+        # 1. local training over resident clients: vmapped, optionally in
+        #    sequential chunks with rematerialisation
         def train_one(x, y):
             return local_train_impl(apply_fn, params, x, y, lr=lr,
                                     batch_size=batch_size,
                                     local_epochs=local_epochs)
-        deltas_local, costs_local = jax.vmap(train_one)(xs, ys)
+        if remat:
+            train_one = jax.checkpoint(train_one)
+        if client_chunk and client_chunk < n_local:
+            nch = n_local // client_chunk
+
+            def chunk_fn(args):
+                cx, cy = args
+                return jax.vmap(train_one)(cx, cy)
+
+            xs_c = xs.reshape((nch, client_chunk) + xs.shape[1:])
+            ys_c = ys.reshape((nch, client_chunk) + ys.shape[1:])
+            deltas_c, costs_c = jax.lax.map(chunk_fn, (xs_c, ys_c))
+            deltas_local = jax.tree_util.tree_map(
+                lambda t: t.reshape((n_local,) + t.shape[2:]), deltas_c)
+            costs_local = costs_c.reshape((n_local,))
+        else:
+            deltas_local, costs_local = jax.vmap(train_one)(xs, ys)
         deltas_local = _ensure_varying(deltas_local)
 
         # 2. ring committee scoring -> local rows, then gather the tiny
         #    (N, N) matrix everywhere for the replicated decision
         rows = ring_score_matrix(apply_fn, params, deltas_local, lr, xs, ys,
-                                 n_devices)
+                                 n_devices, chunk=client_chunk)
         score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)   # (N, N)
         costs = jax.lax.all_gather(costs_local, AXIS, tiled=True)   # (N,)
 

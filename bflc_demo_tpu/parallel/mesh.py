@@ -22,6 +22,18 @@ def local_device_count() -> int:
     return len(jax.devices())
 
 
+def pvary_compat(x, axis_names: Tuple[str, ...]):
+    """Mark a value device-varying over axes, across jax's pvary->pcast
+    rename (pvary deprecated in 0.9; pcast is its replacement)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        try:
+            return pcast(x, axis_names, to="varying")
+        except TypeError:
+            pass
+    return jax.lax.pvary(x, axis_names)
+
+
 def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a mesh of the given logical shape from the first prod(shape)

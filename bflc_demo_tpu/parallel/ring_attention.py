@@ -1,0 +1,108 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+The long-context mechanism (SURVEY.md directive: "ring attention or
+all-to-all sequence parallelism ... shapes the core design").  Sequence is
+sharded over an "sp" mesh axis; each device holds one block of Q/K/V.  KV
+blocks travel around the ring with `lax.ppermute` while every device
+accumulates its queries' attention over each passing block using streaming
+(flash-style) softmax renormalisation — numerically exact, with peak memory
+one resident + one transit KV block regardless of total sequence length, and
+the ppermute overlapping with the block computation on TPU (ICI DMA runs
+async under XLA latency hiding).
+
+`sp_transformer_forward` runs the pure-JAX transformer (models/transformer)
+with this attention over sequence shards and differential-matches the
+single-device forward bit-for-tolerance (tests/test_ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bflc_demo_tpu.models.transformer import (TransformerConfig, NEG_INF,
+                                              transformer_forward)
+
+Pytree = Any
+SP_AXIS = "sp"
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   kv_mask: jax.Array, axis_name: str = SP_AXIS) -> jax.Array:
+    """Exact attention with KV blocks ring-rotated over `axis_name`.
+
+    Shapes (per device): q/k/v (B, S_blk, H, Dh); kv_mask (B, S_blk) bool
+    marking which resident keys are real (PAD=False).  Returns (B,S_blk,H,Dh)
+    — the attention output for the resident queries over the FULL sequence.
+    """
+    n_dev = jax.lax.axis_size(axis_name)
+    b, s, h, dh = q.shape
+    scale = 1.0 / np.sqrt(dh)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def body(_, carry):
+        acc, m, l, kb, vb, mb = carry
+        logits = (jnp.einsum("bqhd,bkhd->bhqk", q, kb)
+                  .astype(jnp.float32) * scale)
+        logits = jnp.where(mb[:, None, None, :], logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(-1))
+        p = jnp.exp(logits - m_new[..., None])
+        # when every logit seen so far is NEG_INF, exp(NEG_INF - NEG_INF)=1
+        # would resurrect masked keys — zero them explicitly
+        p = jnp.where(mb[:, None, None, :], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        mb = jax.lax.ppermute(mb, axis_name, perm)
+        return acc, m_new, l, kb, vb, mb
+
+    from bflc_demo_tpu.parallel.mesh import pvary_compat
+    acc0 = jnp.zeros((b, h, s, dh), jnp.float32)
+    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    acc0, m0, l0 = jax.tree_util.tree_map(
+        lambda t: pvary_compat(t, (axis_name,)), (acc0, m0, l0))
+    acc, _, l, _, _, _ = jax.lax.fori_loop(
+        0, n_dev, body, (acc0, m0, l0, k, v, kv_mask))
+    out = acc / jnp.maximum(l[..., None], 1e-30)       # fully-PAD query rows
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
+                                ) -> Callable[[Pytree, jax.Array], jax.Array]:
+    """Sequence-parallel classifier forward over the mesh's 'sp' axis.
+
+    tokens: (B, S) with S divisible by the sp axis size; params replicated.
+    Per-token work (embed/LN/MLP) runs on local sequence shards; attention is
+    the ring; the padding-aware mean-pool becomes a masked psum.
+    """
+    n_sp = mesh.shape[SP_AXIS]
+    if cfg.seq_len % n_sp:
+        raise ValueError(f"seq_len {cfg.seq_len} not divisible by sp axis "
+                         f"{n_sp}")
+    s_blk = cfg.seq_len // n_sp
+
+    def body(params, tokens_blk):
+        my = jax.lax.axis_index(SP_AXIS)
+
+        def attn_fn(q, k, v, kv_mask):
+            return ring_attention(q, k, v, kv_mask, SP_AXIS)
+
+        # the SAME forward as single-device, parameterised for this shard
+        return transformer_forward(params, tokens_blk, cfg, attn_fn=attn_fn,
+                                   pos_offset=my * s_blk,
+                                   pool_psum_axis=SP_AXIS)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P(None, SP_AXIS)),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)

@@ -1,0 +1,107 @@
+"""Tensor-parallel execution via GSPMD sharding specs (the "tp" axis).
+
+Megatron-style layout for the pure-JAX transformer (models/transformer.py):
+column-parallel QKV and MLP-in (output features sharded), row-parallel
+attention-out and MLP-out (input features sharded) — so each block needs
+exactly one all-reduce per sublayer, which XLA inserts automatically from the
+sharding constraints (the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA place the collectives).  The embedding shards over the
+vocab axis; LayerNorm/bias/head stay replicated (tiny).
+
+`make_tp_train_step` builds the federated local-SGD step (the same
+core semantics as local_train) jitted with these shardings over a
+("dp", "tp") mesh: batch sharded over dp, weights sharded over tp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bflc_demo_tpu.core.losses import softmax_cross_entropy
+from bflc_demo_tpu.models.transformer import TransformerConfig
+
+Pytree = Any
+
+
+def transformer_partition_specs(params: Pytree, tp_axis: str = "tp") -> Pytree:
+    """PartitionSpec pytree matching init_transformer_params' structure."""
+
+    def block_spec(bp):
+        del bp
+        return {
+            "ln1": {"scale": P(), "bias": P()},
+            "wq": P(None, tp_axis), "wk": P(None, tp_axis),
+            "wv": P(None, tp_axis),          # column-parallel: heads sharded
+            "wo": P(tp_axis, None),          # row-parallel
+            "ln2": {"scale": P(), "bias": P()},
+            "w1": P(None, tp_axis), "b1": P(tp_axis),
+            "w2": P(tp_axis, None), "b2": P(),
+        }
+
+    return {
+        "embed": P(tp_axis, None),           # vocab-sharded
+        "pos": P(),
+        "blocks": tuple(block_spec(bp) for bp in params["blocks"]),
+        "ln_f": {"scale": P(), "bias": P()},
+        "head_w": P(), "head_b": P(),
+    }
+
+
+def shard_transformer_params(params: Pytree, mesh: Mesh,
+                             tp_axis: str = "tp") -> Pytree:
+    specs = transformer_partition_specs(params, tp_axis)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_tp_train_step(mesh: Mesh, apply_fn: Callable, cfg: TransformerConfig,
+                       lr: float, dp_axis: str = "dp", tp_axis: str = "tp",
+                       ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                     Tuple[Pytree, jax.Array]]:
+    """One SGD step with dp-sharded batch and tp-sharded weights.
+
+    Returns step(params, tokens, labels_onehot) -> (new_params, loss).
+    Shardings are expressed as jit in/out_shardings; XLA emits the gradient
+    all-reduces over dp and the activation collectives over tp.
+    """
+    del cfg
+
+    def step(params, tokens, labels):
+        def loss_fn(p):
+            return softmax_cross_entropy(apply_fn(p, tokens), labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree_util.tree_map(
+            lambda w, g: w - lr * g, params, grads)
+        return new_params, loss
+
+    def param_shardings(params):
+        specs = transformer_partition_specs(params, tp_axis)
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def compiled_for(params):
+        ps = param_shardings(params)
+        data = NamedSharding(mesh, P(dp_axis))
+        return jax.jit(step, in_shardings=(ps, data, data),
+                       out_shardings=(ps, NamedSharding(mesh, P())))
+
+    # the returned callable compiles lazily on first use (needs the concrete
+    # params structure for the sharding pytree)
+    cache = {}
+
+    def run(params, tokens, labels):
+        key = jax.tree_util.tree_structure(params)
+        if key not in cache:
+            cache[key] = compiled_for(params)
+        return cache[key](params, tokens, labels)
+
+    return run
