@@ -1,0 +1,60 @@
+"""CLI runner: `python -m bflc_demo_tpu --config config2 --rounds 10 ...`.
+
+The reference's entry point is `python main.py` spawning 21 processes with
+hardcoded constants (main.py:343-358); this runner selects a benchmark
+config, runtime, protocol overrides, tracing and checkpointing from flags.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from bflc_demo_tpu.eval.configs import CONFIGS
+    from bflc_demo_tpu.utils.flags import parse_args
+    from bflc_demo_tpu.utils.tracing import Tracer
+
+    opts, cfg = parse_args(argv)
+    if opts.config not in CONFIGS:
+        print(f"unknown config {opts.config!r}; have {list(CONFIGS)}",
+              file=sys.stderr)
+        return 2
+    preset = CONFIGS[opts.config]
+    tracer = Tracer(enabled=bool(opts.trace_path))
+
+    kw = dict(rounds=opts.rounds, seed=opts.seed, runtime=opts.runtime,
+              ledger_backend=opts.ledger_backend, verbose=opts.verbose)
+    if cfg is not None:
+        kw["cfg"] = cfg
+    if opts.checkpoint_dir and opts.checkpoint_every and \
+            opts.runtime == "mesh":
+        kw["checkpoint_dir"] = opts.checkpoint_dir
+        kw["checkpoint_every"] = opts.checkpoint_every
+    with tracer.span("run", config=opts.config, runtime=opts.runtime):
+        res = preset.build(**kw)
+
+    if opts.checkpoint_dir:
+        from bflc_demo_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(opts.checkpoint_dir, res.final_params, res.ledger,
+                        extra={"config": opts.config,
+                               "rounds": res.rounds_completed})
+        print(f"checkpoint (model + ledger oplog) -> {opts.checkpoint_dir}")
+    if opts.trace_path:
+        tracer.dump_jsonl(opts.trace_path)
+
+    print(json.dumps({
+        "config": opts.config,
+        "rounds": res.rounds_completed,
+        "final_acc": res.final_accuracy,
+        "best_acc": res.best_accuracy(),
+        "wall_time_s": round(res.wall_time_s, 3),
+        "ledger_log_size": res.ledger_log_size,
+        "ledger_log_head": res.ledger_log_head.hex(),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,10 @@ def run_federated_mesh(model: Model,
                        participation: str = "full",
                        client_chunk: int = 0,
                        remat: bool = False,
+                       initial_params=None,
+                       resume_ledger=None,
+                       checkpoint_dir: str = "",
+                       checkpoint_every: int = 0,
                        verbose: bool = False) -> SimulationResult:
     """participation:
     - 'full': every registered client trains each round (the reference's
@@ -113,14 +117,25 @@ def run_federated_mesh(model: Model,
 
     xte, yte = test_set
     sponsor = Sponsor(model, jnp.asarray(xte), jnp.asarray(one_hot(yte, nc)))
-    ledger = make_ledger(cfg, backend=ledger_backend)
     rng = np.random.default_rng(seed)
-    params = model.init_params(init_seed)
-
-    for i in range(n):
-        ledger.register_node(_addr(i))
-    if ledger.epoch != 0:
-        raise RuntimeError(f"FL did not start (epoch={ledger.epoch})")
+    if resume_ledger is not None:
+        # checkpoint/resume: continue from a replayed ledger + saved model —
+        # the reference's "chain restart resumes exactly" property
+        # (SURVEY.md §5 Checkpoint/resume)
+        if initial_params is None:
+            raise ValueError("resume_ledger requires initial_params")
+        ledger = resume_ledger
+        params = initial_params
+        if ledger.epoch < 0:
+            raise RuntimeError("resume ledger has not started FL")
+    else:
+        ledger = make_ledger(cfg, backend=ledger_backend)
+        params = (initial_params if initial_params is not None
+                  else model.init_params(init_seed))
+        for i in range(n):
+            ledger.register_node(_addr(i))
+        if ledger.epoch != 0:
+            raise RuntimeError(f"FL did not start (epoch={ledger.epoch})")
 
     loss_history, round_times = [], []
     t0 = time.perf_counter()
@@ -185,6 +200,11 @@ def run_federated_mesh(model: Model,
         loss_history.append((epoch, ledger.last_global_loss))
         acc = sponsor.observe(epoch, params)
         round_times.append(time.perf_counter() - rt0)
+        if checkpoint_dir and checkpoint_every and \
+                ledger.epoch % checkpoint_every == 0:
+            from bflc_demo_tpu.utils.checkpoint import save_checkpoint
+            save_checkpoint(checkpoint_dir, params, ledger,
+                            extra={"acc": acc})
         if verbose:
             print(f"Epoch: {epoch:03d}, test_acc: {acc:.4f}, "
                   f"global_loss: {ledger.last_global_loss:.5f}")
@@ -198,4 +218,5 @@ def run_federated_mesh(model: Model,
         round_times_s=round_times,
         ledger_log_head=ledger.log_head(),
         ledger_log_size=ledger.log_size(),
-        n_devices=mesh.shape[AXIS])
+        n_devices=mesh.shape[AXIS],
+        ledger=ledger)

@@ -41,6 +41,7 @@ class SimulationResult:
     ledger_log_head: bytes
     ledger_log_size: int
     n_devices: int = 1          # devices the data plane actually used
+    ledger: Any = None          # the live ledger (for checkpointing/inspection)
 
     @property
     def final_accuracy(self) -> float:
@@ -125,4 +126,5 @@ def run_federated(model: Model,
         wall_time_s=time.perf_counter() - t0,
         round_times_s=round_times,
         ledger_log_head=ledger.log_head(),
-        ledger_log_size=ledger.log_size())
+        ledger_log_size=ledger.log_size(),
+        ledger=ledger)

@@ -1,0 +1,249 @@
+"""Threaded concurrent runtime: N real client threads against one ledger.
+
+The reference tests distributed behavior as 21 OS processes with randomized
+polling against a PBFT chain (main.py:343-358; SURVEY.md §4) and relies on
+consensus ordering for safety; its failure story is over-provisioning plus
+epoch guards, and a dead committee member deadlocks the round (SURVEY.md §5).
+
+This runtime is the equivalent under true concurrency, with recovery:
+
+- every client is a thread running the same FLNode state machine as the
+  synchronous simulation; the ledger (wrapped in `LockingLedger`) is the one
+  serialization point — the first-come-K cap, dup and epoch guards are
+  exercised by actual racing uploads, not by construction;
+- event-driven: a shared Condition wakes clients on ledger transitions
+  instead of the reference's uniform(10,30) s polls;
+- a failure detector watches round progress and drives the ledger's
+  recovery ops: `close_round` when trainers die short of the K-cap,
+  `force_aggregate` when committee rows stop arriving — rounds keep
+  completing with whatever arrived (the reference would hang forever);
+- crash injection (`crash_at`) kills chosen clients at chosen epochs to
+  test exactly that.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bflc_demo_tpu.client.runtime import FLNode, ComputePlane, Sponsor
+from bflc_demo_tpu.client.simulation import SimulationResult
+from bflc_demo_tpu.comm.store import UpdateStore
+from bflc_demo_tpu.data.partition import one_hot
+from bflc_demo_tpu.ledger import make_ledger
+from bflc_demo_tpu.models.base import Model
+from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
+from bflc_demo_tpu.utils.tracing import Tracer, NULL_TRACER
+
+
+class LockingLedger:
+    """Serializes every ledger call behind one lock — the consensus point."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._lock = threading.RLock()
+
+    def __getattr__(self, name):
+        # the getattr itself must run under the lock: properties (epoch,
+        # update_count, ...) execute inner-ledger code when evaluated
+        with self._lock:
+            attr = getattr(self._inner, name)
+        if callable(attr):
+            def locked(*a, **kw):
+                with self._lock:
+                    return getattr(self._inner, name)(*a, **kw)
+            return locked
+        return attr
+
+
+class ThreadedFederation:
+    def __init__(self, model: Model,
+                 shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 test_set: Tuple[np.ndarray, np.ndarray],
+                 cfg: ProtocolConfig = DEFAULT_PROTOCOL,
+                 ledger_backend: str = "auto",
+                 crash_at: Optional[Dict[int, int]] = None,
+                 stall_timeout_s: float = 5.0,
+                 init_seed: int = 0,
+                 tracer: Tracer = NULL_TRACER):
+        cfg.validate()
+        self.cfg = cfg
+        self.model = model
+        self.tracer = tracer
+        self.crash_at = crash_at or {}       # client id -> epoch to die at
+        self.stall_timeout_s = stall_timeout_s
+
+        nc = model.num_classes
+        self.nodes = [
+            FLNode(address=f"0x{i:040x}",
+                   x=jnp.asarray(sx), y=jnp.asarray(one_hot(sy, nc)),
+                   model=model, cfg=cfg,
+                   trained_epoch=cfg.initial_trained_epoch)
+            for i, (sx, sy) in enumerate(shards)]
+        xte, yte = test_set
+        self.sponsor = Sponsor(model, jnp.asarray(xte),
+                               jnp.asarray(one_hot(yte, nc)))
+        self.ledger = LockingLedger(make_ledger(cfg, backend=ledger_backend))
+        self.store = UpdateStore()
+        self.plane = ComputePlane(cfg)
+        self.params = model.init_params(init_seed)
+        self._params_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._last_progress = time.monotonic()
+        self._busy = 0                       # clients inside step() right now
+        self._busy_lock = threading.Lock()
+        self._alive = {i: True for i in range(len(self.nodes))}
+        self.loss_history: List[Tuple[int, float]] = []
+        self.recoveries: List[str] = []
+
+    # --- shared-state helpers ---
+    def _get_params(self):
+        with self._params_lock:
+            return self.params
+
+    def _touch(self):
+        self._last_progress = time.monotonic()
+        with self._cv:
+            self._cv.notify_all()
+
+    # --- threads ---
+    def _client_loop(self, idx: int):
+        node = self.nodes[idx]
+        try:
+            while not self._stop.is_set():
+                epoch = self.ledger.epoch
+                if epoch > self.cfg.max_epoch:
+                    return
+                crash_epoch = self.crash_at.get(idx)
+                if crash_epoch is not None and epoch >= crash_epoch:
+                    self.tracer.event("client.crash", client=idx, epoch=epoch)
+                    return                  # simulated hard crash
+                # the busy counter tells the failure detector that someone is
+                # actively working (possibly jit-compiling) — slow != dead
+                with self._busy_lock:
+                    self._busy += 1
+                try:
+                    acted = node.step(self.ledger, self.store,
+                                      self._get_params())
+                finally:
+                    with self._busy_lock:
+                        self._busy -= 1
+                if acted:
+                    self.tracer.charge("ledger.ops")
+                    self._touch()
+                else:
+                    with self._cv:
+                        self._cv.wait(timeout=0.05)
+        finally:
+            self._alive[idx] = False
+
+    def _aggregator_loop(self, rounds: int):
+        completed = 0
+        while completed < rounds and not self._stop.is_set():
+            if self.ledger.aggregate_ready():
+                epoch = self.ledger.epoch
+                with self._params_lock:
+                    new_params = self.plane.maybe_aggregate(
+                        self.ledger, self.store, self.params)
+                    if new_params is not None:
+                        self.params = new_params
+                if new_params is not None:
+                    self.loss_history.append(
+                        (epoch, self.ledger.last_global_loss))
+                    self.sponsor.observe(epoch, new_params)
+                    completed += 1
+                    self._touch()
+                    continue
+            # failure detection: no progress past the stall timeout AND no
+            # client currently inside step() (slow/compiling != dead)
+            stalled_for = time.monotonic() - self._last_progress
+            with self._busy_lock:
+                anyone_busy = self._busy > 0
+            if stalled_for > self.stall_timeout_s and not anyone_busy:
+                self._recover()
+                self._touch()
+            with self._cv:
+                self._cv.wait(timeout=0.05)
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _recover(self):
+        """Drive the ledger's recovery ops for whatever phase is stuck.
+
+        Order: close an under-filled round (dead trainers) -> reseat a dead
+        committee with live clients -> force aggregation over whatever rows
+        exist.  Each is an op in the replicated log, so replicas replaying
+        the log reach the same post-recovery state.
+        """
+        led = self.ledger
+        if led.aggregate_ready():
+            return
+        if 0 < led.update_count < self.cfg.needed_update_count \
+                and not led.round_closed:
+            if led.close_round().name == "OK":
+                self.recoveries.append(f"close_round@{led.epoch}")
+                self.tracer.event("recover.close_round", epoch=led.epoch)
+                return
+        # scoring phase stuck: is the committee dead?
+        committee = set(led.committee())
+        comm_alive = [i for i in range(len(self.nodes))
+                      if self.nodes[i].address in committee
+                      and self._alive.get(i)]
+        if led.update_count > 0 and not comm_alive:
+            # seat live clients as the committee (prefer non-uploaders so
+            # nobody scores their own update; fall back to anyone alive)
+            uploaders = {u.sender for u in led.query_all_updates()}
+            live = [i for i, a in self._alive.items() if a]
+            pool = ([i for i in live
+                     if self.nodes[i].address not in uploaders] or live)
+            seats = [self.nodes[i].address
+                     for i in pool[: self.cfg.comm_count]]
+            if seats and led.reseat_committee(seats).name == "OK":
+                self.recoveries.append(f"reseat@{led.epoch}")
+                self.tracer.event("recover.reseat", epoch=led.epoch,
+                                  seats=len(seats))
+                return
+        if led.score_count > 0:
+            if led.force_aggregate().name == "OK":
+                self.recoveries.append(f"force_aggregate@{led.epoch}")
+                self.tracer.event("recover.force_aggregate", epoch=led.epoch)
+
+    def run(self, rounds: int = 5, timeout_s: float = 300.0,
+            ) -> SimulationResult:
+        t0 = time.perf_counter()
+        for node in self.nodes:
+            node.register(self.ledger)
+        if self.ledger.epoch != 0:
+            raise RuntimeError("registration did not start FL")
+        threads = [threading.Thread(target=self._client_loop, args=(i,),
+                                    daemon=True)
+                   for i in range(len(self.nodes))]
+        agg = threading.Thread(target=self._aggregator_loop, args=(rounds,),
+                               daemon=True)
+        for t in threads:
+            t.start()
+        agg.start()
+        agg.join(timeout=timeout_s)
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in threads:
+            t.join(timeout=5.0)
+        if agg.is_alive():
+            raise RuntimeError("threaded federation timed out")
+        return SimulationResult(
+            accuracy_history=self.sponsor.history,
+            loss_history=self.loss_history,
+            final_params=self._get_params(),
+            rounds_completed=len(self.loss_history),
+            wall_time_s=time.perf_counter() - t0,
+            round_times_s=[],
+            ledger_log_head=self.ledger.log_head(),
+            ledger_log_size=self.ledger.log_size(),
+            ledger=self.ledger)

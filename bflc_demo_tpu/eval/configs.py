@@ -15,7 +15,7 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
-from bflc_demo_tpu.client.simulation import SimulationResult
+from bflc_demo_tpu.client.simulation import SimulationResult, run_federated
 from bflc_demo_tpu.data import (load_occupancy, iid_shards, dirichlet_shards)
 from bflc_demo_tpu.data.synthetic import (
     synthetic_mnist, synthetic_cifar10, synthetic_cifar100,
@@ -33,6 +33,37 @@ class BenchConfig:
     build: Callable[..., SimulationResult]
 
 
+def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
+                     rounds: int = 10, seed: int = 0,
+                     ledger_backend: str = "auto", verbose: bool = False,
+                     **mesh_kw) -> SimulationResult:
+    """Dispatch a federated run to the chosen runtime.
+
+    mesh: device-resident round program (the TPU data plane);
+    host: per-client dispatches, reference-shaped event loop;
+    threaded: true-concurrency thread-per-client with failure recovery.
+    mesh_kw (participation/client_chunk/remat/...) only apply to 'mesh'.
+    """
+    if runtime == "mesh":
+        return run_federated_mesh(model, shards, test_set, cfg,
+                                  rounds=rounds, seed=seed,
+                                  ledger_backend=ledger_backend,
+                                  verbose=verbose, **mesh_kw)
+    if mesh_kw:
+        raise ValueError(f"options {list(mesh_kw)} only apply to the mesh "
+                         f"runtime, not {runtime!r}")
+    if runtime == "host":
+        return run_federated(model, shards, test_set, cfg, rounds=rounds,
+                             seed=seed, ledger_backend=ledger_backend,
+                             verbose=verbose)
+    if runtime == "threaded":
+        from bflc_demo_tpu.client.threaded import ThreadedFederation
+        fed = ThreadedFederation(model, shards, test_set, cfg,
+                                 ledger_backend=ledger_backend)
+        return fed.run(rounds=rounds)
+    raise ValueError(f"runtime must be mesh|host|threaded, got {runtime!r}")
+
+
 def _split(x, y, test_frac=0.2, seed=0):
     rng = np.random.default_rng(seed)
     perm = rng.permutation(len(x))
@@ -42,13 +73,14 @@ def _split(x, y, test_frac=0.2, seed=0):
 
 
 def config1_occupancy(rounds: int = 10, seed: int = 0,
+                      cfg: Optional[ProtocolConfig] = None,
                       **kw) -> SimulationResult:
     """Reference-equivalence run: softmax regression, occupancy, 20 clients."""
-    cfg = ProtocolConfig().validate()
+    cfg = (cfg or ProtocolConfig()).validate()
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(xtr, ytr, cfg.client_num)
-    return run_federated_mesh(make_softmax_regression(), shards, (xte, yte),
-                              cfg, rounds=rounds, seed=seed, **kw)
+    return run_with_runtime(make_softmax_regression(), shards, (xte, yte),
+                            cfg, rounds=rounds, seed=seed, **kw)
 
 
 def config2_lenet_cifar10(rounds: int = 10, seed: int = 0, n_data: int = 6000,
@@ -62,8 +94,8 @@ def config2_lenet_cifar10(rounds: int = 10, seed: int = 0, n_data: int = 6000,
     xtr, ytr, xte, yte = _split(x, y)
     shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=alpha,
                               seed=seed, min_size=cfg.batch_size)
-    return run_federated_mesh(make_lenet5(), shards, (xte, yte), cfg,
-                              rounds=rounds, seed=seed, **kw)
+    return run_with_runtime(make_lenet5(), shards, (xte, yte), cfg,
+                            rounds=rounds, seed=seed, **kw)
 
 
 def config3_femnist_sampled(rounds: int = 10, seed: int = 0,
@@ -80,9 +112,10 @@ def config3_femnist_sampled(rounds: int = 10, seed: int = 0,
     xtr, ytr, xte, yte = _split(x, y)
     shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=1.0,
                               seed=seed, min_size=cfg.batch_size)
-    return run_federated_mesh(make_femnist_cnn(), shards, (xte, yte), cfg,
-                              rounds=rounds, seed=seed,
-                              participation="active", **kw)
+    if kw.get("runtime", "mesh") == "mesh":
+        kw.setdefault("participation", "active")
+    return run_with_runtime(make_femnist_cnn(), shards, (xte, yte), cfg,
+                            rounds=rounds, seed=seed, **kw)
 
 
 def config4_resnet_cifar100(rounds: int = 5, seed: int = 0,
@@ -100,11 +133,12 @@ def config4_resnet_cifar100(rounds: int = 5, seed: int = 0,
     # active participation + chunked/remat training: ResNet-18 x 32 clients
     # on one chip would otherwise exceed HBM (activations scale with
     # clients/device — measured 27G on 16G v5e without these controls)
-    kw.setdefault("participation", "active")
-    kw.setdefault("client_chunk", 4)
-    kw.setdefault("remat", True)
-    return run_federated_mesh(make_resnet18(), shards, (xte, yte), cfg,
-                              rounds=rounds, seed=seed, **kw)
+    if kw.get("runtime", "mesh") == "mesh":
+        kw.setdefault("participation", "active")
+        kw.setdefault("client_chunk", 4)
+        kw.setdefault("remat", True)
+    return run_with_runtime(make_resnet18(), shards, (xte, yte), cfg,
+                            rounds=rounds, seed=seed, **kw)
 
 
 def config5_transformer_sst2(rounds: int = 5, seed: int = 0,
@@ -125,8 +159,8 @@ def config5_transformer_sst2(rounds: int = 5, seed: int = 0,
     model = make_transformer_classifier(vocab_size=1000, seq_len=64,
                                         num_classes=2, dim=128, depth=2,
                                         heads=4)
-    return run_federated_mesh(model, shards, (xte, yte), cfg,
-                              rounds=rounds, seed=seed, **kw)
+    return run_with_runtime(model, shards, (xte, yte), cfg,
+                            rounds=rounds, seed=seed, **kw)
 
 
 CONFIGS: Dict[str, BenchConfig] = {

@@ -47,10 +47,24 @@ def load_library() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(_SO)
-    except OSError:
-        # stale or wrong-arch .so — fall back to the Python mirror
-        _LOAD_FAILED = True
-        return None
+        _declare(lib)
+    except (OSError, AttributeError):
+        # wrong-arch .so, or one built before a symbol was added (stale
+        # checkout artifact) — rebuild once, else fall back to PyLedger
+        try:
+            if _try_build():
+                lib = ctypes.CDLL(_SO)
+                _declare(lib)
+            else:
+                raise OSError("rebuild failed")
+        except (OSError, AttributeError):
+            _LOAD_FAILED = True
+            return None
+    _LIB = lib
+    return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
     i64, i32, f32 = ctypes.c_int64, ctypes.c_int32, ctypes.c_float
     p = ctypes.c_void_p
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -81,6 +95,12 @@ def load_library() -> Optional[ctypes.CDLL]:
     lib.bflc_pending_selected_count.argtypes = [p]
     lib.bflc_commit_model.restype = i32
     lib.bflc_commit_model.argtypes = [p, u8p, i64]
+    for name in ("bflc_close_round", "bflc_force_aggregate",
+                 "bflc_round_closed"):
+        getattr(lib, name).restype = i32
+        getattr(lib, name).argtypes = [p]
+    lib.bflc_reseat_committee.restype = i32
+    lib.bflc_reseat_committee.argtypes = [p, ctypes.c_char_p]
     for name in ("bflc_epoch", "bflc_num_registered", "bflc_update_count",
                  "bflc_score_count", "bflc_log_size"):
         getattr(lib, name).restype = i64
@@ -99,8 +119,6 @@ def load_library() -> Optional[ctypes.CDLL]:
     lib.bflc_apply_op.restype = i32
     lib.bflc_apply_op.argtypes = [p, u8p, i64]
     lib.bflc_sha256.argtypes = [u8p, i64, u8p]
-    _LIB = lib
-    return lib
 
 
 def native_available() -> bool:
@@ -214,6 +232,23 @@ class NativeLedger:
     def commit_model(self, new_model_hash: bytes, epoch: int) -> LedgerStatus:
         return LedgerStatus(self._lib.bflc_commit_model(
             self._h, _digest_buf(new_model_hash), epoch))
+
+    # --- failure-recovery extensions ---
+    def close_round(self) -> LedgerStatus:
+        return LedgerStatus(self._lib.bflc_close_round(self._h))
+
+    def force_aggregate(self) -> LedgerStatus:
+        return LedgerStatus(self._lib.bflc_force_aggregate(self._h))
+
+    def reseat_committee(self, addrs: Sequence[str]) -> LedgerStatus:
+        if any("," in a for a in addrs):
+            return LedgerStatus.BAD_ARG
+        joined = ",".join(addrs).encode()
+        return LedgerStatus(self._lib.bflc_reseat_committee(self._h, joined))
+
+    @property
+    def round_closed(self) -> bool:
+        return bool(self._lib.bflc_round_closed(self._h))
 
     # --- inspection ---
     @property

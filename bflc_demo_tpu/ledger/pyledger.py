@@ -18,6 +18,7 @@ import numpy as np
 from bflc_demo_tpu.ledger.base import LedgerStatus, UpdateInfo, PendingInfo
 
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES, _OP_COMMIT = 1, 2, 3, 4
+_OP_CLOSE, _OP_FORCE, _OP_RESEAT = 5, 6, 7
 
 
 def _put_str(b: bytearray, s: str) -> None:
@@ -45,6 +46,7 @@ class PyLedger:
         self._update_slot: Dict[str, int] = {}
         self._scores: Dict[str, List[float]] = {}
         self._pending: Optional[PendingInfo] = None
+        self._closed = False
         self._ops: List[bytes] = []
         self._log: List[bytes] = []
 
@@ -118,7 +120,7 @@ class PyLedger:
             return LedgerStatus.NOT_COMMITTEE
         if len(scores) != len(self._updates):
             return LedgerStatus.BAD_ARG
-        if len(self._updates) < self.needed_update_count:
+        if len(self._updates) < self.needed_update_count and not self._closed:
             return LedgerStatus.NOT_READY
         # outcome frozen once scoring completed (matches ledger.cpp)
         if self._pending is not None:
@@ -131,9 +133,78 @@ class PyLedger:
         for s in scores:
             op += struct.pack("<f", np.float32(s))
         self._append_log(bytes(op))
-        if len(self._scores) == self.comm_count:
-            self._finish_scoring()
+        self._maybe_fire()
         return LedgerStatus.OK
+
+    def _maybe_fire(self) -> None:
+        """Fire when every CURRENT committee member's row is in (matches
+        ledger.cpp; former members' rows stay in the pool but don't gate)."""
+        comm_now = sum(1 for r in self._roles.values() if r == "comm")
+        present = sum(1 for a in self._scores
+                      if self._roles.get(a) == "comm")
+        if present == comm_now and comm_now > 0:
+            self._finish_scoring()
+
+    def close_round(self) -> LedgerStatus:
+        """Failure-recovery: close an under-filled round so scoring proceeds
+        with the updates present (trainer-failure path; no reference
+        equivalent — the reference just stalls)."""
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if self._closed or self._pending is not None:
+            return LedgerStatus.NOT_READY
+        if len(self._updates) >= self.needed_update_count:
+            return LedgerStatus.NOT_READY
+        if not self._updates:
+            return LedgerStatus.NOT_READY
+        self._closed = True
+        op = bytearray([_OP_CLOSE])
+        op += struct.pack("<q", self._epoch)
+        self._append_log(bytes(op))
+        return LedgerStatus.OK
+
+    def force_aggregate(self) -> LedgerStatus:
+        """Failure-recovery: aggregate with the committee rows present (a
+        dead committee member deadlocks the reference round, SURVEY.md §5)."""
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if self._pending is not None:
+            return LedgerStatus.NOT_READY
+        if not self._scores:
+            return LedgerStatus.NOT_READY
+        op = bytearray([_OP_FORCE])
+        op += struct.pack("<q", self._epoch)
+        self._append_log(bytes(op))
+        self._finish_scoring()
+        return LedgerStatus.OK
+
+    def reseat_committee(self, addrs: Sequence[str]) -> LedgerStatus:
+        """Mid-round committee re-election (dead-committee recovery); no
+        reference equivalent — 'nothing re-elects mid-round' (SURVEY.md §5)."""
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if self._pending is not None:
+            return LedgerStatus.NOT_READY
+        if not addrs or len(addrs) > self.comm_count:
+            return LedgerStatus.BAD_ARG
+        if any(a not in self._roles for a in addrs):
+            return LedgerStatus.BAD_ARG
+        for a in self._roles:
+            self._roles[a] = "trainer"
+        for a in addrs:
+            self._roles[a] = "comm"
+        op = bytearray([_OP_RESEAT])
+        op += struct.pack("<q", self._epoch)
+        op += struct.pack("<q", len(addrs))
+        for a in addrs:
+            _put_str(op, a)
+        self._append_log(bytes(op))
+        self._maybe_fire()
+        return LedgerStatus.OK
+
+    @property
+    def round_closed(self) -> bool:
+        return self._closed
 
     def _finish_scoring(self) -> None:
         k = len(self._updates)
@@ -154,7 +225,7 @@ class PyLedger:
                                     global_loss=float(np.float32(loss)))
 
     def query_all_updates(self) -> List[UpdateInfo]:
-        if len(self._updates) < self.needed_update_count:
+        if len(self._updates) < self.needed_update_count and not self._closed:
             return []
         return list(self._updates)
 
@@ -180,6 +251,7 @@ class PyLedger:
         self._update_slot = {}
         self._scores = {}
         self._pending = None
+        self._closed = False
         self._epoch += 1
         op = bytearray([_OP_COMMIT])
         op += bytes(new_model_hash)
@@ -263,6 +335,29 @@ class PyLedger:
                 payload = body[:32]
                 ep, = struct.unpack_from("<q", body, 32)
                 return self.commit_model(payload, ep)
+            if code == _OP_CLOSE:
+                ep, = struct.unpack_from("<q", body, 0)
+                if ep != self._epoch:
+                    return LedgerStatus.BAD_ARG
+                return self.close_round()
+            if code == _OP_FORCE:
+                ep, = struct.unpack_from("<q", body, 0)
+                if ep != self._epoch:
+                    return LedgerStatus.BAD_ARG
+                return self.force_aggregate()
+            if code == _OP_RESEAT:
+                ep, = struct.unpack_from("<q", body, 0)
+                n, = struct.unpack_from("<q", body, 8)
+                if ep != self._epoch or n <= 0:
+                    return LedgerStatus.BAD_ARG
+                off = 16
+                addrs = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("<q", body, off)
+                    off += 8
+                    addrs.append(body[off:off + ln].decode())
+                    off += ln
+                return self.reseat_committee(addrs)
         except (struct.error, UnicodeDecodeError, IndexError):
             return LedgerStatus.BAD_ARG
         return LedgerStatus.BAD_ARG

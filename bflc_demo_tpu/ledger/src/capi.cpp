@@ -107,6 +107,34 @@ int64_t bflc_pending_selected_count(void* h) {
   return p ? int64_t(p->selected.size()) : -1;
 }
 
+int32_t bflc_close_round(void* h) {
+  return int32_t(static_cast<CommitteeLedger*>(h)->close_round());
+}
+
+int32_t bflc_force_aggregate(void* h) {
+  return int32_t(static_cast<CommitteeLedger*>(h)->force_aggregate());
+}
+
+int32_t bflc_round_closed(void* h) {
+  return static_cast<CommitteeLedger*>(h)->round_closed() ? 1 : 0;
+}
+
+// addrs as a comma-joined list (addresses are hex strings, comma-free)
+int32_t bflc_reseat_committee(void* h, const char* addrs_csv) {
+  std::vector<std::string> addrs;
+  std::string cur;
+  for (const char* p = addrs_csv; *p; ++p) {
+    if (*p == ',') {
+      if (!cur.empty()) addrs.push_back(cur);
+      cur.clear();
+    } else {
+      cur.push_back(*p);
+    }
+  }
+  if (!cur.empty()) addrs.push_back(cur);
+  return int32_t(static_cast<CommitteeLedger*>(h)->reseat_committee(addrs));
+}
+
 int32_t bflc_commit_model(void* h, const uint8_t* hash32, int64_t epoch) {
   Digest d;
   std::memcpy(d.data(), hash32, 32);

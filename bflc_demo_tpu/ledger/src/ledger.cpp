@@ -9,7 +9,8 @@ namespace {
 
 // op codes for the serialized log
 enum OpCode : uint8_t { OP_REGISTER = 1, OP_UPLOAD = 2, OP_SCORES = 3,
-                        OP_COMMIT = 4 };
+                        OP_COMMIT = 4, OP_CLOSE = 5, OP_FORCE = 6,
+                        OP_RESEAT = 7 };
 
 void put_i64(std::vector<uint8_t>& b, int64_t v) {
   for (int i = 0; i < 8; ++i) b.push_back(uint8_t(uint64_t(v) >> (8 * i)));
@@ -183,7 +184,7 @@ Status CommitteeLedger::upload_scores(const std::string& sender, int64_t epoch,
   if (it == roles_.end() || it->second != Role::COMMITTEE)
     return Status::NOT_COMMITTEE;                            // .cpp:272-275
   if (len != updates_.size()) return Status::BAD_ARG;
-  if (int64_t(updates_.size()) < cfg_.needed_update_count)
+  if (int64_t(updates_.size()) < cfg_.needed_update_count && !closed_)
     return Status::NOT_READY;  // scoring starts once the round is full
   // once the committee is complete the outcome is frozen until commit — a
   // late re-score must not mutate the selection the compute plane is applying
@@ -197,7 +198,18 @@ Status CommitteeLedger::upload_scores(const std::string& sender, int64_t epoch,
   put_i64(op, int64_t(len));
   for (size_t i = 0; i < len; ++i) put_f32(op, scores[i]);
   append_log(op);
-  if (int64_t(scores_.size()) == cfg_.comm_count) finish_scoring();
+  // fire when every CURRENT committee member's row is in (committee size
+  // equals comm_count normally; smaller after a partial-round election or a
+  // mid-round reseat — former members' rows stay in the pool but don't
+  // gate completion)
+  int64_t comm_now = 0, present = 0;
+  for (const auto& kv : roles_)
+    if (kv.second == Role::COMMITTEE) ++comm_now;
+  for (const auto& kv : scores_) {
+    auto it = roles_.find(kv.first);
+    if (it != roles_.end() && it->second == Role::COMMITTEE) ++present;
+  }
+  if (present == comm_now && comm_now > 0) finish_scoring();
   return Status::OK;
 }
 
@@ -223,8 +235,60 @@ void CommitteeLedger::finish_scoring() {
 }
 
 std::vector<UpdateRecord> CommitteeLedger::query_all_updates() const {
-  if (int64_t(updates_.size()) < cfg_.needed_update_count) return {};
-  return updates_;  // gate per .cpp:304-311
+  if (int64_t(updates_.size()) < cfg_.needed_update_count && !closed_)
+    return {};
+  return updates_;  // gate per .cpp:304-311 (or round closed early)
+}
+
+Status CommitteeLedger::close_round() {
+  if (epoch_ == cfg_.genesis_epoch) return Status::NOT_STARTED;
+  if (closed_ || pending_) return Status::NOT_READY;
+  if (int64_t(updates_.size()) >= cfg_.needed_update_count)
+    return Status::NOT_READY;          // full rounds don't need closing
+  if (updates_.empty()) return Status::NOT_READY;
+  closed_ = true;
+  std::vector<uint8_t> op{OP_CLOSE};
+  put_i64(op, epoch_);
+  append_log(op);
+  return Status::OK;
+}
+
+Status CommitteeLedger::reseat_committee(
+    const std::vector<std::string>& addrs) {
+  if (epoch_ == cfg_.genesis_epoch) return Status::NOT_STARTED;
+  if (pending_) return Status::NOT_READY;
+  if (addrs.empty() || int64_t(addrs.size()) > cfg_.comm_count)
+    return Status::BAD_ARG;
+  for (const auto& a : addrs)
+    if (!roles_.count(a)) return Status::BAD_ARG;
+  for (auto& kv : roles_) kv.second = Role::TRAINER;
+  for (const auto& a : addrs) roles_[a] = Role::COMMITTEE;
+  std::vector<uint8_t> op{OP_RESEAT};
+  put_i64(op, epoch_);
+  put_i64(op, int64_t(addrs.size()));
+  for (const auto& a : addrs) put_str(op, a);
+  append_log(op);
+  // rows already present may now complete the (new, possibly smaller)
+  // committee — check the firing condition immediately
+  int64_t comm_now = int64_t(addrs.size());
+  int64_t present = 0;
+  for (const auto& kv : scores_) {
+    auto it = roles_.find(kv.first);
+    if (it != roles_.end() && it->second == Role::COMMITTEE) ++present;
+  }
+  if (present == comm_now && present > 0) finish_scoring();
+  return Status::OK;
+}
+
+Status CommitteeLedger::force_aggregate() {
+  if (epoch_ == cfg_.genesis_epoch) return Status::NOT_STARTED;
+  if (pending_) return Status::NOT_READY;
+  if (scores_.empty()) return Status::NOT_READY;
+  std::vector<uint8_t> op{OP_FORCE};
+  put_i64(op, epoch_);
+  append_log(op);
+  finish_scoring();
+  return Status::OK;
 }
 
 Status CommitteeLedger::commit_model(const Digest& new_model_hash,
@@ -247,6 +311,7 @@ Status CommitteeLedger::commit_model(const Digest& new_model_hash,
   update_slot_.clear();
   scores_.clear();
   pending_.reset();
+  closed_ = false;
   epoch_ += 1;
   std::vector<uint8_t> op{OP_COMMIT};
   put_digest(op, new_model_hash);
@@ -298,6 +363,25 @@ Status CommitteeLedger::apply_serialized(const std::vector<uint8_t>& op) {
       int64_t ep = r.i64();
       if (!r.ok) return Status::BAD_ARG;
       return commit_model(d, ep);
+    }
+    case OP_CLOSE: {
+      int64_t ep = r.i64();
+      if (!r.ok || ep != epoch_) return Status::BAD_ARG;
+      return close_round();
+    }
+    case OP_FORCE: {
+      int64_t ep = r.i64();
+      if (!r.ok || ep != epoch_) return Status::BAD_ARG;
+      return force_aggregate();
+    }
+    case OP_RESEAT: {
+      int64_t ep = r.i64();
+      int64_t n = r.i64();
+      if (!r.ok || ep != epoch_ || n <= 0) return Status::BAD_ARG;
+      std::vector<std::string> addrs;
+      for (int64_t i = 0; i < n; ++i) addrs.push_back(r.str());
+      if (!r.ok) return Status::BAD_ARG;
+      return reseat_committee(addrs);
     }
     default:
       return Status::BAD_ARG;

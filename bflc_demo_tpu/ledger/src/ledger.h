@@ -96,8 +96,25 @@ class CommitteeLedger {
   // current update_count.
   Status upload_scores(const std::string& sender, int64_t epoch,
                        const float* scores, size_t len);
-  // empty until update_count >= needed_update_count (.cpp:304-311).
+  // empty until update_count >= needed_update_count (.cpp:304-311) or the
+  // round was closed early by close_round().
   std::vector<UpdateRecord> query_all_updates() const;
+
+  // --- failure-recovery extensions (no reference equivalent: a dead
+  // committee member deadlocks the reference round, SURVEY.md §5) ---
+  // Close an under-filled round so scoring can proceed with the updates
+  // present (trainer-failure path).  Requires >= aggregate-worthy updates.
+  Status close_round();
+  // Fire aggregation with the committee rows present (dead-committee path).
+  // Requires at least one score row.
+  Status force_aggregate();
+  // Mid-round committee re-election: seat `addrs` (registered clients) as
+  // the committee so a round whose committee died entirely can still be
+  // scored.  Rows already uploaded by former members stay valid.  The
+  // reference has no equivalent — "nothing re-elects mid-round"
+  // (SURVEY.md §5).
+  Status reseat_committee(const std::vector<std::string>& addrs);
+  bool round_closed() const { return closed_; }
 
   // --- aggregation handshake with the compute plane ---
   bool aggregate_ready() const { return pending_.has_value(); }
@@ -143,6 +160,7 @@ class CommitteeLedger {
   std::unordered_map<std::string, size_t> update_slot_;  // sender -> slot
   std::map<std::string, std::vector<float>> scores_;     // scorer -> slot scores
   std::optional<PendingAggregate> pending_;
+  bool closed_ = false;                            // round closed early
 
   std::vector<std::vector<uint8_t>> ops_;  // serialized accepted mutations
   std::vector<Digest> log_;                // chained digests, log_[i] covers ops_[0..i]

@@ -17,7 +17,6 @@ single-device forward bit-for-tolerance (tests/test_ring_attention.py).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

@@ -15,7 +15,6 @@ core semantics as local_train) jitted with these shardings over a
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Tuple
 
 import jax

@@ -1,0 +1,109 @@
+"""Checkpoint / resume: the op log IS the checkpoint.
+
+Reference semantics (SURVEY.md §5): "the blockchain is the checkpoint" — all
+FL state lives in the replicated chain table (CommitteePrecompiled.cpp:
+321-346); a chain restart resumes exactly; clients self-heal from QueryState.
+The TPU-native equivalent persists two artifacts:
+
+- `ledger.oplog`: the serialized accepted-op stream + head digest.  Replaying
+  it into a fresh ledger reconstructs epoch, roles, committee, counters —
+  and re-verifies the hash chain (tamper-evident resume).
+- `model.bflct`: the global model pytree in the canonical binary codec
+  (utils/serialization.pack_pytree — no JSON, no pickle).
+
+`save_checkpoint` / `load_checkpoint` are runtime-agnostic: both the host and
+mesh runtimes call them between rounds; a restarted run resumes at the exact
+epoch with the exact committee, like the reference's chain restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import pack_pytree, unpack_pytree
+
+Pytree = Any
+
+_OPLOG_MAGIC = b"BFLCLOG1"
+
+
+def save_checkpoint(directory: str, params: Pytree, ledger,
+                    extra: Optional[Dict] = None) -> None:
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "model.bflct"), "wb") as f:
+        f.write(pack_pytree(params))
+    with open(os.path.join(directory, "ledger.oplog"), "wb") as f:
+        f.write(_OPLOG_MAGIC)
+        n = ledger.log_size()
+        f.write(struct.pack("<q", n))
+        for i in range(n):
+            op = ledger.log_op(i)
+            f.write(struct.pack("<q", len(op)))
+            f.write(op)
+        f.write(ledger.log_head())
+    meta = {"epoch": ledger.epoch, "log_size": ledger.log_size(),
+            "log_head": ledger.log_head().hex(), **(extra or {})}
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_checkpoint(directory: str, cfg: ProtocolConfig,
+                    ledger_backend: str = "auto",
+                    ) -> Tuple[Dict[str, np.ndarray], Any, Dict]:
+    """Returns (flat params {path: array}, replayed ledger, meta).
+
+    The ledger is rebuilt by replaying the op stream; the recorded head
+    digest must match the replayed one or the checkpoint is rejected
+    (tamper/corruption evidence).
+    """
+    with open(os.path.join(directory, "model.bflct"), "rb") as f:
+        flat_params = unpack_pytree(f.read())
+    with open(os.path.join(directory, "ledger.oplog"), "rb") as f:
+        blob = f.read()
+    if not blob.startswith(_OPLOG_MAGIC):
+        raise ValueError("not a bflc ledger oplog")
+    off = len(_OPLOG_MAGIC)
+    (n,) = struct.unpack_from("<q", blob, off)
+    off += 8
+    ledger = make_ledger(cfg, backend=ledger_backend)
+    for _ in range(n):
+        (sz,) = struct.unpack_from("<q", blob, off)
+        off += 8
+        op = blob[off:off + sz]
+        off += sz
+        st = ledger.apply_op(op)
+        if st != LedgerStatus.OK:
+            raise ValueError(f"oplog replay rejected an op: {st.name}")
+    recorded_head = blob[off:off + 32]
+    if ledger.log_head() != recorded_head:
+        raise ValueError("oplog head mismatch after replay — corrupt or "
+                         "tampered checkpoint")
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    return flat_params, ledger, meta
+
+
+def restore_params_like(template: Pytree,
+                        flat: Dict[str, np.ndarray]) -> Pytree:
+    """Pour flat {path: array} values into a template pytree's structure."""
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(template)[0]
+    treedef = jax.tree_util.tree_structure(template)
+    values = []
+    for path, leaf in leaves_with_path:
+        key = jax.tree_util.keystr(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"shape mismatch for {key}: "
+                             f"{arr.shape} vs {np.shape(leaf)}")
+        values.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, values)

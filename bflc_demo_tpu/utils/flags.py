@@ -1,0 +1,84 @@
+"""Runtime configuration: one typed surface instead of three unchecked ones.
+
+The reference's configuration is (1) C++ #defines requiring a blockchain-node
+recompile (CommitteePrecompiled.h:4-19), (2) Python module constants
+(main.py:52-69), (3) the SDK's client_config.py — duplicated and unchecked
+(SURVEY.md §5 "Config / flag system").  Here every knob flows through
+`ProtocolConfig` + `RunOptions`, buildable from env vars (BFLC_*) and/or
+argparse, validated once, passed everywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Optional
+
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+_ENV_PREFIX = "BFLC_"
+
+_PROTOCOL_FIELDS = {f.name: f.type for f in
+                    dataclasses.fields(ProtocolConfig)}
+
+
+@dataclasses.dataclass
+class RunOptions:
+    config: str = "config1"          # eval.configs preset name
+    rounds: int = 10
+    runtime: str = "mesh"            # mesh | host | threaded
+    ledger_backend: str = "auto"     # auto | native | python
+    seed: int = 0
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0        # rounds between checkpoints; 0 = off
+    trace_path: str = ""
+    verbose: bool = True
+
+
+def protocol_from_env(base: Optional[ProtocolConfig] = None) -> ProtocolConfig:
+    """Override ProtocolConfig fields via BFLC_<FIELD>=value env vars."""
+    values = dataclasses.asdict(base or ProtocolConfig())
+    for name in values:
+        raw = os.environ.get(_ENV_PREFIX + name.upper())
+        if raw is None:
+            continue
+        current = values[name]
+        values[name] = type(current)(float(raw) if isinstance(current, float)
+                                     else int(raw))
+    return ProtocolConfig(**values).validate()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bflc_demo_tpu",
+        description="TPU-native committee-consensus federated learning")
+    for f in dataclasses.fields(RunOptions):
+        flag = "--" + f.name.replace("_", "-")
+        if f.type == "bool" or isinstance(f.default, bool):
+            p.add_argument(flag, action=argparse.BooleanOptionalAction,
+                           default=f.default)
+        else:
+            p.add_argument(flag, type=type(f.default), default=f.default)
+    for name, default in dataclasses.asdict(ProtocolConfig()).items():
+        p.add_argument("--" + name.replace("_", "-"),
+                       type=type(default), default=None,
+                       help=f"protocol: {name} (default {default})")
+    return p
+
+
+def parse_args(argv=None):
+    """Returns (RunOptions, ProtocolConfig|None).  CLI protocol overrides
+    beat env overrides; None protocol means 'use the preset's default'."""
+    ns = build_parser().parse_args(argv)
+    opts = RunOptions(**{f.name: getattr(ns, f.name)
+                         for f in dataclasses.fields(RunOptions)})
+    overrides = {name: getattr(ns, name)
+                 for name in _PROTOCOL_FIELDS
+                 if getattr(ns, name, None) is not None}
+    env_base = protocol_from_env()
+    if overrides or env_base != ProtocolConfig():
+        cfg = dataclasses.replace(env_base, **overrides).validate()
+    else:
+        cfg = None
+    return opts, cfg

@@ -1,0 +1,85 @@
+"""Structured tracing + per-op cost accounting.
+
+The reference's observability is three print streams (SURVEY.md §5): contract
+clog lines gated by an OUTPUT macro (CommitteePrecompiled.h:4, .cpp:240-293,
+422-425), client prints (main.py:97-241), and the sponsor accuracy line — and
+its only cost model is blockchain gas metering per storage op
+(callResult->gasPricer(), .cpp:143-504).  Here both become first-class:
+
+- `Tracer`: hierarchical timed spans + typed events, in-memory, exportable
+  as JSON lines; zero overhead when disabled (the default NULL_TRACER's
+  methods are no-ops).
+- cost accounting: every span/event can carry a cost dict (ledger ops,
+  device dispatches, host<->device bytes) aggregated per category — the
+  gas-pricer idea mapped to what actually costs money on TPU: dispatches
+  and bytes over the host boundary.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Tracer:
+    """Hierarchical span/event tracer with cost counters."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.events: List[Dict[str, Any]] = []
+        self.costs: Dict[str, float] = defaultdict(float)
+        self._stack: List[str] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        path = "/".join(self._stack + [name])
+        self._stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._stack.pop()
+            self.events.append({
+                "type": "span", "name": path,
+                "dur_s": time.perf_counter() - t0, **attrs})
+
+    def event(self, name: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        path = "/".join(self._stack + [name])
+        self.events.append({"type": "event", "name": path,
+                            "t": time.perf_counter(), **attrs})
+
+    def charge(self, category: str, amount: float = 1.0) -> None:
+        """Cost accounting — the gasPricer equivalent.  Categories in use:
+        'ledger.ops', 'device.dispatches', 'host_bytes.in', 'host_bytes.out',
+        'train.samples'."""
+        if self.enabled:
+            self.costs[category] += amount
+
+    # --- reporting ---
+    def span_totals(self) -> Dict[str, float]:
+        out: Dict[str, float] = defaultdict(float)
+        for e in self.events:
+            if e["type"] == "span":
+                out[e["name"]] += e["dur_s"]
+        return dict(out)
+
+    def summary(self) -> Dict[str, Any]:
+        return {"spans": self.span_totals(), "costs": dict(self.costs),
+                "n_events": len(self.events)}
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for e in self.events:
+                f.write(json.dumps(e) + "\n")
+            f.write(json.dumps({"type": "summary", **self.summary()}) + "\n")
+
+
+NULL_TRACER = Tracer(enabled=False)

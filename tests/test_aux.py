@@ -1,0 +1,303 @@
+"""Aux subsystem tests: tracing/cost accounting, checkpoint/resume,
+failure recovery (threaded runtime + ledger recovery ops), config system."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.data import load_occupancy, iid_shards
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.models import make_softmax_regression
+from bflc_demo_tpu.protocol import ProtocolConfig, DEFAULT_PROTOCOL
+from bflc_demo_tpu.utils.tracing import Tracer
+from bflc_demo_tpu.utils.flags import parse_args, protocol_from_env
+
+SMALL = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=2,
+                       needed_update_count=3, learning_rate=0.001,
+                       batch_size=50, local_epochs=1)
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    xtr, ytr, xte, yte = load_occupancy()
+    return iid_shards(xtr[:2000], ytr[:2000], SMALL.client_num), \
+        (xte[:500], yte[:500])
+
+
+class TestTracing:
+    def test_spans_events_costs(self, tmp_path):
+        tr = Tracer()
+        with tr.span("round", epoch=1):
+            with tr.span("train"):
+                tr.charge("train.samples", 300)
+            tr.event("upload", client=3)
+            tr.charge("ledger.ops")
+        s = tr.summary()
+        assert "round" in s["spans"] and "round/train" in s["spans"]
+        assert s["costs"] == {"train.samples": 300.0, "ledger.ops": 1.0}
+        out = tmp_path / "trace.jsonl"
+        tr.dump_jsonl(str(out))
+        assert out.read_text().count("\n") == 4   # 2 spans + 1 event + summary
+
+    def test_disabled_is_noop(self):
+        tr = Tracer(enabled=False)
+        with tr.span("x"):
+            tr.charge("c")
+        assert tr.events == [] and tr.costs == {}
+
+
+class TestCheckpointResume:
+    def test_roundtrip_and_resume(self, tmp_path, small_data):
+        from bflc_demo_tpu.client import run_federated_mesh
+        from bflc_demo_tpu.utils.checkpoint import (
+            save_checkpoint, load_checkpoint, restore_params_like)
+        shards, test_set = small_data
+        model = make_softmax_regression()
+        r1 = run_federated_mesh(model, shards, test_set, SMALL, rounds=3,
+                                seed=0)
+        ckpt = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt, r1.final_params, r1.ledger)
+
+        flat, ledger, meta = load_checkpoint(ckpt, SMALL)
+        assert meta["epoch"] == 3
+        assert ledger.epoch == 3
+        assert ledger.log_head() == r1.ledger_log_head
+        assert sorted(ledger.committee()) == sorted(r1.ledger.committee())
+        params = restore_params_like(model.init_params(0), flat)
+        np.testing.assert_array_equal(np.asarray(params["W"]),
+                                      np.asarray(r1.final_params["W"]))
+        # resume for 2 more rounds from the restored state
+        r2 = run_federated_mesh(model, shards, test_set, SMALL, rounds=2,
+                                seed=1, initial_params=params,
+                                resume_ledger=ledger)
+        assert r2.ledger.epoch == 5
+        assert all(np.isfinite(a) for _, a in r2.accuracy_history)
+
+    def test_tampered_oplog_rejected(self, tmp_path, small_data):
+        from bflc_demo_tpu.client import run_federated_mesh
+        from bflc_demo_tpu.utils.checkpoint import (save_checkpoint,
+                                                    load_checkpoint)
+        shards, test_set = small_data
+        r = run_federated_mesh(make_softmax_regression(), shards, test_set,
+                               SMALL, rounds=1, seed=0)
+        ckpt = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt, r.final_params, r.ledger)
+        path = os.path.join(ckpt, "ledger.oplog")
+        blob = bytearray(open(path, "rb").read())
+        blob[40] ^= 0xFF          # flip a byte inside the first op
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(ValueError):
+            load_checkpoint(ckpt, SMALL)
+
+
+class TestLedgerRecoveryOps:
+    def _start(self):
+        led = make_ledger(SMALL, backend="python")
+        for i in range(SMALL.client_num):
+            led.register_node(f"0x{i:03x}")
+        return led
+
+    def test_close_round_allows_partial_scoring(self):
+        led = self._start()
+        # only 2 of the needed 3 updates arrive (trainer died)
+        for i in (2, 3):
+            led.upload_local_update(f"0x{i:03x}", b"\1" * 32, 100, 1.0, 0)
+        assert led.query_all_updates() == []
+        assert led.close_round() == LedgerStatus.OK
+        assert len(led.query_all_updates()) == 2
+        for c in led.committee():
+            assert led.upload_scores(c, 0, [0.5, 0.7]) == LedgerStatus.OK
+        assert led.aggregate_ready()
+        assert led.commit_model(b"\2" * 32, 0) == LedgerStatus.OK
+        assert led.epoch == 1
+        assert led.verify_log()
+
+    def test_close_round_guards(self):
+        led = self._start()
+        assert led.close_round() == LedgerStatus.NOT_READY  # no updates
+        for i in (2, 3, 4):
+            led.upload_local_update(f"0x{i:03x}", b"\1" * 32, 100, 1.0, 0)
+        assert led.close_round() == LedgerStatus.NOT_READY  # round is full
+
+    def test_force_aggregate_with_missing_committee_row(self):
+        led = self._start()
+        for i in (2, 3, 4):
+            led.upload_local_update(f"0x{i:03x}", b"\1" * 32, 100, 1.0, 0)
+        comm = led.committee()
+        led.upload_scores(comm[0], 0, [0.9, 0.1, 0.5])  # second member dead
+        assert not led.aggregate_ready()
+        assert led.force_aggregate() == LedgerStatus.OK
+        assert led.aggregate_ready()
+        # medians over the single present row
+        np.testing.assert_allclose(led.pending().medians, [0.9, 0.1, 0.5])
+        assert led.commit_model(b"\2" * 32, 0) == LedgerStatus.OK
+
+    def test_reseat_committee(self):
+        """Mid-round re-election: dead committee replaced by live clients;
+        scoring completes with the new (possibly smaller) committee."""
+        led = self._start()
+        for i in (2, 3, 4):
+            led.upload_local_update(f"0x{i:03x}", b"\1" * 32, 100, 1.0, 0)
+        # whole committee (0x000, 0x001) presumed dead -> reseat 5 and 6
+        st = led.reseat_committee(["0x005", "0x006"])
+        assert st == LedgerStatus.OK
+        assert set(led.committee()) == {"0x005", "0x006"}
+        assert led.upload_scores("0x005", 0, [0.9, 0.2, 0.5]) == \
+            LedgerStatus.OK
+        assert not led.aggregate_ready()
+        assert led.upload_scores("0x006", 0, [0.8, 0.4, 0.6]) == \
+            LedgerStatus.OK
+        assert led.aggregate_ready()      # fires at the NEW committee size
+        assert led.commit_model(b"\2" * 32, 0) == LedgerStatus.OK
+
+    def test_reseat_guards(self):
+        led = self._start()
+        assert led.reseat_committee([]) == LedgerStatus.BAD_ARG
+        assert led.reseat_committee(["0xdead"]) == LedgerStatus.BAD_ARG
+        assert led.reseat_committee(
+            [f"0x{i:03x}" for i in range(3)]) == LedgerStatus.BAD_ARG  # > comm
+
+    def test_recovery_ops_replay(self):
+        led = self._start()
+        for i in (2, 3):
+            led.upload_local_update(f"0x{i:03x}", b"\1" * 32, 100, 1.0, 0)
+        led.close_round()
+        led.upload_scores(led.committee()[0], 0, [0.5, 0.7])
+        led.force_aggregate()
+        led.commit_model(b"\4" * 32, 0)
+        replica = make_ledger(SMALL, backend="python")
+        for i in range(led.log_size()):
+            assert replica.apply_op(led.log_op(i)) == LedgerStatus.OK
+        assert replica.log_head() == led.log_head()
+        assert replica.epoch == 1
+
+
+class TestThreadedRuntime:
+    def test_clean_concurrent_run(self, small_data):
+        from bflc_demo_tpu.client.threaded import ThreadedFederation
+        shards, test_set = small_data
+        fed = ThreadedFederation(make_softmax_regression(), shards, test_set,
+                                 SMALL, stall_timeout_s=3.0)
+        res = fed.run(rounds=3, timeout_s=120)
+        assert res.rounds_completed == 3
+        assert res.ledger.verify_log()
+        # epochs strictly monotonic in the loss history
+        epochs = [e for e, _ in res.loss_history]
+        assert epochs == sorted(set(epochs))
+
+    def test_trainer_crashes_recovered(self, small_data):
+        """Kill most trainers at epoch 1: rounds keep completing via
+        close_round (the reference would stall, SURVEY.md §5)."""
+        from bflc_demo_tpu.client.threaded import ThreadedFederation
+        shards, test_set = small_data
+        crash = {i: 1 for i in range(2, 7)}     # 5 of 8 clients die
+        fed = ThreadedFederation(make_softmax_regression(), shards, test_set,
+                                 SMALL, crash_at=crash, stall_timeout_s=0.75)
+        res = fed.run(rounds=3, timeout_s=180)
+        assert res.rounds_completed == 3
+        # which recovery fires depends on whether the dead five include the
+        # round-1 committee (reseat) or only trainers (close_round) — either
+        # way the run must have recovered rather than stalled
+        assert fed.recoveries, "expected at least one recovery action"
+
+    def test_committee_crash_recovered(self, small_data):
+        """Kill a committee member mid-protocol: force_aggregate unblocks."""
+        from bflc_demo_tpu.client.threaded import ThreadedFederation
+        shards, test_set = small_data
+        # genesis committee = clients 0,1 (registration order); kill 1 at ep 0
+        fed = ThreadedFederation(make_softmax_regression(), shards, test_set,
+                                 SMALL, crash_at={1: 0}, stall_timeout_s=0.75)
+        res = fed.run(rounds=2, timeout_s=180)
+        assert res.rounds_completed == 2
+        assert any(r.startswith("force_aggregate") for r in fed.recoveries), \
+            fed.recoveries
+
+    def test_whole_committee_dead_reseated(self, small_data):
+        """Kill the ENTIRE genesis committee before it can score: the
+        detector reseats live clients mid-round and training continues —
+        the exact case that deadlocks the reference forever (SURVEY.md §5:
+        'a dead committee member deadlocks the round; nothing re-elects
+        mid-round')."""
+        from bflc_demo_tpu.client.threaded import ThreadedFederation
+        shards, test_set = small_data
+        fed = ThreadedFederation(make_softmax_regression(), shards, test_set,
+                                 SMALL, crash_at={0: 0, 1: 0},
+                                 stall_timeout_s=0.75)
+        res = fed.run(rounds=2, timeout_s=180)
+        assert res.rounds_completed == 2
+        assert any(r.startswith("reseat") for r in fed.recoveries), \
+            fed.recoveries
+        assert res.ledger.verify_log()
+
+
+class TestConcurrencyInvariants:
+    @pytest.mark.parametrize("backend", ["python", "native"])
+    def test_upload_storm_respects_guards(self, backend):
+        """64 threads racing uploads: exactly needed_update_count accepted,
+        no duplicate slots, log intact — the protocol invariants of
+        .cpp:225-244 under true concurrency (the reference gets this from
+        PBFT ordering; we get it from the ledger serialization point)."""
+        import threading
+        from bflc_demo_tpu.client.threaded import LockingLedger
+        from bflc_demo_tpu.ledger import bindings
+        if backend == "native" and not bindings.native_available():
+            pytest.skip("native ledger unavailable")
+        cfg = ProtocolConfig(client_num=64, comm_count=4, aggregate_count=6,
+                             needed_update_count=10)
+        led = LockingLedger(make_ledger(cfg, backend=backend))
+        for i in range(64):
+            led.register_node(f"0x{i:03x}")
+        results = {}
+
+        def upload(i):
+            st = led.upload_local_update(f"0x{i:03x}", bytes([i]) * 32,
+                                         100 + i, 1.0, 0)
+            results[i] = st
+            # racing duplicate from the same sender
+            results[(i, "dup")] = led.upload_local_update(
+                f"0x{i:03x}", bytes([i]) * 32, 100 + i, 1.0, 0)
+
+        threads = [threading.Thread(target=upload, args=(i,))
+                   for i in range(4, 64)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        accepted = [i for i in range(4, 64)
+                    if results[i] == LedgerStatus.OK]
+        assert len(accepted) == cfg.needed_update_count
+        assert led.update_count == cfg.needed_update_count
+        # a sender's second call never succeeds (dup or cap)
+        assert all(results[(i, "dup")] != LedgerStatus.OK
+                   for i in range(4, 64))
+        # accepted senders' dups were rejected as DUPLICATE specifically
+        assert all(results[(i, "dup")] == LedgerStatus.DUPLICATE
+                   for i in accepted)
+        assert led.verify_log()
+
+
+class TestFlags:
+    def test_parse_defaults(self):
+        opts, cfg = parse_args([])
+        assert opts.config == "config1" and opts.runtime == "mesh"
+        assert cfg is None        # no overrides -> preset default
+
+    def test_protocol_overrides(self):
+        opts, cfg = parse_args(["--config", "config2", "--rounds", "3",
+                                "--comm-count", "2", "--client-num", "10",
+                                "--needed-update-count", "5",
+                                "--aggregate-count", "3"])
+        assert opts.rounds == 3
+        assert cfg.comm_count == 2 and cfg.client_num == 10
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("BFLC_COMM_COUNT", "3")
+        monkeypatch.setenv("BFLC_LEARNING_RATE", "0.01")
+        cfg = protocol_from_env()
+        assert cfg.comm_count == 3
+        assert abs(cfg.learning_rate - 0.01) < 1e-12
+
+    def test_invalid_override_rejected(self):
+        with pytest.raises(ValueError):
+            parse_args(["--comm-count", "50"])
