@@ -43,6 +43,13 @@ class TransformerConfig:
     heads: int = 4
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
+    # attention core: "einsum" (XLA path), "pallas" (flash kernel, TPU),
+    # "pallas_interpret" (kernel in interpreter mode, CPU tests).  Part of
+    # the config — NOT an env read at trace time — so the choice is visible
+    # in the jit cache key and cannot be silently latched.  Note: the pallas
+    # kernel has no SPMD partitioning rule; use "einsum" for models that run
+    # under tensor-parallel sharding (parallel/tp.py).
+    attention_impl: str = "einsum"
 
     @property
     def head_dim(self) -> int:
@@ -91,7 +98,17 @@ def layer_norm(x, p, dtype):
 
 
 def attention(q, k, v, kv_mask, cfg: TransformerConfig):
-    """Standard masked MHA core. q,k,v: (B, S, H, Dh); kv_mask: (B, S) bool."""
+    """Masked MHA core. q,k,v: (B, S, H, Dh); kv_mask: (B, S) bool.
+
+    cfg.attention_impl selects the implementation (see TransformerConfig).
+    """
+    if cfg.attention_impl in ("pallas", "pallas_interpret"):
+        from bflc_demo_tpu.ops.pallas_attention import flash_attention
+        s = q.shape[1]
+        blk = 128 if s % 128 == 0 else max(
+            b for b in (64, 32, 16, 8, 1) if s % b == 0)
+        return flash_attention(q, k, v, kv_mask, blk, blk,
+                               cfg.attention_impl == "pallas_interpret")
     scale = 1.0 / np.sqrt(cfg.head_dim)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     logits = jnp.where(kv_mask[:, None, None, :], logits, NEG_INF)
@@ -152,11 +169,20 @@ def transformer_forward(params: Pytree, tokens: jax.Array,
 def make_transformer_classifier(vocab_size: int = 1000, seq_len: int = 64,
                                 num_classes: int = 2, dim: int = 128,
                                 depth: int = 2, heads: int = 4,
-                                dtype=jnp.float32) -> Model:
+                                dtype=jnp.float32,
+                                attention_impl: str = "") -> Model:
+    """attention_impl: "" reads BFLC_PALLAS_ATTENTION once, HERE at
+    construction ("1"->pallas, "interpret"->pallas_interpret, else einsum) —
+    never at trace time."""
+    if not attention_impl:
+        import os
+        env = os.environ.get("BFLC_PALLAS_ATTENTION", "")
+        attention_impl = {"1": "pallas", "interpret": "pallas_interpret"
+                          }.get(env, "einsum")
     cfg = TransformerConfig(
         vocab_size=_round_up(vocab_size, 128), seq_len=seq_len,
         num_classes=num_classes, dim=dim, depth=depth, heads=heads,
-        dtype=dtype)
+        dtype=dtype, attention_impl=attention_impl)
 
     def init(rng: jax.Array) -> Dict:
         return init_transformer_params(cfg, rng)

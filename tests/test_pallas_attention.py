@@ -1,0 +1,125 @@
+"""Flash-attention kernel tests (interpreter mode on CPU; same code
+compiles natively on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.ops.pallas_attention import (flash_attention,
+                                                _reference_attention)
+
+
+def _qkv(rng, b=2, s=64, h=4, d=32):
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("block", [16, 32, 64])
+    def test_matches_reference(self, block):
+        rng = np.random.default_rng(0)
+        q, k, v = _qkv(rng)
+        mask = jnp.ones((2, 64), bool)
+        got = flash_attention(q, k, v, mask, block_q=block, block_k=block,
+                              interpret=True)
+        want = _reference_attention(q, k, v, mask, 1.0 / np.sqrt(32))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_padding_mask(self):
+        """PAD keys excluded exactly; changing a PAD key's value is
+        invisible."""
+        rng = np.random.default_rng(1)
+        q, k, v = _qkv(rng)
+        mask = np.ones((2, 64), bool)
+        mask[:, 40:] = False
+        mask = jnp.asarray(mask)
+        got = flash_attention(q, k, v, mask, block_q=16, block_k=16,
+                              interpret=True)
+        want = _reference_attention(q, k, v, mask, 1.0 / np.sqrt(32))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        k2 = k.at[:, 50].set(999.0)          # PAD region
+        v2 = v.at[:, 50].set(-999.0)
+        got2 = flash_attention(q, k2, v2, mask, block_q=16, block_k=16,
+                               interpret=True)
+        np.testing.assert_allclose(got2, got, rtol=1e-6)
+
+    def test_block_fully_masked(self):
+        """A whole K block of PAD must not produce NaNs (the
+        exp(NEG_INF - NEG_INF) case the online softmax guards)."""
+        rng = np.random.default_rng(2)
+        q, k, v = _qkv(rng)
+        mask = np.ones((2, 64), bool)
+        mask[:, 16:32] = False               # exactly one 16-block all PAD
+        got = flash_attention(q, k, v, jnp.asarray(mask), block_q=16,
+                              block_k=16, interpret=True)
+        assert np.isfinite(np.asarray(got)).all()
+        want = _reference_attention(q, k, v, jnp.asarray(mask),
+                                    1.0 / np.sqrt(32))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_reference(self):
+        rng = np.random.default_rng(3)
+        q, k, v = _qkv(rng, b=1, s=32, h=2, d=16)
+        mask = np.ones((1, 32), bool)
+        mask[:, 28:] = False
+        mask = jnp.asarray(mask)
+
+        def loss_flash(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, mask, 16, 16,
+                                           True) ** 2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(_reference_attention(
+                q_, k_, v_, mask, 1.0 / np.sqrt(16)) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-5)
+
+    def test_bad_block_size_rejected(self):
+        rng = np.random.default_rng(4)
+        q, k, v = _qkv(rng, s=60)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, jnp.ones((2, 60), bool), 16, 16, True)
+
+
+class TestTransformerIntegration:
+    def test_transformer_with_pallas_attention(self):
+        """attention_impl='pallas_interpret' swaps the transformer's core;
+        logits must match the einsum path with identical params."""
+        from bflc_demo_tpu.models import transformer as T
+        model = T.make_transformer_classifier(vocab_size=100, seq_len=32,
+                                              num_classes=3, dim=32,
+                                              depth=1, heads=2)
+        kernel_model = T.make_transformer_classifier(
+            vocab_size=100, seq_len=32, num_classes=3, dim=32, depth=1,
+            heads=2, attention_impl="pallas_interpret")
+        rng = np.random.default_rng(5)
+        toks = np.asarray(rng.integers(1, 100, (3, 32)), np.int32)
+        toks[:, 20:] = 0
+        toks = jnp.asarray(toks)
+        params = model.init_params(0)
+        want = model.apply(params, toks)
+        got = kernel_model.apply(params, toks)
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-5)
+
+    def test_env_read_at_construction_not_trace(self, monkeypatch):
+        """The env flag affects models built AFTER it is set, never cached
+        traces of existing models (the trace-time-latch hazard)."""
+        from bflc_demo_tpu.models import transformer as T
+        monkeypatch.setenv("BFLC_PALLAS_ATTENTION", "interpret")
+        m = T.make_transformer_classifier(vocab_size=64, seq_len=16,
+                                          num_classes=2, dim=16, depth=1,
+                                          heads=2)
+        assert m.config.attention_impl == "pallas_interpret"
+        monkeypatch.delenv("BFLC_PALLAS_ATTENTION")
+        m2 = T.make_transformer_classifier(vocab_size=64, seq_len=16,
+                                           num_classes=2, dim=16, depth=1,
+                                           heads=2)
+        assert m2.config.attention_impl == "einsum"
+        # the first model keeps its construction-time choice
+        assert m.config.attention_impl == "pallas_interpret"
