@@ -1,0 +1,65 @@
+"""Multi-host bring-up: ICI data plane + DCN control plane.
+
+The reference scales by running one FISCO node per machine with PBFT over
+P2P (README.md:162-183) and clients dialing any node over Channel TLS.  The
+TPU-native equivalent (BASELINE.json north star: "one FISCO node per TPU VM
+on a pod slice"):
+
+- the DATA plane needs no bespoke backend: `jax.distributed.initialize` +
+  a global mesh makes every collective in this package (psum FedAvg, ring
+  scoring, ring attention, tp/ep/pp shardings) run over ICI within a slice
+  and DCN across slices — XLA routes them, exactly as on the virtual CPU
+  mesh used in tests;
+- the CONTROL plane is the ledger: one host (process_index 0 by convention)
+  owns the writer; other hosts replicate by replaying the op stream
+  (`ledger.apply_op`) and verify with the chained head digest — the same
+  replication contract the tests exercise in-process.
+
+Single real multi-host runs cannot execute in this environment (one chip);
+`initialize()` is a thin, testable wrapper that no-ops gracefully on a
+single process so the same entry point works everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Initialise jax.distributed from args or BFLC_COORDINATOR /
+    BFLC_NUM_PROCESSES / BFLC_PROCESS_ID env vars.  Returns True if a
+    multi-process runtime was initialised, False for single-process."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "BFLC_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("BFLC_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("BFLC_PROCESS_ID", "0"))
+    if not coordinator_address or num_processes <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def is_ledger_writer() -> bool:
+    """The op-log writer host (the control-plane serialization point)."""
+    return jax.process_index() == 0
+
+
+def global_mesh(axis_names=("clients",), shape=None):
+    """Mesh over every device across all hosts (ICI within a slice, DCN
+    between slices — XLA picks the fabric per collective)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    return Mesh(np.asarray(devs[: int(np.prod(shape))]).reshape(shape),
+                axis_names)

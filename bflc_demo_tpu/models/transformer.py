@@ -50,6 +50,10 @@ class TransformerConfig:
     # kernel has no SPMD partitioning rule; use "einsum" for models that run
     # under tensor-parallel sharding (parallel/tp.py).
     attention_impl: str = "einsum"
+    # mixture-of-experts MLP: 0 = dense MLP; >0 = that many expert MLPs with
+    # a softmax router (dense mixture — every expert computes, gates weight;
+    # the expert axis shards over "ep", see parallel/ep.py)
+    moe_experts: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -69,15 +73,29 @@ def init_transformer_params(cfg: TransformerConfig, rng: jax.Array) -> Pytree:
         return jax.random.normal(key, shape, jnp.float32) * s
 
     def block(key):
-        ks = jax.random.split(key, 6)
-        return {
+        # dense path splits exactly as before MoE existed (6 keys) so seeded
+        # initialization of non-MoE models is byte-stable; the MoE path
+        # draws one extra subkey for its expert bank
+        ks = jax.random.split(key, 7 if cfg.moe_experts else 6)
+        out = {
             "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
             "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d)),
             "wv": dense(ks[2], (d, d)), "wo": dense(ks[3], (d, d)),
             "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
-            "w1": dense(ks[4], (d, h)), "b1": jnp.zeros((h,)),
-            "w2": dense(ks[5], (h, d)), "b2": jnp.zeros((d,)),
         }
+        if cfg.moe_experts:
+            e = cfg.moe_experts
+            out.update({
+                "router": dense(ks[4], (d, e)),
+                "we1": dense(ks[5], (e, d, h)), "wb1": jnp.zeros((e, h)),
+                "we2": dense(ks[6], (e, h, d)), "wb2": jnp.zeros((e, d)),
+            })
+        else:
+            out.update({
+                "w1": dense(ks[4], (d, h)), "b1": jnp.zeros((h,)),
+                "w2": dense(ks[5], (h, d)), "b2": jnp.zeros((d,)),
+            })
+        return out
 
     return {
         "embed": dense(keys[0], (cfg.vocab_size, d)),
@@ -132,6 +150,20 @@ def block_forward(x, pad, bp, cfg: TransformerConfig, attn_fn=None):
         o = attn_fn(q, k, v, pad)
     x = x + (o.reshape(b, s, d) @ bp["wo"].astype(dt))
     y = layer_norm(x, bp["ln2"], dt)
+    if cfg.moe_experts:
+        # dense mixture-of-experts: gates weight every expert's MLP output.
+        # The e-axis einsums contract over experts, so sharding the expert
+        # leaves over "ep" (parallel/ep.py) distributes expert compute with
+        # a single psum per block.
+        gates = jax.nn.softmax(
+            (y @ bp["router"].astype(dt)).astype(jnp.float32), -1)  # (b,s,e)
+        hmid = jax.nn.gelu(
+            jnp.einsum("bsd,edh->bseh", y, bp["we1"].astype(dt))
+            + bp["wb1"].astype(dt))
+        outs = jnp.einsum("bseh,ehd->bsed", hmid, bp["we2"].astype(dt)) \
+            + bp["wb2"].astype(dt)
+        y = jnp.einsum("bsed,bse->bsd", outs, gates.astype(dt))
+        return x + y
     y = jax.nn.gelu(y @ bp["w1"].astype(dt) + bp["b1"].astype(dt))
     return x + (y @ bp["w2"].astype(dt) + bp["b2"].astype(dt))
 
@@ -170,7 +202,8 @@ def make_transformer_classifier(vocab_size: int = 1000, seq_len: int = 64,
                                 num_classes: int = 2, dim: int = 128,
                                 depth: int = 2, heads: int = 4,
                                 dtype=jnp.float32,
-                                attention_impl: str = "") -> Model:
+                                attention_impl: str = "",
+                                moe_experts: int = 0) -> Model:
     """attention_impl: "" reads BFLC_PALLAS_ATTENTION once, HERE at
     construction ("1"->pallas, "interpret"->pallas_interpret, else einsum) —
     never at trace time."""
@@ -182,7 +215,8 @@ def make_transformer_classifier(vocab_size: int = 1000, seq_len: int = 64,
     cfg = TransformerConfig(
         vocab_size=_round_up(vocab_size, 128), seq_len=seq_len,
         num_classes=num_classes, dim=dim, depth=depth, heads=heads,
-        dtype=dtype, attention_impl=attention_impl)
+        dtype=dtype, attention_impl=attention_impl,
+        moe_experts=moe_experts)
 
     def init(rng: jax.Array) -> Dict:
         return init_transformer_params(cfg, rng)

@@ -30,68 +30,94 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
-                  scale: float):
-    """One (batch*head, q-block) grid step.
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref,
+                  l_ref, *, scale: float, nk: int):
+    """One (batch*head, q-block, k-block) grid step.
 
-    q_ref: (1, block_q, d); k_ref/v_ref: (1, s_kv, d); mask_ref: (1, s_kv)
-    int32; o_ref: (1, block_q, d) — leading 1 is the grid-blocked row axis.
+    The k axis is the innermost (sequential) grid dimension: only ONE
+    (block_k, d) K/V tile is resident in VMEM per step — K/V stream from
+    HBM tile by tile, so VMEM use is O(block_q*d + block_k*d) regardless of
+    sequence length.  The online-softmax carry (acc, running max m, running
+    denominator l) lives in VMEM scratch, which persists across the
+    sequential k steps; it is reset at k==0 and the normalised output is
+    written at k==nk-1.
+
+    q_ref: (1, block_q, d); k_ref/v_ref: (1, block_k, d);
+    mask_ref: (1, block_k) int32; o_ref: (1, block_q, d);
+    acc_ref: (block_q, d) f32; m_ref/l_ref: (block_q, LANES) f32 (the value
+    is replicated across lanes to keep stores tiled).
     """
+    kidx = pl.program_id(2)
+
+    @pl.when(kidx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
     q = q_ref[0].astype(jnp.float32) * scale
-    block_q, d = q.shape
-    s_kv = k_ref.shape[1]
-    nk = s_kv // block_k
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    mb = mask_ref[0]
 
-    def body(i, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        mb = mask_ref[0, pl.ds(i * block_k, block_k)]
-        logits = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
-        logits = jnp.where((mb > 0)[None, :], logits, NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[:, None])
-        p = jnp.where((mb > 0)[None, :], p, 0.0)   # NEG_INF-NEG_INF guard
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1)
-        acc_new = acc * corr[:, None] + jnp.dot(
-            p, vb, preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+    m = m_ref[:, 0]
+    l = l_ref[:, 0]
+    logits = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+    logits = jnp.where((mb > 0)[None, :], logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[:, None])
+    p = jnp.where((mb > 0)[None, :], p, 0.0)     # NEG_INF-NEG_INF guard
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+        p, vb, preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(kidx == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+_LANES = 128
 
 
 def _flash_fwd_impl(q, k, v, kv_mask, block_q: int, block_k: int,
                     interpret: bool) -> jax.Array:
+    from jax.experimental.pallas import tpu as pltpu
+
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
     if s_q % block_q or s_kv % block_k:
         raise ValueError(f"seq lens ({s_q}, {s_kv}) must divide blocks "
                          f"({block_q}, {block_k})")
     scale = 1.0 / np.sqrt(d)
+    nk = s_kv // block_k
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
     qh = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
     mask_i32 = kv_mask.astype(jnp.int32)      # (B, S_kv)
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale)
+    kernel = functools.partial(_flash_kernel, scale=scale, nk=nk)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, s_q // block_q),
+        grid=(b * h, s_q // block_q, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
             # head rows share their batch's padding mask
-            pl.BlockSpec((1, s_kv), lambda i, j, h=h: (i // h, 0)),
+            pl.BlockSpec((1, block_k), lambda i, j, kk, h=h: (i // h, kk)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),        # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
+        ],
         interpret=interpret,
     )(qh, kh, vh, mask_i32)
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)

@@ -23,3 +23,7 @@ from bflc_demo_tpu.parallel.ring_attention import (  # noqa: F401
 from bflc_demo_tpu.parallel.tp import (  # noqa: F401
     transformer_partition_specs, shard_transformer_params,
     make_tp_train_step)
+from bflc_demo_tpu.parallel.ep import (  # noqa: F401
+    moe_partition_specs, shard_moe_params, make_ep_train_step)
+from bflc_demo_tpu.parallel.pp import (  # noqa: F401
+    stack_blocks, shard_pp_params, make_pp_transformer_forward)

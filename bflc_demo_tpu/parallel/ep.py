@@ -1,0 +1,92 @@
+"""Expert parallelism: shard the MoE expert axis over an "ep" mesh axis.
+
+The MoE block (models/transformer.py, moe_experts > 0) computes every
+expert's MLP as einsums whose contraction runs over the expert axis; with the
+expert-stacked weight leaves (we1/wb1/we2/wb2, leading axis E) sharded over
+"ep", GSPMD partitions those einsums so each device computes only its
+resident experts and inserts one all-reduce per block for the gated
+combination — the annotate-shardings-let-XLA-place-collectives recipe, same
+as the TP layout in parallel/tp.py (the two compose: mesh ("dp","ep")).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bflc_demo_tpu.core.losses import softmax_cross_entropy
+from bflc_demo_tpu.models.transformer import TransformerConfig
+
+Pytree = Any
+
+
+def moe_partition_specs(params: Pytree, ep_axis: str = "ep") -> Pytree:
+    """PartitionSpec pytree for an MoE transformer: expert leaves sharded
+    over ep, everything else replicated (compose with tp specs if both
+    axes are in the mesh)."""
+
+    def block_spec(bp):
+        spec = {k: jax.tree_util.tree_map(lambda _: P(), v)
+                if isinstance(v, dict) else P() for k, v in bp.items()}
+        if "we1" in bp:
+            spec.update({"we1": P(ep_axis, None, None),
+                         "wb1": P(ep_axis, None),
+                         "we2": P(ep_axis, None, None),
+                         "wb2": P(ep_axis, None),
+                         "router": P()})
+        return spec
+
+    return {
+        "embed": P(), "pos": P(),
+        "blocks": tuple(block_spec(bp) for bp in params["blocks"]),
+        "ln_f": {"scale": P(), "bias": P()},
+        "head_w": P(), "head_b": P(),
+    }
+
+
+def shard_moe_params(params: Pytree, mesh: Mesh,
+                     ep_axis: str = "ep") -> Pytree:
+    specs = moe_partition_specs(params, ep_axis)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def make_ep_train_step(mesh: Mesh, apply_fn: Callable,
+                       cfg: TransformerConfig, lr: float,
+                       dp_axis: str = "dp", ep_axis: str = "ep",
+                       ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                     Tuple[Pytree, jax.Array]]:
+    """SGD step with dp-sharded batch and ep-sharded expert weights."""
+    if not cfg.moe_experts:
+        raise ValueError("model has no experts; build with moe_experts > 0")
+    if cfg.moe_experts % mesh.shape[ep_axis]:
+        raise ValueError(f"moe_experts {cfg.moe_experts} not divisible by "
+                         f"ep axis {mesh.shape[ep_axis]}")
+
+    def step(params, tokens, labels):
+        def loss_fn(p):
+            return softmax_cross_entropy(apply_fn(p, tokens), labels)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree_util.tree_map(
+            lambda w, g: w - lr * g, params, grads)
+        return new_params, loss
+
+    cache = {}
+
+    def run(params, tokens, labels):
+        key = jax.tree_util.tree_structure(params)
+        if key not in cache:
+            specs = moe_partition_specs(params, ep_axis)
+            ps = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), specs,
+                is_leaf=lambda x: isinstance(x, P))
+            data = NamedSharding(mesh, P(dp_axis))
+            cache[key] = jax.jit(step, in_shardings=(ps, data, data),
+                                 out_shardings=(ps,
+                                                NamedSharding(mesh, P())))
+        return cache[key](params, tokens, labels)
+
+    return run
