@@ -3,23 +3,41 @@
 Metric: FL round time (seconds) for the reference-equivalence workload
 (config 1: softmax regression on UCI occupancy, 20 clients, committee 4,
 top-6 sample-weighted FedAvg — SURVEY.md §6), full protocol per round
-(10 local trainings + 4x10 committee scorings + aggregation + sponsor eval).
+(10 local trainings + committee scoring + aggregation + sponsor eval) using
+the device-resident mesh runtime (one XLA program per round).
 
 vs_baseline: the reference's round time is structurally bounded below by its
 polling design — every protocol phase waits a uniform(10,30) s sleep per
 client (python-sdk/main.py:62, 231-233), i.e. >= ~20 s/round in expectation
 before any compute.  vs_baseline = 20.0 / measured_round_time (higher is
 better; >1 beats the reference).
+
+Robustness: the measurement runs in a child process with a watchdog.  If the
+TPU backend wedges (observed: a stuck axon tunnel blocks jax.devices()
+indefinitely), the child is killed and the benchmark reruns pinned to CPU,
+honestly labelled "platform": "cpu-fallback" — a number with a caveat beats
+a hung driver.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 
-def main() -> None:
+def _child() -> None:
+    if os.environ.get("BFLC_BENCH_FORCE_CPU"):
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=4")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
     from bflc_demo_tpu.eval import bench_config1
 
-    r = bench_config1(rounds=10, runtime="mesh")
+    platform = jax.devices()[0].platform
+    r = bench_config1(rounds=10, runtime="mesh", rounds_per_dispatch=5)
     # min over rounds excludes the first (compile-bearing) round
     round_time = r["min_round_time_s"]
     baseline_round_s = 20.0
@@ -35,8 +53,41 @@ def main() -> None:
             "train_samples_per_sec_per_chip": round(
                 r["train_samples_per_sec_per_chip"], 1),
             "rounds": r["rounds"],
+            "platform": ("cpu-fallback"
+                         if os.environ.get("BFLC_BENCH_FORCE_CPU")
+                         else platform),
         },
     }))
+
+
+def main() -> None:
+    if os.environ.get("BFLC_BENCH_CHILD"):
+        _child()
+        return
+    budget = int(os.environ.get("BFLC_BENCH_TIMEOUT", "1500"))
+    attempts = [({}, budget), ({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
+    last_err = ""
+    for extra_env, timeout_s in attempts:
+        env = dict(os.environ, BFLC_BENCH_CHILD="1", **extra_env)
+        try:
+            t0 = time.time()
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, capture_output=True, text=True,
+                                  timeout=timeout_s)
+            lines = [ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")]
+            if proc.returncode == 0 and lines:
+                print(lines[-1])
+                return
+            last_err = (f"rc={proc.returncode} after "
+                        f"{time.time() - t0:.0f}s: "
+                        f"{proc.stderr.strip()[-400:]}")
+        except subprocess.TimeoutExpired:
+            last_err = f"timed out after {timeout_s}s (wedged backend?)"
+    print(json.dumps({
+        "metric": "fl_round_time_s_config1", "value": None, "unit": "s/round",
+        "vs_baseline": None, "error": last_err}))
+    sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -21,19 +21,30 @@ from bflc_demo_tpu.protocol.constants import DEFAULT_PROTOCOL
 
 def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
                   seed: int = 0, verbose: bool = False,
-                  runtime: str = "host") -> Dict:
+                  runtime: str = "host",
+                  rounds_per_dispatch: int = 1) -> Dict:
     """runtime: 'host' (per-client dispatches, reference-shaped) or 'mesh'
-    (one XLA program per round — the TPU-first data plane)."""
+    (one XLA program per round — the TPU-first data plane).
+    rounds_per_dispatch > 1 (mesh only) batches R rounds per dispatch with
+    post-hoc ledger audit."""
     if runtime not in ("host", "mesh"):
         raise ValueError(f"runtime must be 'host' or 'mesh', got {runtime!r}")
+    if runtime == "host" and rounds_per_dispatch > 1:
+        raise ValueError("rounds_per_dispatch applies to runtime='mesh' only")
     cfg = DEFAULT_PROTOCOL
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(xtr, ytr, cfg.client_num)
     model = make_softmax_regression()
-    runner = run_federated if runtime == "host" else run_federated_mesh
-    res = runner(model, shards, (xte, yte), cfg, rounds=rounds,
-                 ledger_backend=ledger_backend, seed=seed,
-                 verbose=verbose)
+    if runtime == "host":
+        res = run_federated(model, shards, (xte, yte), cfg, rounds=rounds,
+                            ledger_backend=ledger_backend, seed=seed,
+                            verbose=verbose)
+    else:
+        res = run_federated_mesh(model, shards, (xte, yte), cfg,
+                                 rounds=rounds,
+                                 ledger_backend=ledger_backend, seed=seed,
+                                 rounds_per_dispatch=rounds_per_dispatch,
+                                 verbose=verbose)
     # samples/sec/chip — count the work each runtime actually does:
     # host: the K uploaders train their own (untruncated) shards, one chip;
     # mesh: ALL clients train min-truncated shards, spread over n_chips

@@ -265,6 +265,148 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
     return jax.jit(fn)
 
 
+class MultiRoundResult(NamedTuple):
+    params: Pytree              # model after the last round (replicated)
+    uploader_masks: jax.Array   # (R, N) bool — device-sampled uploader sets
+    committee_masks: jax.Array  # (R, N) bool — committee per round
+    score_matrices: jax.Array   # (R, N, N)
+    medians: jax.Array          # (R, N)
+    selected: jax.Array         # (R, N) bool
+    orders: jax.Array           # (R, N)
+    avg_costs: jax.Array        # (R, N)
+    global_losses: jax.Array    # (R,)
+    delta_fps: jax.Array        # (R, N, 8) uint32
+    params_fps: jax.Array       # (R, 8) uint32 — model hash after each round
+    test_accs: jax.Array        # (R,) sponsor accuracy after each round
+
+
+def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
+                             client_num: int, lr: float, batch_size: int,
+                             local_epochs: int, aggregate_count: int,
+                             comm_count: int, needed_update_count: int,
+                             rounds_per_dispatch: int,
+                             client_chunk: int = 0, remat: bool = False,
+                             ) -> Callable[..., MultiRoundResult]:
+    """R protocol rounds as ONE XLA program — the amortised data plane.
+
+    One host<->device sync per R rounds instead of per round: uploader
+    sampling (the arbitrary first-come-K set, .cpp:239-244, as a seeded
+    device-side draw over current trainers), training, ring scoring, the
+    replicated decision, the psum FedAvg, committee election for the next
+    round (.cpp:443-455 semantics) and the sponsor eval all run under a
+    `lax.scan` over rounds.  The host ledger replays and AUDITS each round
+    afterwards (client/mesh_runtime.py `rounds_per_dispatch`): the op log
+    remains the authority, the device is its optimistic executor, and any
+    decision divergence raises.
+
+    Returned fn signature:
+        fn(params, xs, ys, n_samples, committee_mask0, rng_key, xte, yte)
+    with xs/ys/n_samples sharded over the client axis; committee_mask0 (N,)
+    bool and the test set replicated.
+    """
+    n_devices = mesh.shape[AXIS]
+    if client_num % n_devices:
+        raise ValueError(f"client_num {client_num} not divisible by mesh "
+                         f"axis {n_devices}")
+    if needed_update_count < comm_count:
+        # the device election takes the top comm_count of the K uploader
+        # slots; with K < comm_count it would seat non-uploaders the ledger
+        # never elects, guaranteeing an audit divergence — reject upfront
+        raise ValueError(
+            f"needed_update_count ({needed_update_count}) must be >= "
+            f"comm_count ({comm_count}) for the batched multi-round program")
+    n = client_num
+    k_sel = aggregate_count
+    k_up = needed_update_count
+
+    def body(params, xs, ys, n_samples, comm_mask0, rng_key, xte, yte):
+        n_local = xs.shape[0]
+        my = jax.lax.axis_index(AXIS)
+
+        def round_step(carry, r_key):
+            params_round, comm_mask = carry
+
+            def train_one(x, y):
+                return local_train_impl(apply_fn, params_round, x, y, lr=lr,
+                                        batch_size=batch_size,
+                                        local_epochs=local_epochs)
+            # device-side uploader draw: top-K uniform scores over trainers
+            # (same key on every device -> replicated, consistent sampling)
+            draw = jax.random.uniform(r_key, (n,))
+            draw = jnp.where(comm_mask, -jnp.inf, draw)
+            draw_order = jnp.argsort(-draw, stable=True)
+            draw_rank = jnp.argsort(draw_order, stable=True)
+            uploader_mask = (draw_rank < k_up) & ~comm_mask
+
+            t_one = train_one
+            if remat:
+                t_one = jax.checkpoint(t_one)
+            if client_chunk and client_chunk < n_local:
+                nch = n_local // client_chunk
+                xs_c = xs.reshape((nch, client_chunk) + xs.shape[1:])
+                ys_c = ys.reshape((nch, client_chunk) + ys.shape[1:])
+                d_c, c_c = jax.lax.map(
+                    lambda a: jax.vmap(t_one)(a[0], a[1]), (xs_c, ys_c))
+                deltas_local = jax.tree_util.tree_map(
+                    lambda t: t.reshape((n_local,) + t.shape[2:]), d_c)
+                costs_local = c_c.reshape((n_local,))
+            else:
+                deltas_local, costs_local = jax.vmap(t_one)(xs, ys)
+            deltas_local = _ensure_varying(deltas_local)
+
+            rows = ring_score_matrix(apply_fn, params_round, deltas_local,
+                                     lr, xs, ys, n_devices,
+                                     chunk=client_chunk)
+            score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)
+            costs = jax.lax.all_gather(costs_local, AXIS, tiled=True)
+
+            med = median_scores(score_matrix, comm_mask)
+            order = rank_desc_stable(med, uploader_mask)
+            rank_of = jnp.argsort(order, stable=True)
+            sel = (rank_of < k_sel) & uploader_mask
+            n_sel = jnp.maximum(jnp.sum(sel.astype(costs.dtype)), 1.0)
+            g_loss = jnp.sum(costs * sel.astype(costs.dtype)) / n_sel
+
+            sel_local = jax.lax.dynamic_slice(sel, (my * n_local,),
+                                              (n_local,))
+            new_params = _psum_fedavg_body(params_round, deltas_local,
+                                           n_samples, sel_local, lr)
+
+            fps_local = fingerprint_stacked(deltas_local)
+            delta_fps = jax.lax.all_gather(fps_local, AXIS, tiled=True)
+            params_fp = fingerprint_pytree(new_params)
+
+            # committee election for the next round (.cpp:443-455): top
+            # comm_count uploader slots; K >= comm_count so all are valid
+            electees = order[:comm_count]
+            comm_next = jnp.zeros((n,), bool).at[electees].set(True)
+
+            # sponsor eval on the held-out set (main.py:280-340)
+            logits = apply_fn(new_params, xte)
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == jnp.argmax(yte, -1))
+                .astype(jnp.float32))
+
+            outs = (uploader_mask, comm_mask, score_matrix, med, sel, order,
+                    costs, g_loss, delta_fps, params_fp, acc)
+            return (new_params, comm_next), outs
+
+        keys = jax.random.split(rng_key, rounds_per_dispatch)
+        (final_params, _), outs = jax.lax.scan(
+            round_step, (params, comm_mask0), keys)
+        (uploader_masks, comm_masks, score_ms, meds, sels, orders, costs_all,
+         losses, dfps, pfps, accs) = outs
+        return MultiRoundResult(final_params, uploader_masks, comm_masks,
+                                score_ms, meds, sels, orders, costs_all,
+                                losses, dfps, pfps, accs)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
 def sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, params: Pytree,
                            xs: jax.Array, ys: jax.Array,
                            n_samples: jax.Array, uploader_mask: jax.Array,

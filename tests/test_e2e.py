@@ -47,6 +47,25 @@ def test_mesh_runtime_reaches_reference_accuracy(occupancy):
     assert res.ledger_log_size == 20 + 10 * 15
 
 
+def test_mesh_runtime_batched_dispatch(occupancy):
+    """R-rounds-per-dispatch optimistic execution: device samples/elects/
+    decides for R rounds in one program; the ledger replays and audits each
+    round (divergence would raise inside run_federated_mesh)."""
+    from bflc_demo_tpu.client import run_federated_mesh
+    shards, test_set = occupancy
+    res = run_federated_mesh(make_softmax_regression(), shards, test_set,
+                             DEFAULT_PROTOCOL, rounds=10,
+                             rounds_per_dispatch=5, seed=0)
+    assert res.best_accuracy() >= 0.90, res.accuracy_history
+    assert res.ledger_log_size == 20 + 10 * 15
+    assert res.ledger.verify_log()
+    # deterministic: same seed, same batched run -> same log head
+    res2 = run_federated_mesh(make_softmax_regression(), shards, test_set,
+                              DEFAULT_PROTOCOL, rounds=10,
+                              rounds_per_dispatch=5, seed=0)
+    assert res2.ledger_log_head == res.ledger_log_head
+
+
 def test_deterministic_replay(occupancy):
     """Same seed -> identical ledger log head (scores, ranking, election and
     committed model hashes all bit-equal across runs)."""
