@@ -27,3 +27,5 @@ from bflc_demo_tpu.parallel.ep import (  # noqa: F401
     moe_partition_specs, shard_moe_params, make_ep_train_step)
 from bflc_demo_tpu.parallel.pp import (  # noqa: F401
     stack_blocks, shard_pp_params, make_pp_transformer_forward)
+from bflc_demo_tpu.parallel.secure import (  # noqa: F401
+    secure_masked_sum, secure_fedavg)

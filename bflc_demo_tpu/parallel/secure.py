@@ -1,0 +1,153 @@
+"""Secure aggregation: pairwise-masked FedAvg (the config-4 variant).
+
+SecAgg-style additive masking adapted to the mesh data plane: every client
+pair (i, j) shares a seed; client i adds PRG(seed_ij) for j > i and subtracts
+it for j < i, so the masks cancel EXACTLY in the sum — any observer without
+the pair seeds sees only noise in an individual contribution, while the psum
+total equals the unmasked weighted sum bit-for-bit in integer arithmetic.
+
+Design notes:
+- masks are generated per (pair, round) from jax.random.fold_in — no mask
+  exchange traffic.  THREAT MODEL CAVEAT: this demo derives every pair key
+  from one shared round key (a key-agreement stub, standing in for the
+  reference's ECDSA identity bootstrap); privacy therefore holds against
+  observers WITHOUT the round key, not against a key-holding aggregator,
+  which could recompute and strip any client's mask.  A real deployment
+  derives pair keys from per-pair Diffie-Hellman secrets — only the mask
+  derivation function changes, the cancellation algebra is identical;
+- cancellation must be exact, not approximate: floats don't cancel reliably
+  across reassociation, so deltas are scaled to int32 fixed-point, masked
+  with modular uint32 arithmetic, summed with psum (associative mod 2^32),
+  unmasked, then rescaled.  The quantisation step is the only information
+  loss (tested <= 2^-16 relative);
+- scope: this protects the MERGE inputs.  Committee scoring inherently
+  evaluates candidate models (the Byzantine defense requires seeing them,
+  CommitteePrecompiled semantics) — BFLC trades update privacy from the
+  *aggregator* while committee members remain evaluators.  Masked
+  aggregation composes with selection because the selection mask multiplies
+  the fixed-point values BEFORE masking.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bflc_demo_tpu.parallel.fedavg import AXIS
+
+Pytree = Any
+
+_FRAC_BITS = 16                      # fixed-point fractional bits
+_SCALE = float(1 << _FRAC_BITS)
+
+
+def _pair_mask(pair_key: jax.Array, shape) -> jax.Array:
+    """Deterministic uint32 mask for one client pair."""
+    return jax.random.bits(pair_key, shape, jnp.uint32)
+
+
+def _client_mask(round_key: jax.Array, i: jax.Array, n: int,
+                 shape) -> jax.Array:
+    """Sum of signed pairwise masks for client i (mod 2^32).
+
+    mask_i = sum_{j>i} PRG(k_ij) - sum_{j<i} PRG(k_ij); summed over all
+    clients the terms cancel pairwise.  Pair key is derived from the
+    unordered pair id so both endpoints derive the same mask.
+    """
+    def body(j, acc):
+        lo = jnp.minimum(i, j)
+        hi = jnp.maximum(i, j)
+        pair_id = lo * n + hi
+        m = _pair_mask(jax.random.fold_in(round_key, pair_id), shape)
+        contrib = jnp.where(j > i, m, jnp.uint32(0) - m)
+        return jnp.where(j == i, acc, acc + contrib)
+
+    return jax.lax.fori_loop(0, n, body,
+                             jnp.zeros(shape, jnp.uint32))
+
+
+_PROGRAM_CACHE = {}
+
+
+def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
+                      clip: float = 64.0) -> Pytree:
+    """Sum client-stacked pytrees over the client axis with each client's
+    fixed-point contribution blinded by pairwise-cancelling masks before the
+    psum (see module docstring for the threat-model caveat).
+
+    values: pytree with leading axis N, sharded over the client axis.
+    clip: symmetric range bound for fixed-point encoding (values are
+    clamped to [-clip, clip] before quantisation).
+
+    Capacity: the unmasked total must fit int32 fixed-point, i.e.
+    N * clip < 2^(31 - _FRAC_BITS) = 32768; larger products are rejected
+    (the mod-2^32 sum would silently wrap).  secure_fedavg pre-normalises
+    its weights so its sums are bounded by clip regardless of N.
+    Returns the (replicated) sums, dequantised to float32.
+    """
+    n_total = jax.tree_util.tree_leaves(values)[0].shape[0]
+    if n_total * clip >= float(1 << (31 - _FRAC_BITS)):
+        raise ValueError(
+            f"fixed-point capacity exceeded: N*clip = {n_total * clip:g} "
+            f">= {1 << (31 - _FRAC_BITS)}; lower clip or pre-normalise")
+
+    def body(vals, key):
+        n_local = jax.tree_util.tree_leaves(vals)[0].shape[0]
+        my = jax.lax.axis_index(AXIS)
+
+        def one_leaf(leaf):
+            shape = leaf.shape[1:]
+
+            def mask_one(local_idx, acc):
+                client = my * n_local + local_idx
+                fx = jnp.clip(leaf[local_idx].astype(jnp.float32),
+                              -clip, clip)
+                q = jnp.round(fx * _SCALE).astype(jnp.int32)
+                masked = q.astype(jnp.uint32) + _client_mask(
+                    key, client, n_total, shape)
+                return acc + masked
+
+            total = jax.lax.fori_loop(
+                0, n_local, mask_one, jnp.zeros(shape, jnp.uint32))
+            total = jax.lax.psum(total, AXIS)   # masks cancel mod 2^32 here
+            return (total.astype(jnp.int32).astype(jnp.float32) / _SCALE)
+
+        return jax.tree_util.tree_map(one_leaf, vals)
+
+    # build-once per (mesh, structure, clip): round_key is an ARGUMENT so a
+    # new round never retraces (pp.py build-once convention)
+    cache_key = (id(mesh), jax.tree_util.tree_structure(values),
+                 tuple(jax.tree_util.tree_leaves(
+                     jax.tree_util.tree_map(lambda x: x.shape, values))),
+                 float(clip))
+    if cache_key not in _PROGRAM_CACHE:
+        fn = shard_map(body, mesh=mesh, in_specs=(P(AXIS), P()),
+                       out_specs=P(), check_vma=False)
+        _PROGRAM_CACHE[cache_key] = jax.jit(fn)
+    return _PROGRAM_CACHE[cache_key](values, round_key)
+
+
+def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
+                  sel_mask: jax.Array, global_params: Pytree, lr: float,
+                  round_key: jax.Array, clip: float = 64.0,
+                  ) -> Pytree:
+    """Sample-weighted FedAvg where individual selected deltas are blinded
+    before the sum (hidden from any observer without the pair seeds — see
+    the module threat-model caveat).  Semantics match `apply_selection` up
+    to fixed-point quantisation.
+    """
+    w = (n_samples.astype(jnp.float32) * sel_mask.astype(jnp.float32))
+    wsum = jnp.maximum(jnp.sum(w), 1e-12)
+    # weight each client's delta BEFORE masking so the masked sum is the
+    # numerator of the weighted mean; normalise after unmasking
+    weighted = jax.tree_util.tree_map(
+        lambda d: d * (w / wsum).reshape((-1,) + (1,) * (d.ndim - 1)),
+        deltas)
+    mean_delta = secure_masked_sum(mesh, weighted, round_key, clip=clip)
+    return jax.tree_util.tree_map(
+        lambda g, m: g - jnp.asarray(lr, g.dtype) * m, global_params,
+        mean_delta)

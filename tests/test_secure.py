@@ -1,0 +1,114 @@
+"""Secure-aggregation tests: exact mask cancellation, privacy of individual
+contributions, and FedAvg equivalence up to fixed-point quantisation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.core import apply_selection
+from bflc_demo_tpu.parallel import client_axis_mesh
+from bflc_demo_tpu.parallel.secure import (secure_masked_sum, secure_fedavg,
+                                           _client_mask, _SCALE)
+
+
+def _vals(rng, n=16, shape=(5, 2)):
+    return {"W": jnp.asarray(rng.standard_normal((n,) + shape), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((n, 2)), jnp.float32)}
+
+
+class TestMaskCancellation:
+    def test_pairwise_masks_cancel_exactly(self):
+        key = jax.random.PRNGKey(0)
+        n = 8
+        total = jnp.zeros((4, 4), jnp.uint32)
+        for i in range(n):
+            total = total + _client_mask(key, jnp.int32(i), n, (4, 4))
+        np.testing.assert_array_equal(np.asarray(total), 0)
+
+    def test_sum_matches_plain_sum(self):
+        rng = np.random.default_rng(0)
+        mesh = client_axis_mesh(8)
+        vals = _vals(rng)
+        got = secure_masked_sum(mesh, vals, jax.random.PRNGKey(1))
+        for k in vals:
+            want = np.asarray(vals[k]).sum(axis=0)
+            np.testing.assert_allclose(np.asarray(got[k]), want,
+                                       atol=2 * len(vals[k]) / _SCALE)
+
+    def test_individual_contribution_is_blinded(self):
+        """A single client's masked payload must look nothing like its
+        plaintext: correlation with the true value ~ 0, bits ~ uniform."""
+        key = jax.random.PRNGKey(2)
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((64, 64)).astype(np.float32)
+        q = np.round(np.clip(x, -64, 64) * _SCALE).astype(np.int32)
+        masked = np.asarray(
+            q.astype(np.uint32) +
+            np.asarray(_client_mask(key, jnp.int32(3), 16, (64, 64))))
+        # view masked words as signed and normalise; correlation with the
+        # plaintext should be negligible
+        m = masked.astype(np.int64)
+        m = (m - m.mean()) / (m.std() + 1e-9)
+        xn = (x - x.mean()) / x.std()
+        corr = float(np.abs((m * xn).mean()))
+        assert corr < 0.05, corr
+        # top byte of the masked words ~ uniform (entropy check)
+        top = (masked >> 24) & 0xFF
+        counts = np.bincount(top.reshape(-1), minlength=256)
+        assert counts.max() < 4 * counts.mean()
+
+    def test_capacity_guard(self):
+        """N*clip beyond int32 fixed-point capacity is rejected, not
+        silently wrapped."""
+        mesh = client_axis_mesh(8)
+        rng = np.random.default_rng(9)
+        vals = _vals(rng, n=16)
+        with pytest.raises(ValueError):
+            secure_masked_sum(mesh, vals, jax.random.PRNGKey(0),
+                              clip=4096.0)     # 16 * 4096 = 65536 > 32768
+
+    def test_different_rounds_different_masks(self):
+        k = jax.random.PRNGKey(4)
+        m1 = np.asarray(_client_mask(jax.random.fold_in(k, 1), jnp.int32(0),
+                                     8, (16,)))
+        m2 = np.asarray(_client_mask(jax.random.fold_in(k, 2), jnp.int32(0),
+                                     8, (16,)))
+        assert not np.array_equal(m1, m2)
+
+
+class TestSecureFedAvg:
+    def test_matches_apply_selection_within_quantisation(self):
+        rng = np.random.default_rng(5)
+        mesh = client_axis_mesh(8)
+        n = 16
+        deltas = _vals(rng, n)
+        params = {"W": jnp.asarray(rng.standard_normal((5, 2)), jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal((2,)), jnp.float32)}
+        ns = jnp.asarray(rng.integers(100, 400, n), jnp.int32)
+        sel = jnp.asarray(rng.random(n) < 0.5)
+        got = secure_fedavg(mesh, deltas, ns, sel, params, 0.05,
+                            jax.random.PRNGKey(6))
+        want = apply_selection(params, deltas, ns, sel, 0.05)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(want[k]),
+                                       atol=0.05 * n / _SCALE + 1e-6)
+
+    def test_unselected_clients_contribute_nothing(self):
+        rng = np.random.default_rng(7)
+        mesh = client_axis_mesh(4)
+        n = 8
+        deltas = _vals(rng, n)
+        params = {"W": jnp.zeros((5, 2)), "b": jnp.zeros((2,))}
+        ns = jnp.full((n,), 100, jnp.int32)
+        sel = jnp.asarray([True] * 4 + [False] * 4)
+        got = secure_fedavg(mesh, deltas, ns, sel, params, 1.0,
+                            jax.random.PRNGKey(8))
+        # replacing the unselected deltas entirely must not change the result
+        deltas2 = {k: v.at[4:].set(999.0) for k, v in deltas.items()}
+        got2 = secure_fedavg(mesh, deltas2, ns, sel, params, 1.0,
+                             jax.random.PRNGKey(8))
+        for k in params:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(got2[k]), atol=1e-4)
