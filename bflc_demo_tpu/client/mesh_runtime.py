@@ -48,7 +48,7 @@ def _addr(i: int) -> str:
 
 def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
                  rounds, rounds_per_dispatch, seed, client_chunk, remat,
-                 s_min, checkpoint_dir, checkpoint_every, verbose):
+                 s_min, checkpoint_dir, checkpoint_every, tracer, verbose):
     """R-rounds-per-dispatch execution with post-hoc ledger replay + audit.
 
     The device program (parallel.make_multi_round_program) samples uploaders,
@@ -89,6 +89,9 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
         dfps = np.asarray(res.delta_fps)
         pfps = np.asarray(res.params_fps)
         accs = np.asarray(res.test_accs)
+        tracer.charge("device.dispatches")
+        tracer.charge("host_bytes.out",
+                      dfps.nbytes + score_ms.nbytes + costs.nbytes)
         for r in range(rounds_per_dispatch):
             epoch = ledger.epoch
             ledger_comm = sorted(int(a, 16) for a in ledger.committee())
@@ -120,6 +123,8 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
             st = ledger.commit_model(fingerprint_to_bytes(pfps[r]), epoch)
             if st != LedgerStatus.OK:
                 raise RuntimeError(f"commit rejected: {st.name}")
+            tracer.charge("ledger.ops",
+                          len(uploader_ids) + len(ledger_comm) + 1)
             loss_history.append((epoch, ledger.last_global_loss))
             sponsor.history.append((epoch, float(accs[r])))
             if verbose:
@@ -167,6 +172,7 @@ def run_federated_mesh(model: Model,
                        resume_ledger=None,
                        checkpoint_dir: str = "",
                        checkpoint_every: int = 0,
+                       tracer=None,
                        verbose: bool = False) -> SimulationResult:
     """participation:
     - 'full': every registered client trains each round (the reference's
@@ -258,12 +264,16 @@ def run_federated_mesh(model: Model,
         if ledger.epoch != 0:
             raise RuntimeError(f"FL did not start (epoch={ledger.epoch})")
 
+    from bflc_demo_tpu.utils.tracing import NULL_TRACER as _NULL
     if rounds_per_dispatch > 1:
         return _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns,
                             sponsor, rounds, rounds_per_dispatch, seed,
                             client_chunk, remat, s_min,
-                            checkpoint_dir, checkpoint_every, verbose)
+                            checkpoint_dir, checkpoint_every,
+                            tracer or _NULL, verbose)
 
+    from bflc_demo_tpu.utils.tracing import NULL_TRACER
+    tracer = tracer or NULL_TRACER
     loss_history, round_times = [], []
     t0 = time.perf_counter()
     for _ in range(rounds):
@@ -300,6 +310,10 @@ def run_federated_mesh(model: Model,
         score_rows = np.asarray(res.score_matrix)      # (slots, slots)
         avg_costs = np.asarray(res.avg_costs)
         sel_device = np.flatnonzero(np.asarray(res.selected))
+        tracer.charge("device.dispatches")
+        tracer.charge("host_bytes.out",
+                      delta_fps.nbytes + score_rows.nbytes + avg_costs.nbytes)
+        tracer.event("round.device_done", epoch=epoch)
 
         for j, cid in enumerate(uploader_ids):         # ascending == slot order
             st = ledger.upload_local_update(
@@ -324,6 +338,8 @@ def run_federated_mesh(model: Model,
         if st != LedgerStatus.OK:
             raise RuntimeError(f"commit rejected: {st.name}")
 
+        tracer.charge("ledger.ops",
+                      len(uploader_ids) + len(committee_ids) + 1)
         loss_history.append((epoch, ledger.last_global_loss))
         acc = sponsor.observe(epoch, params)
         round_times.append(time.perf_counter() - rt0)

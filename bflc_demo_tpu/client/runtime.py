@@ -48,6 +48,8 @@ class FLNode:
     cfg: ProtocolConfig
     trained_epoch: int = -1      # main.py:89
     scored_epoch: int = -1
+    optimizer: Any = None        # optax transform for local steps; None =
+                                 # plain SGD (reference parity, main.py:131)
 
     def register(self, ledger) -> LedgerStatus:
         return ledger.register_node(self.address)
@@ -76,7 +78,7 @@ class FLNode:
         delta, avg_cost = local_train(
             self.model.apply, global_params, self.x, self.y,
             lr=self.cfg.learning_rate, batch_size=self.cfg.batch_size,
-            local_epochs=self.cfg.local_epochs)
+            local_epochs=self.cfg.local_epochs, optimizer=self.optimizer)
         payload_hash = store.put(delta)
         st = ledger.upload_local_update(
             self.address, payload_hash, int(self.x.shape[0]),

@@ -59,10 +59,13 @@ def run_federated(model: Model,
                   ledger_backend: str = "auto",
                   seed: int = 0,
                   init_seed: int = 0,
+                  local_optimizer=None,
                   verbose: bool = False) -> SimulationResult:
     """Run the full committee-consensus protocol for `rounds` aggregations.
 
     shards: per-client (x, y) with integer class labels; test_set likewise.
+    local_optimizer: optional optax transform for the clients' local steps
+    (None = the reference's plain SGD).
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -73,7 +76,8 @@ def run_federated(model: Model,
         FLNode(address=f"0x{i:040x}",
                x=jnp.asarray(sx), y=jnp.asarray(one_hot(sy, nc)),
                model=model, cfg=cfg,
-               trained_epoch=cfg.initial_trained_epoch)
+               trained_epoch=cfg.initial_trained_epoch,
+               optimizer=local_optimizer)
         for i, (sx, sy) in enumerate(shards)
     ]
     xte, yte = test_set

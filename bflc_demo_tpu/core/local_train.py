@@ -39,15 +39,23 @@ def _num_batches(n: int, batch_size: int) -> int:
 
 def local_train_impl(apply_fn: ApplyFn, params: Pytree, x: jax.Array,
                      y: jax.Array, lr: float, batch_size: int,
-                     local_epochs: int = 1) -> Tuple[Pytree, jax.Array]:
-    """Run local SGD; return (delta, avg_cost).  Unjitted implementation —
-    compose it under vmap/shard_map (nested jit inside shard_map drops
+                     local_epochs: int = 1,
+                     optimizer=None) -> Tuple[Pytree, jax.Array]:
+    """Run local training; return (delta, avg_cost).  Unjitted implementation
+    — compose it under vmap/shard_map (nested jit inside shard_map drops
     varying-axis metadata); call `local_train` for the jitted entry point.
 
     delta is (params_in - params_out) / lr — the wire format of the reference
     (main.py:153-155), chosen so the coordinator's
     ``global -= lr * weighted_mean(delta)`` equals the sample-weighted mean of
-    client post-training models (exact FedAvg, SURVEY.md §2c).
+    client post-training models (exact FedAvg, SURVEY.md §2c).  This identity
+    holds for ANY local optimizer: delta always encodes the client's final
+    model relative to the global.
+
+    optimizer: an optax GradientTransformation for the local steps; None =
+    plain gradient descent at lr (the reference's
+    GradientDescentOptimizer(0.001), main.py:131).  Optimizer state is fresh
+    per round, like the reference rebuilding its graph each round.
 
     x: (n, *feature_dims), y: (n, num_classes) one-hot.  The first
     floor(n/batch_size)*batch_size examples are used, like the reference.
@@ -62,24 +70,38 @@ def local_train_impl(apply_fn: ApplyFn, params: Pytree, x: jax.Array,
 
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def sgd_step(p, batch):
-        bx, by = batch
-        cost, g = grad_fn(p, bx, by)
-        p = jax.tree_util.tree_map(lambda w, gw: w - lr * gw, p, g)
-        return p, cost
+    if optimizer is None:
+        def step(carry, batch):
+            p, _ = carry
+            bx, by = batch
+            cost, g = grad_fn(p, bx, by)
+            p = jax.tree_util.tree_map(lambda w, gw: w - lr * gw, p, g)
+            return (p, ()), cost
+        opt_state0 = ()
+    else:
+        def step(carry, batch):
+            p, opt_state = carry
+            bx, by = batch
+            cost, g = grad_fn(p, bx, by)
+            updates, opt_state = optimizer.update(g, opt_state, p)
+            import optax
+            p = optax.apply_updates(p, updates)
+            return (p, opt_state), cost
+        opt_state0 = optimizer.init(params)
 
-    def one_epoch(p, _):
-        p, costs = jax.lax.scan(sgd_step, p, (xb, yb))
-        return p, jnp.mean(costs)
+    def one_epoch(carry, _):
+        carry, costs = jax.lax.scan(step, carry, (xb, yb))
+        return carry, jnp.mean(costs)
 
-    trained, epoch_costs = jax.lax.scan(one_epoch, params, None,
-                                        length=local_epochs)
+    (trained, _), epoch_costs = jax.lax.scan(
+        one_epoch, (params, opt_state0), None, length=local_epochs)
     delta = jax.tree_util.tree_map(lambda a, b: (a - b) / lr, params, trained)
     return delta, jnp.mean(epoch_costs)
 
 
 local_train = functools.partial(
-    jax.jit, static_argnames=("apply_fn", "batch_size", "local_epochs")
+    jax.jit, static_argnames=("apply_fn", "batch_size", "local_epochs",
+                              "optimizer")
 )(local_train_impl)
 
 

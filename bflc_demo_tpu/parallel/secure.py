@@ -74,7 +74,8 @@ _PROGRAM_CACHE = {}
 
 
 def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
-                      clip: float = 64.0) -> Pytree:
+                      clip: float = 64.0,
+                      sum_bound: float | None = None) -> Pytree:
     """Sum client-stacked pytrees over the client axis with each client's
     fixed-point contribution blinded by pairwise-cancelling masks before the
     psum (see module docstring for the threat-model caveat).
@@ -83,17 +84,21 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
     clip: symmetric range bound for fixed-point encoding (values are
     clamped to [-clip, clip] before quantisation).
 
-    Capacity: the unmasked total must fit int32 fixed-point, i.e.
-    N * clip < 2^(31 - _FRAC_BITS) = 32768; larger products are rejected
-    (the mod-2^32 sum would silently wrap).  secure_fedavg pre-normalises
-    its weights so its sums are bounded by clip regardless of N.
+    Capacity: the unmasked total must fit int32 fixed-point, i.e. stay below
+    2^(31 - _FRAC_BITS) = 32768 in magnitude — the mod-2^32 sum would
+    silently wrap otherwise.  The guard uses `sum_bound` when given (callers
+    that pre-normalise, like secure_fedavg whose weights sum to 1, pass
+    sum_bound=clip so client count never spuriously trips it) and the
+    worst case N * clip otherwise.
     Returns the (replicated) sums, dequantised to float32.
     """
     n_total = jax.tree_util.tree_leaves(values)[0].shape[0]
-    if n_total * clip >= float(1 << (31 - _FRAC_BITS)):
+    bound = sum_bound if sum_bound is not None else n_total * clip
+    if bound >= float(1 << (31 - _FRAC_BITS)):
         raise ValueError(
-            f"fixed-point capacity exceeded: N*clip = {n_total * clip:g} "
-            f">= {1 << (31 - _FRAC_BITS)}; lower clip or pre-normalise")
+            f"fixed-point capacity exceeded: sum bound {bound:g} "
+            f">= {1 << (31 - _FRAC_BITS)}; lower clip, pre-normalise, or "
+            f"pass a tighter sum_bound")
 
     def body(vals, key):
         n_local = jax.tree_util.tree_leaves(vals)[0].shape[0]
@@ -118,9 +123,10 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
 
         return jax.tree_util.tree_map(one_leaf, vals)
 
-    # build-once per (mesh, structure, clip): round_key is an ARGUMENT so a
-    # new round never retraces (pp.py build-once convention)
-    cache_key = (id(mesh), jax.tree_util.tree_structure(values),
+    # build-once per (mesh, structure, shapes, clip): round_key is an
+    # ARGUMENT so a new round never retraces.  Mesh is hashable by value
+    # (devices + axis names), so no id()-aliasing across GC'd meshes.
+    cache_key = (mesh, jax.tree_util.tree_structure(values),
                  tuple(jax.tree_util.tree_leaves(
                      jax.tree_util.tree_map(lambda x: x.shape, values))),
                  float(clip))
@@ -147,7 +153,9 @@ def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
     weighted = jax.tree_util.tree_map(
         lambda d: d * (w / wsum).reshape((-1,) + (1,) * (d.ndim - 1)),
         deltas)
-    mean_delta = secure_masked_sum(mesh, weighted, round_key, clip=clip)
+    # weights sum to 1, so the true sum is bounded by clip regardless of N
+    mean_delta = secure_masked_sum(mesh, weighted, round_key, clip=clip,
+                                   sum_bound=clip)
     return jax.tree_util.tree_map(
         lambda g, m: g - jnp.asarray(lr, g.dtype) * m, global_params,
         mean_delta)

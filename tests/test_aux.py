@@ -40,6 +40,26 @@ class TestTracing:
         tr.dump_jsonl(str(out))
         assert out.read_text().count("\n") == 4   # 2 spans + 1 event + summary
 
+    def test_mesh_runtime_emits_costs(self, small_data):
+        from bflc_demo_tpu.client import run_federated_mesh
+        shards, test_set = small_data
+        tr = Tracer()
+        run_federated_mesh(make_softmax_regression(), shards, test_set,
+                           SMALL, rounds=2, seed=0, tracer=tr)
+        costs = tr.summary()["costs"]
+        assert costs["device.dispatches"] == 2
+        # 3 uploads + 2 scores + 1 commit per round
+        assert costs["ledger.ops"] == 2 * (3 + 2 + 1)
+        assert costs["host_bytes.out"] > 0
+        # the batched path charges the same ledger ops, fewer dispatches
+        tr2 = Tracer()
+        run_federated_mesh(make_softmax_regression(), shards, test_set,
+                           SMALL, rounds=2, seed=0, rounds_per_dispatch=2,
+                           tracer=tr2)
+        costs2 = tr2.summary()["costs"]
+        assert costs2["device.dispatches"] == 1
+        assert costs2["ledger.ops"] == 2 * (3 + 2 + 1)
+
     def test_disabled_is_noop(self):
         tr = Tracer(enabled=False)
         with tr.span("x"):
