@@ -1,0 +1,148 @@
+"""Property-based differential fuzzing of the ledger backends.
+
+The C++ ledger and its Python mirror must be observationally identical under
+ARBITRARY op sequences — not just the happy paths the unit tests script.
+Hypothesis drives random protocol traffic (valid and invalid interleaved)
+into both backends simultaneously and asserts lock-step equivalence of every
+status code and every piece of observable state, plus the protocol
+invariants the reference enforces via PBFT ordering (SURVEY.md §4
+"property tests: epoch monotonicity, at-most-one-update-per-client-per-
+round").
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus, bindings
+from bflc_demo_tpu.protocol import ProtocolConfig
+
+CFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                     needed_update_count=3)
+
+pytestmark = pytest.mark.skipif(not bindings.native_available(),
+                                reason="native ledger unavailable")
+
+ADDRS = [f"0x{i:03x}" for i in range(8)]
+# register draws only 0..5 (client_num=6) so addresses 6-7 are GUARANTEED
+# unregistered — uploads/scores from them always exercise the unknown-sender
+# paths
+ACTION = st.one_of(
+    st.tuples(st.just("register"), st.integers(0, 5)),
+    st.tuples(st.just("upload"), st.integers(0, 7), st.integers(-1, 1),
+              st.integers(0, 255), st.integers(1, 500)),
+    st.tuples(st.just("scores"), st.integers(0, 7), st.integers(-1, 1),
+              st.integers(0, 100)),
+    st.tuples(st.just("close"), ),
+    st.tuples(st.just("force"), ),
+    st.tuples(st.just("reseat"), st.lists(st.integers(0, 7), min_size=1,
+                                          max_size=3)),
+    st.tuples(st.just("commit"), st.integers(-1, 1), st.integers(0, 255)),
+)
+
+
+def _apply(led, action):
+    kind = action[0]
+    if kind == "register":
+        return led.register_node(ADDRS[action[1]])
+    if kind == "upload":
+        _, actor, ep_off, payload, nsamp = action
+        return led.upload_local_update(
+            ADDRS[actor], bytes([payload]) * 32, nsamp, 1.25,
+            led.epoch + ep_off)
+    if kind == "scores":
+        _, actor, ep_off, base = action
+        k = led.update_count
+        scores = [float(np.float32((base + j) / 101.0)) for j in range(k)]
+        return led.upload_scores(ADDRS[actor], led.epoch + ep_off, scores)
+    if kind == "close":
+        return led.close_round()
+    if kind == "force":
+        return led.force_aggregate()
+    if kind == "reseat":
+        return led.reseat_committee([ADDRS[i] for i in action[1]])
+    if kind == "commit":
+        _, ep_off, payload = action
+        return led.commit_model(bytes([payload]) * 32, led.epoch + ep_off)
+    raise AssertionError(kind)
+
+
+def _observe(led):
+    return {
+        "epoch": led.epoch,
+        "registered": led.num_registered,
+        "updates": led.update_count,
+        "scores": led.score_count,
+        "committee": led.committee(),
+        "ready": led.aggregate_ready(),
+        "closed": led.round_closed,
+        "log_size": led.log_size(),
+        "head": led.log_head(),
+        "model": led.query_global_model(),
+        # exact f32 equality — both backends compute in float32, so any
+        # reduction-order divergence must surface, not be rounded away
+        "loss": float(led.last_global_loss),
+    }
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.lists(ACTION, min_size=1, max_size=60))
+def test_native_python_lockstep(actions):
+    nat = make_ledger(CFG, backend="native")
+    py = make_ledger(CFG, backend="python")
+    for action in actions:
+        st_nat = _apply(nat, action)
+        st_py = _apply(py, action)
+        assert st_nat == st_py, (action, st_nat, st_py)
+        obs_n, obs_p = _observe(nat), _observe(py)
+        assert obs_n == obs_p, (action, obs_n, obs_p)
+    assert nat.verify_log() and py.verify_log()
+
+
+@settings(max_examples=150, deadline=None)
+@given(st.lists(ACTION, min_size=1, max_size=60))
+def test_protocol_invariants(actions):
+    led = make_ledger(CFG, backend="python")
+    last_epoch = led.epoch
+    uploaded_this_round = set()
+    for action in actions:
+        before_epoch = led.epoch
+        status = _apply(led, action)
+        # epoch moves forward only: genesis -> 0 on the client_num-th
+        # registration (the FL start trigger, .cpp:175-186), +1 on commit
+        assert led.epoch >= last_epoch
+        if led.epoch != before_epoch:
+            if before_epoch == CFG.genesis_epoch:
+                assert action[0] == "register" and led.epoch == 0
+                assert led.num_registered == CFG.client_num
+            else:
+                assert action[0] == "commit" and status == LedgerStatus.OK
+                assert led.epoch == before_epoch + 1
+                uploaded_this_round.clear()
+                # post-commit the round state is reset
+                assert led.update_count == 0 and led.score_count == 0
+                assert not led.round_closed and not led.aggregate_ready()
+        last_epoch = led.epoch
+        # at most one accepted upload per client per round, cap respected
+        if action[0] == "upload" and status == LedgerStatus.OK:
+            assert action[1] not in uploaded_this_round
+            uploaded_this_round.add(action[1])
+        assert led.update_count <= CFG.needed_update_count
+        # committee never exceeds comm_count
+        assert len(led.committee()) <= CFG.comm_count
+    assert led.verify_log()
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(ACTION, min_size=1, max_size=40))
+def test_replay_reconstructs_any_state(actions):
+    """Whatever traffic produced a ledger state, replaying its accepted-op
+    log into a fresh replica reproduces it exactly (the replication
+    contract — every op sequence, not just clean rounds)."""
+    led = make_ledger(CFG, backend="python")
+    for action in actions:
+        _apply(led, action)
+    replica = make_ledger(CFG, backend="python")
+    for i in range(led.log_size()):
+        assert replica.apply_op(led.log_op(i)) == LedgerStatus.OK
+    assert _observe(led) == _observe(replica)

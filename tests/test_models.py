@@ -67,6 +67,28 @@ def test_registry_complete():
                              "femnist_cnn", "resnet18"}
 
 
+def test_bfloat16_compute_path():
+    """MXU-native bf16 compute with f32 params/logits: the whole FL triangle
+    (train -> score -> fingerprint) runs and stays finite."""
+    model = make_lenet5((16, 16, 3), num_classes=4, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.random((64, 16, 16, 3)), jnp.float32)
+    y = jnp.asarray(np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)])
+    params = model.init_params(0)
+    logits = model.apply(params, x)
+    assert logits.dtype == jnp.float32          # head stays f32
+    delta, cost = local_train(model.apply, params, x, y, lr=0.05,
+                              batch_size=32)
+    assert np.isfinite(float(cost))
+    stacked = jax.tree_util.tree_map(
+        lambda d: jnp.stack([d, jnp.zeros_like(d)]), delta)
+    scores = score_candidates(model.apply, params, stacked, 0.05, x, y)
+    assert np.isfinite(np.asarray(scores)).all()
+    from bflc_demo_tpu.ops import fingerprint_pytree
+    fp = np.asarray(fingerprint_pytree(delta))
+    assert fp.shape == (8,)
+
+
 def test_mlp_learns_synthetic():
     model = make_mlp((8, 8, 1), hidden=64, num_classes=4)
     from bflc_demo_tpu.data.synthetic import synthetic_image_classification
