@@ -50,8 +50,15 @@ class FLNode:
     scored_epoch: int = -1
     optimizer: Any = None        # optax transform for local steps; None =
                                  # plain SGD (reference parity, main.py:131)
+    keyring: Any = None          # comm.identity.KeyRing: when set, every
+                                 # client-originated ledger op carries a MAC
+                                 # (the reference's per-client ECDSA signing)
 
     def register(self, ledger) -> LedgerStatus:
+        if self.keyring is not None:
+            from bflc_demo_tpu.comm.identity import sign_register
+            return ledger.register_node(
+                self.address, sign_register(self.keyring, self.address))
         return ledger.register_node(self.address)
 
     def step(self, ledger, store: UpdateStore,
@@ -80,9 +87,17 @@ class FLNode:
             lr=self.cfg.learning_rate, batch_size=self.cfg.batch_size,
             local_epochs=self.cfg.local_epochs, optimizer=self.optimizer)
         payload_hash = store.put(delta)
-        st = ledger.upload_local_update(
-            self.address, payload_hash, int(self.x.shape[0]),
-            float(avg_cost), epoch)
+        n_samples = int(self.x.shape[0])
+        if self.keyring is not None:
+            from bflc_demo_tpu.comm.identity import sign_upload
+            st = ledger.upload_local_update(
+                self.address, payload_hash, n_samples, float(avg_cost),
+                epoch, sign_upload(self.keyring, self.address, payload_hash,
+                                   n_samples, float(avg_cost), epoch))
+        else:
+            st = ledger.upload_local_update(
+                self.address, payload_hash, n_samples,
+                float(avg_cost), epoch)
         if st == LedgerStatus.OK:
             self.trained_epoch = epoch      # main.py:162-163
             return "train:OK"
@@ -104,8 +119,14 @@ class FLNode:
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *deltas)
         scores = score_candidates(self.model.apply, global_params, stacked,
                                   self.cfg.learning_rate, self.x, self.y)
-        st = ledger.upload_scores(self.address, epoch,
-                                  [float(s) for s in np.asarray(scores)])
+        score_list = [float(s) for s in np.asarray(scores)]
+        if self.keyring is not None:
+            from bflc_demo_tpu.comm.identity import sign_scores
+            st = ledger.upload_scores(
+                self.address, epoch, score_list,
+                sign_scores(self.keyring, self.address, epoch, score_list))
+        else:
+            st = ledger.upload_scores(self.address, epoch, score_list)
         self.scored_epoch = epoch
         return f"score:{st.name}" if st == LedgerStatus.OK else None
 

@@ -69,6 +69,7 @@ class ThreadedFederation:
                  crash_at: Optional[Dict[int, int]] = None,
                  stall_timeout_s: float = 5.0,
                  init_seed: int = 0,
+                 keyring=None,
                  tracer: Tracer = NULL_TRACER):
         cfg.validate()
         self.cfg = cfg
@@ -82,12 +83,19 @@ class ThreadedFederation:
             FLNode(address=f"0x{i:040x}",
                    x=jnp.asarray(sx), y=jnp.asarray(one_hot(sy, nc)),
                    model=model, cfg=cfg,
-                   trained_epoch=cfg.initial_trained_epoch)
+                   trained_epoch=cfg.initial_trained_epoch,
+                   keyring=keyring)
             for i, (sx, sy) in enumerate(shards)]
         xte, yte = test_set
         self.sponsor = Sponsor(model, jnp.asarray(xte),
                                jnp.asarray(one_hot(yte, nc)))
-        self.ledger = LockingLedger(make_ledger(cfg, backend=ledger_backend))
+        inner = make_ledger(cfg, backend=ledger_backend)
+        if keyring is not None:
+            # origin authentication at the transport boundary, inside the
+            # serialization lock (the reference's ECDSA-signed transactions)
+            from bflc_demo_tpu.comm.identity import AuthenticatedLedger
+            inner = AuthenticatedLedger(inner, keyring)
+        self.ledger = LockingLedger(inner)
         self.store = UpdateStore()
         self.plane = ComputePlane(cfg)
         self.params = model.init_params(init_seed)

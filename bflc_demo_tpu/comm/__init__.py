@@ -12,3 +12,5 @@ The reference's fabric is Channel-TLS RPC + PBFT carrying JSON-in-ABI strings
 """
 
 from bflc_demo_tpu.comm.store import UpdateStore  # noqa: F401
+from bflc_demo_tpu.comm.identity import (  # noqa: F401
+    KeyRing, AuthenticatedLedger, sign_register, sign_upload, sign_scores)

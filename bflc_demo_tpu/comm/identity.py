@@ -1,0 +1,144 @@
+"""Client identity + authenticated coordinator access.
+
+The reference gives every simulated client an ECDSA keypair
+(bin/get_batch_accounts.sh; SDK signer patch README.md:348-359) and the chain
+authenticates transactions at the transport layer — the contract itself
+trusts `origin`.  This module plays the same role at the same boundary:
+
+- `KeyRing`: derives per-client secrets from a master seed (the
+  get_batch_accounts.sh equivalent — one command provisions N identities)
+  and issues per-op MACs;
+- `AuthenticatedLedger`: a proxy that verifies a client's MAC over the
+  canonical op bytes before forwarding to ANY ledger backend — mutations
+  from unknown identities or with bad/replayed tags are rejected with
+  BAD_ARG before the coordinator sees them, exactly as the chain rejected
+  unsigned transactions before the contract ran.
+
+MACs are HMAC-SHA256 (shared-secret, provisioned at registration — the
+trust bootstrap the reference got from copying PEM files to clients).  Tags
+bind the op KIND, the sender, the epoch and the payload, and each tag is
+single-use per ledger instance (replay of an observed tag is rejected).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import struct
+from typing import Dict, Sequence
+
+from bflc_demo_tpu.ledger.base import LedgerStatus
+
+
+class KeyRing:
+    """Per-client secrets derived from one master seed."""
+
+    def __init__(self, master_seed: bytes):
+        if len(master_seed) < 16:
+            raise ValueError("master seed must be at least 16 bytes")
+        self._master = bytes(master_seed)
+
+    def secret_for(self, address: str) -> bytes:
+        return hashlib.sha256(self._master + b"|" + address.encode()).digest()
+
+    def mac(self, address: str, op_bytes: bytes) -> bytes:
+        return hmac.new(self.secret_for(address), op_bytes,
+                        hashlib.sha256).digest()
+
+
+def _op_bytes(kind: str, sender: str, epoch: int, payload: bytes) -> bytes:
+    b = bytearray()
+    kb = kind.encode()
+    sb = sender.encode()
+    b += struct.pack("<q", len(kb)) + kb
+    b += struct.pack("<q", len(sb)) + sb
+    b += struct.pack("<q", epoch)
+    b += struct.pack("<q", len(payload)) + payload
+    return bytes(b)
+
+
+class AuthenticatedLedger:
+    """MAC-verifying proxy in front of a ledger backend.
+
+    Client-originated mutations (register/upload/scores) require a valid
+    tag; reads and the runtime's coordinator-side ops (commit, recovery)
+    pass through — they are issued by the op-log writer itself, whose
+    authority is the log (comm/multihost.is_ledger_writer), not a client
+    identity.
+    """
+
+    def __init__(self, inner, keyring: KeyRing):
+        self._inner = inner
+        self._keys = keyring
+        # replay tracking bucketed by op epoch: stale buckets are pruned once
+        # the ledger moves past them (replays of old-epoch tags already fail
+        # the inner WRONG_EPOCH guard), keeping the set O(ops per round)
+        self._seen_tags: Dict[int, set] = {}
+
+    # --- authenticated mutations ---
+    def _verify(self, kind: str, sender: str, epoch: int, payload: bytes,
+                tag: bytes) -> bool:
+        expect = self._keys.mac(sender, _op_bytes(kind, sender, epoch,
+                                                  payload))
+        if not hmac.compare_digest(expect, tag):
+            return False
+        return tag not in self._seen_tags.get(epoch, ())
+
+    def _consume(self, epoch: int, tag: bytes) -> None:
+        """Mark a tag used — called only after the inner ledger ACCEPTED the
+        op, so a transiently-rejected op (e.g. scores before the round fills)
+        can be legitimately retried with the same deterministic MAC."""
+        current = self._inner.epoch
+        for ep in [e for e in self._seen_tags if e < current]:
+            del self._seen_tags[ep]
+        self._seen_tags.setdefault(epoch, set()).add(tag)
+
+    def register_node(self, addr: str, tag: bytes) -> LedgerStatus:
+        if not self._verify("register", addr, 0, b"", tag):
+            return LedgerStatus.BAD_ARG
+        st = self._inner.register_node(addr)
+        if st == LedgerStatus.OK:
+            self._consume(0, tag)
+        return st
+
+    def upload_local_update(self, sender: str, payload_hash: bytes,
+                            n_samples: int, avg_cost: float, epoch: int,
+                            tag: bytes) -> LedgerStatus:
+        body = payload_hash + struct.pack("<qd", n_samples, avg_cost)
+        if not self._verify("upload", sender, epoch, body, tag):
+            return LedgerStatus.BAD_ARG
+        st = self._inner.upload_local_update(sender, payload_hash,
+                                             n_samples, avg_cost, epoch)
+        if st == LedgerStatus.OK:
+            self._consume(epoch, tag)
+        return st
+
+    def upload_scores(self, sender: str, epoch: int,
+                      scores: Sequence[float], tag: bytes) -> LedgerStatus:
+        body = struct.pack(f"<{len(scores)}d", *scores)
+        if not self._verify("scores", sender, epoch, body, tag):
+            return LedgerStatus.BAD_ARG
+        st = self._inner.upload_scores(sender, epoch, scores)
+        if st == LedgerStatus.OK:
+            self._consume(epoch, tag)
+        return st
+
+    # --- everything else passes through to the backend ---
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def sign_register(keys: KeyRing, addr: str) -> bytes:
+    return keys.mac(addr, _op_bytes("register", addr, 0, b""))
+
+
+def sign_upload(keys: KeyRing, sender: str, payload_hash: bytes,
+                n_samples: int, avg_cost: float, epoch: int) -> bytes:
+    body = payload_hash + struct.pack("<qd", n_samples, avg_cost)
+    return keys.mac(sender, _op_bytes("upload", sender, epoch, body))
+
+
+def sign_scores(keys: KeyRing, sender: str, epoch: int,
+                scores: Sequence[float]) -> bytes:
+    body = struct.pack(f"<{len(scores)}d", *scores)
+    return keys.mac(sender, _op_bytes("scores", sender, epoch, body))

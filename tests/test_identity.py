@@ -1,0 +1,137 @@
+"""Authenticated-ledger tests: identity provisioning, MAC verification,
+replay rejection, full authenticated round."""
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.comm.identity import (KeyRing, AuthenticatedLedger,
+                                         sign_register, sign_upload,
+                                         sign_scores)
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.protocol import ProtocolConfig
+
+CFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                     needed_update_count=3)
+
+
+def addr(i):
+    return f"0x{i:03x}"
+
+
+@pytest.fixture
+def auth_led():
+    keys = KeyRing(b"master-seed-0123456789abcdef")
+    led = AuthenticatedLedger(make_ledger(CFG, backend="python"), keys)
+    return led, keys
+
+
+class TestIdentity:
+    def test_keyring_deterministic_distinct(self):
+        k = KeyRing(b"master-seed-0123456789abcdef")
+        assert k.secret_for("0x001") == k.secret_for("0x001")
+        assert k.secret_for("0x001") != k.secret_for("0x002")
+        with pytest.raises(ValueError):
+            KeyRing(b"short")
+
+    def test_valid_round_trip(self, auth_led):
+        led, keys = auth_led
+        for i in range(CFG.client_num):
+            st = led.register_node(addr(i), sign_register(keys, addr(i)))
+            assert st == LedgerStatus.OK
+        assert led.epoch == 0
+        st = led.upload_local_update(
+            addr(3), b"\1" * 32, 100, 1.5, 0,
+            sign_upload(keys, addr(3), b"\1" * 32, 100, 1.5, 0))
+        assert st == LedgerStatus.OK
+
+    def test_wrong_key_rejected(self, auth_led):
+        led, _ = auth_led
+        impostor = KeyRing(b"some-other-master-seed-xxxxx")
+        st = led.register_node(addr(0), sign_register(impostor, addr(0)))
+        assert st == LedgerStatus.BAD_ARG
+        assert led.num_registered == 0
+
+    def test_tag_bound_to_content(self, auth_led):
+        led, keys = auth_led
+        for i in range(CFG.client_num):
+            led.register_node(addr(i), sign_register(keys, addr(i)))
+        tag = sign_upload(keys, addr(3), b"\1" * 32, 100, 1.5, 0)
+        # altered payload under the same tag
+        st = led.upload_local_update(addr(3), b"\2" * 32, 100, 1.5, 0, tag)
+        assert st == LedgerStatus.BAD_ARG
+        # altered epoch under the same tag
+        st = led.upload_local_update(addr(3), b"\1" * 32, 100, 1.5, 1, tag)
+        assert st == LedgerStatus.BAD_ARG
+        # sender substitution: client 4 replaying client 3's tag
+        st = led.upload_local_update(addr(4), b"\1" * 32, 100, 1.5, 0, tag)
+        assert st == LedgerStatus.BAD_ARG
+        assert led.update_count == 0
+
+    def test_replay_rejected(self, auth_led):
+        led, keys = auth_led
+        for i in range(CFG.client_num):
+            led.register_node(addr(i), sign_register(keys, addr(i)))
+        tag = sign_upload(keys, addr(3), b"\1" * 32, 100, 1.5, 0)
+        assert led.upload_local_update(addr(3), b"\1" * 32, 100, 1.5, 0,
+                                       tag) == LedgerStatus.OK
+        # an eavesdropper replaying the exact same authenticated op
+        assert led.upload_local_update(addr(3), b"\1" * 32, 100, 1.5, 0,
+                                       tag) == LedgerStatus.BAD_ARG
+
+    def test_retry_after_transient_rejection_allowed(self, auth_led):
+        """A tag is consumed only when the op is ACCEPTED: scores rejected
+        as NOT_READY (round under-filled) may be resent with the same MAC
+        once close_round opens the way."""
+        led, keys = auth_led
+        for i in range(CFG.client_num):
+            led.register_node(addr(i), sign_register(keys, addr(i)))
+        for i in (2, 3):     # only 2 of the needed 3 updates arrive
+            h = bytes([i]) * 32
+            led.upload_local_update(
+                addr(i), h, 100, 1.0, 0,
+                sign_upload(keys, addr(i), h, 100, 1.0, 0))
+        comm = led.committee()[0]
+        scores = [0.5, 0.7]
+        tag = sign_scores(keys, comm, 0, scores)
+        assert led.upload_scores(comm, 0, scores, tag) == \
+            LedgerStatus.NOT_READY
+        assert led.close_round() == LedgerStatus.OK
+        assert led.upload_scores(comm, 0, scores, tag) == LedgerStatus.OK
+
+    def test_threaded_runtime_authenticated(self):
+        """The concurrent runtime with a keyring: every client op carries a
+        MAC through the locked transport boundary and the run converges."""
+        from bflc_demo_tpu.client.threaded import ThreadedFederation
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+        from bflc_demo_tpu.models import make_softmax_regression
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:2000], ytr[:2000], CFG.client_num)
+        fed = ThreadedFederation(
+            make_softmax_regression(), shards, (xte[:500], yte[:500]), CFG,
+            keyring=KeyRing(b"threaded-master-seed-123456"))
+        res = fed.run(rounds=2, timeout_s=120)
+        assert res.rounds_completed == 2
+        assert res.ledger.verify_log()
+
+    def test_full_authenticated_round(self, auth_led):
+        led, keys = auth_led
+        for i in range(CFG.client_num):
+            led.register_node(addr(i), sign_register(keys, addr(i)))
+        for i in (2, 3, 4):
+            h = bytes([i]) * 32
+            st = led.upload_local_update(
+                addr(i), h, 100 + i, 1.0, 0,
+                sign_upload(keys, addr(i), h, 100 + i, 1.0, 0))
+            assert st == LedgerStatus.OK
+        rng = np.random.default_rng(0)
+        for c in led.committee():
+            scores = [float(s) for s in rng.random(3)]
+            st = led.upload_scores(c, 0, scores,
+                                   sign_scores(keys, c, 0, scores))
+            assert st == LedgerStatus.OK
+        assert led.aggregate_ready()
+        # coordinator-side ops pass through unauthenticated (writer authority)
+        assert led.commit_model(b"\x09" * 32,
+                                0) == LedgerStatus.OK
+        assert led.epoch == 1
+        assert led.verify_log()
