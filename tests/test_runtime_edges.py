@@ -1,0 +1,94 @@
+"""Host-runtime edge cases: stale-epoch retry, store hygiene, compute-plane
+guards — the seams between FLNode, UpdateStore, ComputePlane and the ledger."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.client.runtime import FLNode, ComputePlane
+from bflc_demo_tpu.comm import UpdateStore
+from bflc_demo_tpu.data import load_occupancy, iid_shards, one_hot
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.models import make_softmax_regression
+from bflc_demo_tpu.protocol import ProtocolConfig
+
+CFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                     needed_update_count=3, learning_rate=0.001,
+                     batch_size=50)
+MODEL = make_softmax_regression()
+
+
+def _setup():
+    xtr, ytr, _, _ = load_occupancy()
+    shards = iid_shards(xtr[:1200], ytr[:1200], CFG.client_num)
+    nodes = [FLNode(address=f"0x{i:03x}", x=jnp.asarray(sx),
+                    y=jnp.asarray(one_hot(sy, 2)), model=MODEL, cfg=CFG)
+             for i, (sx, sy) in enumerate(shards)]
+    ledger = make_ledger(CFG, backend="python")
+    for n in nodes:
+        n.register(ledger)
+    return nodes, ledger, UpdateStore(), MODEL.init_params(0)
+
+
+def test_stale_epoch_upload_leaves_node_retryable():
+    """If the round advances between a node reading the epoch and uploading,
+    FLNode._train drops the rejected payload from the store and leaves
+    trained_epoch untouched so the node retries at the new epoch (reviewed
+    leak/wedge case) — driven through the node's real upload path with a
+    stale epoch value."""
+    nodes, ledger, store, params = _setup()
+    trainer = nodes[2]
+    # the race, through the real path: the node acts on a stale epoch read
+    out = trainer._train(ledger, store, params, epoch=7)
+    assert out is None
+    assert len(store) == 0                  # rejected payload reclaimed
+    assert trainer.trained_epoch == CFG.initial_trained_epoch
+    # next event sees the true epoch and succeeds
+    acted = trainer.step(ledger, store, params)
+    assert acted == "train:OK"
+    assert trainer.trained_epoch == 0
+    assert len(store) == 1
+
+
+def test_cap_rejection_drops_payload_from_store():
+    nodes, ledger, store, params = _setup()
+    for n in nodes[2:5]:                    # fills the 3-update round
+        assert n.step(ledger, store, params) == "train:OK"
+    assert len(store) == 3
+    late = nodes[5]
+    assert late.step(ledger, store, params) == "train:CAP_REACHED"
+    assert len(store) == 3                  # late payload not retained
+    assert late.trained_epoch == 0          # done for this epoch anyway
+
+
+def test_compute_plane_clears_round_payloads():
+    nodes, ledger, store, params = _setup()
+    for n in nodes[2:5]:
+        n.step(ledger, store, params)
+    for n in nodes[:2]:                     # committee scores
+        n.step(ledger, store, params)
+    assert ledger.aggregate_ready()
+    plane = ComputePlane(CFG)
+    new_params = plane.maybe_aggregate(ledger, store, params)
+    assert new_params is not None
+    assert len(store) == 0                  # round payloads reclaimed
+    assert ledger.epoch == 1
+
+
+def test_compute_plane_noop_when_not_ready():
+    nodes, ledger, store, params = _setup()
+    plane = ComputePlane(CFG)
+    assert plane.maybe_aggregate(ledger, store, params) is None
+
+
+def test_committee_node_waits_for_full_round():
+    nodes, ledger, store, params = _setup()
+    comm = nodes[0]
+    assert comm.step(ledger, store, params) is None     # nothing to score
+    nodes[2].step(ledger, store, params)
+    assert comm.step(ledger, store, params) is None     # still under-filled
+    nodes[3].step(ledger, store, params)
+    nodes[4].step(ledger, store, params)
+    assert comm.step(ledger, store, params) == "score:OK"
+    # one score per epoch (main.py:221-222 semantics)
+    assert comm.step(ledger, store, params) is None
