@@ -118,6 +118,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.bflc_log_op.argtypes = [p, i64, u8p, i64]
     lib.bflc_apply_op.restype = i32
     lib.bflc_apply_op.argtypes = [p, u8p, i64]
+    lib.bflc_attach_wal.restype = i32
+    lib.bflc_attach_wal.argtypes = [p, ctypes.c_char_p]
+    lib.bflc_detach_wal.argtypes = [p]
+    lib.bflc_replay_wal.restype = i64
+    lib.bflc_replay_wal.argtypes = [p, ctypes.c_char_p]
     lib.bflc_sha256.argtypes = [u8p, i64, u8p]
 
 
@@ -306,3 +311,20 @@ class NativeLedger:
     def apply_op(self, op: bytes) -> LedgerStatus:
         buf = (ctypes.c_uint8 * len(op))(*op)
         return LedgerStatus(self._lib.bflc_apply_op(self._h, buf, len(op)))
+
+    # --- write-ahead log ---
+    def attach_wal(self, path: str) -> bool:
+        return self._lib.bflc_attach_wal(self._h, path.encode()) == 0
+
+    def detach_wal(self) -> None:
+        self._lib.bflc_detach_wal(self._h)
+
+    def replay_wal(self, path: str) -> int:
+        """Apply a WAL file's ops; returns ops applied, raises on a corrupt
+        file or an op the state machine rejects."""
+        n = self._lib.bflc_replay_wal(self._h, path.encode())
+        if n == -1:
+            raise ValueError(f"not a bflc WAL (or unreadable): {path}")
+        if n < 0:
+            raise ValueError(f"WAL replay rejected op {-(n + 2)}: {path}")
+        return int(n)

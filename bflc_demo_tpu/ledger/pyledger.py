@@ -49,6 +49,7 @@ class PyLedger:
         self._closed = False
         self._ops: List[bytes] = []
         self._log: List[bytes] = []
+        self._wal = None
 
     # --- log plumbing (must match ledger.cpp append_log exactly) ---
     def _append_log(self, op: bytes) -> None:
@@ -58,6 +59,58 @@ class PyLedger:
         h.update(op)
         self._ops.append(op)
         self._log.append(h.digest())
+        if self._wal is not None:
+            # matches ledger.cpp: a write failure detaches the WAL (state
+            # machine keeps serving, observably un-journaled) instead of
+            # raising out of the mutation or silently dropping records
+            try:
+                self._wal.write(struct.pack("<Q", len(op)) + op)
+                self._wal.flush()
+            except OSError:
+                self.detach_wal()
+
+    # --- write-ahead log (format matches ledger.cpp / capi.cpp) ---
+    _WAL_MAGIC = b"BFLCWAL1"
+
+    def attach_wal(self, path: str) -> bool:
+        self.detach_wal()
+        try:
+            f = open(path, "wb")
+        except OSError:
+            return False
+        f.write(self._WAL_MAGIC)
+        for op in self._ops:
+            f.write(struct.pack("<Q", len(op)) + op)
+        f.flush()
+        self._wal = f
+        return True
+
+    def detach_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+    def replay_wal(self, path: str) -> int:
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:     # parity with NativeLedger's ValueError
+            raise ValueError(
+                f"not a bflc WAL (or unreadable): {path}") from e
+        if not blob.startswith(self._WAL_MAGIC):
+            raise ValueError(f"not a bflc WAL (or unreadable): {path}")
+        off = len(self._WAL_MAGIC)
+        applied = 0
+        while off + 8 <= len(blob):
+            (n,) = struct.unpack_from("<Q", blob, off)
+            if n > (1 << 26) or off + 8 + n > len(blob):
+                break                      # torn/corrupt trailing record
+            op = blob[off + 8:off + 8 + n]
+            off += 8 + n
+            if self.apply_op(op) != LedgerStatus.OK:
+                raise ValueError(f"WAL replay rejected op {applied}: {path}")
+            applied += 1
+        return applied
 
     # --- protocol surface ---
     def register_node(self, addr: str) -> LedgerStatus:

@@ -206,6 +206,47 @@ int32_t bflc_apply_op(void* h, const uint8_t* buf, int64_t len) {
   return int32_t(static_cast<CommitteeLedger*>(h)->apply_serialized(op));
 }
 
+// --- write-ahead log ---
+int32_t bflc_attach_wal(void* h, const char* path) {
+  return static_cast<CommitteeLedger*>(h)->attach_wal(path) ? 0 : -1;
+}
+
+void bflc_detach_wal(void* h) {
+  static_cast<CommitteeLedger*>(h)->detach_wal();
+}
+
+// Replay a WAL file into the ledger.  Returns the number of ops applied, or
+// -1 on open/magic failure.  A torn trailing record (crash mid-append) is
+// skipped; an op the state machine rejects stops replay (corrupt file).
+int64_t bflc_replay_wal(void* h, const char* path) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[8];
+  if (std::fread(magic, 1, 8, f) != 8 ||
+      std::memcmp(magic, "BFLCWAL1", 8) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  int64_t applied = 0;
+  auto* led = static_cast<CommitteeLedger*>(h);
+  for (;;) {
+    uint8_t hdr[8];
+    if (std::fread(hdr, 1, 8, f) != 8) break;        // clean EOF / torn size
+    uint64_t n = 0;
+    for (int i = 0; i < 8; ++i) n |= uint64_t(hdr[i]) << (8 * i);
+    if (n > (1u << 26)) break;                       // implausible: corrupt
+    std::vector<uint8_t> op(n);
+    if (std::fread(op.data(), 1, n, f) != n) break;  // torn record: stop
+    if (led->apply_serialized(op) != Status::OK) {
+      std::fclose(f);
+      return -(applied + 2);   // signal rejection point (negative, != -1)
+    }
+    ++applied;
+  }
+  std::fclose(f);
+  return applied;
+}
+
 // stand-alone SHA-256 so Python and C++ agree on payload hashing
 void bflc_sha256(const uint8_t* data, int64_t len, uint8_t* out32) {
   Digest d = bflc::Sha256::hash(data, size_t(len));

@@ -85,8 +85,50 @@ float median_of(std::vector<float> v) {
 
 }  // namespace
 
+namespace {
+constexpr char kWalMagic[] = "BFLCWAL1";     // 8 bytes incl. no terminator use
+}
+
 CommitteeLedger::CommitteeLedger(const LedgerConfig& cfg)
     : cfg_(cfg), epoch_(cfg.genesis_epoch) {}
+
+CommitteeLedger::~CommitteeLedger() { detach_wal(); }
+
+static bool wal_write_record(std::FILE* f, const std::vector<uint8_t>& op,
+                             bool flush) {
+  uint8_t hdr[8];
+  uint64_t n = op.size();
+  for (int i = 0; i < 8; ++i) hdr[i] = uint8_t(n >> (8 * i));
+  if (std::fwrite(hdr, 1, 8, f) != 8) return false;
+  if (std::fwrite(op.data(), 1, op.size(), f) != op.size()) return false;
+  if (flush && std::fflush(f) != 0) return false;
+  return true;
+}
+
+bool CommitteeLedger::attach_wal(const std::string& path) {
+  detach_wal();
+  std::FILE* f = std::fopen(path.c_str(), "wb");
+  if (!f) return false;
+  // snapshot the accepted history with ONE flush at the end
+  bool ok = std::fwrite(kWalMagic, 1, 8, f) == 8;
+  for (const auto& op : ops_) {
+    if (!ok) break;
+    ok = wal_write_record(f, op, /*flush=*/false);
+  }
+  if (!ok || std::fflush(f) != 0) {
+    std::fclose(f);
+    return false;
+  }
+  wal_ = f;
+  return true;
+}
+
+void CommitteeLedger::detach_wal() {
+  if (wal_) {
+    std::fclose(wal_);
+    wal_ = nullptr;
+  }
+}
 
 void CommitteeLedger::append_log(const std::vector<uint8_t>& op) {
   Sha256 h;
@@ -94,6 +136,11 @@ void CommitteeLedger::append_log(const std::vector<uint8_t>& op) {
   h.update(op.data(), op.size());
   ops_.push_back(op);
   log_.push_back(h.finish());
+  // durability point: the op reaches the WAL before the call returns.
+  // A write failure (ENOSPC, EIO) detaches the WAL so wal_attached() flips
+  // false — the in-memory state machine keeps serving, observably
+  // un-journaled, rather than silently losing records.
+  if (wal_ && !wal_write_record(wal_, op, /*flush=*/true)) detach_wal();
 }
 
 Digest CommitteeLedger::log_head() const {

@@ -33,6 +33,7 @@
 #pragma once
 
 #include <cstdint>
+#include <cstdio>
 #include <map>
 #include <optional>
 #include <string>
@@ -144,6 +145,21 @@ class CommitteeLedger {
   // status the op produced (replicas must observe the same).
   Status apply_serialized(const std::vector<uint8_t>& op);
 
+  // --- write-ahead log (durable op streaming) ---
+  // Attach a WAL file: existing accepted ops are written out, then every
+  // subsequently accepted op is appended and flushed before the mutation
+  // returns.  PROCESS-crash durability: a crash mid-append leaves at most
+  // one torn trailing record, which recovery skips.  (fflush reaches the OS
+  // page cache, not the platter — power-loss durability would need fsync
+  // per record, a policy left to deployments that need it.)  A write
+  // failure (ENOSPC/EIO) detaches the WAL; poll wal_attached() to notice.
+  bool attach_wal(const std::string& path);
+  void detach_wal();
+  bool wal_attached() const { return wal_ != nullptr; }
+  ~CommitteeLedger();
+  CommitteeLedger(const CommitteeLedger&) = delete;      // owns a FILE*
+  CommitteeLedger& operator=(const CommitteeLedger&) = delete;
+
  private:
   void append_log(const std::vector<uint8_t>& op);
   void maybe_start(const std::string& addr);
@@ -164,6 +180,7 @@ class CommitteeLedger {
 
   std::vector<std::vector<uint8_t>> ops_;  // serialized accepted mutations
   std::vector<Digest> log_;                // chained digests, log_[i] covers ops_[0..i]
+  std::FILE* wal_ = nullptr;               // durable op stream (optional)
 };
 
 }  // namespace bflc
