@@ -10,6 +10,7 @@ oracle in tests, (c) readable documentation of the protocol.
 from __future__ import annotations
 
 import hashlib
+import math
 import struct
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -147,6 +148,11 @@ class PyLedger:
             return LedgerStatus.WRONG_EPOCH
         if sender in self._update_slot:
             return LedgerStatus.DUPLICATE
+        # update set freezes once scoring can begin (matches ledger.cpp):
+        # score rows are sized to the update count at upload time, so a late
+        # update after close_round()/first score row would desynchronize them
+        if self._closed or self._scores:
+            return LedgerStatus.CAP_REACHED
         if len(self._updates) >= self.needed_update_count:
             return LedgerStatus.CAP_REACHED
         self._update_slot[sender] = len(self._updates)
@@ -173,12 +179,19 @@ class PyLedger:
             return LedgerStatus.NOT_COMMITTEE
         if len(scores) != len(self._updates):
             return LedgerStatus.BAD_ARG
+        # non-finite scores never enter the log (matches ledger.cpp): NaN
+        # breaks sort ordering and diverges between backends.  Checked after
+        # float32 conversion — a finite float64 can overflow to inf in f32.
+        with np.errstate(over="ignore"):      # overflow-to-inf is the point
+            vals = [float(np.float32(s)) for s in scores]
+        if any(not math.isfinite(v) for v in vals):
+            return LedgerStatus.BAD_ARG
         if len(self._updates) < self.needed_update_count and not self._closed:
             return LedgerStatus.NOT_READY
         # outcome frozen once scoring completed (matches ledger.cpp)
         if self._pending is not None:
             return LedgerStatus.NOT_READY
-        self._scores[sender] = [float(np.float32(s)) for s in scores]
+        self._scores[sender] = vals
         op = bytearray([_OP_SCORES])
         _put_str(op, sender)
         op += struct.pack("<q", epoch)
@@ -262,12 +275,19 @@ class PyLedger:
     def _finish_scoring(self) -> None:
         k = len(self._updates)
         # scorer iteration in address order (C++ std::map key order == bytewise
-        # string order == Python sorted() on str for ASCII addresses)
-        rows = [self._scores[a] for a in sorted(self._scores)]
-        cols = np.asarray(rows, np.float32)          # (C, k)
-        srt = np.sort(cols, axis=0)
-        n = cols.shape[0]
-        medians = 0.5 * (srt[(n - 1) // 2] + srt[n // 2])
+        # string order == Python sorted() on str for ASCII addresses).  Rows
+        # with a stale length are skipped, matching ledger.cpp's
+        # defense-in-depth guard (they can't occur through the API: the
+        # update set freezes once scoring begins).
+        rows = [self._scores[a] for a in sorted(self._scores)
+                if len(self._scores[a]) == k]
+        if not rows:
+            medians = np.zeros(k, np.float32)
+        else:
+            cols = np.asarray(rows, np.float32)          # (C, k)
+            srt = np.sort(cols, axis=0)
+            n = cols.shape[0]
+            medians = 0.5 * (srt[(n - 1) // 2] + srt[n // 2])
         order = sorted(range(k), key=lambda s: (-medians[s], s))
         take = min(self.aggregate_count, k)
         selected = order[:take]
@@ -363,25 +383,34 @@ class PyLedger:
         if not op:
             return LedgerStatus.BAD_ARG
         code, body = op[0], op[1:]
+
+        def _str_at(off: int):
+            # bounds-checked string read matching the C++ Reader: a length
+            # that runs past the buffer is a malformed op, never a silently
+            # truncated Python slice
+            (n,) = struct.unpack_from("<q", body, off)
+            if n < 0 or off + 8 + n > len(body):
+                raise IndexError("string past end of op")
+            return body[off + 8:off + 8 + n].decode(), off + 8 + n
+
         try:
             if code == _OP_REGISTER:
-                (n,) = struct.unpack_from("<q", body, 0)
-                return self.register_node(body[8:8 + n].decode())
+                addr, _ = _str_at(0)
+                return self.register_node(addr)
             if code == _OP_UPLOAD:
-                (n,) = struct.unpack_from("<q", body, 0)
-                off = 8 + n
-                sender = body[8:off].decode()
+                sender, off = _str_at(0)
                 payload = body[off:off + 32]
                 ns, = struct.unpack_from("<q", body, off + 32)
                 cost, = struct.unpack_from("<f", body, off + 40)
                 ep, = struct.unpack_from("<q", body, off + 44)
                 return self.upload_local_update(sender, payload, ns, cost, ep)
             if code == _OP_SCORES:
-                (n,) = struct.unpack_from("<q", body, 0)
-                off = 8 + n
-                sender = body[8:off].decode()
+                sender, off = _str_at(0)
                 ep, = struct.unpack_from("<q", body, off)
                 cnt, = struct.unpack_from("<q", body, off + 8)
+                # bound cnt by the bytes present, matching ledger.cpp
+                if cnt < 0 or off + 16 + 4 * cnt > len(body):
+                    return LedgerStatus.BAD_ARG
                 scores = list(struct.unpack_from(f"<{cnt}f", body, off + 16))
                 return self.upload_scores(sender, ep, scores)
             if code == _OP_COMMIT:
@@ -401,15 +430,15 @@ class PyLedger:
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)
-                if ep != self._epoch or n <= 0:
+                # each address needs at least its 8-byte length prefix
+                # (matches ledger.cpp's pre-loop bound)
+                if ep != self._epoch or n <= 0 or n > (len(body) - 16) // 8:
                     return LedgerStatus.BAD_ARG
                 off = 16
                 addrs = []
                 for _ in range(n):
-                    (ln,) = struct.unpack_from("<q", body, off)
-                    off += 8
-                    addrs.append(body[off:off + ln].decode())
-                    off += ln
+                    a, off = _str_at(off)
+                    addrs.append(a)
                 return self.reseat_committee(addrs)
         except (struct.error, UnicodeDecodeError, IndexError):
             return LedgerStatus.BAD_ARG

@@ -1,6 +1,7 @@
 #include "ledger.h"
 
 #include <algorithm>
+#include <cmath>
 #include <cstring>
 
 namespace bflc {
@@ -206,6 +207,17 @@ Status CommitteeLedger::upload_local_update(const std::string& sender,
   if (epoch_ == cfg_.genesis_epoch) return Status::NOT_STARTED;
   if (epoch != epoch_) return Status::WRONG_EPOCH;          // .cpp:225-226
   if (update_slot_.count(sender)) return Status::DUPLICATE;  // .cpp:232-233
+  // The update set freezes once scoring can begin: score rows are sized to
+  // the update count at upload time, so a late update after close_round()
+  // (or after any score row landed) would desynchronize row lengths and
+  // corrupt the medians.  No reference equivalent — the contract can't close
+  // a round early, so its update set only grows before scoring.
+  // Compat note: a WAL written by pre-guard code that logged such an op now
+  // stops replay at it with a clean rejection.  That log was already
+  // poisoned — replaying it reproduced the out-of-bounds corruption — so
+  // failing loudly at the exact op is the recovery improvement, not a
+  // format break.
+  if (closed_ || !scores_.empty()) return Status::CAP_REACHED;
   if (int64_t(updates_.size()) >= cfg_.needed_update_count)
     return Status::CAP_REACHED;                              // .cpp:239-244
   // parity note: like the contract, no role check here — the reference never
@@ -231,6 +243,11 @@ Status CommitteeLedger::upload_scores(const std::string& sender, int64_t epoch,
   if (it == roles_.end() || it->second != Role::COMMITTEE)
     return Status::NOT_COMMITTEE;                            // .cpp:272-275
   if (len != updates_.size()) return Status::BAD_ARG;
+  // Non-finite scores never enter the log: NaN breaks the strict weak
+  // ordering of the median/ranking sorts (UB) and NaN ordering diverges
+  // between backends, so a Byzantine scorer could fork the replicas.
+  for (size_t i = 0; i < len; ++i)
+    if (!std::isfinite(scores[i])) return Status::BAD_ARG;
   if (int64_t(updates_.size()) < cfg_.needed_update_count && !closed_)
     return Status::NOT_READY;  // scoring starts once the round is full
   // once the committee is complete the outcome is frozen until commit — a
@@ -269,7 +286,11 @@ void CommitteeLedger::finish_scoring() {
   for (size_t s = 0; s < k; ++s) {
     std::vector<float> col;
     col.reserve(scores_.size());
-    for (const auto& kv : scores_) col.push_back(kv.second[s]);
+    // rows are length-checked at upload and the update set freezes once
+    // scoring begins, so every row has length k; skip any that don't
+    // (defense in depth — never index past a row's end)
+    for (const auto& kv : scores_)
+      if (kv.second.size() == k) col.push_back(kv.second[s]);
     p.medians[s] = median_of(std::move(col));
   }
   p.order = rank_slots(p.medians);
@@ -399,7 +420,9 @@ Status CommitteeLedger::apply_serialized(const std::vector<uint8_t>& op) {
       std::string sender = r.str();
       int64_t ep = r.i64();
       int64_t len = r.i64();
-      if (!r.ok || len < 0) return Status::BAD_ARG;
+      // bound len by the bytes actually present (4 per score) BEFORE
+      // allocating — a corrupt/hostile op could claim an exabyte here
+      if (!r.ok || len < 0 || len > (r.end - r.p) / 4) return Status::BAD_ARG;
       std::vector<float> sc(static_cast<size_t>(len));
       for (auto& v : sc) v = r.f32();
       if (!r.ok) return Status::BAD_ARG;
@@ -424,9 +447,12 @@ Status CommitteeLedger::apply_serialized(const std::vector<uint8_t>& op) {
     case OP_RESEAT: {
       int64_t ep = r.i64();
       int64_t n = r.i64();
-      if (!r.ok || ep != epoch_ || n <= 0) return Status::BAD_ARG;
+      // every address needs at least its 8-byte length prefix, so n is
+      // bounded by the remaining bytes — check BEFORE looping
+      if (!r.ok || ep != epoch_ || n <= 0 || n > (r.end - r.p) / 8)
+        return Status::BAD_ARG;
       std::vector<std::string> addrs;
-      for (int64_t i = 0; i < n; ++i) addrs.push_back(r.str());
+      for (int64_t i = 0; i < n && r.ok; ++i) addrs.push_back(r.str());
       if (!r.ok) return Status::BAD_ARG;
       return reseat_committee(addrs);
     }

@@ -250,3 +250,94 @@ class TestNativePythonEquivalence:
         for i in range(nat.log_size()):
             assert py.apply_op(nat.log_op(i)) == LedgerStatus.OK
         assert py.log_head() == nat.log_head()
+
+
+class TestHardening:
+    """Round-2 guards: frozen update set, finite scores, hostile op bounds
+    (advisor findings: post-close uploads desynchronized score-row lengths
+    into an OOB read; apply-op length fields were trusted before allocation;
+    NaN scores broke sort ordering)."""
+
+    def _close_partial_round(self, led, n_uploads=4):
+        fill_registration(led)
+        run_upload_phase(led, n=n_uploads)
+        assert led.update_count == n_uploads
+        assert led.close_round() == LedgerStatus.OK
+
+    def test_upload_rejected_after_close(self, led):
+        self._close_partial_round(led)
+        st = led.upload_local_update(addr(18), b"\2" * 32, 100, 1.0, 0)
+        assert st == LedgerStatus.CAP_REACHED
+        assert led.update_count == 4
+
+    def test_upload_rejected_once_scoring_began(self, led):
+        self._close_partial_round(led)
+        assert led.upload_scores(led.committee()[0], 0,
+                                 [0.5] * 4) == LedgerStatus.OK
+        st = led.upload_local_update(addr(19), b"\3" * 32, 100, 1.0, 0)
+        assert st == LedgerStatus.CAP_REACHED
+        # the round still completes with consistent row lengths
+        for c in led.committee()[1:]:
+            assert led.upload_scores(c, 0, [0.5] * 4) == LedgerStatus.OK
+        assert led.aggregate_ready()
+        assert all(np.isfinite(led.pending().medians))
+        assert led.commit_model(b"\4" * 32, 0) == LedgerStatus.OK
+
+    def test_frozen_round_replays_identically(self, led):
+        """The close -> score -> (rejected upload) -> commit sequence must
+        replay to the same head on a fresh replica (the pre-fix crash made
+        recovery permanently impossible)."""
+        self._close_partial_round(led)
+        for c in led.committee():
+            led.upload_scores(c, 0, [0.5] * 4)
+        led.upload_local_update(addr(19), b"\3" * 32, 100, 1.0, 0)  # rejected
+        led.commit_model(b"\4" * 32, 0)
+        replica = make_ledger(CFG, backend="python")
+        for i in range(led.log_size()):
+            assert replica.apply_op(led.log_op(i)) == LedgerStatus.OK
+        assert replica.log_head() == led.log_head()
+
+    @pytest.mark.parametrize("bad", [float("nan"), float("inf"),
+                                     float("-inf"), 1e39])
+    def test_nonfinite_scores_rejected(self, led, bad):
+        # 1e39 is finite in float64 but overflows to inf in float32 — the
+        # wire/native type — so it must be rejected too
+        fill_registration(led)
+        run_upload_phase(led)
+        scores = [0.5] * 10
+        scores[3] = bad
+        assert led.upload_scores(led.committee()[0], 0,
+                                 scores) == LedgerStatus.BAD_ARG
+        assert led.score_count == 0
+
+    def _prep_epoch0(self, led):
+        fill_registration(led)
+        run_upload_phase(led)
+
+    def test_hostile_scores_op_bounded(self, led):
+        """OP_SCORES claiming 2^60 floats must be rejected, not allocated."""
+        import struct
+        self._prep_epoch0(led)
+        sender = addr(0).encode()
+        op = bytes([3]) + struct.pack("<q", len(sender)) + sender
+        op += struct.pack("<q", 0)          # epoch
+        op += struct.pack("<q", 1 << 60)    # claimed length
+        op += struct.pack("<f", 0.5)        # far fewer bytes than claimed
+        assert led.apply_op(op) == LedgerStatus.BAD_ARG
+
+    def test_hostile_reseat_op_bounded(self, led):
+        """OP_RESEAT with an unbounded count must not loop/allocate."""
+        import struct
+        self._prep_epoch0(led)
+        op = bytes([7]) + struct.pack("<q", 0)       # epoch
+        op += struct.pack("<q", 1 << 60)             # claimed address count
+        op += struct.pack("<q", 1) + b"x"
+        assert led.apply_op(op) == LedgerStatus.BAD_ARG
+
+    def test_truncated_trailing_string_rejected(self, led):
+        """A string length running past the op must be BAD_ARG on both
+        backends (Python slices used to silently truncate)."""
+        import struct
+        a = addr(0).encode()
+        op = bytes([1]) + struct.pack("<q", len(a) + 50) + a  # claims 50 extra
+        assert led.apply_op(op) == LedgerStatus.BAD_ARG
