@@ -119,7 +119,12 @@ class FLNode:
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *deltas)
         scores = score_candidates(self.model.apply, global_params, stacked,
                                   self.cfg.learning_rate, self.x, self.y)
-        score_list = [float(s) for s in np.asarray(scores)]
+        # accuracies are finite by construction (mean of comparisons); the
+        # nan_to_num is belt-and-braces so an honest node can never emit a
+        # row the ledger's non-finite guard rejects and stall its epoch
+        score_list = [float(s) for s in
+                      np.nan_to_num(np.asarray(scores), nan=0.0,
+                                    posinf=1.0, neginf=0.0)]
         if self.keyring is not None:
             from bflc_demo_tpu.comm.identity import sign_scores
             st = ledger.upload_scores(

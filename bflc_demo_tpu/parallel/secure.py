@@ -144,16 +144,27 @@ def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
     """Sample-weighted FedAvg where individual selected deltas are blinded
     before the sum (hidden from any observer without the pair seeds — see
     the module threat-model caveat).  Semantics match `apply_selection` up
-    to fixed-point quantisation.
+    to fixed-point quantisation and per-delta clipping at ±clip.
     """
     w = (n_samples.astype(jnp.float32) * sel_mask.astype(jnp.float32))
     wsum = jnp.maximum(jnp.sum(w), 1e-12)
+    # Clip each delta BEFORE the weighting: |clip(d_i)·w_i/Σw| <= clip·w_i/Σw,
+    # so the weighted sum really is bounded by clip and sum_bound=clip below
+    # is sound for any N.  (Clipping only after weighting let N adversarial
+    # clients contribute ±clip each, wrapping the int32 fixed-point psum past
+    # its 2^15 capacity despite the guard.)
+    # nan_to_num first: clip propagates NaN, and the int32 fixed-point cast
+    # of NaN is implementation-defined — one NaN delta would corrupt the
+    # whole masked psum
+    clipped = jax.tree_util.tree_map(
+        lambda d: jnp.clip(jnp.nan_to_num(d.astype(jnp.float32), nan=0.0,
+                                          posinf=clip, neginf=-clip),
+                           -clip, clip), deltas)
     # weight each client's delta BEFORE masking so the masked sum is the
     # numerator of the weighted mean; normalise after unmasking
     weighted = jax.tree_util.tree_map(
         lambda d: d * (w / wsum).reshape((-1,) + (1,) * (d.ndim - 1)),
-        deltas)
-    # weights sum to 1, so the true sum is bounded by clip regardless of N
+        clipped)
     mean_delta = secure_masked_sum(mesh, weighted, round_key, clip=clip,
                                    sum_bound=clip)
     return jax.tree_util.tree_map(

@@ -112,3 +112,51 @@ class TestSecureFedAvg:
         for k in params:
             np.testing.assert_allclose(np.asarray(got[k]),
                                        np.asarray(got2[k]), atol=1e-4)
+
+    def test_adversarial_huge_deltas_stay_bounded(self):
+        """N clients with enormous deltas: pre-weighting clipping bounds the
+        weighted sum by clip, so the fixed-point psum cannot wrap (advisor
+        finding: post-weighting clipping let each client contribute +/-clip
+        and the true sum reach N*clip)."""
+        rng = np.random.default_rng(11)
+        mesh = client_axis_mesh(8)
+        n, clip = 16, 8.0
+        deltas = {k: v * 1e6 for k, v in _vals(rng, n).items()}  # all clipped
+        params = {"W": jnp.zeros((5, 2)), "b": jnp.zeros((2,))}
+        ns = jnp.full((n,), 100, jnp.int32)
+        sel = jnp.ones((n,), bool)
+        got = secure_fedavg(mesh, deltas, ns, sel, params, 1.0,
+                            jax.random.PRNGKey(12), clip=clip)
+        # reference: weighted mean of the CLIPPED deltas — every entry of a
+        # huge-magnitude delta clips to +/-clip, so |result| == clip exactly
+        want = apply_selection(
+            params,
+            {k: jnp.clip(v, -clip, clip) for k, v in deltas.items()},
+            ns, sel, 1.0)
+        for k in params:
+            got_k = np.asarray(got[k])
+            np.testing.assert_allclose(got_k, np.asarray(want[k]),
+                                       atol=n / _SCALE + 1e-6)
+            assert np.all(np.abs(got_k) <= clip + 1e-3)   # no int32 wrap
+
+    def test_nan_delta_cannot_corrupt_aggregate(self):
+        """clip propagates NaN and the int32 cast of NaN is implementation-
+        defined, so NaN deltas must be neutralised before quantisation."""
+        rng = np.random.default_rng(13)
+        mesh = client_axis_mesh(4)
+        n = 8
+        deltas = _vals(rng, n)
+        poisoned = {k: v.at[2].set(jnp.nan) for k, v in deltas.items()}
+        params = {"W": jnp.zeros((5, 2)), "b": jnp.zeros((2,))}
+        ns = jnp.full((n,), 100, jnp.int32)
+        sel = jnp.ones((n,), bool)
+        got = secure_fedavg(mesh, poisoned, ns, sel, params, 1.0,
+                            jax.random.PRNGKey(14))
+        # NaN client behaves as a zero delta; everyone else aggregates intact
+        zeroed = {k: v.at[2].set(0.0) for k, v in deltas.items()}
+        want = apply_selection(params, zeroed, ns, sel, 1.0)
+        for k in params:
+            assert np.all(np.isfinite(np.asarray(got[k])))
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(want[k]),
+                                       atol=n / _SCALE + 1e-6)
