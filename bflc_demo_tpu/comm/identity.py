@@ -3,21 +3,28 @@
 The reference gives every simulated client an ECDSA keypair
 (bin/get_batch_accounts.sh; SDK signer patch README.md:348-359) and the chain
 authenticates transactions at the transport layer — the contract itself
-trusts `origin`.  This module plays the same role at the same boundary:
+trusts `origin`.  This module plays the same role at the same boundary, with
+TWO trust models:
 
-- `KeyRing`: derives per-client secrets from a master seed (the
-  get_batch_accounts.sh equivalent — one command provisions N identities)
-  and issues per-op MACs;
-- `AuthenticatedLedger`: a proxy that verifies a client's MAC over the
-  canonical op bytes before forwarding to ANY ledger backend — mutations
-  from unknown identities or with bad/replayed tags are rejected with
-  BAD_ARG before the coordinator sees them, exactly as the chain rejected
-  unsigned transactions before the contract ran.
+- `KeyRing`: HMAC-SHA256 shared secrets derived from a master seed — cheap,
+  dependency-free, but the verifier can forge any client's tag (documented
+  round-1 weakness; kept for closed single-operator deployments and tests);
+- `Wallet` / `PublicDirectory`: per-client Ed25519 signing keys, matching
+  the reference's trust model exactly — the coordinator holds ONLY public
+  keys, so it can verify but never fabricate a client's op, and addresses
+  are self-authenticating (derived from the public key like an Ethereum
+  address, so claiming an address requires its private key).  Wallets also
+  carry an X25519 key: `pair_secret` gives any client pair a shared seed via
+  Diffie-Hellman, which `parallel.secure` uses to derive pairwise masks the
+  aggregator cannot strip (closing the round-1 secure-agg key-agreement
+  stub).
 
-MACs are HMAC-SHA256 (shared-secret, provisioned at registration — the
-trust bootstrap the reference got from copying PEM files to clients).  Tags
-bind the op KIND, the sender, the epoch and the payload, and each tag is
-single-use per ledger instance (replay of an observed tag is rejected).
+Both implement the same signer surface (`mac`) and verifier surface
+(`verify`), so `AuthenticatedLedger` and `FLNode` take either
+interchangeably.  Tags bind the op KIND, the sender, the epoch and the
+payload, and each tag is single-use per ledger instance (replay of an
+observed tag is rejected; Ed25519 is deterministic per RFC 8032 so honest
+retries after a transient rejection re-produce the same tag).
 """
 
 from __future__ import annotations
@@ -25,13 +32,24 @@ from __future__ import annotations
 import hashlib
 import hmac
 import struct
-from typing import Dict, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 from bflc_demo_tpu.ledger.base import LedgerStatus
 
+try:                                    # baked into this image; gate anyway
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey, Ed25519PublicKey)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography.exceptions import InvalidSignature
+    HAVE_ED25519 = True
+except ImportError:                     # pragma: no cover
+    HAVE_ED25519 = False
+
 
 class KeyRing:
-    """Per-client secrets derived from one master seed."""
+    """Per-client secrets derived from one master seed (HMAC trust model)."""
 
     def __init__(self, master_seed: bytes):
         if len(master_seed) < 16:
@@ -44,6 +62,119 @@ class KeyRing:
     def mac(self, address: str, op_bytes: bytes) -> bytes:
         return hmac.new(self.secret_for(address), op_bytes,
                         hashlib.sha256).digest()
+
+    def verify(self, address: str, op_bytes: bytes, tag: bytes) -> bool:
+        return hmac.compare_digest(self.mac(address, op_bytes), tag)
+
+
+def _require_ed25519():
+    if not HAVE_ED25519:                # pragma: no cover
+        raise RuntimeError(
+            "asymmetric identity requires the 'cryptography' package; "
+            "use KeyRing (HMAC) where it is unavailable")
+
+
+def address_of(public_bytes: bytes) -> str:
+    """Self-authenticating address: 0x + first 20 bytes of sha256(pubkey) —
+    the Ethereum-style derivation, so an address claim is checkable against
+    the public key that signs for it."""
+    return "0x" + hashlib.sha256(public_bytes).hexdigest()[:40]
+
+
+class Wallet:
+    """One client's asymmetric identity: Ed25519 signing + X25519 agreement.
+
+    The get_batch_accounts.sh equivalent (one PEM per client,
+    README.md:348-359): `Wallet.from_seed` provisions deterministically for
+    tests; `Wallet.generate` draws fresh OS randomness for real use.
+    """
+
+    def __init__(self, sign_key: "Ed25519PrivateKey",
+                 dh_key: "X25519PrivateKey"):
+        _require_ed25519()
+        self._sign = sign_key
+        self._dh = dh_key
+        self.public_bytes = sign_key.public_key().public_bytes(
+            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        self.dh_public_bytes = dh_key.public_key().public_bytes(
+            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        self.address = address_of(self.public_bytes)
+
+    @classmethod
+    def generate(cls) -> "Wallet":
+        _require_ed25519()
+        return cls(Ed25519PrivateKey.generate(), X25519PrivateKey.generate())
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "Wallet":
+        _require_ed25519()
+        sk = hashlib.sha256(b"bflc-ed25519|" + seed).digest()
+        dk = hashlib.sha256(b"bflc-x25519|" + seed).digest()
+        return cls(Ed25519PrivateKey.from_private_bytes(sk),
+                   X25519PrivateKey.from_private_bytes(dk))
+
+    def sign(self, op_bytes: bytes) -> bytes:
+        return self._sign.sign(op_bytes)
+
+    # signer surface shared with KeyRing so FLNode/sign_* helpers take either
+    def mac(self, address: str, op_bytes: bytes) -> bytes:
+        if address != self.address:
+            raise ValueError(f"wallet for {self.address} cannot sign for "
+                             f"{address}")
+        return self.sign(op_bytes)
+
+    def pair_secret(self, their_dh_public: bytes, context: bytes = b"",
+                    ) -> bytes:
+        """X25519 shared secret with another wallet, hashed with `context`
+        (e.g. the round number) — both endpoints derive the same bytes; the
+        coordinator, holding neither private key, cannot."""
+        shared = self._dh.exchange(X25519PublicKey.from_public_bytes(
+            their_dh_public))
+        return hashlib.sha256(b"bflc-pair|" + shared + b"|" + context
+                              ).digest()
+
+
+class PublicDirectory:
+    """Verifier-side registry: address -> Ed25519 public key, nothing else.
+
+    This is what the coordinator holds — it can check any tag but cannot
+    produce one, which is the reference's trust model (the chain verifies
+    ECDSA transaction signatures; node operators never hold client keys).
+    """
+
+    def __init__(self):
+        _require_ed25519()
+        self._keys: Dict[str, "Ed25519PublicKey"] = {}
+
+    def enroll(self, public_bytes: bytes) -> str:
+        addr = address_of(public_bytes)
+        self._keys[addr] = Ed25519PublicKey.from_public_bytes(public_bytes)
+        return addr
+
+    def knows(self, address: str) -> bool:
+        return address in self._keys
+
+    def verify(self, address: str, op_bytes: bytes, tag: bytes) -> bool:
+        key = self._keys.get(address)
+        if key is None:
+            return False
+        try:
+            key.verify(tag, op_bytes)
+            return True
+        except InvalidSignature:
+            return False
+
+
+def provision_wallets(n: int, master_seed: bytes,
+                      ) -> Tuple[List[Wallet], PublicDirectory]:
+    """Provision N wallets + the coordinator's public directory — the
+    one-command batch bootstrap of get_batch_accounts.sh (-n 20)."""
+    wallets = [Wallet.from_seed(master_seed + struct.pack("<q", i))
+               for i in range(n)]
+    directory = PublicDirectory()
+    for w in wallets:
+        directory.enroll(w.public_bytes)
+    return wallets, directory
 
 
 def _op_bytes(kind: str, sender: str, epoch: int, payload: bytes) -> bytes:
@@ -58,16 +189,20 @@ def _op_bytes(kind: str, sender: str, epoch: int, payload: bytes) -> bytes:
 
 
 class AuthenticatedLedger:
-    """MAC-verifying proxy in front of a ledger backend.
+    """Tag-verifying proxy in front of a ledger backend.
 
     Client-originated mutations (register/upload/scores) require a valid
     tag; reads and the runtime's coordinator-side ops (commit, recovery)
     pass through — they are issued by the op-log writer itself, whose
     authority is the log (comm/multihost.is_ledger_writer), not a client
     identity.
+
+    `keyring` is anything with verify(address, op_bytes, tag) -> bool:
+    a `KeyRing` (HMAC shared-secret) or a `PublicDirectory` (Ed25519 —
+    the verifier cannot forge).
     """
 
-    def __init__(self, inner, keyring: KeyRing):
+    def __init__(self, inner, keyring):
         self._inner = inner
         self._keys = keyring
         # replay tracking bucketed by op epoch: stale buckets are pruned once
@@ -78,9 +213,8 @@ class AuthenticatedLedger:
     # --- authenticated mutations ---
     def _verify(self, kind: str, sender: str, epoch: int, payload: bytes,
                 tag: bytes) -> bool:
-        expect = self._keys.mac(sender, _op_bytes(kind, sender, epoch,
-                                                  payload))
-        if not hmac.compare_digest(expect, tag):
+        if not self._keys.verify(sender, _op_bytes(kind, sender, epoch,
+                                                   payload), tag):
             return False
         return tag not in self._seen_tags.get(epoch, ())
 

@@ -8,13 +8,15 @@ total equals the unmasked weighted sum bit-for-bit in integer arithmetic.
 
 Design notes:
 - masks are generated per (pair, round) from jax.random.fold_in — no mask
-  exchange traffic.  THREAT MODEL CAVEAT: this demo derives every pair key
-  from one shared round key (a key-agreement stub, standing in for the
-  reference's ECDSA identity bootstrap); privacy therefore holds against
-  observers WITHOUT the round key, not against a key-holding aggregator,
-  which could recompute and strip any client's mask.  A real deployment
-  derives pair keys from per-pair Diffie-Hellman secrets — only the mask
-  derivation function changes, the cancellation algebra is identical;
+  exchange traffic.  TWO key-agreement modes:
+  (a) shared round key (the round-1 stub, kept for tests/closed setups):
+      privacy holds only against observers without the round key;
+  (b) per-pair X25519 Diffie-Hellman (`pair_seeds` from
+      `derive_pair_seeds`, keys from comm.identity.Wallet): each pair's
+      mask seed comes from a DH exchange the aggregator is not party to,
+      so the coordinator/aggregator can verify uploads (Ed25519) yet
+      CANNOT strip any client's mask — the reference-parity trust model.
+  Only the key derivation differs; the cancellation algebra is identical;
 - cancellation must be exact, not approximate: floats don't cancel reliably
   across reassociation, so deltas are scaled to int32 fixed-point, masked
   with modular uint32 arithmetic, summed with psum (associative mod 2^32),
@@ -70,19 +72,69 @@ def _client_mask(round_key: jax.Array, i: jax.Array, n: int,
                              jnp.zeros(shape, jnp.uint32))
 
 
+def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
+                    shape) -> jax.Array:
+    """DH-keyed variant of `_client_mask`: the pair key comes from the
+    (N, N, 2) uint32 seed matrix (X25519-derived, `derive_pair_seeds`)
+    instead of a shared round key.  Seed symmetry (seeds[i,j] == seeds[j,i])
+    gives both endpoints the same mask; the signed sum cancels identically.
+    """
+    base = jax.random.PRNGKey(0)
+
+    def body(j, acc):
+        s = pair_seeds[i, j]
+        key = jax.random.fold_in(jax.random.fold_in(base, s[0]), s[1])
+        m = _pair_mask(key, shape)
+        contrib = jnp.where(j > i, m, jnp.uint32(0) - m)
+        return jnp.where(j == i, acc, acc + contrib)
+
+    return jax.lax.fori_loop(0, n, body,
+                             jnp.zeros(shape, jnp.uint32))
+
+
+def derive_pair_seeds(wallets, round_index: int):
+    """(N, N, 2) uint32 symmetric pair-seed matrix from per-pair X25519.
+
+    Each entry [i, j] is derived from wallet i's DH exchange with wallet j's
+    public key, bound to the round — both endpoints compute the same bytes;
+    anyone without one of the two private keys (including the aggregator)
+    cannot.  In this in-process harness the full matrix is assembled in one
+    place for convenience; a deployment computes only row i on client i and
+    the device program is unchanged (the matrix is just stacked rows).
+    """
+    import struct as _struct
+
+    import numpy as np
+
+    n = len(wallets)
+    seeds = np.zeros((n, n, 2), np.uint32)
+    ctx = _struct.pack("<q", round_index)
+    for i in range(n):
+        for j in range(i + 1, n):
+            s = wallets[i].pair_secret(wallets[j].dh_public_bytes,
+                                       context=ctx)
+            words = np.frombuffer(s[:8], "<u4")
+            seeds[i, j] = seeds[j, i] = words
+    return jnp.asarray(seeds)
+
+
 _PROGRAM_CACHE = {}
 
 
 def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
                       clip: float = 64.0,
-                      sum_bound: float | None = None) -> Pytree:
+                      sum_bound: float | None = None,
+                      pair_seeds: jax.Array | None = None) -> Pytree:
     """Sum client-stacked pytrees over the client axis with each client's
     fixed-point contribution blinded by pairwise-cancelling masks before the
-    psum (see module docstring for the threat-model caveat).
+    psum (see module docstring for the threat-model modes).
 
     values: pytree with leading axis N, sharded over the client axis.
     clip: symmetric range bound for fixed-point encoding (values are
     clamped to [-clip, clip] before quantisation).
+    pair_seeds: optional (N, N, 2) uint32 DH seed matrix
+    (`derive_pair_seeds`) — when given, masks are keyed per-pair and the
+    aggregator cannot strip them; `round_key` is then unused.
 
     Capacity: the unmasked total must fit int32 fixed-point, i.e. stay below
     2^(31 - _FRAC_BITS) = 32768 in magnitude — the mod-2^32 sum would
@@ -99,8 +151,12 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
             f"fixed-point capacity exceeded: sum bound {bound:g} "
             f">= {1 << (31 - _FRAC_BITS)}; lower clip, pre-normalise, or "
             f"pass a tighter sum_bound")
+    dh_mode = pair_seeds is not None
+    if dh_mode and tuple(pair_seeds.shape) != (n_total, n_total, 2):
+        raise ValueError(f"pair_seeds must be ({n_total}, {n_total}, 2), "
+                         f"got {tuple(pair_seeds.shape)}")
 
-    def body(vals, key):
+    def body(vals, key_or_seeds):
         n_local = jax.tree_util.tree_leaves(vals)[0].shape[0]
         my = jax.lax.axis_index(AXIS)
 
@@ -112,9 +168,10 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
                 fx = jnp.clip(leaf[local_idx].astype(jnp.float32),
                               -clip, clip)
                 q = jnp.round(fx * _SCALE).astype(jnp.int32)
-                masked = q.astype(jnp.uint32) + _client_mask(
-                    key, client, n_total, shape)
-                return acc + masked
+                mask = (_client_mask_dh(key_or_seeds, client, n_total, shape)
+                        if dh_mode else
+                        _client_mask(key_or_seeds, client, n_total, shape))
+                return acc + q.astype(jnp.uint32) + mask
 
             total = jax.lax.fori_loop(
                 0, n_local, mask_one, jnp.zeros(shape, jnp.uint32))
@@ -123,28 +180,31 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
 
         return jax.tree_util.tree_map(one_leaf, vals)
 
-    # build-once per (mesh, structure, shapes, clip): round_key is an
-    # ARGUMENT so a new round never retraces.  Mesh is hashable by value
-    # (devices + axis names), so no id()-aliasing across GC'd meshes.
+    # build-once per (mesh, structure, shapes, clip, mode): round_key /
+    # pair_seeds are ARGUMENTS so a new round never retraces.  Mesh is
+    # hashable by value (devices + axis names), so no id()-aliasing across
+    # GC'd meshes.
     cache_key = (mesh, jax.tree_util.tree_structure(values),
                  tuple(jax.tree_util.tree_leaves(
                      jax.tree_util.tree_map(lambda x: x.shape, values))),
-                 float(clip))
+                 float(clip), dh_mode)
     if cache_key not in _PROGRAM_CACHE:
         fn = shard_map(body, mesh=mesh, in_specs=(P(AXIS), P()),
                        out_specs=P(), check_vma=False)
         _PROGRAM_CACHE[cache_key] = jax.jit(fn)
-    return _PROGRAM_CACHE[cache_key](values, round_key)
+    return _PROGRAM_CACHE[cache_key](
+        values, pair_seeds if dh_mode else round_key)
 
 
 def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
                   sel_mask: jax.Array, global_params: Pytree, lr: float,
                   round_key: jax.Array, clip: float = 64.0,
-                  ) -> Pytree:
+                  pair_seeds: jax.Array | None = None) -> Pytree:
     """Sample-weighted FedAvg where individual selected deltas are blinded
     before the sum (hidden from any observer without the pair seeds — see
-    the module threat-model caveat).  Semantics match `apply_selection` up
-    to fixed-point quantisation and per-delta clipping at ±clip.
+    the module threat-model modes; pass `pair_seeds` for the DH mode the
+    aggregator cannot strip).  Semantics match `apply_selection` up to
+    fixed-point quantisation and per-delta clipping at ±clip.
     """
     w = (n_samples.astype(jnp.float32) * sel_mask.astype(jnp.float32))
     wsum = jnp.maximum(jnp.sum(w), 1e-12)
@@ -166,7 +226,7 @@ def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
         lambda d: d * (w / wsum).reshape((-1,) + (1,) * (d.ndim - 1)),
         clipped)
     mean_delta = secure_masked_sum(mesh, weighted, round_key, clip=clip,
-                                   sum_bound=clip)
+                                   sum_bound=clip, pair_seeds=pair_seeds)
     return jax.tree_util.tree_map(
         lambda g, m: g - jnp.asarray(lr, g.dtype) * m, global_params,
         mean_delta)

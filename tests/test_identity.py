@@ -1,12 +1,14 @@
 """Authenticated-ledger tests: identity provisioning, MAC verification,
-replay rejection, full authenticated round."""
+replay rejection, asymmetric (Ed25519) identity, full authenticated round."""
 
 import numpy as np
 import pytest
 
 from bflc_demo_tpu.comm.identity import (KeyRing, AuthenticatedLedger,
+                                         Wallet, PublicDirectory,
+                                         provision_wallets, address_of,
                                          sign_register, sign_upload,
-                                         sign_scores)
+                                         sign_scores, _op_bytes)
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol import ProtocolConfig
 
@@ -112,6 +114,70 @@ class TestIdentity:
         res = fed.run(rounds=2, timeout_s=120)
         assert res.rounds_completed == 2
         assert res.ledger.verify_log()
+
+    def test_wallet_sign_verify_and_forgery(self):
+        """Ed25519: the directory verifies genuine tags and rejects forgeries;
+        critically, the VERIFIER holds only public keys, so unlike the HMAC
+        keyring it cannot fabricate a client's tag (the round-1 weakness the
+        reference's ECDSA model never had)."""
+        wallets, directory = provision_wallets(3, b"ed-master-seed-000001")
+        w = wallets[0]
+        ob = _op_bytes("upload", w.address, 0, b"\1" * 32)
+        tag = w.mac(w.address, ob)
+        assert directory.verify(w.address, ob, tag)
+        assert not directory.verify(w.address, ob + b"x", tag)
+        assert not directory.verify(wallets[1].address, ob, tag)
+        assert not directory.verify(w.address, ob, b"\0" * 64)
+        # address is self-authenticating: derived from the public key
+        assert w.address == address_of(w.public_bytes)
+        # a wallet refuses to sign for an address it doesn't own
+        with pytest.raises(ValueError):
+            w.mac(wallets[1].address, ob)
+
+    def test_wallet_determinism_and_uniqueness(self):
+        a = Wallet.from_seed(b"seed-a")
+        a2 = Wallet.from_seed(b"seed-a")
+        b = Wallet.from_seed(b"seed-b")
+        assert a.address == a2.address
+        assert a.sign(b"msg") == a2.sign(b"msg")     # RFC 8032 deterministic
+        assert a.address != b.address
+
+    def test_pair_secret_agreement(self):
+        """X25519: both endpoints derive the same pair secret; different
+        pairs and different contexts derive different secrets."""
+        wallets, _ = provision_wallets(3, b"dh-master-seed-000001")
+        a, b, c = wallets
+        s_ab = a.pair_secret(b.dh_public_bytes, context=b"round7")
+        s_ba = b.pair_secret(a.dh_public_bytes, context=b"round7")
+        assert s_ab == s_ba
+        assert s_ab != a.pair_secret(c.dh_public_bytes, context=b"round7")
+        assert s_ab != a.pair_secret(b.dh_public_bytes, context=b"round8")
+
+    def test_authenticated_ledger_with_directory(self):
+        """The AuthenticatedLedger over a PublicDirectory: wallet-signed ops
+        accepted, wrong-wallet and replayed tags rejected — same transport
+        contract as the HMAC keyring, stronger trust model."""
+        wallets, directory = provision_wallets(
+            CFG.client_num, b"dir-master-seed-000001")
+        led = AuthenticatedLedger(make_ledger(CFG, backend="python"),
+                                  directory)
+        for w in wallets:
+            st = led.register_node(w.address, sign_register(w, w.address))
+            assert st == LedgerStatus.OK
+        assert led.epoch == 0
+        w = wallets[3]
+        tag = sign_upload(w, w.address, b"\1" * 32, 100, 1.5, 0)
+        assert led.upload_local_update(w.address, b"\1" * 32, 100, 1.5, 0,
+                                       tag) == LedgerStatus.OK
+        # replay
+        assert led.upload_local_update(w.address, b"\1" * 32, 100, 1.5, 0,
+                                       tag) == LedgerStatus.BAD_ARG
+        # another wallet cannot sign for w's address
+        x = wallets[4]
+        forged = x.sign(_op_bytes("upload", w.address, 0, b"\2" * 32 +
+                                  __import__("struct").pack("<qd", 50, 1.0)))
+        assert led.upload_local_update(w.address, b"\2" * 32, 50, 1.0, 0,
+                                       forged) == LedgerStatus.BAD_ARG
 
     def test_full_authenticated_round(self, auth_led):
         led, keys = auth_led

@@ -9,7 +9,9 @@ import pytest
 from bflc_demo_tpu.core import apply_selection
 from bflc_demo_tpu.parallel import client_axis_mesh
 from bflc_demo_tpu.parallel.secure import (secure_masked_sum, secure_fedavg,
-                                           _client_mask, _SCALE)
+                                           derive_pair_seeds,
+                                           _client_mask, _client_mask_dh,
+                                           _SCALE)
 
 
 def _vals(rng, n=16, shape=(5, 2)):
@@ -75,6 +77,72 @@ class TestMaskCancellation:
         m2 = np.asarray(_client_mask(jax.random.fold_in(k, 2), jnp.int32(0),
                                      8, (16,)))
         assert not np.array_equal(m1, m2)
+
+
+class TestDHPairKeys:
+    """The X25519 key-agreement mode: pair seeds come from per-pair DH, so
+    the aggregator (holding no client private keys) cannot derive or strip
+    any mask — closing the round-1 shared-round-key stub."""
+
+    def _seeds(self, n=8, rnd=3):
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        wallets, _ = provision_wallets(n, b"secure-dh-master-000001")
+        return derive_pair_seeds(wallets, rnd)
+
+    def test_dh_masks_cancel_exactly(self):
+        n = 8
+        seeds = self._seeds(n)
+        total = jnp.zeros((4, 4), jnp.uint32)
+        for i in range(n):
+            total = total + _client_mask_dh(seeds, jnp.int32(i), n, (4, 4))
+        np.testing.assert_array_equal(np.asarray(total), 0)
+
+    def test_dh_sum_matches_plain_sum(self):
+        rng = np.random.default_rng(21)
+        mesh = client_axis_mesh(8)
+        n = 8
+        vals = _vals(rng, n)
+        got = secure_masked_sum(mesh, vals, jax.random.PRNGKey(0),
+                                pair_seeds=self._seeds(n))
+        for k in vals:
+            want = np.asarray(vals[k]).sum(axis=0)
+            np.testing.assert_allclose(np.asarray(got[k]), want,
+                                       atol=2 * n / _SCALE)
+
+    def test_dh_rounds_and_pairs_differ(self):
+        n = 8
+        s3 = np.asarray(self._seeds(n, rnd=3))
+        s4 = np.asarray(self._seeds(n, rnd=4))
+        assert not np.array_equal(s3, s4)            # round-bound
+        np.testing.assert_array_equal(s3, s3.transpose(1, 0, 2))  # symmetric
+        iu = np.triu_indices(n, k=1)
+        flat = s3[iu[0], iu[1]].reshape(-1, 2)
+        assert len(np.unique(flat, axis=0)) == len(flat)   # distinct pairs
+
+    def test_dh_secure_fedavg_matches_plain(self):
+        rng = np.random.default_rng(22)
+        mesh = client_axis_mesh(4)
+        n = 8
+        deltas = _vals(rng, n)
+        params = {"W": jnp.asarray(rng.standard_normal((5, 2)), jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal((2,)), jnp.float32)}
+        ns = jnp.asarray(rng.integers(100, 400, n), jnp.int32)
+        sel = jnp.asarray(rng.random(n) < 0.5)
+        got = secure_fedavg(mesh, deltas, ns, sel, params, 0.05,
+                            jax.random.PRNGKey(0),
+                            pair_seeds=self._seeds(n))
+        want = apply_selection(params, deltas, ns, sel, 0.05)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(want[k]),
+                                       atol=0.05 * n / _SCALE + 1e-6)
+
+    def test_bad_seed_shape_rejected(self):
+        mesh = client_axis_mesh(4)
+        vals = _vals(np.random.default_rng(0), 8)
+        with pytest.raises(ValueError):
+            secure_masked_sum(mesh, vals, jax.random.PRNGKey(0),
+                              pair_seeds=jnp.zeros((4, 4, 2), jnp.uint32))
 
 
 class TestSecureFedAvg:
