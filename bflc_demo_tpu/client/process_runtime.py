@@ -1,0 +1,359 @@
+"""Process-parallel federation: real OS processes over a real socket.
+
+The reference simulates its fleet as 21 `multiprocessing.Process` clients
+(python-sdk/main.py:343-358) talking TLS to a 4-node chain — separate memory,
+separate failure domains, all coordination over the wire.  This runtime is
+that shape for the TPU-native stack:
+
+- one **coordinator process** runs `comm.ledger_service.LedgerServer`: the
+  native C++ ledger, Ed25519 verification, blob store, on-coordinator
+  aggregation, stall recovery;
+- N **client processes** (spawned, not forked — each owns a fresh JAX CPU
+  runtime) train/score against their private shard and speak only the frame
+  protocol; a crashed client is a real dead process, and the coordinator's
+  failure detector carries the round (close_round / reseat_committee /
+  force_aggregate — where the reference deadlocks on a dead committee,
+  SURVEY.md §5);
+- the parent acts as the sponsor (main.py:280-340): it polls the published
+  global model and records held-out accuracy;
+- a **replica process** can replay the op stream live and prove head-digest
+  equality (`comm.ledger_service.replicate`).
+
+Clients are event-driven via the server's blocking `wait` call — no
+uniform(10,30)s polls (SURVEY.md §6: polling dominates the reference's round
+time).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import multiprocessing as mp
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+
+def _force_cpu_jax() -> None:
+    """Child processes must never open the TPU tunnel: pin the platform
+    BEFORE any jax op runs (same rule as __graft_entry__.dryrun_multichip).
+
+    The env var alone is NOT enough here: the container's sitecustomize may
+    have imported jax and configured an accelerator platform at interpreter
+    startup (before this target function runs), and jax.config beats
+    JAX_PLATFORMS.  `jax.config.update` is authoritative either way."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+@contextlib.contextmanager
+def _cpu_spawn_env():
+    """Scrub accelerator plumbing from os.environ while spawning children.
+
+    Spawned interpreters run sitecustomize before any of our code; if the
+    container wires a TPU tunnel there (keyed off these vars), every child
+    would race to register it.  Children are pure-CPU by design, so drop the
+    trigger vars for the duration of the spawns and restore afterwards."""
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
+                 stall_timeout_s: float, wal_path: str,
+                 verbose: bool) -> None:
+    _force_cpu_jax()
+    from bflc_demo_tpu.comm.ledger_service import LedgerServer
+    server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
+                          stall_timeout_s=stall_timeout_s,
+                          wal_path=wal_path, verbose=verbose)
+    port_q.put(server.port)
+    server.serve_forever()
+
+
+def _sign(wallet, kind: str, epoch: int, payload: bytes) -> str:
+    from bflc_demo_tpu.comm.identity import _op_bytes
+    return wallet.sign(_op_bytes(kind, wallet.address, epoch,
+                                 payload)).hex()
+
+
+def _client_proc(host: str, port: int, wallet_seed: bytes,
+                 model_factory: str, factory_kw: dict,
+                 x: np.ndarray, y_onehot: np.ndarray, cfg_kw: dict,
+                 rounds: int, crash_at_epoch: Optional[int]) -> None:
+    """One federated client: register -> role loop -> train/score -> exit.
+
+    Runs the same state machine as client/runtime.FLNode.step (itself the
+    reference's main_loop, main.py:236-271), but every ledger interaction is
+    a signed socket request and every tensor crosses as a canonical blob.
+    """
+    _force_cpu_jax()
+    import jax.numpy as jnp
+
+    import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.comm.identity import Wallet
+    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+    from bflc_demo_tpu.core.local_train import local_train
+    from bflc_demo_tpu.core.scoring import score_candidates
+    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+                                                   unpack_pytree,
+                                                   restore_pytree)
+
+    cfg = ProtocolConfig(**cfg_kw)
+    model = getattr(models, model_factory)(**factory_kw)
+    template = model.init_params(0)
+    wallet = Wallet.from_seed(wallet_seed)
+    xj, yj = jnp.asarray(x), jnp.asarray(y_onehot)
+
+    client = CoordinatorClient(host, port, timeout_s=120.0)
+    reply = client.request("register", addr=wallet.address,
+                           pubkey=wallet.public_bytes.hex(),
+                           tag=_sign(wallet, "register", 0, b""))
+    if not reply["ok"] and reply.get("status") != "ALREADY_REGISTERED":
+        raise RuntimeError(f"register failed: {reply}")
+
+    trained_epoch = scored_epoch = cfg.initial_trained_epoch
+    known_log = 0
+    while True:
+        st = client.request("state", addr=wallet.address)
+        epoch = st["epoch"]
+        if epoch >= rounds or epoch > cfg.max_epoch:
+            break
+        if crash_at_epoch is not None and 0 <= crash_at_epoch <= epoch:
+            os._exit(17)        # simulated hard crash: the process dies
+        if epoch < 0:           # registration phase
+            known_log = client.request("wait", log_size=known_log,
+                                       timeout_s=2.0)["log_size"]
+            continue
+        acted = False
+        if st["role"] == "trainer" and epoch > trained_epoch:
+            mr = client.request("model")
+            if mr["epoch"] != epoch:
+                continue        # round turned over mid-step; resync
+            params = restore_pytree(
+                template, unpack_pytree(bytes.fromhex(mr["blob"])))
+            delta, cost = local_train(
+                model.apply, params, xj, yj, lr=cfg.learning_rate,
+                batch_size=cfg.batch_size, local_epochs=cfg.local_epochs)
+            blob = pack_pytree(delta)
+            digest = hashlib.sha256(blob).digest()
+            n = int(x.shape[0])
+            payload = digest + struct.pack("<qd", n, float(cost))
+            r = client.request(
+                "upload", addr=wallet.address, blob=blob.hex(),
+                hash=digest.hex(), n=n, cost=float(cost), epoch=epoch,
+                tag=_sign(wallet, "upload", epoch, payload))
+            if r.get("status") in ("OK", "CAP_REACHED", "DUPLICATE",
+                                   "NOT_READY"):
+                # NOT_READY = round closed under recovery; wait it out
+                trained_epoch = epoch
+                acted = r["ok"]
+        elif st["role"] == "comm" and epoch > scored_epoch:
+            ups = client.request("updates")["updates"]
+            if ups:
+                import jax
+                deltas = []
+                for u in ups:
+                    b = bytes.fromhex(client.request(
+                        "blob", hash=u["hash"])["blob"])
+                    deltas.append(restore_pytree(template,
+                                                 unpack_pytree(b)))
+                mr = client.request("model")
+                params = restore_pytree(
+                    template, unpack_pytree(bytes.fromhex(mr["blob"])))
+                stacked = jax.tree_util.tree_map(
+                    lambda *t: jnp.stack(t), *deltas)
+                scores = score_candidates(model.apply, params, stacked,
+                                          cfg.learning_rate, xj, yj)
+                score_list = [float(s) for s in
+                              np.nan_to_num(np.asarray(scores), nan=0.0,
+                                            posinf=1.0, neginf=0.0)]
+                payload = struct.pack(f"<{len(score_list)}d", *score_list)
+                r = client.request(
+                    "scores", addr=wallet.address, epoch=epoch,
+                    scores=score_list,
+                    tag=_sign(wallet, "scores", epoch, payload))
+                if r.get("status") in ("OK", "WRONG_EPOCH"):
+                    scored_epoch = epoch
+                    acted = r["ok"]
+        if not acted:
+            known_log = client.request("wait", log_size=known_log,
+                                       timeout_s=2.0)["log_size"]
+    client.close()
+
+
+def _replica_proc(host: str, port: int, cfg_kw: dict, until_ops: int,
+                  out_q) -> None:
+    _force_cpu_jax()
+    from bflc_demo_tpu.comm.ledger_service import replicate
+    try:
+        replica = replicate(host, port, ProtocolConfig(**cfg_kw),
+                            until_ops=until_ops, timeout_s=120.0)
+        out_q.put({"ok": True, "head": replica.log_head().hex(),
+                   "size": replica.log_size(), "epoch": replica.epoch})
+    except Exception as e:              # report, don't hang the parent
+        out_q.put({"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+
+class ProcessFederationResult:
+    def __init__(self, accuracy_history, rounds_completed, log_head,
+                 log_size, recovered_clients, replica_report):
+        self.accuracy_history = accuracy_history
+        self.rounds_completed = rounds_completed
+        self.ledger_log_head = log_head
+        self.ledger_log_size = log_size
+        self.recovered_clients = recovered_clients
+        self.replica_report = replica_report
+
+    def best_accuracy(self) -> float:
+        return max((a for _, a in self.accuracy_history), default=0.0)
+
+
+def run_federated_processes(
+        model_factory: str,
+        shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+        test_set: Tuple[np.ndarray, np.ndarray],
+        cfg: ProtocolConfig,
+        rounds: int = 5, *,
+        factory_kw: Optional[dict] = None,
+        master_seed: bytes = b"process-federation-master-0001",
+        crash_at: Optional[Dict[int, int]] = None,
+        stall_timeout_s: float = 5.0,
+        wal_path: str = "",
+        with_replica: bool = True,
+        timeout_s: float = 600.0,
+        init_seed: int = 0,
+        verbose: bool = False) -> ProcessFederationResult:
+    """Run a full federation as (1 coordinator + N clients [+ 1 replica])
+    OS processes.  Parent = sponsor.
+
+    crash_at: {client_index: epoch} — that client's process hard-exits at
+    that epoch; the coordinator's recovery ops must carry the round.
+    """
+    cfg.validate()
+    if len(shards) != cfg.client_num:
+        raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    crash_at = crash_at or {}
+    factory_kw = factory_kw or {}
+
+    import jax.numpy as jnp
+
+    import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.core.local_train import evaluate
+    from bflc_demo_tpu.data.partition import one_hot
+    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+                                                   unpack_pytree,
+                                                   restore_pytree)
+
+    model = getattr(models, model_factory)(**factory_kw)
+    template = model.init_params(0)
+    initial_params = model.init_params(init_seed)
+    initial_blob = pack_pytree(initial_params)
+    nc = model.num_classes
+    cfg_kw = {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)}
+
+    ctx = mp.get_context("spawn")
+    port_q = ctx.Queue()
+    with _cpu_spawn_env():
+        server = ctx.Process(target=_server_proc,
+                             args=(cfg_kw, initial_blob, port_q,
+                                   stall_timeout_s, wal_path, verbose),
+                             daemon=True)
+        server.start()
+        port = port_q.get(timeout=60)
+        host = "127.0.0.1"
+
+        clients = []
+        for i, (sx, sy) in enumerate(shards):
+            p = ctx.Process(
+                target=_client_proc,
+                args=(host, port, master_seed + struct.pack("<q", i),
+                      model_factory, factory_kw,
+                      np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
+                      rounds, crash_at.get(i)),
+                daemon=True)
+            p.start()
+            clients.append(p)
+
+    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+    xte, yte = test_set
+    xte_j = jnp.asarray(xte)
+    yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
+    sponsor = CoordinatorClient(host, port, timeout_s=120.0)
+    history: List[Tuple[int, float]] = []
+    seen_epoch = 0              # model at epoch 0 is the uncommitted init
+    deadline = time.monotonic() + timeout_s
+    try:
+        while time.monotonic() < deadline:
+            info = sponsor.request("info")
+            if info["epoch"] > seen_epoch:
+                mr = sponsor.request("model")
+                if mr["epoch"] > seen_epoch:
+                    params = restore_pytree(
+                        template,
+                        unpack_pytree(bytes.fromhex(mr["blob"])))
+                    acc = float(evaluate(model.apply, params, xte_j, yte_j))
+                    history.append((mr["epoch"] - 1, acc))
+                    seen_epoch = mr["epoch"]
+                    if verbose:
+                        print(f"Epoch: {mr['epoch'] - 1:03d}, "
+                              f"test_acc: {acc:.4f}", flush=True)
+            if info["rounds_completed"] >= rounds:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"process federation incomplete after {timeout_s}s "
+                f"({len(history)}/{rounds} rounds)")
+        final = sponsor.request("info")
+        replica_report = None
+        if with_replica:
+            rep_q = ctx.Queue()
+            with _cpu_spawn_env():
+                rp = ctx.Process(target=_replica_proc,
+                                 args=(host, port, cfg_kw,
+                                       final["log_size"], rep_q),
+                                 daemon=True)
+                rp.start()
+            replica_report = rep_q.get(timeout=120)
+            rp.join(timeout=10)
+            if not replica_report["ok"]:
+                raise RuntimeError(
+                    f"replica failed: {replica_report['error']}")
+            if replica_report["size"] == final["log_size"] and \
+                    replica_report["head"] != final["log_head"]:
+                raise RuntimeError("replica/writer head divergence")
+    finally:
+        sponsor.close()
+        for i, p in enumerate(clients):
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        server.terminate()
+        server.join(timeout=10)
+
+    crashed = [i for i in crash_at
+               if clients[i].exitcode not in (0, None)]
+    return ProcessFederationResult(
+        accuracy_history=history,
+        rounds_completed=final["rounds_completed"],
+        log_head=final["log_head"],
+        log_size=final["log_size"],
+        recovered_clients=crashed,
+        replica_report=replica_report)

@@ -1,0 +1,498 @@
+"""The networked coordinator: the ledger behind a real socket boundary.
+
+This is the process that plays the reference's blockchain node: it owns the
+authoritative ledger state machine, verifies client signatures, stores
+update payloads, runs the aggregation when a round completes, and streams
+the replicated op log to live replicas — the roles FISCO-BCOS gave the
+reference's contract via PBFT + Channel TLS (SURVEY.md §1 L0-L2;
+CommitteePrecompiled.cpp:349-456 for on-chain aggregation).  Every client
+interaction crosses a length-prefixed socket frame (comm.wire): no caller
+shares memory with the coordinator.
+
+Trust model: client mutations carry Ed25519 tags verified against a
+public-key directory (comm.identity) — the server can verify but not forge.
+Registration is trust-on-first-use by default (the address must match the
+presented public key) or closed-enrollment when a pre-provisioned directory
+is passed.  Coordinator-side ops (aggregate/commit, recovery) are the
+writer's own authority, exactly like the in-process runtimes.
+
+Replication: replicas connect and `subscribe`; the server pushes canonical
+op bytes (the same bytes `ledger.log_op` serves and the WAL stores), and the
+replica's replayed head digest must equal the writer's at every index — the
+multi-node consistency check the reference evidenced with identical loss
+lines in all four node logs (imgs/runtime.jpg).
+
+Failure detection: a monitor thread watches round progress; on a stall it
+drives the ledger's recovery ops (close_round → reseat_committee with
+recently-seen clients → force_aggregate), each an op in the replicated log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.comm.identity import (PublicDirectory, address_of,
+                                         _op_bytes)
+from bflc_demo_tpu.comm.wire import send_msg, recv_msg, WireError
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import unpack_pytree, pack_entries
+
+
+def _aggregate_flat(global_flat: Dict[str, np.ndarray],
+                    delta_flats: List[Dict[str, np.ndarray]],
+                    n_samples: List[int], selected: List[int],
+                    lr: float) -> Dict[str, np.ndarray]:
+    """Server-side FedAvg on flat entries: global -= lr * weighted mean of
+    the selected deltas (CommitteePrecompiled.cpp:403-414 semantics, the
+    same arithmetic `core.aggregate.apply_selection` implements on device —
+    numpy float32 here so the coordinator needs no accelerator)."""
+    w = np.zeros(len(delta_flats), np.float32)
+    for s in selected:
+        w[s] = float(n_samples[s])
+    wsum = max(float(w.sum()), 1e-12)
+    out: Dict[str, np.ndarray] = {}
+    for key, g in global_flat.items():
+        acc = np.zeros_like(np.asarray(g), dtype=np.float32)
+        for i, d in enumerate(delta_flats):
+            if w[i] > 0.0:
+                acc += np.asarray(d[key], np.float32) * (w[i] / wsum)
+        out[key] = (np.asarray(g, np.float32) - lr * acc).astype(
+            np.asarray(g).dtype)
+    return out
+
+
+class LedgerServer:
+    """Coordinator process body: socket server + aggregator + stall monitor.
+
+    Run via `serve_forever()` (blocking; typical use inside a dedicated
+    OS process — client/process_runtime.py spawns it) or `start()` for an
+    in-thread server in tests.
+    """
+
+    def __init__(self, cfg: ProtocolConfig, initial_model_blob: bytes,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 directory: Optional[PublicDirectory] = None,
+                 ledger_backend: str = "auto",
+                 wal_path: str = "",
+                 require_auth: bool = True,
+                 stall_timeout_s: float = 10.0,
+                 verbose: bool = False):
+        cfg.validate()
+        self.cfg = cfg
+        self.verbose = verbose
+        self.require_auth = require_auth
+        self.stall_timeout_s = stall_timeout_s
+        self._open_enrollment = directory is None
+        self.directory = directory if directory is not None \
+            else PublicDirectory()
+
+        # one lock serializes ledger + blob + model state — the consensus
+        # point (the reference leaned on PBFT ordering here); subscribers
+        # wait on the condition for new log entries
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self.ledger = make_ledger(cfg, backend=ledger_backend)
+        if wal_path:
+            if not self.ledger.attach_wal(wal_path):
+                raise RuntimeError(f"cannot attach WAL at {wal_path}")
+        self._blobs: Dict[bytes, bytes] = {}
+        self._model_blob = initial_model_blob
+        self._model_hash = hashlib.sha256(initial_model_blob).digest()
+        self._last_seen: Dict[str, float] = {}
+        self._last_progress = time.monotonic()
+        self._rounds_completed = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+
+    # ------------------------------------------------------------------ run
+    def start(self) -> None:
+        """Accept + monitor threads in the background (test convenience)."""
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        m = threading.Thread(target=self._monitor_loop, daemon=True)
+        m.start()
+        self._threads += [t, m]
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.1)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    # ----------------------------------------------------------- connection
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                method = msg.get("method", "")
+                if method == "subscribe":
+                    self._stream_ops(conn, int(msg.get("from", 0)))
+                    return
+                try:
+                    reply = self._dispatch(method, msg)
+                except (KeyError, ValueError, TypeError) as e:
+                    reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                send_msg(conn, reply)
+        except (WireError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _stream_ops(self, conn: socket.socket, start: int) -> None:
+        """Push canonical op bytes from `start` onward until the peer goes
+        away — the replica feed (WAL-identical bytes, ledger.cpp op codec)."""
+        next_i = start
+        while not self._stop.is_set():
+            with self._cv:
+                size = self.ledger.log_size()
+                ops = [self.ledger.log_op(i) for i in range(next_i,
+                                                            min(size,
+                                                                next_i + 256))]
+                if not ops:
+                    self._cv.wait(timeout=0.5)
+                    continue
+            for i, op in enumerate(ops):
+                send_msg(conn, {"i": next_i + i, "op": op.hex()})
+            next_i += len(ops)
+
+    # ------------------------------------------------------------- dispatch
+    def _touch(self, addr: str) -> None:
+        self._last_seen[addr] = time.monotonic()
+
+    def _verify(self, kind: str, addr: str, epoch: int, payload: bytes,
+                tag_hex: str) -> bool:
+        if not self.require_auth:
+            return True
+        return self.directory.verify(
+            addr, _op_bytes(kind, addr, epoch, payload), bytes.fromhex(
+                tag_hex))
+
+    def _dispatch(self, method: str, m: dict) -> dict:
+        with self._lock:
+            if method == "register":
+                addr = m["addr"]
+                if self.require_auth:
+                    pub = bytes.fromhex(m.get("pubkey", ""))
+                    if self._open_enrollment:
+                        # trust-on-first-use: the address must BE the key
+                        if address_of(pub) != addr:
+                            return {"ok": False, "status": "BAD_ARG",
+                                    "error": "address/pubkey mismatch"}
+                        if not self.directory.knows(addr):
+                            self.directory.enroll(pub)
+                    elif not self.directory.knows(addr):
+                        return {"ok": False, "status": "BAD_ARG",
+                                "error": "unknown identity"}
+                    if not self._verify("register", addr, 0, b"",
+                                        m.get("tag", "")):
+                        return {"ok": False, "status": "BAD_ARG",
+                                "error": "bad signature"}
+                st = self.ledger.register_node(addr)
+                self._touch(addr)
+                self._note_progress(st)
+                return {"ok": st == LedgerStatus.OK, "status": st.name,
+                        "epoch": self.ledger.epoch}
+            if method == "state":
+                addr = m["addr"]
+                self._touch(addr)
+                role, epoch = self.ledger.query_state(addr)
+                return {"ok": True, "role": role, "epoch": epoch,
+                        "round_closed": self.ledger.round_closed}
+            if method == "model":
+                return {"ok": True, "epoch": self.ledger.epoch,
+                        "hash": self._model_hash.hex(),
+                        "blob": self._model_blob.hex()}
+            if method == "upload":
+                addr = m["addr"]
+                blob = bytes.fromhex(m["blob"])
+                digest = hashlib.sha256(blob).digest()
+                if digest.hex() != m["hash"]:
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "blob/hash mismatch"}
+                payload = digest + struct.pack("<qd", int(m["n"]),
+                                               float(m["cost"]))
+                if not self._verify("upload", addr, int(m["epoch"]), payload,
+                                    m.get("tag", "")):
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "bad signature"}
+                st = self.ledger.upload_local_update(
+                    addr, digest, int(m["n"]), float(m["cost"]),
+                    int(m["epoch"]))
+                if st == LedgerStatus.OK:
+                    self._blobs[digest] = blob
+                self._touch(addr)
+                self._note_progress(st)
+                return {"ok": st == LedgerStatus.OK, "status": st.name}
+            if method == "updates":
+                ups = self.ledger.query_all_updates()
+                return {"ok": True, "updates": [
+                    {"sender": u.sender, "hash": u.payload_hash.hex(),
+                     "n": u.n_samples, "cost": u.avg_cost} for u in ups]}
+            if method == "blob":
+                digest = bytes.fromhex(m["hash"])
+                blob = self._blobs.get(digest)
+                if blob is None:
+                    return {"ok": False, "error": "unknown blob"}
+                return {"ok": True, "blob": blob.hex()}
+            if method == "scores":
+                addr = m["addr"]
+                scores = [float(s) for s in m["scores"]]
+                payload = struct.pack(f"<{len(scores)}d", *scores)
+                if not self._verify("scores", addr, int(m["epoch"]), payload,
+                                    m.get("tag", "")):
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "bad signature"}
+                st = self.ledger.upload_scores(addr, int(m["epoch"]), scores)
+                self._touch(addr)
+                self._note_progress(st)
+                if st == LedgerStatus.OK and self.ledger.aggregate_ready():
+                    self._aggregate_and_commit()
+                return {"ok": st == LedgerStatus.OK, "status": st.name}
+            if method == "committee":
+                return {"ok": True, "committee": self.ledger.committee()}
+            if method == "info":
+                return {"ok": True, "epoch": self.ledger.epoch,
+                        "num_registered": self.ledger.num_registered,
+                        "update_count": self.ledger.update_count,
+                        "score_count": self.ledger.score_count,
+                        "round_closed": self.ledger.round_closed,
+                        "last_global_loss": self.ledger.last_global_loss,
+                        "rounds_completed": self._rounds_completed,
+                        "log_size": self.ledger.log_size(),
+                        "log_head": self.ledger.log_head().hex()}
+            if method == "log_range":
+                start, end = int(m["start"]), int(m["end"])
+                size = self.ledger.log_size()
+                end = min(end, size)
+                if not (0 <= start <= end):
+                    return {"ok": False, "error": "bad range"}
+                return {"ok": True, "ops": [self.ledger.log_op(i).hex()
+                                            for i in range(start, end)]}
+            if method == "wait":
+                # event-driven poll: block until the log grows past the
+                # caller's view (or timeout) — replaces the reference's
+                # uniform(10,30)s sleep loop (main.py:231-233)
+                known = int(m["log_size"])
+                deadline = time.monotonic() + min(float(
+                    m.get("timeout_s", 5.0)), 60.0)
+                while (self.ledger.log_size() == known
+                       and not self._stop.is_set()):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                return {"ok": True, "log_size": self.ledger.log_size()}
+            return {"ok": False, "error": f"unknown method {method!r}"}
+
+    def _note_progress(self, st: LedgerStatus) -> None:
+        if st == LedgerStatus.OK:
+            self._last_progress = time.monotonic()
+            self._cv.notify_all()
+
+    # ---------------------------------------------------- coordinator logic
+    def _aggregate_and_commit(self) -> None:
+        """On-coordinator aggregation — the reference's on-chain Aggregate
+        (.cpp:349-456): weighted-FedAvg the ledger-selected deltas into the
+        global model, commit the new model's content hash, publish blob."""
+        pending = self.ledger.pending()
+        updates = self.ledger.query_all_updates()
+        epoch = self.ledger.epoch
+        global_flat = unpack_pytree(self._model_blob)
+        delta_flats = [unpack_pytree(self._blobs[u.payload_hash])
+                       for u in updates]
+        new_flat = _aggregate_flat(global_flat, delta_flats,
+                                   [u.n_samples for u in updates],
+                                   list(pending.selected),
+                                   self.cfg.learning_rate)
+        blob = pack_entries(new_flat)
+        digest = hashlib.sha256(blob).digest()
+        st = self.ledger.commit_model(digest, epoch)
+        if st != LedgerStatus.OK:
+            raise RuntimeError(f"commit rejected: {st.name}")
+        for u in updates:
+            self._blobs.pop(u.payload_hash, None)
+        self._model_blob = blob
+        self._model_hash = digest
+        self._rounds_completed += 1
+        self._last_progress = time.monotonic()
+        self._cv.notify_all()
+        if self.verbose:
+            print(f"[coordinator] epoch {epoch} aggregated: "
+                  f"loss={self.ledger.last_global_loss:.5f}", flush=True)
+
+    def _monitor_loop(self) -> None:
+        """Failure detector: when a round stalls (dead client processes),
+        drive the recovery ops.  Mirrors client/threaded.py's detector, but
+        liveness comes from request recency, not shared memory."""
+        while not self._stop.is_set():
+            time.sleep(min(self.stall_timeout_s / 4, 1.0))
+            with self._lock:
+                if self.ledger.epoch < 0:
+                    continue
+                stalled = (time.monotonic() - self._last_progress
+                           > self.stall_timeout_s)
+                if not stalled:
+                    continue
+                try:
+                    self._recover()
+                except Exception as e:      # noqa: BLE001 — the detector
+                    # must survive anything recovery throws (hostile blob
+                    # structure, commit race): a dead monitor thread would
+                    # silently disable stall recovery for the whole run
+                    if self.verbose:
+                        print(f"[coordinator] recovery failed: "
+                              f"{type(e).__name__}: {e}", flush=True)
+                self._last_progress = time.monotonic()
+
+    def _recover(self) -> None:
+        led = self.ledger
+        if led.aggregate_ready():
+            self._aggregate_and_commit()
+            return
+        if 0 < led.update_count < self.cfg.needed_update_count \
+                and not led.round_closed:
+            if led.close_round() == LedgerStatus.OK:
+                if self.verbose:
+                    print(f"[coordinator] recovery: close_round@{led.epoch}",
+                          flush=True)
+                self._cv.notify_all()
+                return
+        # scoring stuck — committee presumed dead: seat recently-seen
+        # clients (prefer non-uploaders so nobody scores their own update)
+        if led.update_count > 0 and led.score_count < self.cfg.comm_count:
+            uploaders = {u.sender for u in led.query_all_updates()}
+            fresh_cut = time.monotonic() - self.stall_timeout_s
+            live = [a for a, t in sorted(self._last_seen.items(),
+                                         key=lambda kv: -kv[1])
+                    if t >= fresh_cut]
+            committee = set(led.committee())
+            dead_committee = not any(a in committee for a in live)
+            if dead_committee:
+                pool = ([a for a in live if a not in uploaders] or live)
+                seats = pool[: self.cfg.comm_count]
+                if seats and led.reseat_committee(seats) == LedgerStatus.OK:
+                    if self.verbose:
+                        print(f"[coordinator] recovery: reseat@{led.epoch}",
+                              flush=True)
+                    self._cv.notify_all()
+                    return
+        if led.score_count > 0:
+            if led.force_aggregate() == LedgerStatus.OK:
+                if self.verbose:
+                    print(f"[coordinator] recovery: "
+                          f"force_aggregate@{led.epoch}", flush=True)
+                if led.aggregate_ready():
+                    self._aggregate_and_commit()
+
+
+# --------------------------------------------------------------- client side
+class CoordinatorClient:
+    """Client-side proxy: one socket, blocking request/reply.
+
+    Thin by design — signing and tensor codec live in the caller
+    (client/process_runtime.py); this class only frames messages.
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout_s)
+
+    def request(self, method: str, **fields) -> dict:
+        send_msg(self.sock, {"method": method, **fields})
+        reply = recv_msg(self.sock)
+        if reply is None:
+            raise ConnectionError("coordinator closed the connection")
+        return reply
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def replicate(host: str, port: int, cfg: ProtocolConfig,
+              ledger_backend: str = "auto", until_ops: int = 0,
+              timeout_s: float = 60.0):
+    """Live replica: subscribe to the writer's op stream, replay every op
+    into a fresh local ledger, and verify chained-head equality against the
+    writer at the end — the multi-node replication consistency contract
+    (reference: identical state on all 4 PBFT nodes, imgs/runtime.jpg).
+
+    Returns the replica ledger once `until_ops` ops are applied (or raises
+    on divergence/timeout).
+    """
+    replica = make_ledger(cfg, backend=ledger_backend)
+    sub = CoordinatorClient(host, port, timeout_s=timeout_s)
+    try:
+        send_msg(sub.sock, {"method": "subscribe", "from": 0})
+        applied = 0
+        deadline = time.monotonic() + timeout_s
+        while applied < until_ops:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replica saw {applied}/{until_ops} ops in {timeout_s}s")
+            msg = recv_msg(sub.sock)
+            if msg is None:
+                raise ConnectionError("writer closed the op stream")
+            st = replica.apply_op(bytes.fromhex(msg["op"]))
+            if st != LedgerStatus.OK:
+                raise RuntimeError(
+                    f"replica rejected op {msg['i']}: {st.name}")
+            applied += 1
+    finally:
+        sub.close()
+    if not replica.verify_log():
+        raise RuntimeError("replica chain verification failed")
+    probe = CoordinatorClient(host, port)
+    try:
+        info = probe.request("info")
+        # when the writer hasn't moved past our view, the chained head must
+        # match byte-for-byte (the replicas-agree-by-construction contract);
+        # if it has moved on, callers re-run with the larger until_ops
+        if info["log_size"] == applied and \
+                info["log_head"] != replica.log_head().hex():
+            raise RuntimeError("replica/writer head digest divergence")
+    finally:
+        probe.close()
+    return replica

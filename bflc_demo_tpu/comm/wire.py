@@ -1,0 +1,72 @@
+"""Length-prefixed message framing for the control-plane socket protocol.
+
+The reference's client↔chain transport is the FISCO Channel protocol: TLS
+frames carrying ABI-encoded calls with JSON payloads inside
+(README.md:240-260; SURVEY.md §2c).  This is the equivalent boundary for the
+TPU-native coordinator: a trivially parseable frame format —
+
+    [4-byte big-endian length][UTF-8 JSON object]
+
+— where binary fields (digests, signatures, op bytes, tensor blobs) travel
+hex-encoded inside the JSON.  Control messages are tiny (hashes + scores +
+meta; tensors cross separately as store blobs), so JSON's overhead is
+irrelevant and its debuggability is worth more than a binary codec here.
+Integrity/authenticity comes from Ed25519 op tags (comm.identity), not the
+transport.
+
+Frames are capped at 256 MiB: a hostile or corrupt length prefix must not
+drive an unbounded allocation (same rule as the ledger's op-byte bounds).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional
+
+MAX_FRAME = 256 << 20
+
+
+class WireError(ConnectionError):
+    """Framing violation or unexpected EOF mid-frame."""
+
+
+def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    data = json.dumps(msg, separators=(",", ":")).encode()
+    if len(data) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(data)}")
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF at a frame boundary."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            if not buf:
+                return None
+            raise WireError(f"EOF mid-frame ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Receive one frame; None on clean EOF (peer closed)."""
+    header = recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise WireError(f"frame length {length} exceeds cap")
+    body = recv_exact(sock, length)
+    if body is None:
+        raise WireError("EOF between header and body")
+    try:
+        msg = json.loads(body.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"undecodable frame: {e}") from e
+    if not isinstance(msg, dict):
+        raise WireError("frame is not a JSON object")
+    return msg

@@ -41,9 +41,10 @@ def _leaf_entries(tree: Pytree) -> List[Tuple[str, np.ndarray]]:
     return entries
 
 
-def canonical_bytes(tree: Pytree) -> bytes:
+def _encode_entries(entries: List[Tuple[str, np.ndarray]]) -> bytes:
+    """The one canonical entry encoder — hashing, wire, and checkpoint
+    formats all flow through here so they can never drift apart."""
     out = [_MAGIC]
-    entries = _leaf_entries(tree)
     out.append(struct.pack("<q", len(entries)))
     for key, arr in entries:
         kb = key.encode()
@@ -65,6 +66,10 @@ def canonical_bytes(tree: Pytree) -> bytes:
     return b"".join(out)
 
 
+def canonical_bytes(tree: Pytree) -> bytes:
+    return _encode_entries(_leaf_entries(tree))
+
+
 def hash_pytree(tree: Pytree) -> bytes:
     """32-byte content hash — the ledger's view of a tensor payload."""
     return hashlib.sha256(canonical_bytes(tree)).digest()
@@ -73,6 +78,37 @@ def hash_pytree(tree: Pytree) -> bytes:
 def pack_pytree(tree: Pytree) -> bytes:
     """Self-describing binary encoding (also the checkpoint leaf format)."""
     return canonical_bytes(tree)
+
+
+def pack_entries(entries: Dict[str, np.ndarray]) -> bytes:
+    """Encode already-flat {path: array} entries in the canonical layout.
+
+    `pack_entries(unpack_pytree(blob)) == blob`: a coordinator that unpacks
+    a model blob, aggregates the arrays key-by-key, and re-packs with the
+    same keys produces bytes whose sha256 equals `hash_pytree` of the
+    corresponding nested tree — so content addresses agree across the
+    network boundary without the server ever knowing the model's structure.
+    """
+    return _encode_entries([(k, np.asarray(a))
+                            for k, a in sorted(entries.items())])
+
+
+def restore_pytree(template: Pytree, flat: Dict[str, np.ndarray]) -> Pytree:
+    """Rebuild `template`'s structure from `unpack_pytree` output — the
+    client-side inverse of the wire format (models know their tree-def)."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = jax.tree_util.keystr(path)
+        if key not in flat:
+            raise KeyError(f"blob missing leaf {key}")
+        arr = np.asarray(flat[key])
+        want = np.asarray(leaf)
+        if arr.shape != want.shape:
+            raise ValueError(f"leaf {key}: shape {arr.shape} != "
+                             f"{want.shape}")
+        leaves.append(arr.astype(want.dtype, copy=False))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def unpack_pytree(data: bytes) -> Dict[str, np.ndarray]:
