@@ -27,5 +27,7 @@ from bflc_demo_tpu.parallel.ep import (  # noqa: F401
     moe_partition_specs, shard_moe_params, make_ep_train_step)
 from bflc_demo_tpu.parallel.pp import (  # noqa: F401
     stack_blocks, shard_pp_params, make_pp_transformer_forward)
+from bflc_demo_tpu.parallel.sp_tp import (  # noqa: F401
+    make_sp_tp_transformer_forward)
 from bflc_demo_tpu.parallel.secure import (  # noqa: F401
-    secure_masked_sum, secure_fedavg)
+    secure_masked_sum, secure_fedavg, derive_pair_seeds)

@@ -1,0 +1,124 @@
+"""Composed sequence x tensor parallelism: ring attention with head-sharded
+QKV on an ("sp", "tp") mesh.
+
+The last pairing in the parallelism portfolio (dp/tp/pp/sp/ep each work
+alone; dpxtp, dpxep, and pp compose in __graft_entry__.dryrun_multichip):
+long sequences shard over "sp" (each device holds a sequence block) while
+the transformer's weights shard Megatron-style over "tp" (each device holds
+a head/feature slice).  Every device therefore computes attention for ITS
+sequence block over ITS heads only: the ring ppermute cycles KV blocks
+around "sp" exactly as in parallel/ring_attention.py, but each traveling
+block is 1/n_tp the size because only the local heads ride it — ICI traffic
+and attention FLOPs both divide by n_tp, which is what makes tp the right
+second axis once a single head-set's ring saturates a chip.
+
+Layout (reference for the tp algebra: parallel/tp.py, which expresses the
+same layout as GSPMD jit shardings; here the collectives are explicit
+because the ring already requires shard_map):
+
+- embed vocab-sharded over tp: each device gathers the token rows it owns,
+  one psum("tp") rebuilds the full embedding (the Megatron vocab-parallel
+  embedding);
+- wq/wk/wv column-parallel (heads sharded), wo row-parallel + psum("tp");
+- w1/b1 column-parallel, w2 row-parallel + psum("tp"), b2 added once after;
+- LayerNorm/pos/head replicated (tiny); the padding-aware mean-pool
+  psum("sp")s its numerator/denominator as in the sp-only forward.
+
+One all-reduce per sublayer over tp + the KV ring over sp — no other
+communication.  Differential-tested against the single-device forward
+(tests/test_sp_tp.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bflc_demo_tpu.models.transformer import TransformerConfig, layer_norm
+from bflc_demo_tpu.parallel.ring_attention import ring_attention, SP_AXIS
+from bflc_demo_tpu.parallel.tp import transformer_partition_specs
+
+Pytree = Any
+TP_AXIS = "tp"
+
+
+def _tp_block(x: jax.Array, pad: jax.Array, bp: Pytree,
+              cfg: TransformerConfig, n_tp: int) -> jax.Array:
+    """One encoder block on a (sequence-block, head-shard) holding device.
+
+    Mirrors models/transformer.block_forward with the tp collectives made
+    explicit: the attention core is the sp ring over the LOCAL heads.
+    """
+    b, s, d = x.shape
+    h_loc, dh = cfg.heads // n_tp, cfg.head_dim
+    dt = cfg.dtype
+    y = layer_norm(x, bp["ln1"], dt)
+    q = (y @ bp["wq"].astype(dt)).reshape(b, s, h_loc, dh)
+    k = (y @ bp["wk"].astype(dt)).reshape(b, s, h_loc, dh)
+    v = (y @ bp["wv"].astype(dt)).reshape(b, s, h_loc, dh)
+    o = ring_attention(q, k, v, pad, SP_AXIS)
+    x = x + jax.lax.psum(o.reshape(b, s, h_loc * dh) @ bp["wo"].astype(dt),
+                         TP_AXIS)
+    y = layer_norm(x, bp["ln2"], dt)
+    y = jax.nn.gelu(y @ bp["w1"].astype(dt) + bp["b1"].astype(dt))
+    return x + (jax.lax.psum(y @ bp["w2"].astype(dt), TP_AXIS)
+                + bp["b2"].astype(dt))
+
+
+def make_sp_tp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
+                                   ) -> Callable[[Pytree, jax.Array],
+                                                 jax.Array]:
+    """Classifier forward with sequence sharded over "sp" and weights over
+    "tp".  tokens: (B, S); params in the init_transformer_params layout
+    (dense blocks — MoE routes its experts over "ep" instead, parallel/ep.py).
+
+    Params may arrive replicated or already tp-sharded: the in_specs are the
+    same transformer_partition_specs the GSPMD path uses, so jit reshards
+    as needed and a checkpointed model drops in unchanged.
+    """
+    n_sp, n_tp = mesh.shape[SP_AXIS], mesh.shape[TP_AXIS]
+    if cfg.moe_experts:
+        raise ValueError("sp x tp composes the dense transformer; shard MoE "
+                         "experts over 'ep' (parallel/ep.py) instead")
+    for name, val, div in (("seq_len", cfg.seq_len, n_sp),
+                           ("heads", cfg.heads, n_tp),
+                           ("vocab_size", cfg.vocab_size, n_tp),
+                           ("mlp hidden", cfg.mlp_ratio * cfg.dim, n_tp)):
+        if val % div:
+            raise ValueError(f"{name} {val} not divisible by axis size {div}")
+    s_blk = cfg.seq_len // n_sp
+    v_blk = cfg.vocab_size // n_tp
+
+    def body(params, tokens_blk):
+        my_sp = jax.lax.axis_index(SP_AXIS)
+        my_tp = jax.lax.axis_index(TP_AXIS)
+        dt = cfg.dtype
+        pad = tokens_blk != 0
+        # vocab-parallel embedding: gather locally-owned rows, psum the rest
+        loc = tokens_blk - my_tp * v_blk
+        mine = (loc >= 0) & (loc < v_blk)
+        x = jnp.where(
+            mine[..., None],
+            params["embed"].astype(dt)[jnp.clip(loc, 0, v_blk - 1)],
+            jnp.zeros((), dt))
+        x = jax.lax.psum(x, TP_AXIS)
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos"].astype(dt), my_sp * s_blk, s_blk, axis=0)[None]
+        for bp in params["blocks"]:
+            x = _tp_block(x, pad, bp, cfg, n_tp)
+        x = layer_norm(x, params["ln_f"], jnp.float32)
+        num = jax.lax.psum((x * pad[..., None]).sum(1), SP_AXIS)
+        den = jax.lax.psum(pad.sum(-1, keepdims=True), SP_AXIS)
+        pooled = num / jnp.maximum(den, 1).astype(jnp.float32)
+        return pooled @ params["head_w"] + params["head_b"]
+
+    param_specs = transformer_partition_specs(
+        {"blocks": (None,) * cfg.depth}, TP_AXIS)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(param_specs, P(None, SP_AXIS)),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)
