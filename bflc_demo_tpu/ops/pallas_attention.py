@@ -43,7 +43,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref,
     written at k==nk-1.
 
     q_ref: (1, block_q, d); k_ref/v_ref: (1, block_k, d);
-    mask_ref: (1, block_k) int32; o_ref: (1, block_q, d);
+    mask_ref: (1, 1, block_k) int32 — the batch mask carries a unit middle
+    axis so its block's trailing two dims are (1, block_k), which satisfies
+    Mosaic's tiling rule (second-minor equal to the array dim, minor
+    lane-divisible); o_ref: (1, block_q, d);
     acc_ref: (block_q, d) f32; m_ref/l_ref: (block_q, LANES) f32 (the value
     is replicated across lanes to keep stores tiled).
     """
@@ -55,14 +58,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    kb = k_ref[0].astype(jnp.float32)
-    vb = v_ref[0].astype(jnp.float32)
-    mb = mask_ref[0]
+    # keep q/k/v in their storage dtype for the MXU dots (bf16 inputs run at
+    # full MXU rate; f32 accumulation comes from preferred_element_type) —
+    # only the softmax state is explicitly float32
+    q = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    mb = mask_ref[0, 0]
 
     m = m_ref[:, 0]
     l = l_ref[:, 0]
-    logits = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+    logits = jnp.dot(q, kb.T,
+                     preferred_element_type=jnp.float32) * scale
     logits = jnp.where((mb > 0)[None, :], logits, NEG_INF)
     m_new = jnp.maximum(m, logits.max(axis=-1))
     p = jnp.exp(logits - m_new[:, None])
@@ -70,7 +77,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref,
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
     acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
-        p, vb, preferred_element_type=jnp.float32)
+        p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
     m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -98,7 +105,7 @@ def _flash_fwd_impl(q, k, v, kv_mask, block_q: int, block_k: int,
     qh = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
-    mask_i32 = kv_mask.astype(jnp.int32)      # (B, S_kv)
+    mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]   # (B, 1, S_kv)
 
     kernel = functools.partial(_flash_kernel, scale=scale, nk=nk)
     out = pl.pallas_call(
@@ -109,7 +116,8 @@ def _flash_fwd_impl(q, k, v, kv_mask, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
             # head rows share their batch's padding mask
-            pl.BlockSpec((1, block_k), lambda i, j, kk, h=h: (i // h, kk)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda i, j, kk, h=h: (i // h, 0, kk)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),

@@ -1,0 +1,109 @@
+"""On-TPU validation stages: run whenever the accelerator is reachable.
+
+The CI suite pins CPU (tests/conftest.py) because multi-chip hardware isn't
+guaranteed, so everything hardware-specific lives here: native Mosaic
+compilation of the Pallas flash-attention kernel, correctness vs the einsum
+core, and amortised timing at long sequence lengths.  Results append to
+TPU_RESULTS.md and print as JSON for machine capture.
+
+Usage:  python tools/tpu_validate.py [--rounds N] [--out TPU_RESULTS.md]
+
+The kernel timing chains N applications inside ONE dispatch (lax.fori_loop)
+so per-call tunnel/dispatch latency (~60 ms through the axon relay) doesn't
+drown the kernel time — the same discipline bench.py uses for round times.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu",):
+        print(json.dumps({"ok": False,
+                          "error": f"no TPU (platform={platform})"}))
+        return 1
+
+    from bflc_demo_tpu.ops.pallas_attention import (flash_attention,
+                                                    _reference_attention)
+
+    rng = np.random.default_rng(0)
+    rows = []
+
+    def run_case(b, s, h, d, dtype, blk):
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+        mask = jnp.asarray(rng.random((b, s)) > 0.1)
+        scale = 1.0 / np.sqrt(d)
+
+        # correctness: one native-Mosaic call vs the einsum core
+        out_p = jax.jit(lambda *a: flash_attention(*a, blk, blk, False))(
+            q, k, v, mask)
+        out_r = jax.jit(lambda *a: _reference_attention(*a, scale))(
+            q, k, v, mask)
+        err = float(jnp.max(jnp.abs(out_p.astype(jnp.float32)
+                                    - out_r.astype(jnp.float32))))
+
+        def amortised(fn):
+            @jax.jit
+            def many(q_):
+                return jax.lax.fori_loop(
+                    0, args.iters, lambda i, acc: fn(acc, k, v, mask), q_)
+            many(q).block_until_ready()
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                many(q).block_until_ready()
+                best = min(best, (time.perf_counter() - t0) / args.iters)
+            return best
+
+        tp = amortised(lambda q_, k_, v_, m_: flash_attention(
+            q_, k_, v_, m_, blk, blk, False))
+        tr = amortised(lambda q_, k_, v_, m_: _reference_attention(
+            q_, k_, v_, m_, scale))
+        rows.append({"b": b, "s": s, "h": h, "d": d,
+                     "dtype": np.dtype(dtype).name, "block": blk,
+                     "max_err": err, "pallas_ms": round(tp * 1e3, 2),
+                     "einsum_ms": round(tr * 1e3, 2),
+                     "speedup": round(tr / tp, 2)})
+        print(json.dumps(rows[-1]), flush=True)
+
+    run_case(2, 1024, 8, 64, jnp.float32, 128)
+    run_case(2, 4096, 8, 64, jnp.bfloat16, 128)
+    run_case(2, 8192, 8, 64, jnp.bfloat16, 128)
+
+    ok = all(r["max_err"] < 5e-3 for r in rows)
+    summary = {"ok": ok, "platform": platform,
+               "device": str(jax.devices()[0]), "rows": rows}
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(f"\n## tools/tpu_validate.py run "
+                    f"({time.strftime('%Y-%m-%d %H:%M')})\n\n")
+            f.write("| b | s | dtype | block | max_err | pallas ms | "
+                    "einsum ms | speedup |\n|---|---|---|---|---|---|---|"
+                    "---|\n")
+            for r in rows:
+                f.write(f"| {r['b']} | {r['s']} | {r['dtype']} | "
+                        f"{r['block']} | {r['max_err']:.1e} | "
+                        f"{r['pallas_ms']} | {r['einsum_ms']} | "
+                        f"{r['speedup']}x |\n")
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
