@@ -4,19 +4,29 @@ Metric: FL round time (seconds) for the reference-equivalence workload
 (config 1: softmax regression on UCI occupancy, 20 clients, committee 4,
 top-6 sample-weighted FedAvg — SURVEY.md §6), full protocol per round
 (10 local trainings + committee scoring + aggregation + sponsor eval) using
-the device-resident mesh runtime (one XLA program per round).
+the device-resident mesh runtime.  Both execution paths are measured:
+
+- per-round (rounds_per_dispatch=1): one XLA program per protocol round,
+  host ledger audited synchronously — the latency-honest number;
+- batched (rounds_per_dispatch=5): R rounds per dispatch with post-hoc
+  ledger replay/audit — the amortised number (the headline `value`).
 
 vs_baseline: the reference's round time is structurally bounded below by its
 polling design — every protocol phase waits a uniform(10,30) s sleep per
 client (python-sdk/main.py:62, 231-233), i.e. >= ~20 s/round in expectation
 before any compute.  vs_baseline = 20.0 / measured_round_time (higher is
-better; >1 beats the reference).
+better; >1 beats the reference).  That floor is sleep-bound, so `extra`
+also carries accuracy parity (reference sponsor line: 0.9214,
+imgs/runtime.jpg) and samples/sec/chip — the axes a compute-bound
+comparison needs.
 
-Robustness: the measurement runs in a child process with a watchdog.  If the
-TPU backend wedges (observed: a stuck axon tunnel blocks jax.devices()
-indefinitely), the child is killed and the benchmark reruns pinned to CPU,
-honestly labelled "platform": "cpu-fallback" — a number with a caveat beats
-a hung driver.
+Robustness: measurements run in child processes under a watchdog.  The TPU
+attempt is gated by a cheap PRE-FLIGHT probe child (jax.devices() + one
+matmul under its own short timeout, retried once) so a wedged axon tunnel
+costs ~2 probe timeouts, not the whole budget (round-1 failure mode: the
+full 1500 s burned before the CPU fallback).  If the probe never passes,
+the benchmark reruns pinned to CPU, honestly labelled
+"platform": "cpu-fallback".
 """
 
 import json
@@ -24,6 +34,28 @@ import os
 import subprocess
 import sys
 import time
+
+PROBE_TIMEOUT_S = int(os.environ.get("BFLC_BENCH_PROBE_TIMEOUT", "150"))
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "x = jnp.ones((512, 512), jnp.bfloat16); "
+    "(x @ x).block_until_ready(); "
+    "print('PROBE_OK', jax.devices()[0].platform)"
+)
+
+
+def _probe_tpu() -> bool:
+    """Can this host reach a working accelerator quickly?  Two attempts."""
+    for _ in range(2):
+        try:
+            r = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                               capture_output=True, text=True,
+                               timeout=PROBE_TIMEOUT_S)
+            if r.returncode == 0 and "PROBE_OK" in r.stdout:
+                return "PROBE_OK cpu" not in r.stdout
+        except subprocess.TimeoutExpired:
+            pass
+    return False
 
 
 def _child() -> None:
@@ -37,9 +69,12 @@ def _child() -> None:
     from bflc_demo_tpu.eval import bench_config1
 
     platform = jax.devices()[0].platform
-    r = bench_config1(rounds=10, runtime="mesh", rounds_per_dispatch=5)
-    # min over rounds excludes the first (compile-bearing) round
-    round_time = r["min_round_time_s"]
+    # batched path: the headline (20 rounds, 5 per dispatch; min round time
+    # excludes the compile-bearing first dispatch)
+    rb = bench_config1(rounds=20, runtime="mesh", rounds_per_dispatch=5)
+    # per-round path: latency per protocol round with synchronous audit
+    rp = bench_config1(rounds=6, runtime="mesh", rounds_per_dispatch=1)
+    round_time = rb["min_round_time_s"]
     baseline_round_s = 20.0
     print(json.dumps({
         "metric": "fl_round_time_s_config1",
@@ -47,12 +82,17 @@ def _child() -> None:
         "unit": "s/round",
         "vs_baseline": round(baseline_round_s / round_time, 2),
         "extra": {
-            "best_test_acc": round(r["best_acc"], 4),
+            "best_test_acc": round(max(rb["best_acc"], rp["best_acc"]), 4),
             "reference_test_acc": 0.9214,
-            "mean_round_time_s": round(r["mean_round_time_s"], 5),
+            "batched_min_round_time_s": round(rb["min_round_time_s"], 5),
+            "batched_mean_round_time_s": round(rb["mean_round_time_s"], 5),
+            "per_round_min_round_time_s": round(rp["min_round_time_s"], 5),
             "train_samples_per_sec_per_chip": round(
-                r["train_samples_per_sec_per_chip"], 1),
-            "rounds": r["rounds"],
+                rb["train_samples_per_sec_per_chip"], 1),
+            "rounds": rb["rounds"] + rp["rounds"],
+            "baseline_note": ("20 s/round is the reference's structural "
+                              "polling floor (sleep-bound); accuracy parity "
+                              "and samples/sec/chip are the compute axes"),
             "platform": ("cpu-fallback"
                          if os.environ.get("BFLC_BENCH_FORCE_CPU")
                          else platform),
@@ -65,9 +105,19 @@ def main() -> None:
         _child()
         return
     budget = int(os.environ.get("BFLC_BENCH_TIMEOUT", "1500"))
-    attempts = [({}, budget), ({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
+
+    attempts = []
+    if os.environ.get("BFLC_BENCH_FORCE_CPU"):
+        attempts = [({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
+    elif _probe_tpu():
+        attempts = [({}, budget), ({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
+    else:
+        attempts = [({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
     last_err = ""
     for extra_env, timeout_s in attempts:
+        # each attempt gets its own full budget: if the TPU child wedges
+        # after a passing probe, the CPU fallback must still have enough
+        # room to produce the honest "cpu-fallback" number
         env = dict(os.environ, BFLC_BENCH_CHILD="1", **extra_env)
         try:
             t0 = time.time()
