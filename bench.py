@@ -67,7 +67,9 @@ def _child() -> None:
     import jax
 
     from bflc_demo_tpu.eval import bench_config1
+    from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
 
+    enable_persistent_cache()
     platform = jax.devices()[0].platform
     # batched path: the headline (20 rounds, 5 per dispatch; min round time
     # excludes the compile-bearing first dispatch)

@@ -13,6 +13,7 @@ import sys
 
 def main(argv=None) -> int:
     from bflc_demo_tpu.eval.configs import CONFIGS
+    from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
     from bflc_demo_tpu.utils.flags import parse_args
     from bflc_demo_tpu.utils.tracing import Tracer
 
@@ -21,6 +22,16 @@ def main(argv=None) -> int:
         print(f"unknown config {opts.config!r}; have {list(CONFIGS)}",
               file=sys.stderr)
         return 2
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        # honor the user's platform choice even when host site hooks
+        # configured a different platform programmatically at interpreter
+        # start (jax.config beats the env var, so re-assert it)
+        import jax
+        jax.config.update("jax_platforms", plat)
+    enable_persistent_cache()   # after arg validation: --help and error
+                                # paths must not pay the jax import
     preset = CONFIGS[opts.config]
     tracer = Tracer(enabled=bool(opts.trace_path))
 

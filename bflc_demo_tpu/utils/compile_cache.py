@@ -1,0 +1,43 @@
+"""Persistent XLA compilation cache.
+
+The big round programs (ResNet/transformer full-geometry protocol rounds)
+cost 20 s - minutes to compile, and on the remote-compile TPU path that
+latency recurs per process.  JAX's persistent compilation cache keyes
+compiled executables by (HLO, compile options, platform version) on disk,
+so a re-run — the CLI, bench.py, the driver's repeated invocations — pays
+compile once per program, not once per process.
+
+Env contract:
+  BFLC_COMPILE_CACHE=<dir>   cache directory (default
+                             ~/.cache/bflc_demo_tpu/jax)
+  BFLC_COMPILE_CACHE=0       disable entirely
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache() -> str:
+    """Idempotently point jax at the on-disk compilation cache.
+
+    Returns the cache dir ('' when disabled).  Safe to call before or after
+    backend init; compile-cache config is read at compile time.
+    """
+    spec = os.environ.get("BFLC_COMPILE_CACHE", "")
+    if spec == "0":
+        return ""
+    cache_dir = spec or os.path.join(
+        os.path.expanduser("~"), ".cache", "bflc_demo_tpu", "jax")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every program that takes noticeable compile time; tiny
+        # programs stay memory-only (the default threshold skips sub-second
+        # compiles whose disk round-trip would cost more than they save)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:                    # noqa: BLE001 — cache is advisory
+        return ""
+    return cache_dir
