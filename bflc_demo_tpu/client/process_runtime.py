@@ -236,7 +236,7 @@ def run_federated_processes(
         crash_at: Optional[Dict[int, int]] = None,
         stall_timeout_s: float = 5.0,
         wal_path: str = "",
-        with_replica: bool = True,
+        replicas: int = 1,
         timeout_s: float = 600.0,
         init_seed: int = 0,
         verbose: bool = False) -> ProcessFederationResult:
@@ -245,6 +245,9 @@ def run_federated_processes(
 
     crash_at: {client_index: epoch} — that client's process hard-exits at
     that epoch; the coordinator's recovery ops must carry the round.
+    replicas: live replica processes replaying the writer's op stream
+    (the reference's 4-node deployment = 1 writer + 3 replicas); each must
+    independently reproduce the writer's chained head digest.
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -323,22 +326,28 @@ def run_federated_processes(
                 f"({len(history)}/{rounds} rounds)")
         final = sponsor.request("info")
         replica_report = None
-        if with_replica:
+        if replicas > 0:
             rep_q = ctx.Queue()
             with _cpu_spawn_env():
-                rp = ctx.Process(target=_replica_proc,
-                                 args=(host, port, cfg_kw,
-                                       final["log_size"], rep_q),
-                                 daemon=True)
-                rp.start()
-            replica_report = rep_q.get(timeout=120)
-            rp.join(timeout=10)
-            if not replica_report["ok"]:
-                raise RuntimeError(
-                    f"replica failed: {replica_report['error']}")
-            if replica_report["size"] == final["log_size"] and \
-                    replica_report["head"] != final["log_head"]:
-                raise RuntimeError("replica/writer head divergence")
+                rps = [ctx.Process(target=_replica_proc,
+                                   args=(host, port, cfg_kw,
+                                         final["log_size"], rep_q),
+                                   daemon=True)
+                       for _ in range(replicas)]
+                for rp in rps:
+                    rp.start()
+            reports = [rep_q.get(timeout=120) for _ in rps]
+            for rp in rps:
+                rp.join(timeout=10)
+            # writer-head equality per replica implies replica/replica
+            # agreement, so one check covers both
+            for rep in reports:
+                if not rep["ok"]:
+                    raise RuntimeError(f"replica failed: {rep['error']}")
+                if rep["size"] == final["log_size"] and \
+                        rep["head"] != final["log_head"]:
+                    raise RuntimeError("replica/writer head divergence")
+            replica_report = reports[0]
     finally:
         sponsor.close()
         for i, p in enumerate(clients):

@@ -244,12 +244,14 @@ class TestProcessFederation:
     """Real OS processes end to end (coordinator + clients + replica)."""
 
     def test_converges_across_process_boundaries(self):
+        """1 writer + 3 live replicas — the reference's 4-node topology
+        (README.md:162-183), every replica reproducing the writer's head."""
         from bflc_demo_tpu.client.process_runtime import \
             run_federated_processes
         shards, test_set = _occupancy_shards(CFG.client_num)
         res = run_federated_processes(
             "make_softmax_regression", shards, test_set, CFG,
-            rounds=4, stall_timeout_s=20.0, timeout_s=420.0)
+            rounds=4, stall_timeout_s=20.0, timeout_s=420.0, replicas=3)
         assert res.rounds_completed >= 4
         assert res.best_accuracy() > 0.85, res.accuracy_history
         assert res.replica_report["ok"]
