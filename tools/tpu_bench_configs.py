@@ -1,0 +1,110 @@
+"""Benchmark configs 1-5 on the real TPU chip (VERDICT round-1 item 8).
+
+Runs each benchmark config's mesh-runtime geometry for a few rounds on the
+accelerator, recording per-round times and best accuracy; appends a table
+to TPU_RESULTS.md and prints one JSON line per config.  Geometries follow
+each config's defaults; config 4 (ResNet-18 x 32 clients) relies on the
+participation='active' / client_chunk / remat controls that keep it inside
+a 16 GB v5e (eval/configs.py), and dataset size can be scaled down with
+--n-data (configs 2-5; recorded in the artifact rather than hidden).
+
+Usage: python tools/tpu_bench_configs.py [--rounds N] [--configs 2,3,4,5]
+       [--n-data N] [--out TPU_RESULTS.md]
+
+Each config runs in its own child process under a watchdog: one wedged
+compile (the axon tunnel's failure mode) skips that config instead of
+killing the sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+CHILD_CODE = """
+import json, time
+import jax
+from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
+from bflc_demo_tpu.eval.configs import CONFIGS
+enable_persistent_cache()
+name, rounds, n_data = {name!r}, {rounds}, {n_data}
+kw = dict(rounds=rounds, runtime="mesh")
+if n_data and name != "config1":     # config1 = fixed occupancy dataset
+    kw["n_data"] = n_data
+t0 = time.time()
+res = CONFIGS[name].build(**kw)
+wall = time.time() - t0
+times = getattr(res, "round_times_s", None) or []
+print("RESULT " + json.dumps({{
+    "config": name,
+    "platform": jax.devices()[0].platform,
+    "rounds": rounds,
+    "wall_s": round(wall, 2),
+    "min_round_s": round(min(times), 4) if times else None,
+    "mean_round_s": round(sum(times) / len(times), 4) if times else None,
+    "best_acc": round(res.best_accuracy(), 4),
+    "n_data": n_data or "default",
+}}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--n-data", type=int, default=0,
+                    help="override dataset size (0 = config default)")
+    ap.add_argument("--timeout", type=int, default=1200,
+                    help="per-config watchdog seconds")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    rows = []
+    for idx in args.configs.split(","):
+        name = f"config{idx.strip()}"
+        code = CHILD_CODE.format(name=name, rounds=args.rounds,
+                                 n_data=args.n_data)
+        try:
+            t0 = time.time()
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=args.timeout,
+                                  env=dict(os.environ))
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("RESULT ")), None)
+            if proc.returncode == 0 and line:
+                rows.append(json.loads(line[len("RESULT "):]))
+            else:
+                rows.append({"config": name, "error":
+                             f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-300:]}"})
+        except subprocess.TimeoutExpired:
+            rows.append({"config": name,
+                         "error": f"timeout {args.timeout}s "
+                                  f"(after {time.time() - t0:.0f}s)"})
+        print(json.dumps(rows[-1]), flush=True)
+
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(f"\n## tools/tpu_bench_configs.py run "
+                    f"({time.strftime('%Y-%m-%d %H:%M')}, "
+                    f"rounds={args.rounds})\n\n")
+            f.write("| config | platform | min round s | mean round s | "
+                    "best acc | note |\n|---|---|---|---|---|---|\n")
+            for r in rows:
+                if "error" in r:
+                    f.write(f"| {r['config']} | — | — | — | — | "
+                            f"{r['error'][:80]} |\n")
+                else:
+                    f.write(f"| {r['config']} | {r['platform']} | "
+                            f"{r['min_round_s']} | {r['mean_round_s']} | "
+                            f"{r['best_acc']} | n_data={r['n_data']} |\n")
+    return 0 if all("error" not in r for r in rows) else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
