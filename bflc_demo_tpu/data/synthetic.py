@@ -30,20 +30,54 @@ def synthetic_image_classification(n: int, shape: Tuple[int, ...],
     return np.clip(x, 0.0, 1.0).astype(np.float32), y
 
 
+def _real_or_synthetic(name: str, n: int, shape, num_classes: int,
+                       seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Prefer a real dataset dropped at $BFLC_DATA_DIR/<name>.npz (arrays
+    'x'/'y', `load_image_dataset` contract); otherwise the seeded synthetic
+    stand-in.  Shape/cardinality are validated so a mislabeled file fails
+    loudly instead of silently training on the wrong geometry."""
+    data_dir = os.environ.get("BFLC_DATA_DIR", "")
+    if data_dir:
+        path = os.path.join(data_dir, f"{name}.npz")
+        if os.path.exists(path):
+            x, y = load_image_dataset(path)
+            if tuple(x.shape[1:]) != tuple(shape):
+                raise ValueError(f"{path}: images are {x.shape[1:]}, "
+                                 f"config expects {shape}")
+            if int(y.min()) < 0 or int(y.max()) >= num_classes:
+                raise ValueError(f"{path}: labels span "
+                                 f"[{int(y.min())}, {int(y.max())}], "
+                                 f"need [0, {num_classes})")
+            if float(x.min()) < 0.0 or float(x.max()) > 1.0:
+                raise ValueError(f"{path}: pixel range "
+                                 f"[{float(x.min()):g}, "
+                                 f"{float(x.max()):g}] violates the [0, 1] "
+                                 f"contract (0-255 file? divide by 255)")
+            if n and len(x) < n:
+                raise ValueError(f"{path}: {len(x)} samples < requested "
+                                 f"{n}; lower n_data or provide more data")
+            if n and len(x) > n:
+                rng = np.random.default_rng(seed)
+                idx = rng.permutation(len(x))[:n]
+                return x[idx], y[idx]
+            return x, y
+    return synthetic_image_classification(n, shape, num_classes, seed)
+
+
 def synthetic_mnist(n: int = 6000, seed: int = 0):
-    return synthetic_image_classification(n, (28, 28, 1), 10, seed)
+    return _real_or_synthetic("mnist", n, (28, 28, 1), 10, seed)
 
 
 def synthetic_cifar10(n: int = 6000, seed: int = 0):
-    return synthetic_image_classification(n, (32, 32, 3), 10, seed)
+    return _real_or_synthetic("cifar10", n, (32, 32, 3), 10, seed)
 
 
 def synthetic_cifar100(n: int = 6000, seed: int = 0):
-    return synthetic_image_classification(n, (32, 32, 3), 100, seed)
+    return _real_or_synthetic("cifar100", n, (32, 32, 3), 100, seed)
 
 
 def synthetic_femnist(n: int = 8000, seed: int = 0):
-    return synthetic_image_classification(n, (28, 28, 1), 62, seed)
+    return _real_or_synthetic("femnist", n, (28, 28, 1), 62, seed)
 
 
 def synthetic_text_classification(n: int, seq_len: int = 64,

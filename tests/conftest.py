@@ -27,3 +27,8 @@ if "jax" in sys.modules:
         "XLA backend initialised before conftest could set "
         "JAX_PLATFORMS/XLA_FLAGS; run pytest from the repo root")
     jax.config.update("jax_platforms", "cpu")
+
+# Tests must see the seeded synthetic distributions the convergence bars
+# were calibrated against — never real .npz files leaked in from the host
+# environment (data/synthetic._real_or_synthetic keys off this var).
+os.environ.pop("BFLC_DATA_DIR", None)

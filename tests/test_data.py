@@ -57,3 +57,40 @@ def test_dirichlet_impossible_split_raises():
 def test_one_hot():
     oh = one_hot(np.array([0, 1, 1]), 2)
     np.testing.assert_array_equal(oh, [[1, 0], [0, 1], [0, 1]])
+
+
+def test_real_npz_preferred_over_synthetic(tmp_path, monkeypatch):
+    """BFLC_DATA_DIR/<name>.npz wins over the synthetic generator, with
+    geometry validation so a mislabeled file fails loudly."""
+    import pytest
+    from bflc_demo_tpu.data.synthetic import synthetic_cifar10
+    x = np.random.default_rng(0).random((50, 32, 32, 3)).astype(np.float32)
+    y = np.arange(50, dtype=np.int32) % 10
+    np.savez(tmp_path / "cifar10.npz", x=x, y=y)
+    monkeypatch.setenv("BFLC_DATA_DIR", str(tmp_path))
+    gx, gy = synthetic_cifar10(n=30, seed=1)
+    assert gx.shape == (30, 32, 32, 3)          # subsampled real file
+    assert set(np.unique(gy)) <= set(range(10))
+    # the same rows came from the file, not the generator
+    flat_file = {xx.tobytes() for xx in x}
+    assert all(xx.tobytes() in flat_file for xx in gx)
+    # every mismatch fails loudly, never silently trains wrong
+    from bflc_demo_tpu.data.synthetic import _real_or_synthetic
+    np.savez(tmp_path / "cifar100.npz", x=x, y=y)
+    with pytest.raises(ValueError, match="images"):        # wrong geometry
+        _real_or_synthetic("cifar100", 30, (28, 28, 1), 100, 0)
+    np.savez(tmp_path / "mnist.npz",
+             x=(x[:, :, :, :1] * 255).reshape(50, 32, 32), y=y)
+    with pytest.raises(ValueError, match="images"):
+        _real_or_synthetic("mnist", 30, (28, 28, 1), 10, 0)
+    np.savez(tmp_path / "femnist.npz", x=x[:, :, :, :1][:, 2:30, 2:30] * 255,
+             y=y)
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):     # 0-255 scale
+        _real_or_synthetic("femnist", 30, (28, 28, 1), 62, 0)
+    yneg = y.copy(); yneg[0] = -1
+    np.savez(tmp_path / "cifar10.npz", x=x, y=yneg)
+    with pytest.raises(ValueError, match="labels span"):   # negative label
+        _real_or_synthetic("cifar10", 30, (32, 32, 3), 10, 0)
+    np.savez(tmp_path / "cifar10.npz", x=x, y=y)
+    with pytest.raises(ValueError, match="samples <"):     # too few rows
+        _real_or_synthetic("cifar10", 500, (32, 32, 3), 10, 0)
