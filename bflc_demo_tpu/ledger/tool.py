@@ -1,0 +1,166 @@
+"""Ledger ops CLI: inspect, verify, and replay op logs / WAL files.
+
+The reference debugs its chain by tailing four `nohup.out` node logs
+(README.md:400-410); the replicated artifact here is binary — a
+hash-chained op log, durably mirrored in the WAL — so this tool is the
+operator's window into it:
+
+    python -m bflc_demo_tpu.ledger.tool inspect  run.wal
+    python -m bflc_demo_tpu.ledger.tool verify   run.wal --client-num 20 ...
+    python -m bflc_demo_tpu.ledger.tool head     run.wal --backend native
+
+`inspect` decodes records without applying protocol rules (works on
+corrupt/partial files up to the first torn record, the WAL recovery
+contract); `verify` replays every op through a fresh ledger — the same
+state machine a live replica runs — and reports the chained head digest,
+`verify_log`, and the final protocol state; `head` prints just the digest
+for cross-replica comparison (two deployments agree iff their heads do).
+
+Op wire format: [1-byte opcode][fields]; strings are <q length + bytes,
+hashes raw 32 bytes (ledger.cpp serialize_* / pyledger._OP_*).  WAL framing:
+magic + per-record <Q length prefix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import struct
+import sys
+from typing import Iterator, Tuple
+
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.ledger.pyledger import PyLedger
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+_OP_NAMES = {1: "register", 2: "upload", 3: "scores", 4: "commit",
+             5: "close_round", 6: "force_aggregate", 7: "reseat_committee"}
+
+
+def iter_wal_ops(path: str) -> Iterator[Tuple[int, bytes]]:
+    """Yield (index, op_bytes) from a WAL; stops at the first torn/corrupt
+    record (the recovery semantics of `replay_wal`, ledger.cpp)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    magic = PyLedger._WAL_MAGIC
+    if not blob.startswith(magic):
+        raise ValueError(f"not a bflc WAL: {path}")
+    off, i = len(magic), 0
+    while off + 8 <= len(blob):
+        (n,) = struct.unpack_from("<Q", blob, off)
+        if n > (1 << 26) or off + 8 + n > len(blob):
+            return                          # torn tail — recovery stops here
+        yield i, blob[off + 8:off + 8 + n]
+        off += 8 + n
+        i += 1
+
+
+def decode_op(op: bytes) -> dict:
+    """Render one op for humans; pure decode, no state rules applied."""
+    if not op:
+        return {"op": "empty"}
+    code, body = op[0], op[1:]
+    out = {"op": _OP_NAMES.get(code, f"unknown({code})"), "bytes": len(op)}
+
+    def s_at(off):
+        (n,) = struct.unpack_from("<q", body, off)
+        if n < 0 or off + 8 + n > len(body):
+            raise ValueError("string past end of op")
+        return body[off + 8:off + 8 + n].decode(), off + 8 + n
+
+    try:
+        if code == 1:
+            out["addr"], _ = s_at(0)
+        elif code == 2:
+            out["sender"], off = s_at(0)
+            out["payload_hash"] = body[off:off + 32].hex()
+            out["n_samples"], = struct.unpack_from("<q", body, off + 32)
+            out["avg_cost"] = round(
+                struct.unpack_from("<f", body, off + 40)[0], 6)
+            out["epoch"], = struct.unpack_from("<q", body, off + 44)
+        elif code == 3:
+            out["sender"], off = s_at(0)
+            out["epoch"], = struct.unpack_from("<q", body, off)
+            cnt, = struct.unpack_from("<q", body, off + 8)
+            out["scores"] = [round(v, 4) for v in
+                             struct.unpack_from(f"<{cnt}f", body, off + 16)]
+        elif code == 4:
+            out["model_hash"] = body[:32].hex()
+            out["epoch"], = struct.unpack_from("<q", body, 32)
+        elif code in (5, 6):
+            out["epoch"], = struct.unpack_from("<q", body, 0)
+        elif code == 7:
+            out["epoch"], = struct.unpack_from("<q", body, 0)
+            n, = struct.unpack_from("<q", body, 8)
+            off, addrs = 16, []
+            for _ in range(max(0, min(n, (len(body) - 16) // 8))):
+                a, off = s_at(off)
+                addrs.append(a)
+            out["committee"] = addrs
+    except (struct.error, ValueError, UnicodeDecodeError) as e:
+        out["malformed"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _cfg_from(args) -> ProtocolConfig:
+    kw = {f.name: getattr(args, f.name)
+          for f in dataclasses.fields(ProtocolConfig)
+          if getattr(args, f.name, None) is not None}
+    return ProtocolConfig(**kw).validate()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bflc_demo_tpu.ledger.tool",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=["inspect", "verify", "head"])
+    ap.add_argument("path", help="WAL file (attach_wal output)")
+    ap.add_argument("--backend", default="python",
+                    choices=["python", "native", "auto"])
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON object/line)")
+    for f in dataclasses.fields(ProtocolConfig):
+        flag = "--" + f.name.replace("_", "-")
+        ap.add_argument(flag, type=type(f.default), default=None)
+    args = ap.parse_args(argv)
+
+    if args.command == "inspect":
+        count = 0
+        for i, op in iter_wal_ops(args.path):
+            rec = {"i": i, **decode_op(op)}
+            print(json.dumps(rec) if args.json else
+                  f"[{i:05d}] " + ", ".join(f"{k}={v}" for k, v in
+                                            rec.items() if k != "i"))
+            count += 1
+        if not args.json:
+            print(f"{count} record(s) decoded")
+        return 0
+
+    ledger = make_ledger(_cfg_from(args), backend=args.backend)
+    applied = ledger.replay_wal(args.path)
+    ok = ledger.verify_log()
+    head = ledger.log_head().hex()
+    if args.command == "head":
+        print(head)
+        return 0 if ok else 3
+    summary = {
+        "applied_ops": applied,
+        "log_size": ledger.log_size(),
+        "log_head": head,
+        "chain_verified": ok,
+        "epoch": ledger.epoch,
+        "num_registered": ledger.num_registered,
+        "update_count": ledger.update_count,
+        "score_count": ledger.score_count,
+        "round_closed": ledger.round_closed,
+        "last_global_loss": ledger.last_global_loss,
+        "committee": ledger.committee(),
+    }
+    print(json.dumps(summary) if args.json else
+          "\n".join(f"{k:18} {v}" for k, v in summary.items()))
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,18 @@ the last stage pools and classifies; the final psum broadcasts the logits.
 Reverse-mode autodiff works through the schedule (ppermute transposes to the
 reverse permutation), so the same program is trainable — demonstrated in
 tests with a grad check against the single-device forward.
+
+Why GPipe-shaped rather than a hand-scheduled 1F1B: on TPU under XLA the
+whole (m + pp - 1)-step loop is one compiled program — XLA already
+overlaps each stage's ppermute DMA with the next microbatch's compute
+(async collective + latency hiding), which is the bandwidth overlap 1F1B
+hand-creates in eager frameworks.  What 1F1B uniquely buys is a smaller
+activation working set (pp in-flight microbatches instead of m); the
+TPU-idiomatic lever for the same memory is `jax.checkpoint` around
+`run_stage` (remat is a flag on the protocol-round builders), which keeps
+the schedule compiler-visible instead of fighting the scheduler.  Revisit
+only if pp becomes the headline axis at depth where remat's recompute cost
+beats 1F1B's bubble.
 """
 
 from __future__ import annotations
