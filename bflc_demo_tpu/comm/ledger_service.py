@@ -106,6 +106,10 @@ class LedgerServer:
         self._blobs: Dict[bytes, bytes] = {}
         self._model_blob = initial_model_blob
         self._model_hash = hashlib.sha256(initial_model_blob).digest()
+        # {key: (shape, dtype)} of the current model — the delta admission
+        # schema, rebuilt only when the model changes (not per upload)
+        self._model_schema = {k: (a.shape, a.dtype) for k, a in
+                              unpack_pytree(initial_model_blob).items()}
         self._last_seen: Dict[str, float] = {}
         self._last_progress = time.monotonic()
         self._rounds_completed = 0
@@ -255,6 +259,14 @@ class LedgerServer:
                                     m.get("tag", "")):
                     return {"ok": False, "status": "BAD_ARG",
                             "error": "bad signature"}
+                # structural admission check (post-auth so unsigned spam
+                # can't buy blob decodes): a delta whose leaves don't match
+                # the current model must die HERE, not later inside an
+                # innocent committee member's scores dispatch when
+                # aggregation walks the mismatched keys
+                err = self._delta_shape_error(blob)
+                if err:
+                    return {"ok": False, "status": "BAD_ARG", "error": err}
                 st = self.ledger.upload_local_update(
                     addr, digest, int(m["n"]), float(m["cost"]),
                     int(m["epoch"]))
@@ -324,6 +336,32 @@ class LedgerServer:
                 return {"ok": True, "log_size": self.ledger.log_size()}
             return {"ok": False, "error": f"unknown method {method!r}"}
 
+    def _delta_shape_error(self, blob: bytes) -> str:
+        """'' if the delta blob's flat entries mirror the current global
+        model's keys, shapes, AND dtypes; a reason string otherwise.
+        Dtype equality matters as much as shape: a string-typed leaf with
+        the right geometry would otherwise defer the failure to the
+        float32 cast inside aggregation."""
+        try:
+            delta = unpack_pytree(blob)
+        except (ValueError, TypeError, struct.error) as e:
+            return f"undecodable delta blob: {e}"
+        schema = self._model_schema
+        if delta.keys() != schema.keys():
+            missing = sorted(schema.keys() - delta.keys())[:3]
+            extra = sorted(delta.keys() - schema.keys())[:3]
+            return (f"delta structure mismatch (missing={missing}, "
+                    f"extra={extra})")
+        for key, arr in delta.items():
+            want_shape, want_dtype = schema[key]
+            if arr.shape != want_shape:
+                return (f"delta leaf {key}: shape {arr.shape} != "
+                        f"{want_shape}")
+            if arr.dtype != want_dtype:
+                return (f"delta leaf {key}: dtype {arr.dtype} != "
+                        f"{want_dtype}")
+        return ""
+
     def _note_progress(self, st: LedgerStatus) -> None:
         if st == LedgerStatus.OK:
             self._last_progress = time.monotonic()
@@ -353,6 +391,8 @@ class LedgerServer:
             self._blobs.pop(u.payload_hash, None)
         self._model_blob = blob
         self._model_hash = digest
+        self._model_schema = {k: (a.shape, a.dtype)
+                              for k, a in new_flat.items()}
         self._rounds_completed += 1
         self._last_progress = time.monotonic()
         self._cv.notify_all()

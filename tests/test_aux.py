@@ -321,3 +321,24 @@ class TestFlags:
     def test_invalid_override_rejected(self):
         with pytest.raises(ValueError):
             parse_args(["--comm-count", "50"])
+
+
+class TestCompileCache:
+    def test_enable_sets_jax_config(self, tmp_path, monkeypatch):
+        import jax
+        from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
+        before = jax.config.jax_compilation_cache_dir
+        monkeypatch.setenv("BFLC_COMPILE_CACHE", str(tmp_path / "cc"))
+        try:
+            assert enable_persistent_cache() == str(tmp_path / "cc")
+            assert jax.config.jax_compilation_cache_dir == \
+                str(tmp_path / "cc")
+        finally:
+            # jax.config is process-global: restore so later tests never
+            # write cache artifacts into this test's tmp dir
+            jax.config.update("jax_compilation_cache_dir", before)
+
+    def test_disabled_via_env(self, monkeypatch):
+        from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
+        monkeypatch.setenv("BFLC_COMPILE_CACHE", "0")
+        assert enable_persistent_cache() == ""

@@ -5,6 +5,7 @@ import hashlib
 import struct
 
 import pytest
+from hypothesis import given, settings, strategies as st
 
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.ledger.tool import main, iter_wal_ops, decode_op
@@ -79,3 +80,20 @@ def test_not_a_wal_raises(tmp_path):
     bad.write_bytes(b"garbage")
     with pytest.raises(ValueError, match="not a bflc WAL"):
         list(iter_wal_ops(str(bad)))
+
+
+class TestDecodeFuzz:
+    """decode_op is a rendering function for untrusted bytes: it must never
+    raise, only report malformed-ness."""
+
+    @given(st.binary(max_size=200))
+    @settings(max_examples=300, deadline=None)
+    def test_never_raises_on_arbitrary_bytes(self, blob):
+        rec = decode_op(blob)
+        assert isinstance(rec, dict) and "op" in rec
+
+    @given(st.integers(1, 7), st.binary(max_size=120))
+    @settings(max_examples=300, deadline=None)
+    def test_never_raises_on_valid_opcode_garbage_body(self, code, body):
+        rec = decode_op(bytes([code]) + body)
+        assert isinstance(rec, dict)

@@ -146,6 +146,28 @@ class TestCoordinatorServer:
         assert not r["ok"] and r["status"] == "BAD_ARG"
         c.close()
 
+    def test_structurally_mismatched_delta_rejected_at_upload(self, server):
+        """A delta missing leaves / with wrong shapes must be refused at
+        the upload boundary — never accepted and left to blow up inside
+        aggregation on a committee member's scores call."""
+        c = CoordinatorClient(server.host, server.port)
+        _register_all(c)
+        for bad in ({"W": np.ones((5, 2), np.float32)},         # missing b
+                    {"W": np.ones((5, 3), np.float32),          # bad shape
+                     "b": np.zeros((2,), np.float32)},
+                    {"W": np.ones((5, 2), np.float32),          # extra leaf
+                     "b": np.zeros((2,), np.float32),
+                     "c": np.zeros((1,), np.float32)},
+                    {"W": np.full((5, 2), "x"),                 # bad dtype:
+                     "b": np.zeros((2,), np.float32)}):         # U1 strings
+            blob = pack_pytree(bad)
+            digest = hashlib.sha256(blob).digest()
+            r = c.request("upload", addr="0x" + "0" * 40, blob=blob.hex(),
+                          hash=digest.hex(), n=1, cost=0.0, epoch=0)
+            assert not r["ok"] and r["status"] == "BAD_ARG", r
+        assert c.request("info")["update_count"] == 0
+        c.close()
+
     def test_wait_blocks_until_log_grows(self, server):
         c = CoordinatorClient(server.host, server.port)
         base = c.request("info")["log_size"]
@@ -213,6 +235,47 @@ class TestAuthenticatedServer:
         r = c.request("upload", addr=trainer.address, blob=blob2.hex(),
                       hash=d2.hex(), n=10, cost=1.0, epoch=0, tag=forged)
         assert not r["ok"]
+        c.close()
+
+
+class TestSocketDifferential:
+    def test_socket_and_inprocess_ledgers_agree(self, server):
+        """Driving the same protocol sequence through the socket dispatch
+        and through a direct in-process ledger must produce byte-identical
+        chained heads — the server's framing/auth layers may never perturb
+        state-machine semantics."""
+        from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+        direct = make_ledger(CFG, backend="python")
+        c = CoordinatorClient(server.host, server.port)
+        addrs = _register_all(c)
+        for a in addrs:
+            assert direct.register_node(a) == LedgerStatus.OK
+        committee = c.request("committee")["committee"]
+        trainers = [a for a in addrs if a not in committee]
+        for i, a in enumerate(trainers[:3]):
+            blob = pack_pytree({"W": np.full((5, 2), float(i), np.float32),
+                                "b": np.zeros((2,), np.float32)})
+            digest = hashlib.sha256(blob).digest()
+            assert c.request("upload", addr=a, blob=blob.hex(),
+                             hash=digest.hex(), n=50 + i, cost=0.5,
+                             epoch=0)["ok"]
+            assert direct.upload_local_update(a, digest, 50 + i, 0.5,
+                                              0) == LedgerStatus.OK
+        for j, comm in enumerate(committee):
+            scores = [0.9 - j * 0.1, 0.5, 0.3]
+            assert c.request("scores", addr=comm, epoch=0,
+                             scores=scores)["ok"]
+            assert direct.upload_scores(comm, 0,
+                                        scores) == LedgerStatus.OK
+        # the server aggregated+committed on the last score; mirror it with
+        # the server's own model hash so the commit ops are byte-identical
+        info = c.request("info")
+        assert info["epoch"] == 1
+        mr = c.request("model")
+        assert direct.commit_model(bytes.fromhex(mr["hash"]),
+                                   0) == LedgerStatus.OK
+        assert direct.log_size() == info["log_size"]
+        assert direct.log_head().hex() == info["log_head"]
         c.close()
 
 
