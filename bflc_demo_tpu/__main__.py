@@ -54,6 +54,11 @@ def main(argv=None) -> int:
         print(f"checkpoint (model + ledger oplog) -> {opts.checkpoint_dir}")
     if opts.trace_path:
         tracer.dump_jsonl(opts.trace_path)
+    if opts.plot_path:
+        from bflc_demo_tpu.eval.plot import plot_run
+        plot_run(res, opts.plot_path,
+                 title=f"{opts.config} · {opts.runtime} runtime")
+        print(f"run-evidence plot -> {opts.plot_path}")
 
     print(json.dumps({
         "config": opts.config,

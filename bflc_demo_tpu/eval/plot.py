@@ -1,0 +1,64 @@
+"""Run-evidence plots: the reference's `imgs/runtime.jpg` made reproducible.
+
+The reference's only published run evidence is a screenshot of terminal
+logs — the sponsor accuracy line and four identical node-loss lines
+(README.md:400-410).  This renders the same evidence from a
+`SimulationResult` (any runtime) as an actual artifact: sponsor test
+accuracy per epoch with the reference's 0.9214 acceptance line, global
+training loss on a log axis, and per-round wall time.
+
+Headless-safe (Agg backend, set before pyplot import).  CLI:
+`python -m bflc_demo_tpu --config config1 --plot-path run.png`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+REFERENCE_ACC = 0.9214          # sponsor line at epoch 009, imgs/runtime.jpg
+
+
+def plot_run(result, path: str, title: str = "",
+             reference_acc: Optional[float] = REFERENCE_ACC) -> str:
+    """Write a 3-panel PNG for a finished run; returns the path."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    acc = list(result.accuracy_history)
+    losses = list(getattr(result, "loss_history", []) or [])
+    times = list(getattr(result, "round_times_s", []) or [])
+    n_panels = 1 + bool(losses) + bool(times)
+    fig, axes = plt.subplots(1, n_panels, figsize=(5 * n_panels, 3.4))
+    if n_panels == 1:
+        axes = [axes]
+    ax = axes[0]
+    if acc:
+        ax.plot([e for e, _ in acc], [a for _, a in acc],
+                marker="o", lw=1.5, label="sponsor test acc")
+    if reference_acc is not None:
+        ax.axhline(reference_acc, ls="--", lw=1, color="0.4",
+                   label=f"reference {reference_acc:.4f}")
+    ax.set_xlabel("epoch")
+    ax.set_ylabel("test accuracy")
+    ax.legend(loc="lower right", fontsize=8)
+    ax.set_title(title or "sponsor accuracy")
+    i = 1
+    if losses:
+        # loss_history entries are (epoch, loss) tuples (SimulationResult)
+        axes[i].plot([e for e, _ in losses], [v for _, v in losses],
+                     marker=".", lw=1.2)
+        axes[i].set_yscale("log")
+        axes[i].set_xlabel("epoch")
+        axes[i].set_ylabel("global loss")
+        axes[i].set_title("committee-selected avg cost")
+        i += 1
+    if times:
+        axes[i].bar(range(len(times)), times, width=0.8)
+        axes[i].set_xlabel("round")
+        axes[i].set_ylabel("seconds")
+        axes[i].set_title("round wall time")
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path

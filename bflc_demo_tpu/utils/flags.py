@@ -33,6 +33,7 @@ class RunOptions:
     checkpoint_dir: str = ""
     checkpoint_every: int = 0        # rounds between checkpoints; 0 = off
     trace_path: str = ""
+    plot_path: str = ""              # write a run-evidence PNG here
     verbose: bool = True
 
 

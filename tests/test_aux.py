@@ -342,3 +342,17 @@ class TestCompileCache:
         from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
         monkeypatch.setenv("BFLC_COMPILE_CACHE", "0")
         assert enable_persistent_cache() == ""
+
+
+def test_plot_run_writes_png(tmp_path):
+    """Run-evidence plot: renders a full SimulationResult-shaped object
+    headlessly and writes a real PNG."""
+    from types import SimpleNamespace
+    from bflc_demo_tpu.eval.plot import plot_run
+    res = SimpleNamespace(
+        accuracy_history=[(0, 0.8), (1, 0.9), (2, 0.93)],
+        loss_history=[(0, 55.0), (1, 6.2), (2, 5.9)],
+        round_times_s=[0.5, 0.2, 0.2])
+    out = plot_run(res, str(tmp_path / "ev.png"), title="t")
+    with open(out, "rb") as f:
+        assert f.read(8) == b"\x89PNG\r\n\x1a\n"

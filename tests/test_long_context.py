@@ -1,0 +1,60 @@
+"""Long-context at real lengths: ring attention beyond toy sequences.
+
+The per-shard equivalence tests (test_ring_attention.py) run at seq 32;
+these run the lengths the mechanism exists for — 8k with a bit-exact
+differential against the single-device forward, 32k ring-only (the
+single-device einsum would materialise a 2x32k^2 f32 logits tensor there,
+which is exactly the regime ring attention removes).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.models.transformer import (make_transformer_classifier,
+                                              transformer_forward)
+from bflc_demo_tpu.parallel.mesh import make_mesh
+from bflc_demo_tpu.parallel.ring_attention import (SP_AXIS,
+                                                   make_sp_transformer_forward)
+
+
+def _setup(seq_len, real_len, seed=0):
+    model = make_transformer_classifier(vocab_size=128, seq_len=seq_len,
+                                        num_classes=2, dim=16, depth=1,
+                                        heads=2)
+    rng = np.random.default_rng(seed)
+    toks = np.zeros((2, seq_len), np.int32)
+    toks[:, :real_len] = rng.integers(1, 128, (2, real_len))
+    return model, jnp.asarray(toks)
+
+
+@pytest.mark.slow
+def test_8k_matches_single_device_exactly():
+    """At seq 8192 over 8 sequence shards the ring forward reproduces the
+    single-device forward (measured bit-exact on CPU: same reduction order
+    per block, f32 streaming softmax)."""
+    model, toks = _setup(8192, 300)
+    mesh = make_mesh((8,), (SP_AXIS,))
+    got = make_sp_transformer_forward(mesh, model.config)(
+        model.init_params(0), toks)
+    want = transformer_forward(model.init_params(0), toks, model.config)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_32k_ring_runs_and_attends():
+    """Seq 32768 on the 8-device mesh: finite logits, and the output is
+    actually sensitive to a single resident token (the ring really carried
+    information, it didn't just mask everything)."""
+    model, toks = _setup(32768, 200)
+    mesh = make_mesh((8,), (SP_AXIS,))
+    params = model.init_params(0)
+    fn = make_sp_transformer_forward(mesh, model.config)
+    out = np.asarray(fn(params, toks))
+    assert out.shape == (2, 2) and np.isfinite(out).all()
+    toks2 = np.array(toks)
+    toks2[0, 5] = (toks2[0, 5] % 127) + 1       # different non-PAD token
+    out2 = np.asarray(fn(params, jnp.asarray(toks2)))
+    assert np.any(np.abs(out2[0] - out[0]) > 0)
+    np.testing.assert_allclose(out2[1], out[1], rtol=1e-6)  # batch isolated
