@@ -9,13 +9,18 @@ the device-resident mesh runtime.  Both execution paths are measured:
 - per-round (rounds_per_dispatch=1): one XLA program per protocol round,
   host ledger audited synchronously — the latency-honest number;
 - batched (rounds_per_dispatch=5): R rounds per dispatch with post-hoc
-  ledger replay/audit — the amortised number (the headline `value`).
+  ledger replay/audit.
+
+The headline `value` is the batched **mean** round time (compile-bearing
+first dispatch excluded); min and per-round numbers ride in `extra` — the
+mean is what a user pays per round in steady state, the min is the
+best-case floor.
 
 vs_baseline: the reference's round time is structurally bounded below by its
 polling design — every protocol phase waits a uniform(10,30) s sleep per
 client (python-sdk/main.py:62, 231-233), i.e. >= ~20 s/round in expectation
-before any compute.  vs_baseline = 20.0 / measured_round_time (higher is
-better; >1 beats the reference).  That floor is sleep-bound, so `extra`
+before any compute.  vs_baseline = 20.0 / measured_mean_round_time (higher
+is better; >1 beats the reference).  That floor is sleep-bound, so `extra`
 also carries accuracy parity (reference sponsor line: 0.9214,
 imgs/runtime.jpg) and samples/sec/chip — the axes a compute-bound
 comparison needs.
@@ -23,10 +28,12 @@ comparison needs.
 Robustness: measurements run in child processes under a watchdog.  The TPU
 attempt is gated by a cheap PRE-FLIGHT probe child (jax.devices() + one
 matmul under its own short timeout, retried once) so a wedged axon tunnel
-costs ~2 probe timeouts, not the whole budget (round-1 failure mode: the
-full 1500 s burned before the CPU fallback).  If the probe never passes,
-the benchmark reruns pinned to CPU, honestly labelled
-"platform": "cpu-fallback".
+costs ~2 probe timeouts, not the whole budget.  Every successful on-TPU run
+also snapshots its JSON line to BENCH_LATEST.json; if at a later invocation
+the chip is unreachable (the axon tunnel is intermittent), the benchmark
+replays that snapshot — labelled with `captured_at` and `cached: true` so
+the artifact is honest about when the chip was actually measured — before
+resorting to the CPU fallback ("platform": "cpu-fallback").
 """
 
 import json
@@ -35,6 +42,8 @@ import subprocess
 import sys
 import time
 
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+LATEST_PATH = os.path.join(REPO_DIR, "BENCH_LATEST.json")
 PROBE_TIMEOUT_S = int(os.environ.get("BFLC_BENCH_PROBE_TIMEOUT", "150"))
 PROBE_CODE = (
     "import jax, jax.numpy as jnp; "
@@ -45,14 +54,22 @@ PROBE_CODE = (
 
 
 def _probe_tpu() -> bool:
-    """Can this host reach a working accelerator quickly?  Two attempts."""
+    """Can this host reach a working TPU quickly?  Two attempts.
+
+    Parses the exact platform token printed by the probe child — only
+    'tpu' counts (a cuda/rocm backend would be a misconfigured host for
+    this benchmark, not a TPU).
+    """
     for _ in range(2):
         try:
             r = subprocess.run([sys.executable, "-c", PROBE_CODE],
                                capture_output=True, text=True,
                                timeout=PROBE_TIMEOUT_S)
-            if r.returncode == 0 and "PROBE_OK" in r.stdout:
-                return "PROBE_OK cpu" not in r.stdout
+            if r.returncode == 0:
+                for ln in r.stdout.splitlines():
+                    if ln.startswith("PROBE_OK"):
+                        toks = ln.split()
+                        return len(toks) >= 2 and toks[1] == "tpu"
         except subprocess.TimeoutExpired:
             pass
     return False
@@ -71,12 +88,12 @@ def _child() -> None:
 
     enable_persistent_cache()
     platform = jax.devices()[0].platform
-    # batched path: the headline (20 rounds, 5 per dispatch; min round time
-    # excludes the compile-bearing first dispatch)
+    # batched path (20 rounds, 5 per dispatch; mean/min exclude the
+    # compile-bearing first dispatch)
     rb = bench_config1(rounds=20, runtime="mesh", rounds_per_dispatch=5)
     # per-round path: latency per protocol round with synchronous audit
     rp = bench_config1(rounds=6, runtime="mesh", rounds_per_dispatch=1)
-    round_time = rb["min_round_time_s"]
+    round_time = rb["mean_round_time_s"]
     baseline_round_s = 20.0
     print(json.dumps({
         "metric": "fl_round_time_s_config1",
@@ -86,8 +103,8 @@ def _child() -> None:
         "extra": {
             "best_test_acc": round(max(rb["best_acc"], rp["best_acc"]), 4),
             "reference_test_acc": 0.9214,
-            "batched_min_round_time_s": round(rb["min_round_time_s"], 5),
             "batched_mean_round_time_s": round(rb["mean_round_time_s"], 5),
+            "batched_min_round_time_s": round(rb["min_round_time_s"], 5),
             "per_round_min_round_time_s": round(rp["min_round_time_s"], 5),
             "train_samples_per_sec_per_chip": round(
                 rb["train_samples_per_sec_per_chip"], 1),
@@ -102,18 +119,55 @@ def _child() -> None:
     }))
 
 
+def _emit(line: str) -> None:
+    """Print the result line; snapshot it if it was a FRESH on-TPU
+    measurement (replayed cache lines must not refresh captured_at — that
+    timestamp is the honesty anchor for when the chip was really hit)."""
+    print(line)
+    try:
+        rec = json.loads(line)
+        if (rec.get("extra", {}).get("platform") == "tpu"
+                and not rec.get("extra", {}).get("cached")):
+            rec["extra"]["captured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            with open(LATEST_PATH, "w") as f:
+                json.dump(rec, f)
+                f.write("\n")
+    except (ValueError, OSError):
+        pass
+
+
+def _cached_tpu_line() -> "str | None":
+    """A prior on-chip capture from this repo checkout, if one exists."""
+    try:
+        with open(LATEST_PATH) as f:
+            rec = json.load(f)
+        if rec.get("extra", {}).get("platform") == "tpu":
+            rec["extra"]["cached"] = True
+            rec["extra"]["cache_note"] = (
+                "chip unreachable at invocation time; this is the most "
+                "recent on-TPU capture from this round (see captured_at)")
+            return json.dumps(rec)
+    except (OSError, ValueError):
+        pass
+    return None
+
+
 def main() -> None:
     if os.environ.get("BFLC_BENCH_CHILD"):
         _child()
         return
     budget = int(os.environ.get("BFLC_BENCH_TIMEOUT", "1500"))
 
-    attempts = []
     if os.environ.get("BFLC_BENCH_FORCE_CPU"):
         attempts = [({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
     elif _probe_tpu():
         attempts = [({}, budget), ({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
     else:
+        cached = _cached_tpu_line()
+        if cached is not None:
+            _emit(cached)
+            return
         attempts = [({"BFLC_BENCH_FORCE_CPU": "1"}, budget)]
     last_err = ""
     for extra_env, timeout_s in attempts:
@@ -129,13 +183,19 @@ def main() -> None:
             lines = [ln for ln in proc.stdout.splitlines()
                      if ln.startswith("{")]
             if proc.returncode == 0 and lines:
-                print(lines[-1])
+                _emit(lines[-1])
                 return
             last_err = (f"rc={proc.returncode} after "
                         f"{time.time() - t0:.0f}s: "
                         f"{proc.stderr.strip()[-400:]}")
         except subprocess.TimeoutExpired:
             last_err = f"timed out after {timeout_s}s (wedged backend?)"
+    if not os.environ.get("BFLC_BENCH_FORCE_CPU"):
+        # an explicit CPU-only request must never answer with a TPU line
+        cached = _cached_tpu_line()
+        if cached is not None:
+            _emit(cached)
+            return
     print(json.dumps({
         "metric": "fl_round_time_s_config1", "value": None, "unit": "s/round",
         "vs_baseline": None, "error": last_err}))

@@ -204,6 +204,13 @@ def run_federated_mesh(model: Model,
     n = cfg.client_num
     if len(shards) != n:
         raise ValueError(f"need {n} shards, got {len(shards)}")
+    empties = [i for i, (sx, _) in enumerate(shards) if len(sx) == 0]
+    if empties:
+        # only dirichlet_shards guarantees min_size; caller-supplied shards
+        # can be empty and would otherwise die in cyclic padding with an
+        # opaque ZeroDivisionError
+        raise ValueError(f"shards {empties} are empty; every client needs "
+                         f"at least one sample")
     k, c = cfg.needed_update_count, cfg.comm_count
     n_slots = n if participation == "full" else k + c
     if mesh is None:

@@ -177,6 +177,32 @@ def provision_wallets(n: int, master_seed: bytes,
     return wallets, directory
 
 
+class ReplayGuard:
+    """Single-use-tag tracking bucketed by op epoch.
+
+    Shared by `AuthenticatedLedger` (in-process trust boundary) and
+    `comm.ledger_service.LedgerServer` (socket trust boundary) so the two
+    enforcement points are structurally identical — not mirrored by hand.
+    Buckets for epochs the ledger has moved past are pruned on consume:
+    replays of old-epoch tags already fail the inner WRONG_EPOCH guard, so
+    the set stays O(ops per round).
+    """
+
+    def __init__(self):
+        self._seen: Dict[int, set] = {}
+
+    def seen(self, epoch: int, tag: bytes) -> bool:
+        return tag in self._seen.get(epoch, ())
+
+    def consume(self, current_epoch: int, epoch: int, tag: bytes) -> None:
+        """Mark a tag used — call only after the inner ledger ACCEPTED the
+        op, so a transiently-rejected op (e.g. scores before the round
+        fills) can be retried with the same deterministic signature."""
+        for ep in [e for e in self._seen if e < current_epoch]:
+            del self._seen[ep]
+        self._seen.setdefault(epoch, set()).add(tag)
+
+
 def _op_bytes(kind: str, sender: str, epoch: int, payload: bytes) -> bytes:
     b = bytearray()
     kb = kind.encode()
@@ -205,10 +231,7 @@ class AuthenticatedLedger:
     def __init__(self, inner, keyring):
         self._inner = inner
         self._keys = keyring
-        # replay tracking bucketed by op epoch: stale buckets are pruned once
-        # the ledger moves past them (replays of old-epoch tags already fail
-        # the inner WRONG_EPOCH guard), keeping the set O(ops per round)
-        self._seen_tags: Dict[int, set] = {}
+        self._guard = ReplayGuard()
 
     # --- authenticated mutations ---
     def _verify(self, kind: str, sender: str, epoch: int, payload: bytes,
@@ -216,16 +239,10 @@ class AuthenticatedLedger:
         if not self._keys.verify(sender, _op_bytes(kind, sender, epoch,
                                                    payload), tag):
             return False
-        return tag not in self._seen_tags.get(epoch, ())
+        return not self._guard.seen(epoch, tag)
 
     def _consume(self, epoch: int, tag: bytes) -> None:
-        """Mark a tag used — called only after the inner ledger ACCEPTED the
-        op, so a transiently-rejected op (e.g. scores before the round fills)
-        can be legitimately retried with the same deterministic MAC."""
-        current = self._inner.epoch
-        for ep in [e for e in self._seen_tags if e < current]:
-            del self._seen_tags[ep]
-        self._seen_tags.setdefault(epoch, set()).add(tag)
+        self._guard.consume(self._inner.epoch, epoch, tag)
 
     def register_node(self, addr: str, tag: bytes) -> LedgerStatus:
         if not self._verify("register", addr, 0, b"", tag):

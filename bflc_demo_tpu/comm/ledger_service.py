@@ -38,8 +38,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bflc_demo_tpu.comm.identity import (PublicDirectory, address_of,
-                                         _op_bytes)
+from bflc_demo_tpu.comm.identity import (PublicDirectory, ReplayGuard,
+                                         address_of, _op_bytes)
 from bflc_demo_tpu.comm.wire import send_msg, recv_msg, WireError
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
@@ -111,6 +111,10 @@ class LedgerServer:
         self._model_schema = {k: (a.shape, a.dtype) for k, a in
                               unpack_pytree(initial_model_blob).items()}
         self._last_seen: Dict[str, float] = {}
+        # replay rejection at the auth layer, not merely ledger idempotency
+        # — the SAME ReplayGuard class AuthenticatedLedger uses, so the two
+        # enforcement points cannot drift
+        self._replay = ReplayGuard()
         self._last_progress = time.monotonic()
         self._rounds_completed = 0
         self._stop = threading.Event()
@@ -171,7 +175,12 @@ class LedgerServer:
                     return
                 try:
                     reply = self._dispatch(method, msg)
-                except (KeyError, ValueError, TypeError) as e:
+                except Exception as e:      # noqa: BLE001 — any dispatch
+                    # failure (including a RuntimeError thrown by
+                    # aggregation inside the scores handler) must produce an
+                    # error frame: a silently-killed connection thread
+                    # leaves the innocent caller blocked until its socket
+                    # timeout even though its own op may have been accepted
                     reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                 send_msg(conn, reply)
         except (WireError, OSError):
@@ -207,9 +216,17 @@ class LedgerServer:
                 tag_hex: str) -> bool:
         if not self.require_auth:
             return True
-        return self.directory.verify(
-            addr, _op_bytes(kind, addr, epoch, payload), bytes.fromhex(
-                tag_hex))
+        tag = bytes.fromhex(tag_hex)
+        if not self.directory.verify(
+                addr, _op_bytes(kind, addr, epoch, payload), tag):
+            return False
+        return not self._replay.seen(epoch, tag)
+
+    def _consume_tag(self, epoch: int, tag_hex: str) -> None:
+        if not self.require_auth:
+            return
+        self._replay.consume(self.ledger.epoch, epoch,
+                             bytes.fromhex(tag_hex))
 
     def _dispatch(self, method: str, m: dict) -> dict:
         with self._lock:
@@ -232,6 +249,8 @@ class LedgerServer:
                         return {"ok": False, "status": "BAD_ARG",
                                 "error": "bad signature"}
                 st = self.ledger.register_node(addr)
+                if st == LedgerStatus.OK:
+                    self._consume_tag(0, m.get("tag", ""))
                 self._touch(addr)
                 self._note_progress(st)
                 return {"ok": st == LedgerStatus.OK, "status": st.name,
@@ -272,6 +291,7 @@ class LedgerServer:
                     int(m["epoch"]))
                 if st == LedgerStatus.OK:
                     self._blobs[digest] = blob
+                    self._consume_tag(int(m["epoch"]), m.get("tag", ""))
                 self._touch(addr)
                 self._note_progress(st)
                 return {"ok": st == LedgerStatus.OK, "status": st.name}
@@ -295,6 +315,8 @@ class LedgerServer:
                     return {"ok": False, "status": "BAD_ARG",
                             "error": "bad signature"}
                 st = self.ledger.upload_scores(addr, int(m["epoch"]), scores)
+                if st == LedgerStatus.OK:
+                    self._consume_tag(int(m["epoch"]), m.get("tag", ""))
                 self._touch(addr)
                 self._note_progress(st)
                 if st == LedgerStatus.OK and self.ledger.aggregate_ready():

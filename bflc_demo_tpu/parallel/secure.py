@@ -75,15 +75,24 @@ def _client_mask(round_key: jax.Array, i: jax.Array, n: int,
 def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
                     shape) -> jax.Array:
     """DH-keyed variant of `_client_mask`: the pair key comes from the
-    (N, N, 2) uint32 seed matrix (X25519-derived, `derive_pair_seeds`)
+    (N, N, 8) uint32 seed matrix (X25519-derived, `derive_pair_seeds`)
     instead of a shared round key.  Seed symmetry (seeds[i,j] == seeds[j,i])
     gives both endpoints the same mask; the signed sum cancels identically.
+
+    All 8 words (the full 256-bit hashed shared secret) are chain-folded
+    into the key, so per-pair mask secrecy is bounded by the 256-bit DH
+    output, not by how many words the key absorbs.  (Threefry keys are
+    64-bit internally, so the *PRG state* is 2^64 — the chain folding
+    guarantees an attacker must still guess the full secret to reproduce
+    the key, there being no 64-bit shortcut input.)
     """
     base = jax.random.PRNGKey(0)
 
     def body(j, acc):
         s = pair_seeds[i, j]
-        key = jax.random.fold_in(jax.random.fold_in(base, s[0]), s[1])
+        key = base
+        for word in range(8):           # static unroll: 8 words, fixed
+            key = jax.random.fold_in(key, s[word])
         m = _pair_mask(key, shape)
         contrib = jnp.where(j > i, m, jnp.uint32(0) - m)
         return jnp.where(j == i, acc, acc + contrib)
@@ -93,7 +102,7 @@ def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
 
 
 def derive_pair_seeds(wallets, round_index: int):
-    """(N, N, 2) uint32 symmetric pair-seed matrix from per-pair X25519.
+    """(N, N, 8) uint32 symmetric pair-seed matrix from per-pair X25519.
 
     Each entry [i, j] is derived from wallet i's DH exchange with wallet j's
     public key, bound to the round — both endpoints compute the same bytes;
@@ -107,13 +116,13 @@ def derive_pair_seeds(wallets, round_index: int):
     import numpy as np
 
     n = len(wallets)
-    seeds = np.zeros((n, n, 2), np.uint32)
+    seeds = np.zeros((n, n, 8), np.uint32)
     ctx = _struct.pack("<q", round_index)
     for i in range(n):
         for j in range(i + 1, n):
             s = wallets[i].pair_secret(wallets[j].dh_public_bytes,
                                        context=ctx)
-            words = np.frombuffer(s[:8], "<u4")
+            words = np.frombuffer(s, "<u4")    # all 32 bytes -> 8 words
             seeds[i, j] = seeds[j, i] = words
     return jnp.asarray(seeds)
 
@@ -132,7 +141,7 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
     values: pytree with leading axis N, sharded over the client axis.
     clip: symmetric range bound for fixed-point encoding (values are
     clamped to [-clip, clip] before quantisation).
-    pair_seeds: optional (N, N, 2) uint32 DH seed matrix
+    pair_seeds: optional (N, N, 8) uint32 DH seed matrix
     (`derive_pair_seeds`) — when given, masks are keyed per-pair and the
     aggregator cannot strip them; `round_key` is then unused.
 
@@ -152,8 +161,8 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
             f">= {1 << (31 - _FRAC_BITS)}; lower clip, pre-normalise, or "
             f"pass a tighter sum_bound")
     dh_mode = pair_seeds is not None
-    if dh_mode and tuple(pair_seeds.shape) != (n_total, n_total, 2):
-        raise ValueError(f"pair_seeds must be ({n_total}, {n_total}, 2), "
+    if dh_mode and tuple(pair_seeds.shape) != (n_total, n_total, 8):
+        raise ValueError(f"pair_seeds must be ({n_total}, {n_total}, 8), "
                          f"got {tuple(pair_seeds.shape)}")
 
     def body(vals, key_or_seeds):

@@ -116,7 +116,7 @@ class TestDHPairKeys:
         assert not np.array_equal(s3, s4)            # round-bound
         np.testing.assert_array_equal(s3, s3.transpose(1, 0, 2))  # symmetric
         iu = np.triu_indices(n, k=1)
-        flat = s3[iu[0], iu[1]].reshape(-1, 2)
+        flat = s3[iu[0], iu[1]].reshape(-1, 8)
         assert len(np.unique(flat, axis=0)) == len(flat)   # distinct pairs
 
     def test_dh_secure_fedavg_matches_plain(self):

@@ -1,0 +1,68 @@
+"""Opportunistic TPU bench runner.
+
+The axon tunnel to the TPU is intermittent; the driver-run `bench.py` at
+round end may land in a window where the chip is unreachable.  This
+watcher closes that gap: it loops, probing the chip cheaply, and whenever
+the probe passes it runs `python bench.py` — which snapshots any on-TPU
+measurement to BENCH_LATEST.json.  A later chip-less `bench.py` invocation
+replays that snapshot (labelled `cached: true` + `captured_at`).
+
+Usage:  python tools/bench_watch.py [--interval 900] [--max-captures 4]
+Runs until max-captures on-TPU measurements have been taken (refreshing
+the snapshot each time), then exits.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _probe_tpu  # noqa: E402 — the cheap 150 s gate
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=900,
+                    help="seconds between attempts")
+    ap.add_argument("--max-captures", type=int, default=4)
+    args = ap.parse_args()
+
+    captures = 0
+    while captures < args.max_captures:
+        t0 = time.time()
+        # probe first: when the chip is down, one iteration costs ~2 probe
+        # timeouts, not a full throwaway CPU benchmark
+        if not _probe_tpu():
+            print(f"[bench_watch] {time.strftime('%H:%M:%S')} probe failed; "
+                  f"chip unreachable", flush=True)
+            time.sleep(max(30.0, args.interval - (time.time() - t0)))
+            continue
+        try:
+            r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                               capture_output=True, text=True, cwd=REPO,
+                               timeout=3600)
+            line = next((ln for ln in r.stdout.splitlines()
+                         if ln.startswith("{")), "")
+            rec = json.loads(line) if line else {}
+            plat = rec.get("extra", {}).get("platform")
+            cached = rec.get("extra", {}).get("cached", False)
+            print(f"[bench_watch] {time.strftime('%H:%M:%S')} platform={plat} "
+                  f"cached={cached} value={rec.get('value')}", flush=True)
+            if plat == "tpu" and not cached:
+                captures += 1
+        except (subprocess.TimeoutExpired, ValueError) as e:
+            print(f"[bench_watch] attempt failed: {e}", flush=True)
+        if captures >= args.max_captures:
+            break
+        elapsed = time.time() - t0
+        time.sleep(max(30.0, args.interval - elapsed))
+    print(f"[bench_watch] done: {captures} on-TPU captures", flush=True)
+
+
+if __name__ == "__main__":
+    main()
