@@ -33,6 +33,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bflc_demo_tpu.client.runtime import Sponsor
 from bflc_demo_tpu.client.simulation import SimulationResult
+from bflc_demo_tpu.client.staging import (audit_round,
+                                          largest_divisor_device_count,
+                                          stage_padded_arrays)
 from bflc_demo_tpu.data.partition import one_hot
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.models.base import Model
@@ -173,6 +176,15 @@ def run_federated_mesh(model: Model,
                        checkpoint_dir: str = "",
                        checkpoint_every: int = 0,
                        tracer=None,
+                       secure_aggregation: bool = False,
+                       secure_wallets=None,
+                       # clip bounds each client's delta contribution; it
+                       # must clear honest update magnitudes (raw-feature
+                       # gradients reach the hundreds on occupancy) while
+                       # staying under the 2^15 fixed-point capacity —
+                       # quantisation resolution is 2^-16 regardless
+                       secure_clip: float = 1024.0,
+                       estimate_flops: bool = False,
                        verbose: bool = False) -> SimulationResult:
     """participation:
     - 'full': every registered client trains each round (the reference's
@@ -188,8 +200,28 @@ def run_federated_mesh(model: Model,
     and the ledger replays/audits each round afterwards (optimistic
     execution; any ledger-vs-device divergence raises).  Amortises the
     host<->device sync to once per R rounds.
+
+    secure_aggregation=True (the BASELINE config-4 variant): the merge runs
+    as the pairwise-masked fixed-point psum (parallel.secure) so no observer
+    of an individual delta contribution learns it.  With `secure_wallets`
+    (one comm.identity.Wallet per client) the masks are keyed by per-pair
+    X25519 — the aggregator cannot strip them; without, a per-round shared
+    PRNG key (privacy against outside observers only).  Per-round dispatch
+    path only (rounds_per_dispatch=1).
     """
     cfg.validate()
+    if secure_aggregation and rounds_per_dispatch > 1:
+        raise ValueError("secure_aggregation requires rounds_per_dispatch=1 "
+                         "(per-round keys don't batch)")
+    if estimate_flops and (secure_aggregation or rounds_per_dispatch > 1):
+        # fail loudly rather than report flops_per_round=0 / mfu()=0.0 for
+        # a benchmark that asked for the metric
+        raise ValueError("estimate_flops is only supported on the plain "
+                         "per-round path (rounds_per_dispatch=1, no "
+                         "secure aggregation)")
+    if secure_wallets is not None and len(secure_wallets) != cfg.client_num:
+        raise ValueError(f"need {cfg.client_num} wallets, "
+                         f"got {len(secure_wallets)}")
     if participation not in ("full", "active"):
         raise ValueError(f"participation must be 'full'|'active', "
                          f"got {participation!r}")
@@ -204,44 +236,14 @@ def run_federated_mesh(model: Model,
     n = cfg.client_num
     if len(shards) != n:
         raise ValueError(f"need {n} shards, got {len(shards)}")
-    empties = [i for i, (sx, _) in enumerate(shards) if len(sx) == 0]
-    if empties:
-        # only dirichlet_shards guarantees min_size; caller-supplied shards
-        # can be empty and would otherwise die in cyclic padding with an
-        # opaque ZeroDivisionError
-        raise ValueError(f"shards {empties} are empty; every client needs "
-                         f"at least one sample")
     k, c = cfg.needed_update_count, cfg.comm_count
     n_slots = n if participation == "full" else k + c
     if mesh is None:
-        # largest device count that divides the slot count
-        nd = len(jax.devices())
-        while n_slots % nd:
-            nd -= 1
-        mesh = client_axis_mesh(nd)
-
-    # uniform shard size for static shapes: pad every shard to the MAXIMUM
-    # by cyclic repetition.  Truncating to the minimum instead silently
-    # discards most of the data under label-skewed splits (Dirichlet shards
-    # range ~39..234 samples at alpha=0.5) and starves training; repetition
-    # keeps all data, and a small client just cycles its shard more often —
-    # the standard static-shape treatment of ragged federated shards.
-    # FedAvg weights use the TRUE sizes, so padding never distorts the
-    # aggregate (reference meta.n_samples = real shard size, main.py:155).
-    sizes_np = np.asarray([len(sx) for sx, _ in shards], np.int64)
-    s_pad = int(sizes_np.max())
-
-    def _cyc(a: np.ndarray) -> np.ndarray:
-        reps = -(-s_pad // len(a))
-        return np.concatenate([np.asarray(a)] * reps)[:s_pad]
+        mesh = client_axis_mesh(largest_divisor_device_count(n_slots))
 
     nc = model.num_classes
-    xs_np = np.stack([_cyc(sx) for sx, _ in shards])
-    # preserve integer inputs (token ids index the embedding table);
-    # everything else runs float32
-    xs_np = (xs_np.astype(np.int32) if np.issubdtype(xs_np.dtype, np.integer)
-             else xs_np.astype(np.float32))
-    ys_np = np.stack([one_hot(_cyc(sy), nc) for _, sy in shards])
+    xs_np, ys_np, sizes_np = stage_padded_arrays(
+        [sx for sx, _ in shards], [sy for _, sy in shards], nc)
     shard_sharding = NamedSharding(mesh, P(AXIS))
     if participation == "full":
         ns = jax.device_put(jnp.asarray(sizes_np, jnp.int32), shard_sharding)
@@ -261,7 +263,8 @@ def run_federated_mesh(model: Model,
             mesh, model.apply, client_num=n_slots, lr=cfg.learning_rate,
             batch_size=cfg.batch_size, local_epochs=cfg.local_epochs,
             aggregate_count=cfg.aggregate_count, client_chunk=client_chunk,
-            remat=remat)
+            remat=remat, secure=secure_aggregation,
+            secure_dh=secure_wallets is not None, secure_clip=secure_clip)
 
     xte, yte = test_set
     sponsor = Sponsor(model, jnp.asarray(xte), jnp.asarray(one_hot(yte, nc)))
@@ -296,6 +299,11 @@ def run_federated_mesh(model: Model,
     from bflc_demo_tpu.utils.tracing import NULL_TRACER
     tracer = tracer or NULL_TRACER
     loss_history, round_times = [], []
+    # estimate_flops: AOT-compile the round with the REAL first-round args,
+    # read XLA's cost analysis (the MFU numerator, eval.mfu), and reuse the
+    # executable for every round — no second compile
+    flops_per_round = 0.0
+    compiled_round = None
     t0 = time.perf_counter()
     for _ in range(rounds):
         rt0 = time.perf_counter()
@@ -306,13 +314,42 @@ def run_federated_mesh(model: Model,
         pick = rng.permutation(len(trainer_ids))[: k]
         uploader_ids = sorted(trainer_ids[int(j)] for j in pick)
 
+        def _secure_key(slot_clients):
+            """Per-round blinding key for the round's slot occupants.
+
+            DH mode re-derives the pair-seed matrix for the participating
+            wallets each round (round index bound into the X25519 KDF
+            context, parallel.secure.derive_pair_seeds); shared-key mode
+            folds the epoch into the run key.  Masks must be keyed over the
+            SLOT set — every slot participates in the masking psum, so the
+            pairwise cancellation spans exactly the round's occupants.
+            """
+            if secure_wallets is not None:
+                from bflc_demo_tpu.parallel.secure import derive_pair_seeds
+                return derive_pair_seeds(
+                    [secure_wallets[i] for i in slot_clients], epoch)
+            return jax.random.fold_in(
+                jax.random.PRNGKey(np.uint32(seed) ^ np.uint32(0x5EC)),
+                epoch)
+
         if participation == "full":
             uploader_mask = np.zeros(n, bool)
             uploader_mask[uploader_ids] = True
             committee_mask = np.zeros(n, bool)
             committee_mask[committee_ids] = True
-            res = round_fn(params, xs, ys, ns, jnp.asarray(uploader_mask),
-                           jnp.asarray(committee_mask))
+            args = (params, xs, ys, ns, jnp.asarray(uploader_mask),
+                    jnp.asarray(committee_mask))
+            if secure_aggregation:
+                args += (_secure_key(list(range(n))),)
+                res = round_fn(*args)
+            else:
+                if estimate_flops and compiled_round is None:
+                    from bflc_demo_tpu.eval.mfu import cost_analysis_flops
+                    compiled_round = round_fn._jitted.lower(
+                        *args, round_fn._dummy).compile()
+                    flops_per_round = cost_analysis_flops(compiled_round)
+                res = (compiled_round(*args, round_fn._dummy)
+                       if compiled_round is not None else round_fn(*args))
             up_slots, comm_slots = uploader_ids, committee_ids
         else:
             # stream this round's participant shards onto the mesh;
@@ -322,8 +359,19 @@ def run_federated_mesh(model: Model,
             ys_a = jax.device_put(jnp.asarray(ys_np[active]), shard_sharding)
             ns_a = jax.device_put(
                 jnp.asarray(sizes_np[active], jnp.int32), shard_sharding)
-            res = round_fn(params, xs_a, ys_a, ns_a, static_uploader,
-                           static_committee)
+            args = (params, xs_a, ys_a, ns_a, static_uploader,
+                    static_committee)
+            if secure_aggregation:
+                args += (_secure_key(active),)
+                res = round_fn(*args)
+            else:
+                if estimate_flops and compiled_round is None:
+                    from bflc_demo_tpu.eval.mfu import cost_analysis_flops
+                    compiled_round = round_fn._jitted.lower(
+                        *args, round_fn._dummy).compile()
+                    flops_per_round = cost_analysis_flops(compiled_round)
+                res = (compiled_round(*args, round_fn._dummy)
+                       if compiled_round is not None else round_fn(*args))
             up_slots = list(range(k))
             comm_slots = list(range(k, k + c))
         params = res.params
@@ -338,28 +386,11 @@ def run_federated_mesh(model: Model,
                       delta_fps.nbytes + score_rows.nbytes + avg_costs.nbytes)
         tracer.event("round.device_done", epoch=epoch)
 
-        for j, cid in enumerate(uploader_ids):         # ascending == slot order
-            st = ledger.upload_local_update(
-                _addr(cid), fingerprint_to_bytes(delta_fps[up_slots[j]]),
-                int(sizes_np[cid]), float(avg_costs[up_slots[j]]), epoch)
-            if st != LedgerStatus.OK:
-                raise RuntimeError(f"upload rejected: {st.name}")
-        for j, cid in enumerate(committee_ids):
-            st = ledger.upload_scores(
-                _addr(cid), epoch,
-                [float(score_rows[comm_slots[j], u]) for u in up_slots])
-            if st != LedgerStatus.OK:
-                raise RuntimeError(f"scores rejected: {st.name}")
-
-        pending = ledger.pending()
-        sel_ledger = np.sort([up_slots[s] for s in pending.selected])
-        if not np.array_equal(sel_ledger, sel_device):
-            raise RuntimeError(
-                "ledger/device decision divergence: "
-                f"ledger={sel_ledger} device={sel_device}")
-        st = ledger.commit_model(fingerprint_to_bytes(res.params_fp), epoch)
-        if st != LedgerStatus.OK:
-            raise RuntimeError(f"commit rejected: {st.name}")
+        # ascending == slot order; audit_round raises on any divergence
+        audit_round(ledger, _addr, epoch, uploader_ids, committee_ids,
+                    up_slots, comm_slots, delta_fps,
+                    lambda cid: sizes_np[cid], avg_costs, score_rows,
+                    sel_device, res.params_fp)
 
         tracer.charge("ledger.ops",
                       len(uploader_ids) + len(committee_ids) + 1)
@@ -385,4 +416,5 @@ def run_federated_mesh(model: Model,
         ledger_log_head=ledger.log_head(),
         ledger_log_size=ledger.log_size(),
         n_devices=mesh.shape[AXIS],
-        ledger=ledger)
+        ledger=ledger,
+        flops_per_round=flops_per_round)

@@ -76,13 +76,17 @@ def _cpu_spawn_env():
 
 
 def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
-                 stall_timeout_s: float, wal_path: str,
+                 stall_timeout_s: float, wal_path: str, tls_dir: str,
                  verbose: bool) -> None:
     _force_cpu_jax()
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
+    tls = None
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import server_context
+        tls = server_context(tls_dir)
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
                           stall_timeout_s=stall_timeout_s,
-                          wal_path=wal_path, verbose=verbose)
+                          wal_path=wal_path, tls=tls, verbose=verbose)
     port_q.put(server.port)
     server.serve_forever()
 
@@ -93,22 +97,26 @@ def _sign(wallet, kind: str, epoch: int, payload: bytes) -> str:
                                  payload)).hex()
 
 
-def _client_proc(host: str, port: int, wallet_seed: bytes,
+def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                  model_factory: str, factory_kw: dict,
                  x: np.ndarray, y_onehot: np.ndarray, cfg_kw: dict,
-                 rounds: int, crash_at_epoch: Optional[int]) -> None:
+                 rounds: int, crash_at_epoch: Optional[int],
+                 tls_dir: str = "") -> None:
     """One federated client: register -> role loop -> train/score -> exit.
 
     Runs the same state machine as client/runtime.FLNode.step (itself the
     reference's main_loop, main.py:236-271), but every ledger interaction is
     a signed socket request and every tensor crosses as a canonical blob.
+    With multiple endpoints the client rides FailoverClient: a dead writer
+    means rotating to the promoted standby and retrying — every mutation is
+    signed + idempotent (DUPLICATE = already in), so retries are safe.
     """
     _force_cpu_jax()
     import jax.numpy as jnp
 
     import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.comm.failover import FailoverClient
     from bflc_demo_tpu.comm.identity import Wallet
-    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
     from bflc_demo_tpu.core.local_train import local_train
     from bflc_demo_tpu.core.scoring import score_candidates
     from bflc_demo_tpu.utils.serialization import (pack_pytree,
@@ -121,11 +129,16 @@ def _client_proc(host: str, port: int, wallet_seed: bytes,
     wallet = Wallet.from_seed(wallet_seed)
     xj, yj = jnp.asarray(x), jnp.asarray(y_onehot)
 
-    client = CoordinatorClient(host, port, timeout_s=120.0)
+    tls = None
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import client_context
+        tls = client_context(tls_dir)
+    client = FailoverClient(endpoints, timeout_s=120.0, tls=tls)
     reply = client.request("register", addr=wallet.address,
                            pubkey=wallet.public_bytes.hex(),
                            tag=_sign(wallet, "register", 0, b""))
-    if not reply["ok"] and reply.get("status") != "ALREADY_REGISTERED":
+    if not reply["ok"] and reply.get("status") not in ("ALREADY_REGISTERED",
+                                                       "DUPLICATE"):
         raise RuntimeError(f"register failed: {reply}")
 
     trained_epoch = scored_epoch = cfg.initial_trained_epoch
@@ -189,7 +202,7 @@ def _client_proc(host: str, port: int, wallet_seed: bytes,
                     "scores", addr=wallet.address, epoch=epoch,
                     scores=score_list,
                     tag=_sign(wallet, "scores", epoch, payload))
-                if r.get("status") in ("OK", "WRONG_EPOCH"):
+                if r.get("status") in ("OK", "WRONG_EPOCH", "DUPLICATE"):
                     scored_epoch = epoch
                     acted = r["ok"]
         if not acted:
@@ -199,16 +212,41 @@ def _client_proc(host: str, port: int, wallet_seed: bytes,
 
 
 def _replica_proc(host: str, port: int, cfg_kw: dict, until_ops: int,
-                  out_q) -> None:
+                  out_q, tls_dir: str = "") -> None:
     _force_cpu_jax()
     from bflc_demo_tpu.comm.ledger_service import replicate
+    tls = None
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import client_context
+        tls = client_context(tls_dir)
     try:
         replica = replicate(host, port, ProtocolConfig(**cfg_kw),
-                            until_ops=until_ops, timeout_s=120.0)
+                            until_ops=until_ops, timeout_s=120.0, tls=tls)
         out_q.put({"ok": True, "head": replica.log_head().hex(),
                    "size": replica.log_size(), "epoch": replica.epoch})
     except Exception as e:              # report, don't hang the parent
         out_q.put({"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+
+def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
+                  index: int, port_q, stall_timeout_s: float,
+                  tls_dir: str, verbose: bool) -> None:
+    """Hot standby: follow the writer's op stream, promote on its death
+    (comm.failover.Standby).  Reports its serving port, then blocks."""
+    _force_cpu_jax()
+    from bflc_demo_tpu.comm.failover import Standby
+    tls_c = tls_s = None
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import client_context, server_context
+        tls_c, tls_s = client_context(tls_dir), server_context(tls_dir)
+    standby = Standby(ProtocolConfig(**cfg_kw),
+                      endpoints + [("127.0.0.1", 0)], index,
+                      stall_timeout_s=stall_timeout_s,
+                      tls_client=tls_c, tls_server=tls_s, verbose=verbose)
+    # the placeholder self-endpoint gets the real bound port
+    standby.endpoints[index] = (standby.host, standby.port)
+    port_q.put(standby.port)
+    standby.run()
 
 
 class ProcessFederationResult:
@@ -237,23 +275,40 @@ def run_federated_processes(
         stall_timeout_s: float = 5.0,
         wal_path: str = "",
         replicas: int = 1,
+        standbys: int = 0,
+        kill_writer_at_epoch: Optional[int] = None,
+        tls_dir: str = "",
         timeout_s: float = 600.0,
         init_seed: int = 0,
         verbose: bool = False) -> ProcessFederationResult:
-    """Run a full federation as (1 coordinator + N clients [+ 1 replica])
-    OS processes.  Parent = sponsor.
+    """Run a full federation as (1 coordinator + N clients [+ standbys]
+    [+ 1 replica]) OS processes.  Parent = sponsor.
 
     crash_at: {client_index: epoch} — that client's process hard-exits at
     that epoch; the coordinator's recovery ops must carry the round.
     replicas: live replica processes replaying the writer's op stream
     (the reference's 4-node deployment = 1 writer + 3 replicas); each must
     independently reproduce the writer's chained head digest.
+    standbys: hot-standby processes (comm.failover.Standby) following the
+    writer live and promoting on its death — clients/sponsor carry the full
+    endpoint list and fail over automatically.
+    tls_dir: when set, the reference's cert-provisioning step
+    (comm.tls.provision_tls writes a CA + server cert there) and EVERY
+    control-plane byte — clients, sponsor, standbys, replicas — rides TLS.
+    kill_writer_at_epoch: SIGKILL the PRIMARY coordinator process once the
+    federation reaches this epoch (requires standbys >= 1) — the no-single-
+    point-of-failure drill: the promoted standby must finish the run.
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    if kill_writer_at_epoch is not None and standbys < 1:
+        raise ValueError("kill_writer_at_epoch requires standbys >= 1")
     crash_at = crash_at or {}
     factory_kw = factory_kw or {}
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import provision_tls
+        provision_tls(tls_dir)
 
     import jax.numpy as jnp
 
@@ -273,34 +328,54 @@ def run_federated_processes(
 
     ctx = mp.get_context("spawn")
     port_q = ctx.Queue()
+    host = "127.0.0.1"
+    standby_procs: List = []
     with _cpu_spawn_env():
         server = ctx.Process(target=_server_proc,
                              args=(cfg_kw, initial_blob, port_q,
-                                   stall_timeout_s, wal_path, verbose),
+                                   stall_timeout_s, wal_path, tls_dir,
+                                   verbose),
                              daemon=True)
         server.start()
         port = port_q.get(timeout=60)
-        host = "127.0.0.1"
+        endpoints = [(host, port)]
+
+        # standbys spawn in priority order; each only needs the endpoints
+        # ABOVE it (election never looks past its own index)
+        for s in range(standbys):
+            sb_q = ctx.Queue()
+            sp = ctx.Process(target=_standby_proc,
+                             args=(cfg_kw, list(endpoints), s + 1, sb_q,
+                                   stall_timeout_s, tls_dir, verbose),
+                             daemon=True)
+            sp.start()
+            endpoints.append((host, sb_q.get(timeout=60)))
+            standby_procs.append(sp)
 
         clients = []
         for i, (sx, sy) in enumerate(shards):
             p = ctx.Process(
                 target=_client_proc,
-                args=(host, port, master_seed + struct.pack("<q", i),
+                args=(list(endpoints), master_seed + struct.pack("<q", i),
                       model_factory, factory_kw,
                       np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
-                      rounds, crash_at.get(i)),
+                      rounds, crash_at.get(i), tls_dir),
                 daemon=True)
             p.start()
             clients.append(p)
 
-    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+    from bflc_demo_tpu.comm.failover import FailoverClient
     xte, yte = test_set
     xte_j = jnp.asarray(xte)
     yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
-    sponsor = CoordinatorClient(host, port, timeout_s=120.0)
+    sponsor_tls = None
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import client_context
+        sponsor_tls = client_context(tls_dir)
+    sponsor = FailoverClient(endpoints, timeout_s=120.0, tls=sponsor_tls)
     history: List[Tuple[int, float]] = []
     seen_epoch = 0              # model at epoch 0 is the uncommitted init
+    writer_killed = False
     deadline = time.monotonic() + timeout_s
     try:
         while time.monotonic() < deadline:
@@ -317,7 +392,20 @@ def run_federated_processes(
                     if verbose:
                         print(f"Epoch: {mr['epoch'] - 1:03d}, "
                               f"test_acc: {acc:.4f}", flush=True)
-            if info["rounds_completed"] >= rounds:
+            if kill_writer_at_epoch is not None and not writer_killed \
+                    and info["epoch"] >= kill_writer_at_epoch:
+                # the no-single-point-of-failure drill: SIGKILL the primary
+                # mid-federation; the standby must detect, promote, and the
+                # fleet must finish the remaining rounds on it
+                server.kill()
+                server.join(timeout=10)
+                writer_killed = True
+                if verbose:
+                    print(f"[drill] primary coordinator killed at epoch "
+                          f"{info['epoch']}", flush=True)
+            # epoch == completed rounds (one commit per epoch), which keeps
+            # counting across a failover; rounds_completed is per-process
+            if info["epoch"] >= rounds:
                 break
             time.sleep(0.2)
         else:
@@ -325,13 +413,14 @@ def run_federated_processes(
                 f"process federation incomplete after {timeout_s}s "
                 f"({len(history)}/{rounds} rounds)")
         final = sponsor.request("info")
+        final_ep = sponsor.current_endpoint
         replica_report = None
         if replicas > 0:
             rep_q = ctx.Queue()
             with _cpu_spawn_env():
                 rps = [ctx.Process(target=_replica_proc,
-                                   args=(host, port, cfg_kw,
-                                         final["log_size"], rep_q),
+                                   args=(final_ep[0], final_ep[1], cfg_kw,
+                                         final["log_size"], rep_q, tls_dir),
                                    daemon=True)
                        for _ in range(replicas)]
                 for rp in rps:
@@ -356,13 +445,214 @@ def run_federated_processes(
                 p.terminate()
         server.terminate()
         server.join(timeout=10)
+        for sp in standby_procs:
+            sp.terminate()
+            sp.join(timeout=10)
 
     crashed = [i for i in crash_at
                if clients[i].exitcode not in (0, None)]
     return ProcessFederationResult(
         accuracy_history=history,
-        rounds_completed=final["rounds_completed"],
+        rounds_completed=final["epoch"],
         log_head=final["log_head"],
         log_size=final["log_size"],
         recovered_clients=crashed,
         replica_report=replica_report)
+
+
+# ------------------------------------------------- mesh-executor federation
+def _executor_proc(cfg_kw: dict, model_factory: str, factory_kw: dict,
+                   rounds: int, port_q, n_virtual_devices: int,
+                   stall_timeout_s: float, verbose: bool) -> None:
+    """Coordinator process that OWNS the device mesh: each round is one
+    SPMD program (comm.executor_service.MeshExecutorServer)."""
+    if n_virtual_devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{n_virtual_devices}").strip()
+    _force_cpu_jax()
+    from bflc_demo_tpu.comm.executor_service import MeshExecutorServer
+    server = MeshExecutorServer(
+        ProtocolConfig(**cfg_kw), model_factory, factory_kw,
+        rounds=rounds, stall_timeout_s=stall_timeout_s, verbose=verbose)
+    port_q.put(server.port)
+    server.serve_forever()
+
+
+def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
+                      model_factory: str, factory_kw: dict,
+                      x: np.ndarray, y: np.ndarray, cfg_kw: dict,
+                      rounds: int) -> None:
+    """Thin driver for the mesh-executor deployment: register, stage the
+    shard ONCE, then watch rounds progress and verify the committed model
+    on the local shard each epoch."""
+    _force_cpu_jax()
+    import hashlib as _hl
+
+    import jax.numpy as jnp
+
+    import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.comm.identity import Wallet, _op_bytes
+    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+    from bflc_demo_tpu.core.local_train import evaluate
+    from bflc_demo_tpu.data.partition import one_hot
+    from bflc_demo_tpu.utils.serialization import (pack_entries,
+                                                   unpack_pytree,
+                                                   restore_pytree)
+
+    model = getattr(models, model_factory)(**factory_kw)
+    template = model.init_params(0)
+    wallet = Wallet.from_seed(wallet_seed)
+    client = CoordinatorClient(host, port, timeout_s=120.0)
+    r = client.request("register", addr=wallet.address,
+                       pubkey=wallet.public_bytes.hex(),
+                       tag=_sign(wallet, "register", 0, b""))
+    if not r["ok"] and r.get("status") not in ("ALREADY_REGISTERED",
+                                               "DUPLICATE"):
+        raise RuntimeError(f"register failed: {r}")
+    # flat entries (pack_entries) keep the literal keys "x"/"y" on the wire
+    xb = pack_entries({"x": np.asarray(x)})
+    yb = pack_entries({"y": np.asarray(y).astype(np.int32)})
+    payload = _hl.sha256(xb).digest() + _hl.sha256(yb).digest()
+    tag = wallet.sign(_op_bytes("stage", wallet.address, 0, payload)).hex()
+    r = client.request("stage", addr=wallet.address, x=xb.hex(), y=yb.hex(),
+                       tag=tag)
+    if not r["ok"]:
+        raise RuntimeError(f"stage failed: {r}")
+
+    xj = jnp.asarray(np.asarray(x))
+    yj = jnp.asarray(one_hot(np.asarray(y), model.num_classes))
+    seen = 0
+    known_log = 0
+    while True:
+        pr = client.request("progress")
+        if pr.get("error"):
+            raise RuntimeError(f"executor failed: {pr['error']}")
+        # cheap "info" first: only fetch the (potentially multi-MB) model
+        # blob when a new epoch actually committed
+        if client.request("info")["epoch"] > seen:
+            mr = client.request("model")
+            if mr["epoch"] > seen:
+                params = restore_pytree(
+                    template, unpack_pytree(bytes.fromhex(mr["blob"])))
+                acc = float(evaluate(model.apply, params, xj, yj))
+                if not np.isfinite(acc):
+                    raise RuntimeError("non-finite local accuracy")
+                seen = mr["epoch"]
+        if pr["rounds_done"] >= rounds:
+            break
+        known_log = client.request("wait", log_size=known_log,
+                                   timeout_s=2.0)["log_size"]
+    client.close()
+
+
+def run_federated_mesh_processes(
+        model_factory: str,
+        shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+        test_set: Tuple[np.ndarray, np.ndarray],
+        cfg: ProtocolConfig,
+        rounds: int = 5, *,
+        factory_kw: Optional[dict] = None,
+        master_seed: bytes = b"mesh-executor-master-0001",
+        n_virtual_devices: int = 0,
+        stall_timeout_s: float = 120.0,
+        timeout_s: float = 600.0,
+        verbose: bool = False) -> ProcessFederationResult:
+    """The composed deployment: OS-process clients drive rounds over the
+    socket while the coordinator executes every round on the accelerator
+    mesh via make_sharded_protocol_round (see comm.executor_service for the
+    trust model).  Parent = sponsor.
+
+    n_virtual_devices: CPU-mesh width for the executor child (tests); 0
+    leaves the platform's real device count (TPU benches).
+    """
+    cfg.validate()
+    if len(shards) != cfg.client_num:
+        raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    factory_kw = factory_kw or {}
+
+    import jax.numpy as jnp
+
+    import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.core.local_train import evaluate
+    from bflc_demo_tpu.data.partition import one_hot
+    from bflc_demo_tpu.utils.serialization import unpack_pytree, restore_pytree
+
+    model = getattr(models, model_factory)(**factory_kw)
+    template = model.init_params(0)
+    nc = model.num_classes
+    cfg_kw = {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)}
+
+    ctx = mp.get_context("spawn")
+    port_q = ctx.Queue()
+    host = "127.0.0.1"
+    with _cpu_spawn_env():
+        server = ctx.Process(
+            target=_executor_proc,
+            args=(cfg_kw, model_factory, factory_kw, rounds, port_q,
+                  n_virtual_devices, stall_timeout_s, verbose),
+            daemon=True)
+        server.start()
+        port = port_q.get(timeout=120)
+
+        clients = []
+        for i, (sx, sy) in enumerate(shards):
+            p = ctx.Process(
+                target=_thin_client_proc,
+                args=(host, port, master_seed + struct.pack("<q", i),
+                      model_factory, factory_kw, np.asarray(sx),
+                      np.asarray(sy), cfg_kw, rounds),
+                daemon=True)
+            p.start()
+            clients.append(p)
+
+    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+    xte, yte = test_set
+    xte_j = jnp.asarray(xte)
+    yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
+    sponsor = CoordinatorClient(host, port, timeout_s=120.0)
+    history: List[Tuple[int, float]] = []
+    seen_epoch = 0
+    deadline = time.monotonic() + timeout_s
+    try:
+        while time.monotonic() < deadline:
+            pr = sponsor.request("progress")
+            if pr.get("error"):
+                raise RuntimeError(f"executor failed: {pr['error']}")
+            info = sponsor.request("info")
+            if info["epoch"] > seen_epoch:
+                mr = sponsor.request("model")
+                if mr["epoch"] > seen_epoch:
+                    params = restore_pytree(
+                        template,
+                        unpack_pytree(bytes.fromhex(mr["blob"])))
+                    acc = float(evaluate(model.apply, params, xte_j, yte_j))
+                    history.append((mr["epoch"] - 1, acc))
+                    seen_epoch = mr["epoch"]
+                    if verbose:
+                        print(f"Epoch: {mr['epoch'] - 1:03d}, "
+                              f"test_acc: {acc:.4f}", flush=True)
+            if pr["rounds_done"] >= rounds:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"mesh-executor federation incomplete after {timeout_s}s")
+        final = sponsor.request("info")
+    finally:
+        sponsor.close()
+        for p in clients:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        server.terminate()
+        server.join(timeout=10)
+
+    return ProcessFederationResult(
+        accuracy_history=history,
+        rounds_completed=final["epoch"],
+        log_head=final["log_head"],
+        log_size=final["log_size"],
+        recovered_clients=[],
+        replica_report=None)

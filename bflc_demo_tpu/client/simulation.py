@@ -42,6 +42,17 @@ class SimulationResult:
     ledger_log_size: int
     n_devices: int = 1          # devices the data plane actually used
     ledger: Any = None          # the live ledger (for checkpointing/inspection)
+    flops_per_round: float = 0.0    # XLA cost-analysis FLOPs of ONE round's
+    # compiled program (0 when not estimated) — the MFU numerator
+
+    def mfu(self, peak_flops: float) -> float:
+        """Model FLOPs utilisation against `peak_flops` (whole data plane:
+        per-chip peak x n_devices), from the measured mean round time."""
+        times = [t for t in self.round_times_s[1:]] or self.round_times_s
+        if not self.flops_per_round or not times or peak_flops <= 0:
+            return 0.0
+        mean_t = sum(times) / len(times)
+        return self.flops_per_round / mean_t / peak_flops
 
     @property
     def final_accuracy(self) -> float:

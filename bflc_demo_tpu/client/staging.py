@@ -1,0 +1,104 @@
+"""Shared data-plane staging + ledger round-audit helpers.
+
+One definition used by BOTH owners of a device round program — the
+in-process mesh runtime (client/mesh_runtime.py) and the socket-fronted
+mesh executor (comm/executor_service.py) — so the staging rules (cyclic
+padding, dtype preservation, empty-shard rejection) and the
+ledger-replay/audit contract cannot drift between deployments.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.data.partition import one_hot
+from bflc_demo_tpu.ledger import LedgerStatus
+from bflc_demo_tpu.ops.fingerprint import fingerprint_to_bytes
+
+
+def stage_padded_arrays(shard_xs: Sequence[np.ndarray],
+                        shard_ys: Sequence[np.ndarray],
+                        num_classes: int,
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Uniform shard size for static shapes: pad every shard to the MAXIMUM
+    by cyclic repetition.  Truncating to the minimum instead silently
+    discards most of the data under label-skewed splits (Dirichlet shards
+    range ~39..234 samples at alpha=0.5) and starves training; repetition
+    keeps all data, and a small client just cycles its shard more often —
+    the standard static-shape treatment of ragged federated shards.
+    FedAvg weights use the TRUE sizes (returned), so padding never distorts
+    the aggregate (reference meta.n_samples = real shard size, main.py:155).
+
+    Returns (xs (N, S_pad, *feat), ys_onehot (N, S_pad, C), sizes (N,)).
+    Integer features (token ids) stay int32; everything else float32.
+    """
+    empties = [i for i, sx in enumerate(shard_xs) if len(sx) == 0]
+    if empties:
+        # only dirichlet_shards guarantees min_size; caller-supplied shards
+        # can be empty and would otherwise die in cyclic padding with an
+        # opaque ZeroDivisionError
+        raise ValueError(f"shards {empties} are empty; every client needs "
+                         f"at least one sample")
+    sizes = np.asarray([len(sx) for sx in shard_xs], np.int64)
+    s_pad = int(sizes.max())
+
+    def cyc(a: np.ndarray) -> np.ndarray:
+        reps = -(-s_pad // len(a))
+        return np.concatenate([np.asarray(a)] * reps)[:s_pad]
+
+    xs = np.stack([cyc(sx) for sx in shard_xs])
+    xs = (xs.astype(np.int32) if np.issubdtype(xs.dtype, np.integer)
+          else xs.astype(np.float32))
+    ys = np.stack([one_hot(cyc(sy), num_classes) for sy in shard_ys])
+    return xs, ys, sizes
+
+
+def largest_divisor_device_count(n_slots: int) -> int:
+    """Largest available device count that divides the slot count."""
+    import jax
+    nd = len(jax.devices())
+    while n_slots % nd:
+        nd -= 1
+    return nd
+
+
+def audit_round(ledger, addr_of: Callable[[int], str], epoch: int,
+                uploader_ids: List[int], committee_ids: List[int],
+                up_slots: List[int], comm_slots: List[int],
+                delta_fps: np.ndarray, sizes_of: Callable[[int], int],
+                avg_costs: np.ndarray, score_rows: np.ndarray,
+                sel_device: np.ndarray, params_fp: np.ndarray) -> None:
+    """Replay one device round's artifacts into the ledger and AUDIT the
+    decision: the op log stays the authority, the mesh its optimistic
+    executor, and any ledger-vs-device divergence raises (the live
+    differential check between the C++ coordinator and the XLA decision
+    procedure — SURVEY.md §3.1 note).
+
+    uploader_ids/committee_ids are CLIENT indices (ledger identity order);
+    up_slots/comm_slots are the corresponding DEVICE slot rows in
+    delta_fps/score_rows (identical lists under full participation).
+    """
+    for j, cid in enumerate(uploader_ids):
+        st = ledger.upload_local_update(
+            addr_of(cid), fingerprint_to_bytes(delta_fps[up_slots[j]]),
+            int(sizes_of(cid)), float(avg_costs[up_slots[j]]), epoch)
+        if st != LedgerStatus.OK:
+            raise RuntimeError(f"upload rejected: {st.name}")
+    for j, cid in enumerate(committee_ids):
+        st = ledger.upload_scores(
+            addr_of(cid), epoch,
+            [float(score_rows[comm_slots[j], u]) for u in up_slots])
+        if st != LedgerStatus.OK:
+            raise RuntimeError(f"scores rejected: {st.name}")
+    pending = ledger.pending()
+    sel_ledger = np.sort([up_slots[s] for s in pending.selected])
+    if not np.array_equal(sel_ledger, np.sort(np.asarray(sel_device))):
+        raise RuntimeError(
+            f"ledger/device decision divergence at epoch {epoch}: "
+            f"ledger={sel_ledger} device={np.sort(np.asarray(sel_device))}")
+    st = ledger.commit_model(fingerprint_to_bytes(np.asarray(params_fp)),
+                             epoch)
+    if st != LedgerStatus.OK:
+        raise RuntimeError(f"commit rejected: {st.name}")

@@ -1,0 +1,346 @@
+"""Writer failover: hot-standby replicas that promote when the writer dies.
+
+The reference keeps serving through chain-node loss because all 4 PBFT nodes
+execute every op (README.md:162-183) — no single node is a failure domain.
+Round 2's networked coordinator replicated its op log to live verifiers but
+they could not take over: the writer was the one unprotected failure domain.
+This module closes it:
+
+- a `Standby` follows the writer LIVE: it subscribes to the op stream
+  (byte-identical, chained, verified on apply), and mirrors the sideband
+  state ops only reference by hash — update payload blobs, the current
+  global model blob (content-hash-checked against the replayed ledger), and
+  the public-key directory (addresses are self-authenticating, so the
+  mirror is integrity-checked);
+- death detection is connection-driven with a probe fallback: a broken or
+  idle op stream triggers an `info` probe of the writer; refused/timed-out
+  probes mean dead;
+- promotion is deterministic, lease-free: endpoints are an ordered priority
+  list (the reference's fixed 4-node topology); standby k promotes only
+  when the writer AND every higher-priority standby are dead
+  (connection-refused — a bound-but-following standby accepts the TCP
+  connect, which distinguishes "alive, not yet serving" from "gone").
+  Highest live priority wins; everyone else re-follows the winner;
+- the standby binds its serving socket AT START, so clients that fail over
+  early sit in the listen backlog until promotion finishes — no
+  connection-refused window;
+- clients use `FailoverClient`: same request surface, rotates through the
+  endpoint list on connection failure.  Retried mutations are safe end to
+  end: ops are Ed25519-tagged and the ledger + replay-guard answer
+  DUPLICATE ("already in") for an op whose reply was lost, which callers
+  treat as progress.
+
+Known window (documented, not hidden): if the writer dies after streaming
+an upload op but before the standby fetched that update's payload blob, the
+promoted writer holds the update record without its payload.  An honest
+uploader that never saw its reply retries and re-supplies the blob (the
+upload handler re-accepts payloads for DUPLICATE uploads); an uploader that
+already got its reply will not, and that round can only complete via the
+stall-recovery path once the round closes over the remaining updates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bflc_demo_tpu.comm.identity import PublicDirectory, address_of
+from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                               LedgerServer)
+from bflc_demo_tpu.comm.wire import send_msg, recv_msg, WireError
+from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+Endpoint = Tuple[str, int]
+
+
+class WriterDead(Exception):
+    """The followed writer is unreachable."""
+
+
+class FailoverClient:
+    """CoordinatorClient over an ordered endpoint list.
+
+    On any connection-level failure the current socket is dropped and the
+    next endpoint is tried; a full silent cycle backs off briefly.  Request
+    retry across endpoints is safe because every mutation is signed and
+    idempotent at the ledger (DUPLICATE for already-applied ops).
+    """
+
+    def __init__(self, endpoints: List[Endpoint], timeout_s: float = 30.0,
+                 max_cycles: int = 6, tls=None):
+        if not endpoints:
+            raise ValueError("need at least one endpoint")
+        self._eps = list(endpoints)
+        self._timeout_s = timeout_s
+        self._max_cycles = max_cycles
+        self._tls = tls
+        self._cur = 0
+        self._client: Optional[CoordinatorClient] = None
+
+    @property
+    def current_endpoint(self) -> Endpoint:
+        return self._eps[self._cur]
+
+    def request(self, method: str, **fields) -> dict:
+        last: Optional[Exception] = None
+        attempts = self._max_cycles * len(self._eps)
+        for attempt in range(attempts):
+            try:
+                if self._client is None:
+                    host, port = self._eps[self._cur]
+                    self._client = CoordinatorClient(
+                        host, port, timeout_s=self._timeout_s,
+                        tls=self._tls)
+                return self._client.request(method, **fields)
+            except (ConnectionError, WireError, OSError) as e:
+                last = e
+                self.close()
+                self._cur = (self._cur + 1) % len(self._eps)
+                if self._cur == 0:          # full cycle without an answer
+                    time.sleep(min(0.25 * (attempt + 1), 2.0))
+        raise ConnectionError(
+            f"all coordinator endpoints failed after {attempts} attempts: "
+            f"{type(last).__name__}: {last}")
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class Standby:
+    """A promotable live replica (see module docstring for the protocol).
+
+    endpoints[0] is the initial writer; this standby is endpoints[index].
+    The serving socket binds in __init__ — advertise `port` to clients
+    before starting.  `run()` blocks: it follows the live writer until the
+    writer dies, promotes (or re-follows the winning standby), and — once
+    promoted — serves until `stop()`.
+    """
+
+    def __init__(self, cfg: ProtocolConfig, endpoints: List[Endpoint],
+                 index: int, *, host: str = "127.0.0.1", port: int = 0,
+                 ledger_backend: str = "auto",
+                 heartbeat_s: float = 1.0,
+                 require_auth: bool = True,
+                 stall_timeout_s: float = 10.0,
+                 tls_client=None, tls_server=None,
+                 verbose: bool = False):
+        if not 1 <= index < len(endpoints):
+            raise ValueError(f"standby index {index} out of range for "
+                             f"{len(endpoints)} endpoints")
+        cfg.validate()
+        self.cfg = cfg
+        self.endpoints = list(endpoints)
+        self.index = index
+        self.heartbeat_s = heartbeat_s
+        self.require_auth = require_auth
+        self.stall_timeout_s = stall_timeout_s
+        self.tls_client = tls_client        # for following the writer
+        self.tls_server = tls_server        # for serving after promotion
+        self.verbose = verbose
+        self.ledger = make_ledger(cfg, backend=ledger_backend)
+        self._blobs: Dict[bytes, bytes] = {}
+        self._model_blob: Optional[bytes] = None
+        self._directory = PublicDirectory() if require_auth else None
+        # sync gating: only hit the writer's sideband endpoints when the
+        # replayed ledger shows the relevant state actually changed
+        self._synced_registered = -1
+        self._synced_update_count = -1
+        self._stop = threading.Event()
+        self.promoted = threading.Event()
+        self.server: Optional[LedgerServer] = None
+        # bind now: failed-over clients queue in the backlog until serving
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+
+    # ------------------------------------------------------------------ api
+    def stop(self) -> None:
+        self._stop.set()
+        if self.server is not None:
+            self.server.close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def run(self) -> None:
+        """Follow -> (writer dies) -> promote or re-follow -> serve."""
+        writer = 0                      # index of the endpoint we follow
+        while not self._stop.is_set():
+            try:
+                self._follow(self.endpoints[writer])
+            except WriterDead:
+                if self.verbose:
+                    print(f"[standby {self.index}] writer "
+                          f"{self.endpoints[writer]} dead", flush=True)
+            if self._stop.is_set():
+                return
+            winner = self._elect()
+            if winner == self.index:
+                self._promote_and_serve()
+                return
+            if winner < 0:
+                time.sleep(self.heartbeat_s)   # nobody promotable yet
+                continue
+            writer = winner
+            # give the winner time to finish promotion before subscribing
+            time.sleep(self.heartbeat_s)
+
+    # ------------------------------------------------------------ following
+    def _follow(self, writer: Endpoint) -> None:
+        """Apply the writer's op stream live; mirror blobs/model/directory.
+
+        Raises WriterDead when the stream breaks and a probe fails.
+        """
+        host, port = writer
+        try:
+            sub = CoordinatorClient(host, port, timeout_s=self.heartbeat_s,
+                                    tls=self.tls_client)
+            send_msg(sub.sock, {"method": "subscribe",
+                                "from": self.ledger.log_size()})
+            ctl = CoordinatorClient(host, port, timeout_s=10.0,
+                                    tls=self.tls_client)
+        except (ConnectionError, OSError) as e:
+            raise WriterDead(str(e))
+        try:
+            self._sync_state(ctl)
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(sub.sock)
+                except (TimeoutError, socket.timeout):
+                    if not self._writer_alive(writer):
+                        raise WriterDead("probe failed")
+                    continue
+                except (WireError, OSError) as e:
+                    raise WriterDead(str(e))
+                if msg is None:
+                    raise WriterDead("op stream closed")
+                st = self.ledger.apply_op(bytes.fromhex(msg["op"]))
+                if st != LedgerStatus.OK:
+                    raise RuntimeError(
+                        f"standby rejected op {msg['i']}: {st.name} — "
+                        f"writer/replica divergence, refusing to continue")
+                try:
+                    self._sync_state(ctl)
+                except (ConnectionError, WireError, OSError):
+                    # the op is applied; blobs resync on the next loop or
+                    # from retrying clients after promotion
+                    if not self._writer_alive(writer):
+                        raise WriterDead("state sync failed")
+        finally:
+            sub.close()
+            ctl.close()
+
+    def _sync_state(self, ctl: CoordinatorClient) -> None:
+        """Mirror hash-referenced sideband state from the writer.
+
+        Everything fetched is verified against the replayed ledger: update
+        blobs by content hash, the model blob by the committed model hash,
+        directory entries by address self-authentication — a lying or
+        confused writer cannot poison the standby.
+
+        Each mirror is gated on the replayed ledger's OWN counters, so a
+        streamed op costs at most the RPCs its state change implies —
+        never a full directory refetch or update rescan per op.
+        """
+        if self.ledger.update_count != self._synced_update_count:
+            for u in self.ledger.query_all_updates():
+                if u.payload_hash not in self._blobs:
+                    r = ctl.request("blob", hash=u.payload_hash.hex())
+                    if r.get("ok"):
+                        blob = bytes.fromhex(r["blob"])
+                        if hashlib.sha256(blob).digest() == u.payload_hash:
+                            self._blobs[u.payload_hash] = blob
+            self._synced_update_count = self.ledger.update_count
+        want_hash, _ = self.ledger.query_global_model()
+        have = (hashlib.sha256(self._model_blob).digest()
+                if self._model_blob is not None else b"")
+        if want_hash != have and want_hash != b"\0" * 32:
+            r = ctl.request("model")
+            if r.get("ok"):
+                blob = bytes.fromhex(r["blob"])
+                if hashlib.sha256(blob).digest() == want_hash:
+                    self._model_blob = blob
+        if self._directory is not None and \
+                self.ledger.num_registered != self._synced_registered:
+            r = ctl.request("directory")
+            if r.get("ok"):
+                for addr, pub_hex in r["keys"].items():
+                    pub = bytes.fromhex(pub_hex)
+                    if address_of(pub) == addr and \
+                            not self._directory.knows(addr):
+                        self._directory.enroll(pub)
+                self._synced_registered = self.ledger.num_registered
+
+    def _writer_alive(self, ep: Endpoint) -> bool:
+        try:
+            probe = CoordinatorClient(ep[0], ep[1], timeout_s=2.0,
+                                      tls=self.tls_client)
+            try:
+                return bool(probe.request("info").get("ok"))
+            finally:
+                probe.close()
+        except (ConnectionError, WireError, OSError):
+            return False
+
+    # ------------------------------------------------------------- election
+    def _elect(self) -> int:
+        """Deterministic, lease-free: the LIVE endpoint with the highest
+        priority (lowest index) wins.  'Live' for a peer standby means its
+        port accepts a TCP connect (bound-in-backlog counts — it will
+        promote or follow); a dead process refuses.  Returns the winning
+        index, self.index when this standby should promote, or -1 when
+        nothing is reachable (retry later)."""
+        for j, ep in enumerate(self.endpoints):
+            if j == self.index:
+                return self.index
+            if j == 0:
+                if self._writer_alive(ep):
+                    return 0            # writer came back; keep following
+                continue
+            try:
+                s = socket.create_connection(ep, timeout=1.0)
+                s.close()
+                return j                # higher-priority standby is alive
+            except OSError:
+                continue
+        return -1
+
+    # ------------------------------------------------------------ promotion
+    def _promote_and_serve(self) -> None:
+        if self._model_blob is None:
+            raise RuntimeError("cannot promote: no model blob mirrored yet")
+        missing = [u.payload_hash.hex()[:12]
+                   for u in self.ledger.query_all_updates()
+                   if u.payload_hash not in self._blobs]
+        if missing and self.verbose:
+            print(f"[standby {self.index}] promoting with {len(missing)} "
+                  f"unmirrored update blobs {missing} — relying on "
+                  f"uploader retries / stall recovery", flush=True)
+        self.server = LedgerServer(
+            self.cfg, self._model_blob,
+            directory=self._directory,
+            require_auth=self.require_auth,
+            stall_timeout_s=self.stall_timeout_s,
+            resume_ledger=self.ledger,
+            resume_blobs=self._blobs,
+            sock=self._sock,
+            tls=self.tls_server,
+            verbose=self.verbose)
+        # open enrollment on the promoted writer: a client the directory
+        # missed re-presents its (self-authenticating) pubkey on register
+        self.server._open_enrollment = True
+        self.promoted.set()
+        if self.verbose:
+            print(f"[standby {self.index}] promoted: serving on "
+                  f"{self.host}:{self.port} at epoch {self.ledger.epoch}",
+                  flush=True)
+        self.server.serve_forever()

@@ -145,11 +145,19 @@ class PublicDirectory:
     def __init__(self):
         _require_ed25519()
         self._keys: Dict[str, "Ed25519PublicKey"] = {}
+        self._raw: Dict[str, bytes] = {}
 
     def enroll(self, public_bytes: bytes) -> str:
         addr = address_of(public_bytes)
         self._keys[addr] = Ed25519PublicKey.from_public_bytes(public_bytes)
+        self._raw[addr] = bytes(public_bytes)
         return addr
+
+    def export_raw(self) -> Dict[str, bytes]:
+        """address -> raw public key bytes — the standby-mirroring surface
+        (public keys are public; addresses are self-authenticating, so an
+        importer re-checks address_of(pub) == addr)."""
+        return dict(self._raw)
 
     def knows(self, address: str) -> bool:
         return address in self._keys
@@ -235,18 +243,24 @@ class AuthenticatedLedger:
 
     # --- authenticated mutations ---
     def _verify(self, kind: str, sender: str, epoch: int, payload: bytes,
-                tag: bytes) -> bool:
+                tag: bytes) -> LedgerStatus:
+        """OK = fresh valid tag; DUPLICATE = valid but already consumed (an
+        honest retry whose reply was lost, or an eavesdropper's replay —
+        either way the op is already in); BAD_ARG = signature failure."""
         if not self._keys.verify(sender, _op_bytes(kind, sender, epoch,
                                                    payload), tag):
-            return False
-        return not self._guard.seen(epoch, tag)
+            return LedgerStatus.BAD_ARG
+        if self._guard.seen(epoch, tag):
+            return LedgerStatus.DUPLICATE
+        return LedgerStatus.OK
 
     def _consume(self, epoch: int, tag: bytes) -> None:
         self._guard.consume(self._inner.epoch, epoch, tag)
 
     def register_node(self, addr: str, tag: bytes) -> LedgerStatus:
-        if not self._verify("register", addr, 0, b"", tag):
-            return LedgerStatus.BAD_ARG
+        v = self._verify("register", addr, 0, b"", tag)
+        if v != LedgerStatus.OK:
+            return v
         st = self._inner.register_node(addr)
         if st == LedgerStatus.OK:
             self._consume(0, tag)
@@ -256,8 +270,9 @@ class AuthenticatedLedger:
                             n_samples: int, avg_cost: float, epoch: int,
                             tag: bytes) -> LedgerStatus:
         body = payload_hash + struct.pack("<qd", n_samples, avg_cost)
-        if not self._verify("upload", sender, epoch, body, tag):
-            return LedgerStatus.BAD_ARG
+        v = self._verify("upload", sender, epoch, body, tag)
+        if v != LedgerStatus.OK:
+            return v
         st = self._inner.upload_local_update(sender, payload_hash,
                                              n_samples, avg_cost, epoch)
         if st == LedgerStatus.OK:
@@ -267,8 +282,9 @@ class AuthenticatedLedger:
     def upload_scores(self, sender: str, epoch: int,
                       scores: Sequence[float], tag: bytes) -> LedgerStatus:
         body = struct.pack(f"<{len(scores)}d", *scores)
-        if not self._verify("scores", sender, epoch, body, tag):
-            return LedgerStatus.BAD_ARG
+        v = self._verify("scores", sender, epoch, body, tag)
+        if v != LedgerStatus.OK:
+            return v
         st = self._inner.upload_scores(sender, epoch, scores)
         if st == LedgerStatus.OK:
             self._consume(epoch, tag)

@@ -84,12 +84,27 @@ class LedgerServer:
                  wal_path: str = "",
                  require_auth: bool = True,
                  stall_timeout_s: float = 10.0,
+                 resume_ledger=None,
+                 resume_blobs: Optional[Dict[bytes, bytes]] = None,
+                 sock: Optional[socket.socket] = None,
+                 tls=None,
                  verbose: bool = False):
+        """resume_ledger/resume_blobs/sock: the promotion surface
+        (comm.failover.Standby) — a server constructed over a replica's
+        replayed ledger, its mirrored blob store, the CURRENT model blob as
+        `initial_model_blob`, and an already-listening socket whose backlog
+        holds the failed-over clients.  `open_enrollment` stays available
+        (a reconnecting client re-presents its pubkey; addresses are
+        self-authenticating)."""
         cfg.validate()
         self.cfg = cfg
         self.verbose = verbose
         self.require_auth = require_auth
         self.stall_timeout_s = stall_timeout_s
+        # ssl.SSLContext (comm.tls.server_context) or None for plaintext;
+        # the handshake happens in the per-connection thread so a stalled
+        # or plaintext peer never blocks the accept loop
+        self._tls = tls
         self._open_enrollment = directory is None
         self.directory = directory if directory is not None \
             else PublicDirectory()
@@ -99,11 +114,12 @@ class LedgerServer:
         # wait on the condition for new log entries
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
-        self.ledger = make_ledger(cfg, backend=ledger_backend)
+        self.ledger = (resume_ledger if resume_ledger is not None
+                       else make_ledger(cfg, backend=ledger_backend))
         if wal_path:
             if not self.ledger.attach_wal(wal_path):
                 raise RuntimeError(f"cannot attach WAL at {wal_path}")
-        self._blobs: Dict[bytes, bytes] = {}
+        self._blobs: Dict[bytes, bytes] = dict(resume_blobs or {})
         self._model_blob = initial_model_blob
         self._model_hash = hashlib.sha256(initial_model_blob).digest()
         # {key: (shape, dtype)} of the current model — the delta admission
@@ -120,10 +136,13 @@ class LedgerServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(64)
+        if sock is not None:
+            self._sock = sock
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
 
     # ------------------------------------------------------------------ run
@@ -164,6 +183,19 @@ class LedgerServer:
 
     # ----------------------------------------------------------- connection
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._tls is not None:
+            import ssl as _ssl
+            try:
+                conn.settimeout(10.0)       # bound the handshake
+                conn = self._tls.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except (_ssl.SSLError, OSError):
+                # plaintext or broken peer: reject at the transport
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         try:
             while not self._stop.is_set():
                 msg = recv_msg(conn)
@@ -213,14 +245,20 @@ class LedgerServer:
         self._last_seen[addr] = time.monotonic()
 
     def _verify(self, kind: str, addr: str, epoch: int, payload: bytes,
-                tag_hex: str) -> bool:
+                tag_hex: str) -> "LedgerStatus":
+        """OK = fresh valid tag; DUPLICATE = valid but consumed (an honest
+        retry whose reply was lost — e.g. across a failover — or a replay;
+        the op is already in either way); BAD_ARG = signature failure.
+        Same tri-state as AuthenticatedLedger._verify."""
         if not self.require_auth:
-            return True
+            return LedgerStatus.OK
         tag = bytes.fromhex(tag_hex)
         if not self.directory.verify(
                 addr, _op_bytes(kind, addr, epoch, payload), tag):
-            return False
-        return not self._replay.seen(epoch, tag)
+            return LedgerStatus.BAD_ARG
+        if self._replay.seen(epoch, tag):
+            return LedgerStatus.DUPLICATE
+        return LedgerStatus.OK
 
     def _consume_tag(self, epoch: int, tag_hex: str) -> None:
         if not self.require_auth:
@@ -244,10 +282,13 @@ class LedgerServer:
                     elif not self.directory.knows(addr):
                         return {"ok": False, "status": "BAD_ARG",
                                 "error": "unknown identity"}
-                    if not self._verify("register", addr, 0, b"",
-                                        m.get("tag", "")):
-                        return {"ok": False, "status": "BAD_ARG",
-                                "error": "bad signature"}
+                    v = self._verify("register", addr, 0, b"",
+                                     m.get("tag", ""))
+                    if v != LedgerStatus.OK:
+                        return {"ok": False, "status": v.name,
+                                "error": "bad signature" if
+                                v == LedgerStatus.BAD_ARG else
+                                "replayed tag"}
                 st = self.ledger.register_node(addr)
                 if st == LedgerStatus.OK:
                     self._consume_tag(0, m.get("tag", ""))
@@ -274,10 +315,14 @@ class LedgerServer:
                             "error": "blob/hash mismatch"}
                 payload = digest + struct.pack("<qd", int(m["n"]),
                                                float(m["cost"]))
-                if not self._verify("upload", addr, int(m["epoch"]), payload,
-                                    m.get("tag", "")):
-                    return {"ok": False, "status": "BAD_ARG",
-                            "error": "bad signature"}
+                v = self._verify("upload", addr, int(m["epoch"]), payload,
+                                 m.get("tag", ""))
+                if v != LedgerStatus.OK:
+                    if v == LedgerStatus.DUPLICATE:
+                        self._resupply_blob(digest, blob)
+                    return {"ok": False, "status": v.name,
+                            "error": "bad signature" if
+                            v == LedgerStatus.BAD_ARG else "replayed tag"}
                 # structural admission check (post-auth so unsigned spam
                 # can't buy blob decodes): a delta whose leaves don't match
                 # the current model must die HERE, not later inside an
@@ -292,6 +337,12 @@ class LedgerServer:
                 if st == LedgerStatus.OK:
                     self._blobs[digest] = blob
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
+                elif st == LedgerStatus.DUPLICATE:
+                    # an honest retry (e.g. across a writer failover) whose
+                    # original reply was lost: the record is in the ledger —
+                    # re-accept the verified payload if the promoted writer
+                    # never mirrored it (comm.failover known window)
+                    self._resupply_blob(digest, blob)
                 self._touch(addr)
                 self._note_progress(st)
                 return {"ok": st == LedgerStatus.OK, "status": st.name}
@@ -310,10 +361,12 @@ class LedgerServer:
                 addr = m["addr"]
                 scores = [float(s) for s in m["scores"]]
                 payload = struct.pack(f"<{len(scores)}d", *scores)
-                if not self._verify("scores", addr, int(m["epoch"]), payload,
-                                    m.get("tag", "")):
-                    return {"ok": False, "status": "BAD_ARG",
-                            "error": "bad signature"}
+                v = self._verify("scores", addr, int(m["epoch"]), payload,
+                                 m.get("tag", ""))
+                if v != LedgerStatus.OK:
+                    return {"ok": False, "status": v.name,
+                            "error": "bad signature" if
+                            v == LedgerStatus.BAD_ARG else "replayed tag"}
                 st = self.ledger.upload_scores(addr, int(m["epoch"]), scores)
                 if st == LedgerStatus.OK:
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
@@ -324,6 +377,12 @@ class LedgerServer:
                 return {"ok": st == LedgerStatus.OK, "status": st.name}
             if method == "committee":
                 return {"ok": True, "committee": self.ledger.committee()}
+            if method == "directory":
+                # enrolled public keys (public data; addresses are
+                # self-authenticating) — the standby-mirroring surface
+                return {"ok": True, "keys": {
+                    a: p.hex()
+                    for a, p in self.directory.export_raw().items()}}
             if method == "info":
                 return {"ok": True, "epoch": self.ledger.epoch,
                         "num_registered": self.ledger.num_registered,
@@ -357,6 +416,16 @@ class LedgerServer:
                     self._cv.wait(timeout=remaining)
                 return {"ok": True, "log_size": self.ledger.log_size()}
             return {"ok": False, "error": f"unknown method {method!r}"}
+
+    def _resupply_blob(self, digest: bytes, blob: bytes) -> None:
+        """Store a hash-verified payload for an update the LEDGER already
+        records but whose blob this writer lacks (a promoted standby inside
+        the one-op mirroring window — comm.failover module docstring)."""
+        if digest in self._blobs:
+            return
+        if any(u.payload_hash == digest
+               for u in self.ledger.query_all_updates()):
+            self._blobs[digest] = blob
 
     def _delta_shape_error(self, blob: bytes) -> str:
         """'' if the delta blob's flat entries mirror the current global
@@ -495,9 +564,12 @@ class CoordinatorClient:
     (client/process_runtime.py); this class only frames messages.
     """
 
-    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 tls=None):
         self.sock = socket.create_connection((host, port),
                                              timeout=timeout_s)
+        if tls is not None:                 # comm.tls.client_context
+            self.sock = tls.wrap_socket(self.sock, server_hostname=host)
 
     def request(self, method: str, **fields) -> dict:
         send_msg(self.sock, {"method": method, **fields})
@@ -515,7 +587,7 @@ class CoordinatorClient:
 
 def replicate(host: str, port: int, cfg: ProtocolConfig,
               ledger_backend: str = "auto", until_ops: int = 0,
-              timeout_s: float = 60.0):
+              timeout_s: float = 60.0, tls=None):
     """Live replica: subscribe to the writer's op stream, replay every op
     into a fresh local ledger, and verify chained-head equality against the
     writer at the end — the multi-node replication consistency contract
@@ -525,7 +597,7 @@ def replicate(host: str, port: int, cfg: ProtocolConfig,
     on divergence/timeout).
     """
     replica = make_ledger(cfg, backend=ledger_backend)
-    sub = CoordinatorClient(host, port, timeout_s=timeout_s)
+    sub = CoordinatorClient(host, port, timeout_s=timeout_s, tls=tls)
     try:
         send_msg(sub.sock, {"method": "subscribe", "from": 0})
         applied = 0
@@ -546,7 +618,7 @@ def replicate(host: str, port: int, cfg: ProtocolConfig,
         sub.close()
     if not replica.verify_log():
         raise RuntimeError("replica chain verification failed")
-    probe = CoordinatorClient(host, port)
+    probe = CoordinatorClient(host, port, tls=tls)
     try:
         info = probe.request("info")
         # when the writer hasn't moved past our view, the chained head must

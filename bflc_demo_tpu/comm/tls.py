@@ -1,0 +1,125 @@
+"""Control-plane TLS: confidentiality for every client<->coordinator byte.
+
+The reference's client<->chain transport is the FISCO Channel protocol —
+TLS with certs provisioned by copying the node's sdk/ directory
+(README.md:240-260).  comm.wire's Ed25519 tags give integrity/authenticity
+but (by documented scope) not confidentiality: score rows, model hashes and
+blob traffic were readable on the wire.  This module closes that gap the
+same way the reference does:
+
+- `provision_tls(dir)` — the cert-copy step: a self-signed CA plus a
+  server key/cert signed by it, written as PEMs (ca.pem, server.pem,
+  server.key).  Idempotent: existing files are reused.
+- `server_context(dir)` / `client_context(dir)` — ssl.SSLContexts for the
+  two ends; the client verifies the server cert against the CA (server
+  authentication + encryption; CLIENT authentication stays with Ed25519 op
+  tags, which also cover the in-process runtimes where there is no socket).
+
+LedgerServer accepts `tls=server_context(...)`; CoordinatorClient and
+FailoverClient accept `tls=client_context(...)`.  A plaintext client
+against a TLS server fails the handshake and is rejected.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+from typing import Tuple
+
+CA_PEM = "ca.pem"
+SERVER_PEM = "server.pem"
+SERVER_KEY = "server.key"
+
+
+def provision_tls(cert_dir: str, common_name: str = "127.0.0.1",
+                  days: int = 365) -> Tuple[str, str, str]:
+    """Write (or reuse) ca.pem / server.pem / server.key under cert_dir.
+
+    Returns the three paths.  The server cert carries SANs for the common
+    name and 127.0.0.1/localhost so loopback deployments verify cleanly.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(cert_dir, exist_ok=True)
+    ca_path = os.path.join(cert_dir, CA_PEM)
+    crt_path = os.path.join(cert_dir, SERVER_PEM)
+    key_path = os.path.join(cert_dir, SERVER_KEY)
+    if all(os.path.exists(p) for p in (ca_path, crt_path, key_path)):
+        return ca_path, crt_path, key_path
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                            "bflc-demo-tpu-ca")])
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(ca_name).issuer_name(ca_name)
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - datetime.timedelta(minutes=5))
+               .not_valid_after(now + datetime.timedelta(days=days))
+               .add_extension(x509.BasicConstraints(ca=True,
+                                                    path_length=0),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+
+    srv_key = ec.generate_private_key(ec.SECP256R1())
+    sans = [x509.DNSName("localhost"), x509.DNSName(common_name)
+            if not _is_ip(common_name) else
+            x509.IPAddress(ipaddress.ip_address(common_name))]
+    sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    srv_cert = (x509.CertificateBuilder()
+                .subject_name(x509.Name([x509.NameAttribute(
+                    NameOID.COMMON_NAME, common_name)]))
+                .issuer_name(ca_name)
+                .public_key(srv_key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=5))
+                .not_valid_after(now + datetime.timedelta(days=days))
+                .add_extension(x509.SubjectAlternativeName(sans),
+                               critical=False)
+                .sign(ca_key, hashes.SHA256()))
+
+    with open(ca_path, "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    with open(crt_path, "wb") as f:
+        f.write(srv_cert.public_bytes(serialization.Encoding.PEM))
+    # 0600: the unencrypted server key must not be world-readable — a local
+    # reader could impersonate the coordinator
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(srv_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+    return ca_path, crt_path, key_path
+
+
+def _is_ip(name: str) -> bool:
+    try:
+        ipaddress.ip_address(name)
+        return True
+    except ValueError:
+        return False
+
+
+def server_context(cert_dir: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(os.path.join(cert_dir, SERVER_PEM),
+                        os.path.join(cert_dir, SERVER_KEY))
+    return ctx
+
+
+def client_context(cert_dir: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_verify_locations(os.path.join(cert_dir, CA_PEM))
+    # loopback deployments connect by IP; the cert carries the IP SAN
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

@@ -72,6 +72,26 @@ def _split(x, y, test_frac=0.2, seed=0):
     return x[n_test:], y[n_test:], x[:n_test], y[:n_test]
 
 
+def config0_mlp_mnist(rounds: int = 10, seed: int = 0, n_data: int = 6000,
+                      cfg: Optional[ProtocolConfig] = None,
+                      **kw) -> SimulationResult:
+    """BASELINE configs[0]: 2-layer MLP on MNIST(-shaped) data, 4-client
+    IID FedAvg.  Protocol geometry shrinks with the fleet: all 4 clients
+    upload, 2 score, top-2 merge (committee mechanics retained, scaled).
+    Real arrays load from $BFLC_DATA_DIR/mnist.npz when present
+    (data/synthetic.py), seeded synthetic otherwise.
+    """
+    cfg = (cfg or ProtocolConfig(
+        client_num=4, comm_count=2, aggregate_count=2,
+        needed_update_count=2, learning_rate=0.05,
+        batch_size=32, local_epochs=2)).validate()
+    x, y = synthetic_mnist(n_data, seed)
+    xtr, ytr, xte, yte = _split(x, y)
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    return run_with_runtime(make_mlp(), shards, (xte, yte), cfg,
+                            rounds=rounds, seed=seed, **kw)
+
+
 def config1_occupancy(rounds: int = 10, seed: int = 0,
                       cfg: Optional[ProtocolConfig] = None,
                       **kw) -> SimulationResult:
@@ -133,8 +153,15 @@ def config3_femnist_sampled(rounds: int = 10, seed: int = 0,
 def config4_resnet_cifar100(rounds: int = 5, seed: int = 0,
                             n_data: int = 4000,
                             cfg: Optional[ProtocolConfig] = None,
+                            secure: bool = False,
                             **kw) -> SimulationResult:
-    """ResNet-18, CIFAR-100 shapes, 32-client cross-silo."""
+    """ResNet-18, CIFAR-100 shapes, 32-client cross-silo.
+
+    secure=True is BASELINE configs[3]'s secure-aggregation variant: each
+    silo's delta is blinded with X25519-keyed pairwise masks before the
+    merge psum (parallel.secure; wallets provisioned per run), so the
+    aggregator verifies uploads yet never sees an individual contribution.
+    """
     cfg = (cfg or ProtocolConfig(
         client_num=32, comm_count=4, aggregate_count=8,
         needed_update_count=12, learning_rate=0.1,
@@ -149,6 +176,14 @@ def config4_resnet_cifar100(rounds: int = 5, seed: int = 0,
         kw.setdefault("participation", "active")
         kw.setdefault("client_chunk", 4)
         kw.setdefault("remat", True)
+        if secure:
+            from bflc_demo_tpu.comm.identity import provision_wallets
+            wallets, _ = provision_wallets(cfg.client_num,
+                                           b"config4-secure-seed-0001")
+            kw.setdefault("secure_aggregation", True)
+            kw.setdefault("secure_wallets", wallets)
+    elif secure:
+        raise ValueError("secure aggregation runs on the mesh runtime")
     return run_with_runtime(make_resnet18(), shards, (xte, yte), cfg,
                             rounds=rounds, seed=seed, **kw)
 
@@ -176,6 +211,8 @@ def config5_transformer_sst2(rounds: int = 5, seed: int = 0,
 
 
 CONFIGS: Dict[str, BenchConfig] = {
+    "config0": BenchConfig("config0", "MLP/MNIST 4-client IID (BASELINE[0])",
+                           config0_mlp_mnist),
     "config1": BenchConfig("config1", "softmax/occupancy 20-client (parity)",
                            config1_occupancy),
     "config2": BenchConfig("config2", "LeNet-5/CIFAR-10 20-client non-IID",

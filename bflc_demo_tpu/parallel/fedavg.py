@@ -168,15 +168,27 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                                 client_num: int, lr: float, batch_size: int,
                                 local_epochs: int, aggregate_count: int,
                                 client_chunk: int = 0, remat: bool = False,
+                                secure: bool = False,
+                                secure_dh: bool = False,
+                                secure_clip: float = 64.0,
                                 ) -> Callable[..., ShardedRoundResult]:
     """Build the jitted full-round SPMD program for a fixed geometry.
 
     Returned fn signature:
         fn(params, xs, ys, n_samples, uploader_mask, committee_mask)
+    — plus a trailing `secure_key` argument when secure=True —
     with xs: (N, S, *feat), ys: (N, S, C) sharded over the client axis;
     masks/(N,) replicated.  Every client trains; `uploader_mask` picks which
     slots constitute the round's K updates (the async first-come-10 of
     .cpp:239-244 becomes a static mask), `committee_mask` picks scorer rows.
+
+    secure=True swaps step 4's plain psum FedAvg for the pairwise-masked
+    fixed-point merge (parallel.secure.secure_fedavg_body): each slot's
+    weighted delta is blinded before the psum, so no observer of any single
+    contribution — including the aggregator in DH mode — learns it.
+    secure_dh selects the key mode the trailing argument carries: a
+    replicated PRNG round key (False) or the (N, N, 8) X25519 pair-seed
+    matrix (True, the aggregator-cannot-strip trust model).
 
     Memory controls for big model families (one device hosting many logical
     clients multiplies training-activation memory by clients/device):
@@ -196,7 +208,8 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                          f"client_chunk {client_chunk}")
     k = aggregate_count
 
-    def body(params, xs, ys, n_samples, uploader_mask, committee_mask):
+    def body(params, xs, ys, n_samples, uploader_mask, committee_mask,
+             secure_key):
         n_local = xs.shape[0]
         my = jax.lax.axis_index(AXIS)
 
@@ -241,10 +254,18 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
         n_sel = jnp.maximum(jnp.sum(sel.astype(costs.dtype)), 1.0)
         g_loss = jnp.sum(costs * sel.astype(costs.dtype)) / n_sel
 
-        # 4. masked weighted FedAvg as a psum over the client axis
+        # 4. masked weighted FedAvg as a psum over the client axis —
+        #    pairwise-blinded fixed-point in secure mode
         sel_local = jax.lax.dynamic_slice(sel, (my * n_local,), (n_local,))
-        new_params = _psum_fedavg_body(params, deltas_local, n_samples,
-                                       sel_local, lr)
+        if secure:
+            from bflc_demo_tpu.parallel.secure import secure_fedavg_body
+            new_params = secure_fedavg_body(
+                params, deltas_local, n_samples, sel_local, lr, secure_key,
+                axis=AXIS, n_total=client_num, clip=secure_clip,
+                dh_mode=secure_dh)
+        else:
+            new_params = _psum_fedavg_body(params, deltas_local, n_samples,
+                                           sel_local, lr)
 
         # 5. on-device payload ids: per-delta + new-model fingerprints, so the
         #    host ledger records 32-byte hashes without any tensor transfer
@@ -260,9 +281,21 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
     # mesh-size-invariance test asserts the replication property instead.
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
         out_specs=P(), check_vma=False)
-    return jax.jit(fn)
+    jfn = jax.jit(fn)
+    if secure:
+        return jfn                      # caller supplies the trailing key
+    _dummy = jax.random.PRNGKey(0)      # untouched when secure=False
+
+    def plain(params, xs, ys, n_samples, uploader_mask, committee_mask):
+        return jfn(params, xs, ys, n_samples, uploader_mask, committee_mask,
+                   _dummy)
+    # AOT surface for cost analysis (eval.mfu): lower/compile the round
+    # with real args once, read XLA's FLOPs estimate, reuse the executable
+    plain._jitted = jfn
+    plain._dummy = _dummy
+    return plain
 
 
 class MultiRoundResult(NamedTuple):

@@ -1,27 +1,29 @@
 """Pipeline parallelism: transformer depth staged over a "pp" mesh axis.
 
-GPipe-style microbatch schedule under shard_map: stage s owns depth/pp
-consecutive blocks (the stacked block parameters are sharded over "pp" so
-each device stores only its stages' weights); activations flow stage to
-stage with `lax.ppermute` while M microbatches stream through, so after
-M + pp - 1 steps every microbatch has crossed every stage.  Stage 0 embeds,
-the last stage pools and classifies; the final psum broadcasts the logits.
+TWO schedules:
 
-Reverse-mode autodiff works through the schedule (ppermute transposes to the
-reverse permutation), so the same program is trainable — demonstrated in
-tests with a grad check against the single-device forward.
+- **GPipe** (`make_pp_transformer_forward`): forward-only streaming; the
+  trainable path is reverse-mode autodiff through the schedule, which
+  stores one activation per loop step — memory grows with the microbatch
+  count M.  On TPU under XLA the whole (M + pp - 1)-step loop is one
+  compiled program, so the bandwidth overlap 1F1B hand-creates in eager
+  frameworks already happens here (async ppermute DMA + latency hiding).
+- **1F1B** (`make_pp_1f1b_train_step`): an explicit-vjp training schedule
+  where each step runs one forward AND one backward microbatch per stage.
+  Only stage INPUTS are buffered (recompute-on-backward), and a microbatch's
+  input is freed as soon as its backward fires, so the live-activation
+  window is at most 2·pp - 1 slots — INDEPENDENT of M.  That is the lever
+  that matters at fixed HBM: GPipe's bubble (pp-1)/(M+pp-1) shrinks only
+  with M, but GPipe's memory grows with M; 1F1B holds memory flat so M can
+  grow to ≥ 4·pp and beyond, buying the smaller bubble GPipe cannot afford
+  at the same budget.  `schedule_stats` is the analytic model of exactly
+  this trade, and the test suite asserts 1F1B's bubble < GPipe's at equal
+  activation memory once M ≥ 4·pp.
 
-Why GPipe-shaped rather than a hand-scheduled 1F1B: on TPU under XLA the
-whole (m + pp - 1)-step loop is one compiled program — XLA already
-overlaps each stage's ppermute DMA with the next microbatch's compute
-(async collective + latency hiding), which is the bandwidth overlap 1F1B
-hand-creates in eager frameworks.  What 1F1B uniquely buys is a smaller
-activation working set (pp in-flight microbatches instead of m); the
-TPU-idiomatic lever for the same memory is `jax.checkpoint` around
-`run_stage` (remat is a flag on the protocol-round builders), which keeps
-the schedule compiler-visible instead of fighting the scheduler.  Revisit
-only if pp becomes the headline axis at depth where remat's recompute cost
-beats 1F1B's bubble.
+Both schedules live under shard_map: stage s owns depth/pp consecutive
+blocks (stacked block params sharded over "pp"), activations flow
+stage-to-stage with `lax.ppermute` (cotangents ride the reverse
+permutation), stage 0 embeds, the last stage pools/classifies.
 """
 
 from __future__ import annotations
@@ -144,5 +146,226 @@ def make_pp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
                            out_specs=P(), check_vma=False)
             cache[key] = jax.jit(fn)
         return cache[key](params, tokens)
+
+    return run
+
+
+# --------------------------------------------------------------------- 1F1B
+def schedule_stats(kind: str, m: int, p: int) -> dict:
+    """Analytic schedule model (per stage, in microbatch work-slots).
+
+    peak_live_microbatches: stage-input activations resident at once —
+    GPipe's trainable path stores every in-flight microbatch (M, via
+    autodiff through the streaming loop), 1F1B frees each input at its
+    backward so the window is ≤ 2p-1 regardless of M.
+    bubble_fraction: idle fraction of the schedule's work-slots, with a
+    backward costed at 2 forward-slots (the standard accounting); use
+    `bubble_at_memory_budget` for the at-equal-memory comparison that is
+    the schedules' real differentiator (see module docstring).
+    """
+    if kind == "gpipe":
+        steps = 2 * (m + p - 1)
+        peak = m
+    elif kind == "1f1b":
+        steps = m + 2 * (p - 1)
+        peak = min(m, 2 * p - 1)
+    else:
+        raise ValueError(f"kind must be gpipe|1f1b, got {kind!r}")
+    return {"steps": steps, "peak_live_microbatches": peak,
+            "bubble_fraction": (p - 1) / (m + p - 1)}
+
+
+def bubble_at_memory_budget(kind: str, budget: int, p: int,
+                            want_m: int) -> float:
+    """Bubble fraction when running `want_m` microbatches under a memory
+    budget of `budget` live stage-inputs; the schedule runs the largest
+    M ≤ want_m it can fit (GPipe: M ≤ budget; 1F1B: any M once budget
+    ≥ 2p-1, else M ≤ budget)."""
+    if kind == "gpipe":
+        m = min(want_m, budget)
+    elif kind == "1f1b":
+        m = want_m if budget >= min(2 * p - 1, want_m) else min(want_m,
+                                                                budget)
+    else:
+        raise ValueError(f"kind must be gpipe|1f1b, got {kind!r}")
+    return (p - 1) / (m + p - 1)
+
+
+def make_pp_1f1b_train_step(mesh: Mesh, cfg: TransformerConfig,
+                            microbatches: int, lr: float,
+                            ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                          "tuple[Pytree, jax.Array]"]:
+    """One SGD step over M microbatches with the 1F1B schedule.
+
+    step(params_stacked, tokens (B, S), labels_onehot (B, C))
+        -> (new_params_stacked, mean_loss)
+
+    Per grid step t, stage s runs forward for microbatch f = t - s and
+    backward for microbatch b = t - 2(p-1) + s (each when in range): the
+    classic non-interleaved 1F1B timetable, where the last stage's backward
+    fires the same step as its forward and stage 0's trails by 2(p-1).
+    Activations: only the stage INPUT is buffered (ring buffer of 2p-1
+    slots, freed at backward); the stage forward is recomputed inside the
+    backward's vjp — recompute-1F1B, the standard memory-bound variant.
+    Collectives per step: one ppermute forward (activations) + one reverse
+    (cotangents).  Gradients: block grads stay stage-local (sharded over
+    pp); embed/pos (stage 0) and ln_f/head (last stage) grads psum over pp
+    onto the replicated leaves.  Loss is the microbatch-mean CE, identical
+    to the single-device batch loss (layernorm has no cross-microbatch
+    state), which the tests assert along with parameter equality after the
+    update.
+    """
+    n_pp = mesh.shape[PP_AXIS]
+    if cfg.depth % n_pp:
+        raise ValueError(f"depth {cfg.depth} not divisible by pp axis "
+                         f"{n_pp}")
+    m = microbatches
+    p = n_pp
+    q_slots = 2 * p - 1
+    perm_fwd = [(j, (j + 1) % p) for j in range(p)]
+    perm_bwd = [(j, (j - 1) % p) for j in range(p)]
+    total_steps = m + 2 * (p - 1)
+
+    def body(params, tokens, labels):
+        stage = jax.lax.axis_index(PP_AXIS)
+        last = p - 1
+        b, s = tokens.shape
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by microbatches {m}")
+        mb = b // m
+        dt = cfg.dtype
+        tok_mb = tokens.reshape(m, mb, s)
+        lab_mb = labels.reshape(m, mb, -1)
+        my_blocks = params["blocks"]
+
+        def stage_fwd(blocks_p, x, pad):
+            def one_block(x, bp):
+                return block_forward(x, pad, bp, cfg), None
+            x, _ = jax.lax.scan(one_block, x, blocks_p)
+            return x
+
+        def embed_fn(emb_p, toks):
+            return emb_p["embed"].astype(dt)[toks] + \
+                emb_p["pos"].astype(dt)[None, :s]
+
+        def tail_fn(tail_p, y, pad, lab):
+            """Last-stage head: pooled CE for one microbatch, pre-scaled by
+            1/m so summing over microbatches gives the batch mean."""
+            xf = layer_norm(y, tail_p["ln_f"], jnp.float32)
+            denom = jnp.maximum(pad.sum(-1, keepdims=True),
+                                1).astype(jnp.float32)
+            pooled = (xf * pad[..., None]).sum(1) / denom
+            logits = pooled @ tail_p["head_w"] + tail_p["head_b"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.sum(lab * logp, axis=-1)) / m
+
+        embed_leaves = {"embed": params["embed"], "pos": params["pos"]}
+        tail_leaves = {"ln_f": params["ln_f"], "head_w": params["head_w"],
+                       "head_b": params["head_b"]}
+        zero_grads = jax.tree_util.tree_map(
+            jnp.zeros_like,
+            {"blocks": my_blocks, "emb": embed_leaves, "tail": tail_leaves})
+
+        def step(t, carry):
+            act_in, cot_in, buf, grads, loss_acc = carry
+
+            # ---------------- forward slot: microbatch f = t - stage
+            f = t - stage
+            f_valid = (f >= 0) & (f < m)
+            f_idx = jnp.clip(f, 0, m - 1)
+            toks_f = jnp.take(tok_mb, f_idx, axis=0)
+            pad_f = toks_f != 0
+            x_in = jnp.where(stage == 0, embed_fn(embed_leaves, toks_f),
+                             act_in)
+            buf = jnp.where(f_valid,
+                            buf.at[f_idx % q_slots].set(x_in), buf)
+            y_out = stage_fwd(my_blocks, x_in, pad_f)
+
+            # ---------------- backward slot: microbatch bb = t-2(p-1)+stage
+            bb = t - 2 * (p - 1) + stage
+            b_valid = (bb >= 0) & (bb < m)
+            b_idx = jnp.clip(bb, 0, m - 1)
+            x_saved = jnp.take(buf, b_idx % q_slots, axis=0)
+            toks_b = jnp.take(tok_mb, b_idx, axis=0)
+            lab_b = jnp.take(lab_mb, b_idx, axis=0)
+            pad_b = toks_b != 0
+
+            # recompute this stage's forward under vjp (recompute-1F1B)
+            y_b, blocks_vjp = jax.vjp(
+                lambda bp, x: stage_fwd(bp, x, pad_b), my_blocks, x_saved)
+            # last stage: cotangent comes from its own tail (same step);
+            # other stages: from the next stage via the reverse ppermute
+            loss_b, tail_vjp = jax.vjp(
+                lambda tp, y: tail_fn(tp, y, pad_b, lab_b), tail_leaves, y_b)
+            dtail, dy_tail = tail_vjp(jnp.ones((), jnp.float32))
+            cot = jnp.where(stage == last, dy_tail.astype(dt),
+                            cot_in).astype(y_b.dtype)
+            dblocks, dx = blocks_vjp(cot)
+            (demb,) = jax.vjp(
+                lambda ep: embed_fn(ep, toks_b), embed_leaves)[1](dx)
+
+            bmask = b_valid.astype(jnp.float32)
+            grads = {
+                "blocks": jax.tree_util.tree_map(
+                    lambda g, d: g + bmask * d.astype(g.dtype),
+                    grads["blocks"], dblocks),
+                "emb": jax.tree_util.tree_map(
+                    lambda g, d: g + (bmask * (stage == 0)) * d.astype(
+                        g.dtype), grads["emb"], demb),
+                "tail": jax.tree_util.tree_map(
+                    lambda g, d: g + (bmask * (stage == last)) * d.astype(
+                        g.dtype), grads["tail"], dtail),
+            }
+            loss_acc = loss_acc + bmask * (stage == last) * loss_b
+
+            act_next = jax.lax.ppermute(y_out.astype(dt), PP_AXIS, perm_fwd)
+            cot_next = jax.lax.ppermute(dx.astype(dt), PP_AXIS, perm_bwd)
+            return act_next, cot_next, buf, grads, loss_acc
+
+        act0 = pvary_compat(jnp.zeros((mb, s, cfg.dim), dt), (PP_AXIS,))
+        cot0 = pvary_compat(jnp.zeros((mb, s, cfg.dim), dt), (PP_AXIS,))
+        buf0 = pvary_compat(jnp.zeros((q_slots, mb, s, cfg.dim), dt),
+                            (PP_AXIS,))
+        zg = jax.tree_util.tree_map(
+            lambda z: pvary_compat(z, (PP_AXIS,)), zero_grads)
+        _, _, _, grads, loss_acc = jax.lax.fori_loop(
+            0, total_steps, step,
+            (act0, cot0, buf0, zg, pvary_compat(
+                jnp.zeros((), jnp.float32), (PP_AXIS,))))
+
+        # replicated leaves: grads live on one stage each — psum replicates
+        emb_g = jax.tree_util.tree_map(lambda g: jax.lax.psum(g, PP_AXIS),
+                                       grads["emb"])
+        tail_g = jax.tree_util.tree_map(lambda g: jax.lax.psum(g, PP_AXIS),
+                                        grads["tail"])
+        loss = jax.lax.psum(loss_acc, PP_AXIS)
+
+        new_params = dict(params)
+        new_params["blocks"] = jax.tree_util.tree_map(
+            lambda w, g: w - jnp.asarray(lr, w.dtype) * g.astype(w.dtype),
+            params["blocks"], grads["blocks"])
+        for name, g in (("embed", emb_g["embed"]), ("pos", emb_g["pos"])):
+            new_params[name] = params[name] - jnp.asarray(
+                lr, params[name].dtype) * g.astype(params[name].dtype)
+        new_params["ln_f"] = jax.tree_util.tree_map(
+            lambda w, g: w - jnp.asarray(lr, w.dtype) * g.astype(w.dtype),
+            params["ln_f"], tail_g["ln_f"])
+        for name in ("head_w", "head_b"):
+            new_params[name] = params[name] - jnp.asarray(
+                lr, params[name].dtype) * tail_g[name].astype(
+                    params[name].dtype)
+        return new_params, loss
+
+    cache = {}
+
+    def run(params, tokens, labels):
+        key = jax.tree_util.tree_structure(params)
+        if key not in cache:
+            specs = pp_partition_specs(params)
+            fn = shard_map(body, mesh=mesh,
+                           in_specs=(specs, P(), P()),
+                           out_specs=(specs, P()), check_vma=False)
+            cache[key] = jax.jit(fn)
+        return cache[key](params, tokens, labels)
 
     return run

@@ -53,18 +53,27 @@ def _pair_mask(pair_key: jax.Array, shape) -> jax.Array:
 
 
 def _client_mask(round_key: jax.Array, i: jax.Array, n: int,
-                 shape) -> jax.Array:
+                 shape, leaf_idx: int) -> jax.Array:
     """Sum of signed pairwise masks for client i (mod 2^32).
 
     mask_i = sum_{j>i} PRG(k_ij) - sum_{j<i} PRG(k_ij); summed over all
     clients the terms cancel pairwise.  Pair key is derived from the
     unordered pair id so both endpoints derive the same mask.
+
+    `leaf_idx` folds the pytree leaf position into the key: without it,
+    every same-shape leaf of one client's delta would be blinded with
+    IDENTICAL mask bits, and (masked_A - masked_B) would leak the exact
+    cross-leaf difference of the individual contribution — precisely what
+    the masking exists to hide (ResNet deltas repeat conv-kernel shapes
+    many times over).
     """
     def body(j, acc):
         lo = jnp.minimum(i, j)
         hi = jnp.maximum(i, j)
         pair_id = lo * n + hi
-        m = _pair_mask(jax.random.fold_in(round_key, pair_id), shape)
+        key = jax.random.fold_in(jax.random.fold_in(round_key, pair_id),
+                                 leaf_idx)
+        m = _pair_mask(key, shape)
         contrib = jnp.where(j > i, m, jnp.uint32(0) - m)
         return jnp.where(j == i, acc, acc + contrib)
 
@@ -73,11 +82,12 @@ def _client_mask(round_key: jax.Array, i: jax.Array, n: int,
 
 
 def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
-                    shape) -> jax.Array:
+                    shape, leaf_idx: int) -> jax.Array:
     """DH-keyed variant of `_client_mask`: the pair key comes from the
     (N, N, 8) uint32 seed matrix (X25519-derived, `derive_pair_seeds`)
     instead of a shared round key.  Seed symmetry (seeds[i,j] == seeds[j,i])
-    gives both endpoints the same mask; the signed sum cancels identically.
+    gives both endpoints the same mask; the signed sum cancels identically;
+    `leaf_idx` de-duplicates same-shape leaves exactly as in _client_mask.
 
     All 8 words (the full 256-bit hashed shared secret) are chain-folded
     into the key, so per-pair mask secrecy is bounded by the 256-bit DH
@@ -93,6 +103,7 @@ def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
         key = base
         for word in range(8):           # static unroll: 8 words, fixed
             key = jax.random.fold_in(key, s[word])
+        key = jax.random.fold_in(key, leaf_idx)
         m = _pair_mask(key, shape)
         contrib = jnp.where(j > i, m, jnp.uint32(0) - m)
         return jnp.where(j == i, acc, acc + contrib)
@@ -169,7 +180,7 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
         n_local = jax.tree_util.tree_leaves(vals)[0].shape[0]
         my = jax.lax.axis_index(AXIS)
 
-        def one_leaf(leaf):
+        def one_leaf(leaf, leaf_idx):
             shape = leaf.shape[1:]
 
             def mask_one(local_idx, acc):
@@ -177,9 +188,11 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
                 fx = jnp.clip(leaf[local_idx].astype(jnp.float32),
                               -clip, clip)
                 q = jnp.round(fx * _SCALE).astype(jnp.int32)
-                mask = (_client_mask_dh(key_or_seeds, client, n_total, shape)
+                mask = (_client_mask_dh(key_or_seeds, client, n_total,
+                                        shape, leaf_idx)
                         if dh_mode else
-                        _client_mask(key_or_seeds, client, n_total, shape))
+                        _client_mask(key_or_seeds, client, n_total, shape,
+                                     leaf_idx))
                 return acc + q.astype(jnp.uint32) + mask
 
             total = jax.lax.fori_loop(
@@ -187,7 +200,12 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
             total = jax.lax.psum(total, AXIS)   # masks cancel mod 2^32 here
             return (total.astype(jnp.int32).astype(jnp.float32) / _SCALE)
 
-        return jax.tree_util.tree_map(one_leaf, vals)
+        # flatten so each leaf gets a distinct index into the mask key —
+        # tree order is deterministic, so every client derives the same
+        # leaf_idx for the same leaf and cancellation is preserved
+        leaves, treedef = jax.tree_util.tree_flatten(vals)
+        out = [one_leaf(leaf, idx) for idx, leaf in enumerate(leaves)]
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # build-once per (mesh, structure, shapes, clip, mode): round_key /
     # pair_seeds are ARGUMENTS so a new round never retraces.  Mesh is
@@ -205,6 +223,74 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
         values, pair_seeds if dh_mode else round_key)
 
 
+def secure_fedavg_body(params: Pytree, deltas_local: Pytree,
+                       n_local: jax.Array, sel_local: jax.Array, lr,
+                       key_or_seeds: jax.Array, *, axis: str, n_total: int,
+                       clip: float, dh_mode: bool) -> Pytree:
+    """Inside-shard_map secure FedAvg — callable from an ENCLOSING shard_map
+    (the full-round program, parallel/fedavg.py) so the protocol round can
+    blind its merge without a second dispatch.  The single definition of the
+    clip -> weight -> mask -> psum -> unmask algebra; the standalone
+    `secure_fedavg` wraps this same body, so the two paths cannot drift.
+
+    deltas_local/n_local/sel_local: this device's client shard (leading axis
+    n_total/axis_size).  key_or_seeds: replicated round key (shared-key
+    mode) or the (N, N, 8) DH seed matrix.  Capacity: weighted values are
+    bounded by `clip` (weights sum to 1), which must stay below the int32
+    fixed-point ceiling — checked statically here.
+    """
+    if clip >= float(1 << (31 - _FRAC_BITS)):
+        raise ValueError(
+            f"fixed-point capacity exceeded: clip {clip:g} >= "
+            f"{1 << (31 - _FRAC_BITS)}")
+    my = jax.lax.axis_index(axis)
+    n_loc = jax.tree_util.tree_leaves(deltas_local)[0].shape[0]
+    w = n_local.astype(jnp.float32) * sel_local.astype(jnp.float32)
+    wsum = jnp.maximum(jax.lax.psum(jnp.sum(w), axis), 1e-12)
+    # Clip each delta BEFORE the weighting: |clip(d_i)·w_i/Σw| <= clip·w_i/Σw,
+    # so the weighted sum really is bounded by clip for any N.  (Clipping
+    # only after weighting would let N adversarial clients contribute ±clip
+    # each, wrapping the int32 fixed-point psum past its 2^15 capacity.)
+    # nan_to_num first: clip propagates NaN, and the int32 fixed-point cast
+    # of NaN is implementation-defined — one NaN delta would corrupt the
+    # whole masked psum
+    wn = (w / wsum)
+
+    def one_leaf(leaf, leaf_idx):
+        shape = leaf.shape[1:]
+        fx_all = jnp.clip(jnp.nan_to_num(leaf.astype(jnp.float32), nan=0.0,
+                                         posinf=clip, neginf=-clip),
+                          -clip, clip)
+        fx_all = fx_all * wn.reshape((-1,) + (1,) * (len(shape)))
+        # second clip mirrors secure_masked_sum's encoder exactly (weighted
+        # values already lie inside ±clip, so this is a no-op numerically)
+        fx_all = jnp.clip(fx_all, -clip, clip)
+
+        def mask_one(local_idx, acc):
+            client = my * n_loc + local_idx
+            q = jnp.round(fx_all[local_idx] * _SCALE).astype(jnp.int32)
+            mask = (_client_mask_dh(key_or_seeds, client, n_total, shape,
+                                    leaf_idx)
+                    if dh_mode else
+                    _client_mask(key_or_seeds, client, n_total, shape,
+                                 leaf_idx))
+            return acc + q.astype(jnp.uint32) + mask
+
+        total = jax.lax.fori_loop(0, n_loc, mask_one,
+                                  jnp.zeros(shape, jnp.uint32))
+        total = jax.lax.psum(total, axis)    # masks cancel mod 2^32 here
+        return total.astype(jnp.int32).astype(jnp.float32) / _SCALE
+
+    # per-leaf key salt over the deterministic flatten order (see
+    # _client_mask: identical-shape leaves must NOT share mask bits)
+    leaves, treedef = jax.tree_util.tree_flatten(deltas_local)
+    mean_leaves = [one_leaf(leaf, idx) for idx, leaf in enumerate(leaves)]
+    mean_delta = jax.tree_util.tree_unflatten(treedef, mean_leaves)
+    return jax.tree_util.tree_map(
+        lambda g, m: g - jnp.asarray(lr, g.dtype) * m.astype(g.dtype),
+        params, mean_delta)
+
+
 def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
                   sel_mask: jax.Array, global_params: Pytree, lr: float,
                   round_key: jax.Array, clip: float = 64.0,
@@ -214,28 +300,29 @@ def secure_fedavg(mesh: Mesh, deltas: Pytree, n_samples: jax.Array,
     the module threat-model modes; pass `pair_seeds` for the DH mode the
     aggregator cannot strip).  Semantics match `apply_selection` up to
     fixed-point quantisation and per-delta clipping at ±clip.
+
+    Standalone-dispatch wrapper over `secure_fedavg_body`.
     """
-    w = (n_samples.astype(jnp.float32) * sel_mask.astype(jnp.float32))
-    wsum = jnp.maximum(jnp.sum(w), 1e-12)
-    # Clip each delta BEFORE the weighting: |clip(d_i)·w_i/Σw| <= clip·w_i/Σw,
-    # so the weighted sum really is bounded by clip and sum_bound=clip below
-    # is sound for any N.  (Clipping only after weighting let N adversarial
-    # clients contribute ±clip each, wrapping the int32 fixed-point psum past
-    # its 2^15 capacity despite the guard.)
-    # nan_to_num first: clip propagates NaN, and the int32 fixed-point cast
-    # of NaN is implementation-defined — one NaN delta would corrupt the
-    # whole masked psum
-    clipped = jax.tree_util.tree_map(
-        lambda d: jnp.clip(jnp.nan_to_num(d.astype(jnp.float32), nan=0.0,
-                                          posinf=clip, neginf=-clip),
-                           -clip, clip), deltas)
-    # weight each client's delta BEFORE masking so the masked sum is the
-    # numerator of the weighted mean; normalise after unmasking
-    weighted = jax.tree_util.tree_map(
-        lambda d: d * (w / wsum).reshape((-1,) + (1,) * (d.ndim - 1)),
-        clipped)
-    mean_delta = secure_masked_sum(mesh, weighted, round_key, clip=clip,
-                                   sum_bound=clip, pair_seeds=pair_seeds)
-    return jax.tree_util.tree_map(
-        lambda g, m: g - jnp.asarray(lr, g.dtype) * m, global_params,
-        mean_delta)
+    n_total = jax.tree_util.tree_leaves(deltas)[0].shape[0]
+    dh_mode = pair_seeds is not None
+    if dh_mode and tuple(pair_seeds.shape) != (n_total, n_total, 8):
+        raise ValueError(f"pair_seeds must be ({n_total}, {n_total}, 8), "
+                         f"got {tuple(pair_seeds.shape)}")
+
+    def body(params, d, n, sel, key_or_seeds):
+        return secure_fedavg_body(params, d, n, sel, lr, key_or_seeds,
+                                  axis=AXIS, n_total=n_total, clip=clip,
+                                  dh_mode=dh_mode)
+
+    cache_key = ("fedavg", mesh, jax.tree_util.tree_structure(deltas),
+                 tuple(jax.tree_util.tree_leaves(
+                     jax.tree_util.tree_map(lambda x: x.shape, deltas))),
+                 float(lr), float(clip), dh_mode)
+    if cache_key not in _PROGRAM_CACHE:
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P()),
+                       out_specs=P(), check_vma=False)
+        _PROGRAM_CACHE[cache_key] = jax.jit(fn)
+    return _PROGRAM_CACHE[cache_key](
+        global_params, deltas, n_samples, sel_mask,
+        pair_seeds if dh_mode else round_key)

@@ -1,0 +1,181 @@
+"""Writer failover: hot-standby promotion + client fail-over.
+
+The reference has no single point of failure — all 4 PBFT nodes execute
+every op, so the chain serves through node loss (README.md:162-183).  These
+tests prove the TPU-native equivalent: a Standby follows the writer's op
+stream live, the writer dies mid-federation, the standby promotes over the
+SAME hash chain, and clients (FailoverClient) finish the run against it.
+"""
+
+import hashlib
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.comm.failover import FailoverClient, Standby
+from bflc_demo_tpu.comm.identity import provision_wallets, _op_bytes
+from bflc_demo_tpu.comm.ledger_service import LedgerServer
+from bflc_demo_tpu.protocol import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import pack_pytree
+
+CFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                     needed_update_count=3, learning_rate=0.05,
+                     batch_size=16)
+
+
+def _init_blob():
+    return pack_pytree({"W": np.zeros((5, 2), np.float32),
+                        "b": np.zeros((2,), np.float32)})
+
+
+def _sign(w, kind, epoch, payload):
+    return w.sign(_op_bytes(kind, w.address, epoch, payload)).hex()
+
+
+def _delta_blob(v):
+    return pack_pytree({"W": np.full((5, 2), v, np.float32),
+                        "b": np.zeros((2,), np.float32)})
+
+
+def _drive_round(client, wallets, epoch):
+    """One full protocol round through signed requests: uploads by the
+    first `needed_update_count` non-committee wallets, then committee
+    scores (triggers aggregation + commit)."""
+    committee = set(client.request("committee")["committee"])
+    trainers = [w for w in wallets if w.address not in committee]
+    ups = []
+    for i, w in enumerate(trainers[: CFG.needed_update_count]):
+        blob = _delta_blob(float(i + 1) * 0.1 + epoch)
+        digest = hashlib.sha256(blob).digest()
+        payload = digest + struct.pack("<qd", 10 + i, 1.0)
+        r = client.request("upload", addr=w.address, blob=blob.hex(),
+                           hash=digest.hex(), n=10 + i, cost=1.0,
+                           epoch=epoch,
+                           tag=_sign(w, "upload", epoch, payload))
+        assert r["ok"] or r["status"] == "DUPLICATE", r
+        ups.append(w.address)
+    comm_wallets = [w for w in wallets if w.address in committee]
+    n_up = CFG.needed_update_count
+    for j, w in enumerate(comm_wallets):
+        scores = [0.5 + 0.01 * (j + u) for u in range(n_up)]
+        payload = struct.pack(f"<{n_up}d", *scores)
+        r = client.request("scores", addr=w.address, epoch=epoch,
+                           scores=scores,
+                           tag=_sign(w, "scores", epoch, payload))
+        assert r["ok"] or r["status"] in ("DUPLICATE", "WRONG_EPOCH"), r
+
+
+class TestInThreadPromotion:
+    def test_standby_promotes_and_continues_the_chain(self):
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"failover-master-0001")
+        srv = LedgerServer(CFG, _init_blob(), directory=directory,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        standby = Standby(CFG, [(srv.host, srv.port), ("127.0.0.1", 0)], 1,
+                          heartbeat_s=0.3, stall_timeout_s=60.0,
+                          ledger_backend="python")
+        standby.endpoints[1] = (standby.host, standby.port)
+        st = threading.Thread(target=standby.run, daemon=True)
+        st.start()
+
+        endpoints = [(srv.host, srv.port), (standby.host, standby.port)]
+        client = FailoverClient(endpoints, timeout_s=15.0)
+        try:
+            for w in wallets:
+                r = client.request("register", addr=w.address,
+                                   pubkey=w.public_bytes.hex(),
+                                   tag=_sign(w, "register", 0, b""))
+                assert r["ok"], r
+            _drive_round(client, wallets, epoch=0)
+            info = client.request("info")
+            assert info["epoch"] == 1
+            head_before = info["log_head"]
+            size_before = info["log_size"]
+
+            # wait until the standby has mirrored everything, then KILL the
+            # writer (socket close = every connection dies)
+            deadline = time.monotonic() + 20
+            while standby.ledger.log_size() < size_before:
+                assert time.monotonic() < deadline, "standby lagging"
+                time.sleep(0.05)
+            srv.close()
+
+            assert standby.promoted.wait(timeout=30), "no promotion"
+            info2 = client.request("info")     # fails over automatically
+            assert info2["epoch"] == 1
+            assert info2["log_size"] >= size_before
+            # same chain: the promoted writer's log extends the old head
+            ops = client.request("log_range", start=0,
+                                 end=size_before)["ops"]
+            h = b""
+            for op in ops:              # pyledger._append_log chaining
+                hh = hashlib.sha256()
+                if h:
+                    hh.update(h)
+                hh.update(bytes.fromhex(op))
+                h = hh.digest()
+            assert h.hex() == head_before
+
+            # the fleet finishes the NEXT round against the promoted writer
+            _drive_round(client, wallets, epoch=1)
+            assert client.request("info")["epoch"] == 2
+        finally:
+            client.close()
+            standby.stop()
+            srv.close()
+
+    def test_standby_rejects_bad_index(self):
+        with pytest.raises(ValueError):
+            Standby(CFG, [("127.0.0.1", 1)], 1)
+
+
+@pytest.mark.slow
+class TestProcessFailoverDrill:
+    def test_kill_coordinator_mid_federation(self):
+        """The no-single-point-of-failure drill as real OS processes: the
+        primary coordinator is SIGKILLed at epoch 2 of 4; the hot standby
+        promotes over the same hash chain and the client fleet finishes the
+        remaining rounds against it (reference parity: the chain keeps
+        serving through node loss, README.md:162-183)."""
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:1500], ytr[:1500], CFG.client_num)
+        res = run_federated_processes(
+            "make_softmax_regression", shards, (xte[:500], yte[:500]), CFG,
+            rounds=4, standbys=1, kill_writer_at_epoch=2,
+            stall_timeout_s=20.0, timeout_s=420.0, replicas=1)
+        assert res.rounds_completed >= 4
+        assert res.best_accuracy() > 0.80, res.accuracy_history
+        # the end-of-run replica replays the PROMOTED writer's full log and
+        # reproduces its head: one unbroken chain across the failover
+        assert res.replica_report["ok"]
+        assert res.replica_report["head"] == res.ledger_log_head
+
+
+class TestFailoverClient:
+    def test_rotates_to_live_endpoint(self):
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        # first endpoint is dead; client must rotate and succeed
+        dead = ("127.0.0.1", 1)          # port 1: connection refused
+        client = FailoverClient([dead, (srv.host, srv.port)], timeout_s=5.0)
+        try:
+            assert client.request("info")["ok"]
+            assert client.current_endpoint == (srv.host, srv.port)
+        finally:
+            client.close()
+            srv.close()
+
+    def test_all_dead_raises(self):
+        client = FailoverClient([("127.0.0.1", 1)], timeout_s=1.0,
+                                max_cycles=2)
+        with pytest.raises(ConnectionError):
+            client.request("info")

@@ -78,7 +78,7 @@ class TestIdentity:
                                        tag) == LedgerStatus.OK
         # an eavesdropper replaying the exact same authenticated op
         assert led.upload_local_update(addr(3), b"\1" * 32, 100, 1.5, 0,
-                                       tag) == LedgerStatus.BAD_ARG
+                                       tag) == LedgerStatus.DUPLICATE
 
     def test_retry_after_transient_rejection_allowed(self, auth_led):
         """A tag is consumed only when the op is ACCEPTED: scores rejected
@@ -171,7 +171,7 @@ class TestIdentity:
                                        tag) == LedgerStatus.OK
         # replay
         assert led.upload_local_update(w.address, b"\1" * 32, 100, 1.5, 0,
-                                       tag) == LedgerStatus.BAD_ARG
+                                       tag) == LedgerStatus.DUPLICATE
         # another wallet cannot sign for w's address
         x = wallets[4]
         forged = x.sign(_op_bytes("upload", w.address, 0, b"\2" * 32 +

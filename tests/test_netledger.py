@@ -236,13 +236,14 @@ class TestAuthenticatedServer:
                       hash=d2.hex(), n=10, cost=1.0, epoch=0, tag=forged)
         assert not r["ok"]
         # verbatim replay of the accepted upload: the server's seen-tag set
-        # must reject it at the AUTH layer (BAD_ARG), not merely via ledger
-        # idempotency (DUPLICATE) — the same enforcement point
-        # AuthenticatedLedger has, so the two boundaries can't drift
+        # must reject it at the AUTH layer with DUPLICATE ("already in",
+        # the retry-safe signal) before the ledger is even consulted — the
+        # same tri-state AuthenticatedLedger enforces, so the two
+        # boundaries can't drift
         r = c.request("upload", addr=trainer.address, blob=blob.hex(),
                       hash=digest.hex(), n=10, cost=1.0, epoch=0,
                       tag=_sign(trainer, "upload", 0, payload))
-        assert not r["ok"] and r["status"] == "BAD_ARG", r
+        assert not r["ok"] and r["status"] == "DUPLICATE", r
         c.close()
 
 

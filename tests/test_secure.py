@@ -25,7 +25,7 @@ class TestMaskCancellation:
         n = 8
         total = jnp.zeros((4, 4), jnp.uint32)
         for i in range(n):
-            total = total + _client_mask(key, jnp.int32(i), n, (4, 4))
+            total = total + _client_mask(key, jnp.int32(i), n, (4, 4), 0)
         np.testing.assert_array_equal(np.asarray(total), 0)
 
     def test_sum_matches_plain_sum(self):
@@ -47,7 +47,7 @@ class TestMaskCancellation:
         q = np.round(np.clip(x, -64, 64) * _SCALE).astype(np.int32)
         masked = np.asarray(
             q.astype(np.uint32) +
-            np.asarray(_client_mask(key, jnp.int32(3), 16, (64, 64))))
+            np.asarray(_client_mask(key, jnp.int32(3), 16, (64, 64), 0)))
         # view masked words as signed and normalise; correlation with the
         # plaintext should be negligible
         m = masked.astype(np.int64)
@@ -73,9 +73,9 @@ class TestMaskCancellation:
     def test_different_rounds_different_masks(self):
         k = jax.random.PRNGKey(4)
         m1 = np.asarray(_client_mask(jax.random.fold_in(k, 1), jnp.int32(0),
-                                     8, (16,)))
+                                     8, (16,), 0))
         m2 = np.asarray(_client_mask(jax.random.fold_in(k, 2), jnp.int32(0),
-                                     8, (16,)))
+                                     8, (16,), 0))
         assert not np.array_equal(m1, m2)
 
 
@@ -94,7 +94,8 @@ class TestDHPairKeys:
         seeds = self._seeds(n)
         total = jnp.zeros((4, 4), jnp.uint32)
         for i in range(n):
-            total = total + _client_mask_dh(seeds, jnp.int32(i), n, (4, 4))
+            total = total + _client_mask_dh(seeds, jnp.int32(i), n, (4, 4),
+                                            0)
         np.testing.assert_array_equal(np.asarray(total), 0)
 
     def test_dh_sum_matches_plain_sum(self):
@@ -137,12 +138,114 @@ class TestDHPairKeys:
                                        np.asarray(want[k]),
                                        atol=0.05 * n / _SCALE + 1e-6)
 
+    def test_same_shape_leaves_get_distinct_masks(self):
+        """Regression: two same-shape leaves of one client's delta must be
+        blinded with DIFFERENT mask bits — otherwise masked_A - masked_B
+        leaks the client's exact cross-leaf difference (ResNet deltas
+        repeat conv shapes many times)."""
+        from bflc_demo_tpu.parallel.secure import (_client_mask,
+                                                   _client_mask_dh)
+        key = jax.random.PRNGKey(7)
+        i = jnp.asarray(1)
+        m0 = _client_mask(key, i, 4, (8,), leaf_idx=0)
+        m1 = _client_mask(key, i, 4, (8,), leaf_idx=1)
+        assert not np.array_equal(np.asarray(m0), np.asarray(m1))
+        seeds = self._seeds(4)
+        d0 = _client_mask_dh(seeds, i, 4, (8,), leaf_idx=0)
+        d1 = _client_mask_dh(seeds, i, 4, (8,), leaf_idx=1)
+        assert not np.array_equal(np.asarray(d0), np.asarray(d1))
+
     def test_bad_seed_shape_rejected(self):
         mesh = client_axis_mesh(4)
         vals = _vals(np.random.default_rng(0), 8)
         with pytest.raises(ValueError):
             secure_masked_sum(mesh, vals, jax.random.PRNGKey(0),
                               pair_seeds=jnp.zeros((4, 4, 2), jnp.uint32))
+
+
+class TestSecureMeshRuntime:
+    """secure_aggregation=True through the full protocol round program —
+    the BASELINE config-4 capability, not just the shelf component."""
+
+    def _run(self, secure, wallets=None, rounds=2):
+        from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+        from bflc_demo_tpu.models import make_softmax_regression
+        from bflc_demo_tpu.protocol import ProtocolConfig
+
+        cfg = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=2,
+                             needed_update_count=3, learning_rate=0.05,
+                             batch_size=16, local_epochs=1)
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:1200], ytr[:1200], 8)
+        return run_federated_mesh(
+            make_softmax_regression(), shards, (xte[:400], yte[:400]), cfg,
+            rounds=rounds, seed=3, secure_aggregation=secure,
+            secure_wallets=wallets)
+
+    def test_secure_run_commits_plain_run_model(self):
+        """The secure run's committed global model equals the plain run's
+        within fixed-point quantisation tolerance, end-to-end (ledger audit
+        included on both paths)."""
+        plain = self._run(secure=False)
+        masked = self._run(secure=True)
+        for key in plain.final_params:
+            np.testing.assert_allclose(
+                np.asarray(masked.final_params[key]),
+                np.asarray(plain.final_params[key]), atol=5e-3)
+        assert masked.rounds_completed == plain.rounds_completed
+
+    def test_secure_dh_run_with_wallets(self):
+        """DH mode: per-pair X25519 mask keys, aggregator cannot strip."""
+        from bflc_demo_tpu.comm.identity import provision_wallets
+
+        wallets, _ = provision_wallets(8, b"mesh-secure-master-01")
+        plain = self._run(secure=False)
+        masked = self._run(secure=True, wallets=wallets)
+        for key in plain.final_params:
+            np.testing.assert_allclose(
+                np.asarray(masked.final_params[key]),
+                np.asarray(plain.final_params[key]), atol=5e-3)
+
+    def test_secure_active_participation(self):
+        """Sampled-participation slots: the mask cancellation spans exactly
+        the round's k+c occupants."""
+        from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+        from bflc_demo_tpu.models import make_softmax_regression
+        from bflc_demo_tpu.protocol import ProtocolConfig
+
+        cfg = ProtocolConfig(client_num=12, comm_count=2, aggregate_count=2,
+                             needed_update_count=3, learning_rate=0.05,
+                             batch_size=16, local_epochs=1)
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:1200], ytr[:1200], 12)
+        wallets, _ = provision_wallets(12, b"mesh-secure-master-02")
+        res = run_federated_mesh(
+            make_softmax_regression(), shards, (xte[:400], yte[:400]), cfg,
+            rounds=2, seed=3, participation="active",
+            secure_aggregation=True, secure_wallets=wallets)
+        assert res.rounds_completed == 2
+        assert all(np.isfinite(a) for _, a in res.accuracy_history)
+
+    def test_secure_rejects_batched_dispatch(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+            from bflc_demo_tpu.data import load_occupancy, iid_shards
+            from bflc_demo_tpu.models import make_softmax_regression
+            from bflc_demo_tpu.protocol import ProtocolConfig
+            cfg = ProtocolConfig(client_num=8, comm_count=2,
+                                 aggregate_count=2, needed_update_count=3,
+                                 learning_rate=0.05, batch_size=16,
+                                 local_epochs=1)
+            xtr, ytr, xte, yte = load_occupancy()
+            run_federated_mesh(
+                make_softmax_regression(),
+                iid_shards(xtr[:800], ytr[:800], 8), (xte[:200], yte[:200]),
+                cfg, rounds=4, rounds_per_dispatch=2,
+                secure_aggregation=True)
 
 
 class TestSecureFedAvg:

@@ -1,0 +1,133 @@
+"""Control-plane TLS: encrypted client<->coordinator transport.
+
+The reference's Channel protocol is TLS with provisioned certs
+(README.md:240-260); these tests prove the equivalent here — the full
+protocol works over TLS, a plaintext client is rejected at the transport,
+and a client refusing the CA fails verification.
+"""
+
+import hashlib
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                               LedgerServer, replicate)
+from bflc_demo_tpu.comm.tls import (client_context, provision_tls,
+                                    server_context)
+from bflc_demo_tpu.comm.wire import WireError, send_msg, recv_msg
+from bflc_demo_tpu.protocol import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import pack_pytree
+
+CFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                     needed_update_count=3, learning_rate=0.05,
+                     batch_size=16)
+
+
+def _init_blob():
+    return pack_pytree({"W": np.zeros((5, 2), np.float32),
+                        "b": np.zeros((2,), np.float32)})
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tls"))
+    provision_tls(d)
+    return d
+
+
+@pytest.fixture
+def tls_server(certs):
+    srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                       stall_timeout_s=60.0, ledger_backend="python",
+                       tls=server_context(certs))
+    srv.start()
+    yield srv
+    srv.close()
+
+
+class TestTLS:
+    def test_provision_idempotent(self, certs):
+        import os
+        paths = provision_tls(certs)
+        mtimes = [os.path.getmtime(p) for p in paths]
+        assert provision_tls(certs) == paths
+        assert [os.path.getmtime(p) for p in paths] == mtimes
+
+    def test_full_protocol_over_tls(self, tls_server, certs):
+        """Register the fleet, drive a full round to an aggregated commit,
+        and replicate the log — every byte TLS-framed."""
+        tls = client_context(certs)
+        c = CoordinatorClient(tls_server.host, tls_server.port, tls=tls)
+        import ssl
+        assert isinstance(c.sock, ssl.SSLSocket)
+        addrs = [f"0x{i:040x}" for i in range(CFG.client_num)]
+        for a in addrs:
+            assert c.request("register", addr=a)["ok"]
+        committee = c.request("committee")["committee"]
+        trainers = [a for a in addrs if a not in committee]
+        for i, a in enumerate(trainers[: CFG.needed_update_count]):
+            blob = pack_pytree({"W": np.full((5, 2), i + 1.0, np.float32),
+                                "b": np.zeros((2,), np.float32)})
+            digest = hashlib.sha256(blob).digest()
+            r = c.request("upload", addr=a, blob=blob.hex(),
+                          hash=digest.hex(), n=10, cost=1.0, epoch=0)
+            assert r["ok"], r
+        for j, a in enumerate(committee):
+            r = c.request("scores", addr=a, epoch=0,
+                          scores=[0.5 + 0.01 * u for u in range(
+                              CFG.needed_update_count)])
+            assert r["ok"], r
+        info = c.request("info")
+        assert info["epoch"] == 1           # aggregated + committed
+        # live replication over the same TLS transport
+        replica = replicate(tls_server.host, tls_server.port, CFG,
+                            ledger_backend="python",
+                            until_ops=info["log_size"], timeout_s=30.0,
+                            tls=tls)
+        assert replica.log_head().hex() == info["log_head"]
+        c.close()
+
+    def test_plaintext_client_rejected(self, tls_server):
+        """A non-TLS client against the TLS server must get nothing back:
+        the server kills the connection at the failed handshake."""
+        sock = socket.create_connection((tls_server.host, tls_server.port),
+                                        timeout=5.0)
+        sock.settimeout(5.0)
+        try:
+            send_msg(sock, {"method": "info"})
+            with pytest.raises((WireError, ConnectionError, OSError)):
+                reply = recv_msg(sock)
+                if reply is None:           # clean close also = rejection
+                    raise ConnectionError("closed by server")
+        finally:
+            sock.close()
+
+    @pytest.mark.slow
+    def test_process_federation_over_tls(self, tmp_path):
+        """The reference's deployment shape with its transport property:
+        OS-process clients, every control-plane byte TLS-encrypted."""
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:1200], ytr[:1200], CFG.client_num)
+        res = run_federated_processes(
+            "make_softmax_regression", shards, (xte[:400], yte[:400]), CFG,
+            rounds=3, stall_timeout_s=20.0, timeout_s=420.0, replicas=1,
+            tls_dir=str(tmp_path / "certs"))
+        assert res.rounds_completed >= 3
+        assert res.best_accuracy() > 0.80
+        assert res.replica_report["ok"]
+
+    def test_wrong_ca_rejected(self, tls_server, tmp_path):
+        """A client that trusts a DIFFERENT CA fails verification."""
+        import ssl
+        other = str(tmp_path / "other")
+        provision_tls(other)
+        with pytest.raises(ssl.SSLError):
+            CoordinatorClient(tls_server.host, tls_server.port,
+                              tls=client_context(other))

@@ -30,15 +30,19 @@ import json, time
 import jax
 from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
 from bflc_demo_tpu.eval.configs import CONFIGS
+from bflc_demo_tpu.eval.mfu import chip_peak_flops
 enable_persistent_cache()
 name, rounds, n_data = {name!r}, {rounds}, {n_data}
-kw = dict(rounds=rounds, runtime="mesh")
+kw = dict(rounds=rounds, runtime="mesh", estimate_flops=True)
 if n_data and name != "config1":     # config1 = fixed occupancy dataset
     kw["n_data"] = n_data
 t0 = time.time()
 res = CONFIGS[name].build(**kw)
 wall = time.time() - t0
 times = getattr(res, "round_times_s", None) or []
+peak = chip_peak_flops()
+mfu = (round(res.mfu(peak * res.n_devices), 5)
+       if peak and res.flops_per_round else None)
 print("RESULT " + json.dumps({{
     "config": name,
     "platform": jax.devices()[0].platform,
@@ -47,6 +51,8 @@ print("RESULT " + json.dumps({{
     "min_round_s": round(min(times), 4) if times else None,
     "mean_round_s": round(sum(times) / len(times), 4) if times else None,
     "best_acc": round(res.best_accuracy(), 4),
+    "flops_per_round": res.flops_per_round,
+    "mfu": mfu,
     "n_data": n_data or "default",
 }}))
 """
@@ -94,15 +100,17 @@ def main() -> int:
                     f"({time.strftime('%Y-%m-%d %H:%M')}, "
                     f"rounds={args.rounds})\n\n")
             f.write("| config | platform | min round s | mean round s | "
-                    "best acc | note |\n|---|---|---|---|---|---|\n")
+                    "best acc | MFU | note |\n|---|---|---|---|---|---|"
+                    "---|\n")
             for r in rows:
                 if "error" in r:
-                    f.write(f"| {r['config']} | — | — | — | — | "
+                    f.write(f"| {r['config']} | — | — | — | — | — | "
                             f"{r['error'][:80]} |\n")
                 else:
                     f.write(f"| {r['config']} | {r['platform']} | "
                             f"{r['min_round_s']} | {r['mean_round_s']} | "
-                            f"{r['best_acc']} | n_data={r['n_data']} |\n")
+                            f"{r['best_acc']} | {r.get('mfu')} | "
+                            f"n_data={r['n_data']} |\n")
     return 0 if all("error" not in r for r in rows) else 2
 
 
