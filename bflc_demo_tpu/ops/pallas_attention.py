@@ -297,6 +297,108 @@ def _flash_bwd_impl(q, k, v, kv_mask, out, lse, g, block_q: int,
     return unflat(dq, s_q), unflat(dk, s_kv), unflat(dv, s_kv)
 
 
+def _flash_carry_kernel(q_ref, k_ref, v_ref, mask_ref, acc_in_ref, m_in_ref,
+                        l_in_ref, acc_out_ref, m_out_ref, l_out_ref,
+                        acc_ref, m_ref, l_ref, *, scale: float, nk: int):
+    """Streaming-softmax step that RESUMES from an (acc, m, l) carry and
+    emits the updated raw carry (no final normalisation) — the building
+    block ring attention needs: each ring hop feeds the previous hop's
+    carry in and hands the updated one to the next, while K/V of the
+    resident block stream through VMEM exactly as in `_flash_kernel`.
+    """
+    kidx = pl.program_id(2)
+
+    @pl.when(kidx == 0)
+    def _init():
+        acc_ref[:] = acc_in_ref[0]
+        m_ref[:] = jnp.broadcast_to(m_in_ref[0, 0][:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_in_ref[0, 0][:, None], l_ref.shape)
+
+    q = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    mb = mask_ref[0, 0]
+
+    m = m_ref[:, 0]
+    l = l_ref[:, 0]
+    logits = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+    logits = jnp.where((mb > 0)[None, :], logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[:, None])
+    p = jnp.where((mb > 0)[None, :], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+        p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(kidx == nk - 1)
+    def _finish():
+        acc_out_ref[0] = acc_ref[:]
+        m_out_ref[0, 0, :] = m_ref[:, 0]
+        l_out_ref[0, 0, :] = l_ref[:, 0]
+
+
+def flash_attention_carry(q, k, v, kv_mask, acc, m, l,
+                          block_q: int = 128, block_k: int = 128,
+                          interpret: bool = False):
+    """One streaming-attention hop over a KV block, resuming from carry.
+
+    q: (B, S_q, H, D); k/v: (B, S_kv, H, D); kv_mask: (B, S_kv);
+    acc: (B*H, S_q, D) f32; m/l: (B*H, 1, S_q) f32.
+    Returns the updated (acc, m, l).  Finalise with
+    `out = acc / max(l, eps)` after the last hop (see
+    parallel.ring_attention's pallas path).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(f"seq lens ({s_q}, {s_kv}) must divide blocks "
+                         f"({block_q}, {block_k})")
+    scale = 1.0 / np.sqrt(d)
+    nk = s_kv // block_k
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+    mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]
+
+    kernel = functools.partial(_flash_carry_kernel, scale=scale, nk=nk)
+    acc2, m2, l2 = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // block_q, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda i, j, kk, h=h: (i // h, 0, kk)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, mask_i32, acc, m, l)
+    return acc2, m2, l2
+
+
 def _reference_attention(q, k, v, kv_mask, scale):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     logits = jnp.where(kv_mask[:, None, None, :], logits, NEG_INF)

@@ -33,13 +33,30 @@ SP_AXIS = "sp"
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   kv_mask: jax.Array, axis_name: str = SP_AXIS) -> jax.Array:
+                   kv_mask: jax.Array, axis_name: str = SP_AXIS,
+                   impl: str = "einsum") -> jax.Array:
     """Exact attention with KV blocks ring-rotated over `axis_name`.
 
     Shapes (per device): q/k/v (B, S_blk, H, Dh); kv_mask (B, S_blk) bool
     marking which resident keys are real (PAD=False).  Returns (B,S_blk,H,Dh)
     — the attention output for the resident queries over the FULL sequence.
+
+    impl: "einsum" (default — XLA path, materialises one (S_blk, S_blk)
+    logits block per hop) or "pallas"/"pallas_interpret" — each hop runs
+    the streaming-carry flash kernel (ops.pallas_attention.
+    flash_attention_carry), so even the per-hop block logits never
+    materialise: the two levels of the same algorithm compose, the ring
+    streaming KV BETWEEN chips and the kernel streaming tiles WITHIN the
+    chip.  The pallas forward is differentiable via a custom vjp that
+    recomputes with the einsum ring (per-hop block logits only — bounded
+    memory in the backward too).
     """
+    if impl in ("pallas", "pallas_interpret"):
+        return _ring_attention_pallas(q, k, v, kv_mask, axis_name,
+                                      impl == "pallas_interpret")
+    if impl != "einsum":
+        raise ValueError(f"impl must be einsum|pallas|pallas_interpret, "
+                         f"got {impl!r}")
     n_dev = jax.lax.axis_size(axis_name)
     b, s, h, dh = q.shape
     scale = 1.0 / np.sqrt(dh)
@@ -76,6 +93,71 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
+import functools as _functools                          # noqa: E402
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _ring_attention_pallas(q, k, v, kv_mask, axis_name, interpret):
+    return _ring_pallas_fwd_impl(q, k, v, kv_mask, axis_name, interpret)
+
+
+def _ring_pallas_fwd_impl(q, k, v, kv_mask, axis_name, interpret):
+    """Ring hops where each hop is one `flash_attention_carry` call: the
+    (acc, m, l) streaming state crosses hops on the host side of the
+    kernel while K/V tiles stream inside it."""
+    from bflc_demo_tpu.ops.pallas_attention import flash_attention_carry
+    from bflc_demo_tpu.parallel.mesh import pvary_compat
+
+    n_dev = jax.lax.axis_size(axis_name)
+    b, s, h, dh = q.shape
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+    blk = 128
+    while s % blk:
+        blk //= 2
+    if blk < 8:
+        raise ValueError(f"sequence block {s} has no usable kernel tile")
+
+    def body(_, carry):
+        acc, m, l, kb, vb, mb = carry
+        acc, m, l = flash_attention_carry(q, kb, vb, mb, acc, m, l,
+                                          block_q=blk, block_k=blk,
+                                          interpret=interpret)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        mb = jax.lax.ppermute(mb, axis_name, perm)
+        return acc, m, l, kb, vb, mb
+
+    acc0 = jnp.zeros((b * h, s, dh), jnp.float32)
+    m0 = jnp.full((b * h, 1, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b * h, 1, s), jnp.float32)
+    acc0, m0, l0 = jax.tree_util.tree_map(
+        lambda t: pvary_compat(t, (axis_name,)), (acc0, m0, l0))
+    acc, _, l, _, _, _ = jax.lax.fori_loop(
+        0, n_dev, body, (acc0, m0, l0, k, v, kv_mask))
+    out = acc / jnp.maximum(l[:, 0, :, None], 1e-30)
+    return out.reshape(b, h, s, dh).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _ring_pallas_vjp_fwd(q, k, v, kv_mask, axis_name, interpret):
+    out = _ring_pallas_fwd_impl(q, k, v, kv_mask, axis_name, interpret)
+    return out, (q, k, v, kv_mask)
+
+
+def _ring_pallas_vjp_bwd(axis_name, interpret, residuals, g):
+    q, k, v, kv_mask = residuals
+    # recompute with the einsum ring — per-hop block logits only, so the
+    # backward's memory is bounded by the block size exactly like the
+    # forward's; gradients are exact (same math, different schedule)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, kv_mask, axis_name,
+                                          impl="einsum"), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_ring_attention_pallas.defvjp(_ring_pallas_vjp_fwd, _ring_pallas_vjp_bwd)
+
+
 def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
                                 ) -> Callable[[Pytree, jax.Array], jax.Array]:
     """Sequence-parallel classifier forward over the mesh's 'sp' axis.
@@ -90,11 +172,16 @@ def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
                          f"{n_sp}")
     s_blk = cfg.seq_len // n_sp
 
+    # the transformer's attention_impl selects the ring's inner step too:
+    # einsum (default) or the streaming-carry flash kernel per hop
+    ring_impl = {"einsum": "einsum", "pallas": "pallas",
+                 "pallas_interpret": "pallas_interpret"}[cfg.attention_impl]
+
     def body(params, tokens_blk):
         my = jax.lax.axis_index(SP_AXIS)
 
         def attn_fn(q, k, v, kv_mask):
-            return ring_attention(q, k, v, kv_mask, SP_AXIS)
+            return ring_attention(q, k, v, kv_mask, SP_AXIS, impl=ring_impl)
 
         # the SAME forward as single-device, parameterised for this shard
         return transformer_forward(params, tokens_blk, cfg, attn_fn=attn_fn,
