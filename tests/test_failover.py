@@ -128,6 +128,59 @@ class TestInThreadPromotion:
             standby.stop()
             srv.close()
 
+    def test_two_standbys_promote_in_priority_order(self):
+        """Kill the writer AND the first standby: the SECOND standby must
+        observe both deaths (connect-refused) and promote — the
+        deterministic lease-free election over the endpoint priority list.
+        """
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"failover-master-0002")
+        srv = LedgerServer(CFG, _init_blob(), directory=directory,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        eps = [(srv.host, srv.port), ("127.0.0.1", 0), ("127.0.0.1", 0)]
+        sb1 = Standby(CFG, list(eps), 1, heartbeat_s=0.3,
+                      stall_timeout_s=60.0, ledger_backend="python")
+        sb1.endpoints[1] = (sb1.host, sb1.port)
+        eps[1] = (sb1.host, sb1.port)
+        sb2 = Standby(CFG, list(eps), 2, heartbeat_s=0.3,
+                      stall_timeout_s=60.0, ledger_backend="python")
+        sb2.endpoints[2] = (sb2.host, sb2.port)
+        eps[2] = (sb2.host, sb2.port)
+        t1 = threading.Thread(target=sb1.run, daemon=True)
+        t2 = threading.Thread(target=sb2.run, daemon=True)
+        t1.start()
+        t2.start()
+
+        client = FailoverClient(eps, timeout_s=15.0)
+        try:
+            for w in wallets:
+                r = client.request("register", addr=w.address,
+                                   pubkey=w.public_bytes.hex(),
+                                   tag=_sign(w, "register", 0, b""))
+                assert r["ok"], r
+            _drive_round(client, wallets, epoch=0)
+            size = client.request("info")["log_size"]
+            deadline = time.monotonic() + 20
+            while (sb1.ledger.log_size() < size
+                   or sb2.ledger.log_size() < size):
+                assert time.monotonic() < deadline, "standby lagging"
+                time.sleep(0.05)
+            # kill writer AND the higher-priority standby
+            sb1.stop()
+            srv.close()
+            assert sb2.promoted.wait(timeout=45), \
+                "second standby did not promote"
+            info = client.request("info")
+            assert info["epoch"] == 1
+            _drive_round(client, wallets, epoch=1)
+            assert client.request("info")["epoch"] == 2
+        finally:
+            client.close()
+            sb1.stop()
+            sb2.stop()
+            srv.close()
+
     def test_standby_rejects_bad_index(self):
         with pytest.raises(ValueError):
             Standby(CFG, [("127.0.0.1", 1)], 1)
