@@ -185,6 +185,7 @@ def run_federated_mesh(model: Model,
                        # quantisation resolution is 2^-16 regardless
                        secure_clip: float = 1024.0,
                        estimate_flops: bool = False,
+                       local_optimizer=None,
                        verbose: bool = False) -> SimulationResult:
     """participation:
     - 'full': every registered client trains each round (the reference's
@@ -227,6 +228,9 @@ def run_federated_mesh(model: Model,
                          f"got {participation!r}")
     if rounds_per_dispatch > 1:
         # fail fast, before any staging/program construction
+        if local_optimizer is not None:
+            raise ValueError("local_optimizer requires "
+                             "rounds_per_dispatch=1")
         if participation != "full":
             raise ValueError("rounds_per_dispatch requires "
                              "participation='full'")
@@ -263,7 +267,8 @@ def run_federated_mesh(model: Model,
             mesh, model.apply, client_num=n_slots, lr=cfg.learning_rate,
             batch_size=cfg.batch_size, local_epochs=cfg.local_epochs,
             aggregate_count=cfg.aggregate_count, client_chunk=client_chunk,
-            remat=remat, secure=secure_aggregation,
+            remat=remat, local_optimizer=local_optimizer,
+            secure=secure_aggregation,
             secure_dh=secure_wallets is not None, secure_clip=secure_clip)
 
     xte, yte = test_set

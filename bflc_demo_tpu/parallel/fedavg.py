@@ -168,6 +168,7 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                                 client_num: int, lr: float, batch_size: int,
                                 local_epochs: int, aggregate_count: int,
                                 client_chunk: int = 0, remat: bool = False,
+                                local_optimizer=None,
                                 secure: bool = False,
                                 secure_dh: bool = False,
                                 secure_clip: float = 64.0,
@@ -214,11 +215,15 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
         my = jax.lax.axis_index(AXIS)
 
         # 1. local training over resident clients: vmapped, optionally in
-        #    sequential chunks with rematerialisation
+        #    sequential chunks with rematerialisation.  local_optimizer: any
+        #    optax transformation for the local steps (fresh state per
+        #    round); the delta wire identity holds for any optimizer
+        #    (core.local_train docstring)
         def train_one(x, y):
             return local_train_impl(apply_fn, params, x, y, lr=lr,
                                     batch_size=batch_size,
-                                    local_epochs=local_epochs)
+                                    local_epochs=local_epochs,
+                                    optimizer=local_optimizer)
         if remat:
             train_one = jax.checkpoint(train_one)
         if client_chunk and client_chunk < n_local:

@@ -1,0 +1,122 @@
+"""Long-context TRAINING step on the real chip: flash vs einsum backward.
+
+VERDICT round-2 weak #4 asked for a backward that doesn't rematerialise the
+(S, S) logits, proven by "a TPU-measured training step at seq 8192 that the
+einsum backward cannot fit/match".  This tool measures exactly that: one
+SGD step (value_and_grad through a 1-block transformer) at increasing
+sequence lengths with attention_impl=pallas (blockwise dq/dk/dv from saved
+LSE) vs einsum (XLA autodiff, full logits in the backward), bf16.
+
+Each (impl, seq) cell runs in a child process under a watchdog so an OOM or
+a wedged tunnel kills the cell, not the sweep.  Appends a table to
+TPU_RESULTS.md and prints one JSON line per cell.
+
+Usage: python tools/tpu_flash_train.py [--seqs 2048,4096,8192]
+       [--timeout 900] [--out TPU_RESULTS.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+CHILD_CODE = """
+import time, json
+import numpy as np
+import jax, jax.numpy as jnp
+from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
+from bflc_demo_tpu.models.transformer import make_transformer_classifier
+enable_persistent_cache()
+impl, seq = {impl!r}, {seq}
+model = make_transformer_classifier(
+    vocab_size=512, seq_len=seq, num_classes=2, dim=256, depth=1, heads=4,
+    dtype=jnp.bfloat16, attention_impl=impl)
+cfg = model.config
+rng = np.random.default_rng(0)
+b = 2
+toks = jnp.asarray(rng.integers(1, 512, (b, seq)), jnp.int32)
+labels = jnp.asarray(np.eye(2, dtype=np.float32)[rng.integers(0, 2, b)])
+params = model.init_params(0)
+params["head_w"] = jnp.asarray(
+    rng.standard_normal((cfg.dim, 2)), jnp.float32) * 0.02
+
+def loss_fn(p):
+    logits = model.apply(p, toks)
+    return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), -1))
+
+step = jax.jit(jax.value_and_grad(loss_fn))
+loss, grads = step(params)          # compile
+jax.block_until_ready(grads)
+reps = 5
+t0 = time.perf_counter()
+for _ in range(reps):
+    loss, grads = step(params)
+jax.block_until_ready(grads)
+dt = (time.perf_counter() - t0) / reps
+finite = all(bool(jnp.isfinite(g).all())
+             for g in jax.tree_util.tree_leaves(grads))
+print("RESULT " + json.dumps({{
+    "impl": impl, "seq": seq, "batch": b,
+    "platform": jax.devices()[0].platform,
+    "train_step_ms": round(dt * 1e3, 2),
+    "loss": round(float(loss), 5), "grads_finite": finite,
+}}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="2048,4096,8192")
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    rows = []
+    for seq in (int(s) for s in args.seqs.split(",")):
+        for impl in ("pallas", "einsum"):
+            code = CHILD_CODE.format(impl=impl, seq=seq)
+            try:
+                t0 = time.time()
+                proc = subprocess.run([sys.executable, "-c", code],
+                                      capture_output=True, text=True,
+                                      timeout=args.timeout,
+                                      env=dict(os.environ))
+                line = next((ln for ln in proc.stdout.splitlines()
+                             if ln.startswith("RESULT ")), None)
+                if proc.returncode == 0 and line:
+                    rows.append(json.loads(line[len("RESULT "):]))
+                else:
+                    err = proc.stderr.strip()[-300:]
+                    rows.append({"impl": impl, "seq": seq,
+                                 "error": f"rc={proc.returncode}: {err}"})
+            except subprocess.TimeoutExpired:
+                rows.append({"impl": impl, "seq": seq,
+                             "error": f"timeout {args.timeout}s "
+                                      f"(after {time.time() - t0:.0f}s)"})
+            print(json.dumps(rows[-1]), flush=True)
+
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(f"\n## tools/tpu_flash_train.py run "
+                    f"({time.strftime('%Y-%m-%d %H:%M')}) — bf16 training "
+                    f"step, 1 block, dim 256, 4 heads, batch 2\n\n")
+            f.write("| seq | impl | train step ms | note |\n"
+                    "|---|---|---|---|\n")
+            for r in rows:
+                if "error" in r:
+                    f.write(f"| {r['seq']} | {r['impl']} | — | "
+                            f"{r['error'][:90]} |\n")
+                else:
+                    f.write(f"| {r['seq']} | {r['impl']} | "
+                            f"{r['train_step_ms']} | "
+                            f"platform={r['platform']} "
+                            f"finite={r['grads_finite']} |\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
