@@ -60,7 +60,9 @@ def _tp_block(x: jax.Array, pad: jax.Array, bp: Pytree,
     q = (y @ bp["wq"].astype(dt)).reshape(b, s, h_loc, dh)
     k = (y @ bp["wk"].astype(dt)).reshape(b, s, h_loc, dh)
     v = (y @ bp["wv"].astype(dt)).reshape(b, s, h_loc, dh)
-    o = ring_attention(q, k, v, pad, SP_AXIS)
+    ring_impl = {"einsum": "einsum", "pallas": "pallas",
+                 "pallas_interpret": "pallas_interpret"}[cfg.attention_impl]
+    o = ring_attention(q, k, v, pad, SP_AXIS, impl=ring_impl)
     x = x + jax.lax.psum(o.reshape(b, s, h_loc * dh) @ bp["wo"].astype(dt),
                          TP_AXIS)
     y = layer_norm(x, bp["ln2"], dt)

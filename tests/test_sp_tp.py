@@ -89,3 +89,27 @@ class TestSpTpForward:
         mesh = make_mesh((1, 8), (SP_AXIS, TP_AXIS))
         with pytest.raises(ValueError, match="heads"):
             make_sp_tp_transformer_forward(mesh, model.config)
+
+
+class TestSpTpPallasRing:
+    def test_sp_tp_with_flash_ring_matches_einsum(self):
+        """sp x tp with the flash-kernel ring hops (attention_impl) — the
+        three-way composition: heads sharded over tp, sequence over sp,
+        KV tiles streamed within the chip."""
+        from bflc_demo_tpu.models.transformer import (
+            make_transformer_classifier, transformer_forward)
+        model = make_transformer_classifier(vocab_size=100, seq_len=32,
+                                            num_classes=3, dim=32, depth=1,
+                                            heads=2)
+        kernel_cfg = make_transformer_classifier(
+            vocab_size=100, seq_len=32, num_classes=3, dim=32, depth=1,
+            heads=2, attention_impl="pallas_interpret").config
+        mesh = make_mesh((2, 2), (SP_AXIS, TP_AXIS))
+        rng = np.random.default_rng(31)
+        tokens = _tokens(rng, 3, 32)
+        params = model.init_params(0)
+        want = transformer_forward(params, tokens, model.config)
+        got = make_sp_tp_transformer_forward(mesh, kernel_cfg)(params,
+                                                              tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=5e-4, atol=5e-5)
