@@ -129,6 +129,7 @@ class Standby:
                  require_auth: bool = True,
                  stall_timeout_s: float = 10.0,
                  tls_client=None, tls_server=None,
+                 wal_path: str = "",
                  verbose: bool = False):
         if not 1 <= index < len(endpoints):
             raise ValueError(f"standby index {index} out of range for "
@@ -142,6 +143,10 @@ class Standby:
         self.stall_timeout_s = stall_timeout_s
         self.tls_client = tls_client        # for following the writer
         self.tls_server = tls_server        # for serving after promotion
+        # attached at PROMOTION: attach_wal journals the full replayed op
+        # log first (pyledger.py:76-87 / ledger.cpp), so the promoted
+        # writer's WAL holds the complete chain, not a mid-stream suffix
+        self.wal_path = wal_path
         self.verbose = verbose
         self.ledger = make_ledger(cfg, backend=ledger_backend)
         self._blobs: Dict[bytes, bytes] = {}
@@ -334,6 +339,7 @@ class Standby:
             resume_blobs=self._blobs,
             sock=self._sock,
             tls=self.tls_server,
+            wal_path=self.wal_path,
             verbose=self.verbose)
         # open enrollment on the promoted writer: a client the directory
         # missed re-presents its (self-authenticating) pubkey on register

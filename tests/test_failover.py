@@ -128,6 +128,52 @@ class TestInThreadPromotion:
             standby.stop()
             srv.close()
 
+    def test_promoted_writer_wal_holds_full_chain(self, tmp_path):
+        """A standby promoted with wal_path journals the COMPLETE chain
+        (pre-promotion replayed ops + its own), replayable to head
+        equality by a fresh ledger — checkpoint/resume parity survives
+        failover."""
+        from bflc_demo_tpu.ledger import make_ledger
+
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"failover-master-0003")
+        srv = LedgerServer(CFG, _init_blob(), directory=directory,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        wal = str(tmp_path / "promoted.wal")
+        standby = Standby(CFG, [(srv.host, srv.port), ("127.0.0.1", 0)], 1,
+                          heartbeat_s=0.3, stall_timeout_s=60.0,
+                          ledger_backend="python", wal_path=wal)
+        standby.endpoints[1] = (standby.host, standby.port)
+        threading.Thread(target=standby.run, daemon=True).start()
+
+        client = FailoverClient([(srv.host, srv.port),
+                                 (standby.host, standby.port)],
+                                timeout_s=15.0)
+        try:
+            for w in wallets:
+                assert client.request(
+                    "register", addr=w.address,
+                    pubkey=w.public_bytes.hex(),
+                    tag=_sign(w, "register", 0, b""))["ok"]
+            _drive_round(client, wallets, epoch=0)
+            size = client.request("info")["log_size"]
+            deadline = time.monotonic() + 20
+            while standby.ledger.log_size() < size:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            srv.close()
+            assert standby.promoted.wait(timeout=30)
+            _drive_round(client, wallets, epoch=1)   # post-promotion ops
+            info = client.request("info")
+            fresh = make_ledger(CFG, backend="python")
+            assert fresh.replay_wal(wal) == info["log_size"]
+            assert fresh.log_head().hex() == info["log_head"]
+        finally:
+            client.close()
+            standby.stop()
+            srv.close()
+
     def test_two_standbys_promote_in_priority_order(self):
         """Kill the writer AND the first standby: the SECOND standby must
         observe both deaths (connect-refused) and promote — the
