@@ -39,6 +39,23 @@ def main(argv=None) -> int:
               ledger_backend=opts.ledger_backend, verbose=opts.verbose)
     if cfg is not None:
         kw["cfg"] = cfg
+    if opts.runtime == "processes":
+        # the reference's deployment shape from the CLI: OS-process fleet,
+        # optional hot standbys + TLS
+        if opts.standbys:
+            kw["standbys"] = opts.standbys
+        if opts.tls_dir:
+            kw["tls_dir"] = opts.tls_dir
+    elif opts.standbys or opts.tls_dir:
+        print("--standbys/--tls-dir apply to --runtime processes",
+              file=sys.stderr)
+        return 2
+    if opts.secure:
+        if opts.config != "config4":
+            print("--secure is the config4 secure-aggregation variant",
+                  file=sys.stderr)
+            return 2
+        kw["secure"] = True
     if opts.checkpoint_dir and opts.checkpoint_every and \
             opts.runtime == "mesh":
         kw["checkpoint_dir"] = opts.checkpoint_dir
@@ -46,7 +63,7 @@ def main(argv=None) -> int:
     with tracer.span("run", config=opts.config, runtime=opts.runtime):
         res = preset.build(**kw)
 
-    if opts.checkpoint_dir:
+    if opts.checkpoint_dir and hasattr(res, "final_params"):
         from bflc_demo_tpu.utils.checkpoint import save_checkpoint
         save_checkpoint(opts.checkpoint_dir, res.final_params, res.ledger,
                         extra={"config": opts.config,
@@ -67,7 +84,10 @@ def main(argv=None) -> int:
         "best_acc": res.best_accuracy(),
         "wall_time_s": round(res.wall_time_s, 3),
         "ledger_log_size": res.ledger_log_size,
-        "ledger_log_head": res.ledger_log_head.hex(),
+        # bytes from in-process ledgers, already-hex from socket results
+        "ledger_log_head": (res.ledger_log_head.hex()
+                            if isinstance(res.ledger_log_head, bytes)
+                            else res.ledger_log_head),
     }))
     return 0
 

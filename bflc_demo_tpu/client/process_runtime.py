@@ -251,13 +251,19 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
 
 class ProcessFederationResult:
     def __init__(self, accuracy_history, rounds_completed, log_head,
-                 log_size, recovered_clients, replica_report):
+                 log_size, recovered_clients, replica_report,
+                 wall_time_s: float = 0.0):
         self.accuracy_history = accuracy_history
         self.rounds_completed = rounds_completed
         self.ledger_log_head = log_head
         self.ledger_log_size = log_size
         self.recovered_clients = recovered_clients
         self.replica_report = replica_report
+        self.wall_time_s = wall_time_s
+
+    @property
+    def final_accuracy(self) -> float:
+        return self.accuracy_history[-1][1] if self.accuracy_history else 0.0
 
     def best_accuracy(self) -> float:
         return max((a for _, a in self.accuracy_history), default=0.0)
@@ -306,6 +312,7 @@ def run_federated_processes(
         raise ValueError("kill_writer_at_epoch requires standbys >= 1")
     crash_at = crash_at or {}
     factory_kw = factory_kw or {}
+    t_start = time.monotonic()
     if tls_dir:
         from bflc_demo_tpu.comm.tls import provision_tls
         provision_tls(tls_dir)
@@ -457,7 +464,8 @@ def run_federated_processes(
         log_head=final["log_head"],
         log_size=final["log_size"],
         recovered_clients=crashed,
-        replica_report=replica_report)
+        replica_report=replica_report,
+        wall_time_s=time.monotonic() - t_start)
 
 
 # ------------------------------------------------- mesh-executor federation
@@ -571,6 +579,7 @@ def run_federated_mesh_processes(
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
     factory_kw = factory_kw or {}
+    t_start = time.monotonic()
 
     import jax.numpy as jnp
 
@@ -655,4 +664,5 @@ def run_federated_mesh_processes(
         log_head=final["log_head"],
         log_size=final["log_size"],
         recovered_clients=[],
-        replica_report=None)
+        replica_report=None,
+        wall_time_s=time.monotonic() - t_start)

@@ -36,12 +36,17 @@ class BenchConfig:
 def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      rounds: int = 10, seed: int = 0,
                      ledger_backend: str = "auto", verbose: bool = False,
+                     process_factory: str = "",
+                     factory_kw: Optional[dict] = None,
+                     standbys: int = 0, tls_dir: str = "",
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
     mesh: device-resident round program (the TPU data plane);
     host: per-client dispatches, reference-shaped event loop;
-    threaded: true-concurrency thread-per-client with failure recovery.
+    threaded: true-concurrency thread-per-client with failure recovery;
+    processes: real OS processes over the socket coordinator (the
+    reference's deployment shape; optional hot standbys + TLS).
     mesh_kw (participation/client_chunk/remat/...) only apply to 'mesh'.
     """
     if runtime == "mesh":
@@ -61,7 +66,18 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         fed = ThreadedFederation(model, shards, test_set, cfg,
                                  ledger_backend=ledger_backend)
         return fed.run(rounds=rounds)
-    raise ValueError(f"runtime must be mesh|host|threaded, got {runtime!r}")
+    if runtime == "processes":
+        if not process_factory:
+            raise ValueError("this preset does not support the 'processes' "
+                             "runtime (no model factory registered)")
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        return run_federated_processes(
+            process_factory, shards, test_set, cfg, rounds=rounds,
+            factory_kw=factory_kw or {}, standbys=standbys,
+            tls_dir=tls_dir, verbose=verbose)
+    raise ValueError(f"runtime must be mesh|host|threaded|processes, "
+                     f"got {runtime!r}")
 
 
 def _split(x, y, test_frac=0.2, seed=0):
@@ -88,6 +104,7 @@ def config0_mlp_mnist(rounds: int = 10, seed: int = 0, n_data: int = 6000,
     x, y = synthetic_mnist(n_data, seed)
     xtr, ytr, xte, yte = _split(x, y)
     shards = iid_shards(xtr, ytr, cfg.client_num)
+    kw.setdefault("process_factory", "make_mlp")
     return run_with_runtime(make_mlp(), shards, (xte, yte), cfg,
                             rounds=rounds, seed=seed, **kw)
 
@@ -99,6 +116,7 @@ def config1_occupancy(rounds: int = 10, seed: int = 0,
     cfg = (cfg or ProtocolConfig()).validate()
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(xtr, ytr, cfg.client_num)
+    kw.setdefault("process_factory", "make_softmax_regression")
     return run_with_runtime(make_softmax_regression(), shards, (xte, yte),
                             cfg, rounds=rounds, seed=seed, **kw)
 
@@ -121,6 +139,7 @@ def config2_lenet_cifar10(rounds: int = 10, seed: int = 0, n_data: int = 6000,
     xtr, ytr, xte, yte = _split(x, y)
     shards = dirichlet_shards(xtr, ytr, cfg.client_num, alpha=alpha,
                               seed=seed, min_size=cfg.batch_size)
+    kw.setdefault("process_factory", "make_lenet5")
     return run_with_runtime(make_lenet5(), shards, (xte, yte), cfg,
                             rounds=rounds, seed=seed, **kw)
 
@@ -146,6 +165,7 @@ def config3_femnist_sampled(rounds: int = 10, seed: int = 0,
                               seed=seed, min_size=cfg.batch_size)
     if kw.get("runtime", "mesh") == "mesh":
         kw.setdefault("participation", "active")
+    kw.setdefault("process_factory", "make_femnist_cnn")
     return run_with_runtime(make_femnist_cnn(), shards, (xte, yte), cfg,
                             rounds=rounds, seed=seed, **kw)
 
@@ -184,6 +204,7 @@ def config4_resnet_cifar100(rounds: int = 5, seed: int = 0,
             kw.setdefault("secure_wallets", wallets)
     elif secure:
         raise ValueError("secure aggregation runs on the mesh runtime")
+    kw.setdefault("process_factory", "make_resnet18")
     return run_with_runtime(make_resnet18(), shards, (xte, yte), cfg,
                             rounds=rounds, seed=seed, **kw)
 
@@ -206,6 +227,10 @@ def config5_transformer_sst2(rounds: int = 5, seed: int = 0,
     model = make_transformer_classifier(vocab_size=1000, seq_len=64,
                                         num_classes=2, dim=128, depth=2,
                                         heads=4)
+    kw.setdefault("process_factory", "make_transformer_classifier")
+    kw.setdefault("factory_kw", dict(vocab_size=1000, seq_len=64,
+                                     num_classes=2, dim=128, depth=2,
+                                     heads=4))
     return run_with_runtime(model, shards, (xte, yte), cfg,
                             rounds=rounds, seed=seed, **kw)
 

@@ -27,13 +27,16 @@ _PROTOCOL_FIELDS = {f.name: f.type for f in
 class RunOptions:
     config: str = "config1"          # eval.configs preset name
     rounds: int = 10
-    runtime: str = "mesh"            # mesh | host | threaded
+    runtime: str = "mesh"            # mesh | host | threaded | processes
     ledger_backend: str = "auto"     # auto | native | python
     seed: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 0        # rounds between checkpoints; 0 = off
     trace_path: str = ""
     plot_path: str = ""              # write a run-evidence PNG here
+    standbys: int = 0                # processes runtime: hot standbys
+    tls_dir: str = ""                # processes runtime: TLS cert dir
+    secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 
 

@@ -214,3 +214,20 @@ def test_config4_secure_preset_full_shapes():
 # above; convergence numbers come from the accelerator via
 # tools/tpu_bench_configs.py (best_acc recorded per config in
 # TPU_RESULTS.md whenever the TPU tunnel is reachable).
+
+
+def test_run_with_runtime_guards():
+    from bflc_demo_tpu.eval.configs import run_with_runtime
+    from bflc_demo_tpu.models import make_softmax_regression
+    import numpy as np
+    shards = [(np.zeros((20, 5), np.float32), np.zeros(20, np.int64))] * 8
+    test = (np.zeros((10, 5), np.float32), np.zeros(10, np.int64))
+    with pytest.raises(ValueError):
+        run_with_runtime(make_softmax_regression(), shards, test, TINY,
+                         runtime="nope")
+    with pytest.raises(ValueError):   # processes needs a registered factory
+        run_with_runtime(make_softmax_regression(), shards, test, TINY,
+                         runtime="processes")
+    with pytest.raises(ValueError):   # mesh-only options on host runtime
+        run_with_runtime(make_softmax_regression(), shards, test, TINY,
+                         runtime="host", participation="active")
