@@ -51,7 +51,8 @@ def _addr(i: int) -> str:
 
 def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
                  rounds, rounds_per_dispatch, seed, client_chunk, remat,
-                 sizes_np, checkpoint_dir, checkpoint_every, tracer, verbose):
+                 sizes_np, checkpoint_dir, checkpoint_every, tracer,
+                 secure=False, secure_clip=1024.0, verbose=False):
     """R-rounds-per-dispatch execution with post-hoc ledger replay + audit.
 
     The device program (parallel.make_multi_round_program) samples uploaders,
@@ -69,7 +70,8 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
         aggregate_count=cfg.aggregate_count, comm_count=cfg.comm_count,
         needed_update_count=cfg.needed_update_count,
         rounds_per_dispatch=rounds_per_dispatch,
-        client_chunk=client_chunk, remat=remat)
+        client_chunk=client_chunk, remat=remat, secure=secure,
+        secure_clip=secure_clip)
 
     loss_history, round_times = [], []
     t0 = time.perf_counter()
@@ -211,9 +213,12 @@ def run_federated_mesh(model: Model,
     path only (rounds_per_dispatch=1).
     """
     cfg.validate()
-    if secure_aggregation and rounds_per_dispatch > 1:
-        raise ValueError("secure_aggregation requires rounds_per_dispatch=1 "
-                         "(per-round keys don't batch)")
+    if secure_aggregation and rounds_per_dispatch > 1 \
+            and secure_wallets is not None:
+        raise ValueError("DH secure aggregation requires "
+                         "rounds_per_dispatch=1 (the per-round X25519 pair "
+                         "matrix is derived on the host); shared-key mode "
+                         "batches (omit secure_wallets)")
     if estimate_flops and (secure_aggregation or rounds_per_dispatch > 1):
         # fail loudly rather than report flops_per_round=0 / mfu()=0.0 for
         # a benchmark that asked for the metric
@@ -299,7 +304,8 @@ def run_federated_mesh(model: Model,
                             sponsor, rounds, rounds_per_dispatch, seed,
                             client_chunk, remat, sizes_np,
                             checkpoint_dir, checkpoint_every,
-                            tracer or _NULL, verbose)
+                            tracer or _NULL, secure_aggregation,
+                            secure_clip, verbose)
 
     from bflc_demo_tpu.utils.tracing import NULL_TRACER
     tracer = tracer or NULL_TRACER

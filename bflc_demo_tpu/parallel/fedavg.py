@@ -324,6 +324,8 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
                              comm_count: int, needed_update_count: int,
                              rounds_per_dispatch: int,
                              client_chunk: int = 0, remat: bool = False,
+                             secure: bool = False,
+                             secure_clip: float = 1024.0,
                              ) -> Callable[..., MultiRoundResult]:
     """R protocol rounds as ONE XLA program — the amortised data plane.
 
@@ -332,7 +334,13 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
     device-side draw over current trainers), training, ring scoring, the
     replicated decision, the psum FedAvg, committee election for the next
     round (.cpp:443-455 semantics) and the sponsor eval all run under a
-    `lax.scan` over rounds.  The host ledger replays and AUDITS each round
+    `lax.scan` over rounds.
+
+    secure=True: the merge is the pairwise-masked fixed-point psum with a
+    per-round mask key folded from each scan step's PRNG key — SHARED-KEY
+    mode only (privacy against observers without the round key; the DH
+    matrix needs host X25519 per round and therefore stays on the
+    per-round dispatch path).  The host ledger replays and AUDITS each round
     afterwards (client/mesh_runtime.py `rounds_per_dispatch`): the op log
     remains the authority, the device is its optimistic executor, and any
     decision divergence raises.
@@ -407,8 +415,18 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
 
             sel_local = jax.lax.dynamic_slice(sel, (my * n_local,),
                                               (n_local,))
-            new_params = _psum_fedavg_body(params_round, deltas_local,
-                                           n_samples, sel_local, lr)
+            if secure:
+                from bflc_demo_tpu.parallel.secure import secure_fedavg_body
+                # independent stream from the uploader draw: fold a fixed
+                # tweak into this round's key
+                mask_key = jax.random.fold_in(r_key, 0x5EC)
+                new_params = secure_fedavg_body(
+                    params_round, deltas_local, n_samples, sel_local, lr,
+                    mask_key, axis=AXIS, n_total=n, clip=secure_clip,
+                    dh_mode=False)
+            else:
+                new_params = _psum_fedavg_body(params_round, deltas_local,
+                                               n_samples, sel_local, lr)
 
             fps_local = fingerprint_stacked(deltas_local)
             delta_fps = jax.lax.all_gather(fps_local, AXIS, tiled=True)

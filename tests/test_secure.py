@@ -229,23 +229,54 @@ class TestSecureMeshRuntime:
         assert res.rounds_completed == 2
         assert all(np.isfinite(a) for _, a in res.accuracy_history)
 
-    def test_secure_rejects_batched_dispatch(self):
+    def test_secure_batched_shared_key_matches_plain(self):
+        """rounds_per_dispatch > 1 with SHARED-KEY secure aggregation: the
+        per-round mask key folds from each scan step's PRNG key on-device,
+        so the amortised path blinds its merges too (DH stays per-round)."""
+        from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+        from bflc_demo_tpu.models import make_softmax_regression
+        from bflc_demo_tpu.protocol import ProtocolConfig
+
+        cfg = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=2,
+                             needed_update_count=3, learning_rate=0.05,
+                             batch_size=16, local_epochs=1)
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:1200], ytr[:1200], 8)
+
+        def run(secure):
+            return run_federated_mesh(
+                make_softmax_regression(), shards, (xte[:400], yte[:400]),
+                cfg, rounds=4, rounds_per_dispatch=2, seed=3,
+                secure_aggregation=secure)
+
+        plain = run(False)
+        masked = run(True)
+        assert masked.rounds_completed == 4
+        for key in plain.final_params:
+            np.testing.assert_allclose(
+                np.asarray(masked.final_params[key]),
+                np.asarray(plain.final_params[key]), atol=1e-2)
+
+    def test_secure_dh_rejects_batched_dispatch(self):
         import pytest as _pytest
+        from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+        from bflc_demo_tpu.models import make_softmax_regression
+        from bflc_demo_tpu.protocol import ProtocolConfig
+        cfg = ProtocolConfig(client_num=8, comm_count=2,
+                             aggregate_count=2, needed_update_count=3,
+                             learning_rate=0.05, batch_size=16,
+                             local_epochs=1)
+        xtr, ytr, xte, yte = load_occupancy()
+        wallets, _ = provision_wallets(8, b"mesh-secure-master-03")
         with _pytest.raises(ValueError):
-            from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
-            from bflc_demo_tpu.data import load_occupancy, iid_shards
-            from bflc_demo_tpu.models import make_softmax_regression
-            from bflc_demo_tpu.protocol import ProtocolConfig
-            cfg = ProtocolConfig(client_num=8, comm_count=2,
-                                 aggregate_count=2, needed_update_count=3,
-                                 learning_rate=0.05, batch_size=16,
-                                 local_epochs=1)
-            xtr, ytr, xte, yte = load_occupancy()
             run_federated_mesh(
                 make_softmax_regression(),
                 iid_shards(xtr[:800], ytr[:800], 8), (xte[:200], yte[:200]),
                 cfg, rounds=4, rounds_per_dispatch=2,
-                secure_aggregation=True)
+                secure_aggregation=True, secure_wallets=wallets)
 
 
 class TestSecureFedAvg:
