@@ -227,6 +227,61 @@ class TestInThreadPromotion:
             sb2.stop()
             srv.close()
 
+    def test_lower_priority_standby_refollows_promoted_winner(self):
+        """Kill ONLY the writer: standby 1 promotes, standby 2 must detect
+        that a higher-priority peer is alive, RE-FOLLOW the promoted
+        writer's op stream, and stay current with post-failover rounds."""
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"failover-master-0004")
+        srv = LedgerServer(CFG, _init_blob(), directory=directory,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        eps = [(srv.host, srv.port), ("127.0.0.1", 0), ("127.0.0.1", 0)]
+        sb1 = Standby(CFG, list(eps), 1, heartbeat_s=0.3,
+                      stall_timeout_s=60.0, ledger_backend="python")
+        sb1.endpoints[1] = (sb1.host, sb1.port)
+        eps[1] = (sb1.host, sb1.port)
+        sb2 = Standby(CFG, list(eps), 2, heartbeat_s=0.3,
+                      stall_timeout_s=60.0, ledger_backend="python")
+        sb2.endpoints[2] = (sb2.host, sb2.port)
+        eps[2] = (sb2.host, sb2.port)
+        threading.Thread(target=sb1.run, daemon=True).start()
+        threading.Thread(target=sb2.run, daemon=True).start()
+
+        client = FailoverClient(eps, timeout_s=15.0)
+        try:
+            for w in wallets:
+                assert client.request(
+                    "register", addr=w.address,
+                    pubkey=w.public_bytes.hex(),
+                    tag=_sign(w, "register", 0, b""))["ok"]
+            _drive_round(client, wallets, epoch=0)
+            size = client.request("info")["log_size"]
+            deadline = time.monotonic() + 20
+            while (sb1.ledger.log_size() < size
+                   or sb2.ledger.log_size() < size):
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            srv.close()                      # writer dies; sb1 stays up
+            assert sb1.promoted.wait(timeout=30)
+            assert not sb2.promoted.is_set()
+            # a round driven against the PROMOTED writer must reach sb2's
+            # replica via its re-followed subscription
+            _drive_round(client, wallets, epoch=1)
+            size2 = client.request("info")["log_size"]
+            deadline = time.monotonic() + 30
+            while sb2.ledger.log_size() < size2:
+                assert time.monotonic() < deadline, \
+                    f"sb2 stalled at {sb2.ledger.log_size()}/{size2}"
+                time.sleep(0.05)
+            assert not sb2.promoted.is_set()   # still a follower
+            assert sb2.ledger.log_head() == sb1.ledger.log_head()
+        finally:
+            client.close()
+            sb1.stop()
+            sb2.stop()
+            srv.close()
+
     def test_standby_rejects_bad_index(self):
         with pytest.raises(ValueError):
             Standby(CFG, [("127.0.0.1", 1)], 1)
