@@ -274,7 +274,9 @@ def run_federated_mesh(model: Model,
             aggregate_count=cfg.aggregate_count, client_chunk=client_chunk,
             remat=remat, local_optimizer=local_optimizer,
             secure=secure_aggregation,
-            secure_dh=secure_wallets is not None, secure_clip=secure_clip)
+            secure_dh=secure_wallets is not None, secure_clip=secure_clip,
+            comm_count=cfg.comm_count,
+            needed_update_count=cfg.needed_update_count)
 
     xte, yte = test_set
     sponsor = Sponsor(model, jnp.asarray(xte), jnp.asarray(one_hot(yte, nc)))

@@ -172,7 +172,9 @@ class MeshExecutorServer(LedgerServer):
             mesh, self.model.apply, client_num=n, lr=cfg.learning_rate,
             batch_size=cfg.batch_size, local_epochs=cfg.local_epochs,
             aggregate_count=cfg.aggregate_count,
-            client_chunk=self._client_chunk, remat=self._remat)
+            client_chunk=self._client_chunk, remat=self._remat,
+            comm_count=cfg.comm_count,
+            needed_update_count=cfg.needed_update_count)
 
         params = self._params
         rng = np.random.default_rng(self.seed)

@@ -21,6 +21,18 @@ This module closes it:
   (connection-refused — a bound-but-following standby accepts the TCP
   connect, which distinguishes "alive, not yet serving" from "gone").
   Highest live priority wins; everyone else re-follows the winner;
+- promotion is FENCED: the promoting standby appends a promote_writer op
+  (generation N+1) to the replicated chain itself before serving.  Clients
+  carry the highest generation they have seen on every request
+  (FailoverClient.gen); a pre-partition writer still at generation N
+  self-demotes (answers STALE_WRITER, closes) the moment any such request
+  reaches it, and a standby never follows a writer whose generation is
+  behind its own chain.  An asymmetric partition can still let the old
+  writer accept ops while isolated, but on heal exactly one chain survives:
+  the fenced one — the old writer's divergent suffix is abandoned and its
+  honest clients' signed ops replay idempotently against the promoted
+  writer.  (The reference gets no-fork from PBFT quorums; this is the
+  fail-stop-plus-fencing equivalent without a quorum round per op.);
 - the standby binds its serving socket AT START, so clients that fail over
   early sit in the listen backlog until promotion finishes — no
   connection-refused window;
@@ -80,6 +92,11 @@ class FailoverClient:
         self._tls = tls
         self._cur = 0
         self._client: Optional[CoordinatorClient] = None
+        # highest writer generation observed in any reply; sent back as the
+        # `fence` on every request, so a partitioned-then-healed stale
+        # writer self-demotes the moment any client that saw the promotion
+        # talks to it (comm.ledger_service fencing)
+        self.gen = 0
 
     @property
     def current_endpoint(self) -> Endpoint:
@@ -88,6 +105,7 @@ class FailoverClient:
     def request(self, method: str, **fields) -> dict:
         last: Optional[Exception] = None
         attempts = self._max_cycles * len(self._eps)
+        fields.setdefault("fence", self.gen)
         for attempt in range(attempts):
             try:
                 if self._client is None:
@@ -95,7 +113,19 @@ class FailoverClient:
                     self._client = CoordinatorClient(
                         host, port, timeout_s=self._timeout_s,
                         tls=self._tls)
-                return self._client.request(method, **fields)
+                reply = self._client.request(method, **fields)
+                g = reply.get("gen")
+                if isinstance(g, int) and g > self.gen:
+                    self.gen = g
+                    fields["fence"] = self.gen
+                if reply.get("status") == "STALE_WRITER":
+                    # the endpoint just demoted itself on our fence — it is
+                    # not the writer; rotate like a connection failure
+                    last = ConnectionError("stale writer demoted")
+                    self.close()
+                    self._cur = (self._cur + 1) % len(self._eps)
+                    continue
+                return reply
             except (ConnectionError, WireError, OSError) as e:
                 last = e
                 self.close()
@@ -190,7 +220,19 @@ class Standby:
                 return
             winner = self._elect()
             if winner == self.index:
-                self._promote_and_serve()
+                try:
+                    self._promote_and_serve()
+                except Exception:
+                    # a failed promotion must not leave the bound socket
+                    # accepting connects while nothing serves: peers would
+                    # keep electing this dead winner forever.  Close it so
+                    # their election sees connection-refused, and surface
+                    # the error instead of dying silently.
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    raise
                 return
             if winner < 0:
                 time.sleep(self.heartbeat_s)   # nobody promotable yet
@@ -213,7 +255,17 @@ class Standby:
                                 "from": self.ledger.log_size()})
             ctl = CoordinatorClient(host, port, timeout_s=10.0,
                                     tls=self.tls_client)
-        except (ConnectionError, OSError) as e:
+            # fence check: never follow a writer whose generation is behind
+            # our replayed chain — that's a stale pre-partition writer whose
+            # ops would fork us off the promoted chain
+            inf = ctl.request("info")
+            if int(inf.get("gen", 0)) < self.ledger.generation:
+                sub.close()
+                ctl.close()
+                raise WriterDead(
+                    f"stale writer: gen {inf.get('gen')} < "
+                    f"ours {self.ledger.generation}")
+        except (ConnectionError, WireError, OSError) as e:
             raise WriterDead(str(e))
         try:
             self._sync_state(ctl)
@@ -257,6 +309,7 @@ class Standby:
         never a full directory refetch or update rescan per op.
         """
         if self.ledger.update_count != self._synced_update_count:
+            all_stored = True
             for u in self.ledger.query_all_updates():
                 if u.payload_hash not in self._blobs:
                     r = ctl.request("blob", hash=u.payload_hash.hex())
@@ -264,7 +317,13 @@ class Standby:
                         blob = bytes.fromhex(r["blob"])
                         if hashlib.sha256(blob).digest() == u.payload_hash:
                             self._blobs[u.payload_hash] = blob
-            self._synced_update_count = self.ledger.update_count
+                    if u.payload_hash not in self._blobs:
+                        all_stored = False
+            # only record the sync point when every wanted blob landed — a
+            # transiently missed fetch must be retried on the next pass,
+            # not silently deferred until update_count changes again
+            if all_stored:
+                self._synced_update_count = self.ledger.update_count
         want_hash, _ = self.ledger.query_global_model()
         have = (hashlib.sha256(self._model_blob).digest()
                 if self._model_blob is not None else b"")
@@ -274,6 +333,15 @@ class Standby:
                 blob = bytes.fromhex(r["blob"])
                 if hashlib.sha256(blob).digest() == want_hash:
                     self._model_blob = blob
+        elif self._model_blob is None:
+            # genesis window: until the first commit the ledger's model
+            # hash is the zero digest, but the writer DOES hold the initial
+            # model blob — mirror it now (hash-unverifiable by design at
+            # genesis; every later commit re-checks), or a writer death
+            # before round 0 commits would make promotion impossible
+            r = ctl.request("model")
+            if r.get("ok"):
+                self._model_blob = bytes.fromhex(r["blob"])
         if self._directory is not None and \
                 self.ledger.num_registered != self._synced_registered:
             r = ctl.request("directory")
@@ -285,16 +353,21 @@ class Standby:
                         self._directory.enroll(pub)
                 self._synced_registered = self.ledger.num_registered
 
-    def _writer_alive(self, ep: Endpoint) -> bool:
+    def _writer_info(self, ep: Endpoint) -> Optional[dict]:
+        """The endpoint's `info` reply, or None when unreachable/broken."""
         try:
             probe = CoordinatorClient(ep[0], ep[1], timeout_s=2.0,
                                       tls=self.tls_client)
             try:
-                return bool(probe.request("info").get("ok"))
+                inf = probe.request("info")
+                return inf if inf.get("ok") else None
             finally:
                 probe.close()
         except (ConnectionError, WireError, OSError):
-            return False
+            return None
+
+    def _writer_alive(self, ep: Endpoint) -> bool:
+        return self._writer_info(ep) is not None
 
     # ------------------------------------------------------------- election
     def _elect(self) -> int:
@@ -308,7 +381,12 @@ class Standby:
             if j == self.index:
                 return self.index
             if j == 0:
-                if self._writer_alive(ep):
+                inf = self._writer_info(ep)
+                # a returned writer only wins if its fence is current: a
+                # stale pre-partition writer (lower generation) must not
+                # reclaim followers (split-brain defense)
+                if inf is not None and \
+                        int(inf.get("gen", 0)) >= self.ledger.generation:
                     return 0            # writer came back; keep following
                 continue
             try:
@@ -323,6 +401,14 @@ class Standby:
     def _promote_and_serve(self) -> None:
         if self._model_blob is None:
             raise RuntimeError("cannot promote: no model blob mirrored yet")
+        # the promotion FENCE: an op in the replicated chain itself.  Every
+        # replica that replays this log knows generation N+1's writer; a
+        # pre-partition writer still serving generation N self-demotes the
+        # moment any fence-carrying request reaches it (ledger_service).
+        st = self.ledger.promote_writer(self.ledger.generation + 1,
+                                        self.index)
+        if st != LedgerStatus.OK:
+            raise RuntimeError(f"promotion fence rejected: {st.name}")
         missing = [u.payload_hash.hex()[:12]
                    for u in self.ledger.query_all_updates()
                    if u.payload_hash not in self._blobs]

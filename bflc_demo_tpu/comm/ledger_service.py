@@ -134,6 +134,12 @@ class LedgerServer:
         self._last_progress = time.monotonic()
         self._rounds_completed = 0
         self._stop = threading.Event()
+        # split-brain defense: set when a request arrives carrying a fence
+        # (writer generation) HIGHER than this ledger's — someone promoted
+        # past us while we were partitioned.  The server self-demotes: it
+        # answers that one request with STALE_WRITER, then closes, so every
+        # later connect is refused and clients rotate to the real writer.
+        self.fenced = threading.Event()
         self._threads: List[threading.Thread] = []
 
         if sock is not None:
@@ -206,6 +212,23 @@ class LedgerServer:
                     self._stream_ops(conn, int(msg.get("from", 0)))
                     return
                 try:
+                    fence = int(msg.get("fence", -1))
+                except (TypeError, ValueError):
+                    fence = -1
+                if fence > self.ledger.generation:
+                    # a higher writer generation exists: self-demote.  The
+                    # reply tells the caller who is stale; the close makes
+                    # every other client see connection-refused and rotate.
+                    reply = {"ok": False, "status": "STALE_WRITER",
+                             "gen": self.ledger.generation,
+                             "observed_fence": fence}
+                    try:
+                        send_msg(conn, reply)
+                    finally:
+                        self.fenced.set()
+                        self.close()
+                    return
+                try:
                     reply = self._dispatch(method, msg)
                 except Exception as e:      # noqa: BLE001 — any dispatch
                     # failure (including a RuntimeError thrown by
@@ -214,6 +237,9 @@ class LedgerServer:
                     # leaves the innocent caller blocked until its socket
                     # timeout even though its own op may have been accepted
                     reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                # every reply carries the writer generation so clients learn
+                # the current fence passively and propagate it on requests
+                reply.setdefault("gen", self.ledger.generation)
                 send_msg(conn, reply)
         except (WireError, OSError):
             pass
@@ -392,7 +418,9 @@ class LedgerServer:
                         "last_global_loss": self.ledger.last_global_loss,
                         "rounds_completed": self._rounds_completed,
                         "log_size": self.ledger.log_size(),
-                        "log_head": self.ledger.log_head().hex()}
+                        "log_head": self.ledger.log_head().hex(),
+                        "gen": self.ledger.generation,
+                        "writer_index": self.ledger.writer_index}
             if method == "log_range":
                 start, end = int(m["start"]), int(m["end"])
                 size = self.ledger.log_size()

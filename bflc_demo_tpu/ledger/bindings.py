@@ -102,9 +102,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.bflc_reseat_committee.restype = i32
     lib.bflc_reseat_committee.argtypes = [p, ctypes.c_char_p]
     for name in ("bflc_epoch", "bflc_num_registered", "bflc_update_count",
-                 "bflc_score_count", "bflc_log_size"):
+                 "bflc_score_count", "bflc_log_size", "bflc_generation",
+                 "bflc_writer_index"):
         getattr(lib, name).restype = i64
         getattr(lib, name).argtypes = [p]
+    lib.bflc_promote_writer.restype = i32
+    lib.bflc_promote_writer.argtypes = [p, i64, i64]
     lib.bflc_last_global_loss.restype = f32
     lib.bflc_last_global_loss.argtypes = [p]
     lib.bflc_committee.restype = i64
@@ -254,6 +257,20 @@ class NativeLedger:
     @property
     def round_closed(self) -> bool:
         return bool(self._lib.bflc_round_closed(self._h))
+
+    # --- writer fencing ---
+    def promote_writer(self, generation: int,
+                       writer_index: int) -> LedgerStatus:
+        return LedgerStatus(self._lib.bflc_promote_writer(
+            self._h, generation, writer_index))
+
+    @property
+    def generation(self) -> int:
+        return self._lib.bflc_generation(self._h)
+
+    @property
+    def writer_index(self) -> int:
+        return self._lib.bflc_writer_index(self._h)
 
     # --- inspection ---
     @property

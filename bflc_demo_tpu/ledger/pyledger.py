@@ -19,7 +19,7 @@ import numpy as np
 from bflc_demo_tpu.ledger.base import LedgerStatus, UpdateInfo, PendingInfo
 
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES, _OP_COMMIT = 1, 2, 3, 4
-_OP_CLOSE, _OP_FORCE, _OP_RESEAT = 5, 6, 7
+_OP_CLOSE, _OP_FORCE, _OP_RESEAT, _OP_PROMOTE = 5, 6, 7, 8
 
 
 def _put_str(b: bytearray, s: str) -> None:
@@ -48,6 +48,8 @@ class PyLedger:
         self._scores: Dict[str, List[float]] = {}
         self._pending: Optional[PendingInfo] = None
         self._closed = False
+        self._generation = 0
+        self._writer_index = 0
         self._ops: List[bytes] = []
         self._log: List[bytes] = []
         self._wal = None
@@ -272,6 +274,30 @@ class PyLedger:
     def round_closed(self) -> bool:
         return self._closed
 
+    # --- writer fencing (split-brain defense; matches ledger.cpp) ---
+    def promote_writer(self, generation: int,
+                       writer_index: int) -> LedgerStatus:
+        """Record a writer promotion in the replicated log.  The fence must
+        advance by exactly one per promotion; valid at any epoch including
+        genesis (a writer can die before round 0 commits)."""
+        if generation != self._generation + 1 or writer_index < 0:
+            return LedgerStatus.BAD_ARG
+        self._generation = generation
+        self._writer_index = writer_index
+        op = bytearray([_OP_PROMOTE])
+        op += struct.pack("<q", generation)
+        op += struct.pack("<q", writer_index)
+        self._append_log(bytes(op))
+        return LedgerStatus.OK
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    @property
+    def writer_index(self) -> int:
+        return self._writer_index
+
     def _finish_scoring(self) -> None:
         k = len(self._updates)
         # scorer iteration in address order (C++ std::map key order == bytewise
@@ -427,6 +453,10 @@ class PyLedger:
                 if ep != self._epoch:
                     return LedgerStatus.BAD_ARG
                 return self.force_aggregate()
+            if code == _OP_PROMOTE:
+                gen, = struct.unpack_from("<q", body, 0)
+                idx, = struct.unpack_from("<q", body, 8)
+                return self.promote_writer(gen, idx)
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)

@@ -119,6 +119,20 @@ int32_t bflc_round_closed(void* h) {
   return static_cast<CommitteeLedger*>(h)->round_closed() ? 1 : 0;
 }
 
+int32_t bflc_promote_writer(void* h, int64_t generation,
+                            int64_t writer_index) {
+  return int32_t(static_cast<CommitteeLedger*>(h)->promote_writer(
+      generation, writer_index));
+}
+
+int64_t bflc_generation(void* h) {
+  return static_cast<CommitteeLedger*>(h)->generation();
+}
+
+int64_t bflc_writer_index(void* h) {
+  return static_cast<CommitteeLedger*>(h)->writer_index();
+}
+
 // addrs as a comma-joined list (addresses are hex strings, comma-free)
 int32_t bflc_reseat_committee(void* h, const char* addrs_csv) {
   std::vector<std::string> addrs;

@@ -11,7 +11,7 @@ namespace {
 // op codes for the serialized log
 enum OpCode : uint8_t { OP_REGISTER = 1, OP_UPLOAD = 2, OP_SCORES = 3,
                         OP_COMMIT = 4, OP_CLOSE = 5, OP_FORCE = 6,
-                        OP_RESEAT = 7 };
+                        OP_RESEAT = 7, OP_PROMOTE = 8 };
 
 void put_i64(std::vector<uint8_t>& b, int64_t v) {
   for (int i = 0; i < 8; ++i) b.push_back(uint8_t(uint64_t(v) >> (8 * i)));
@@ -359,6 +359,22 @@ Status CommitteeLedger::force_aggregate() {
   return Status::OK;
 }
 
+Status CommitteeLedger::promote_writer(int64_t generation,
+                                       int64_t writer_index) {
+  // strictly one step per promotion: replicas replaying the op stream and
+  // WAL recovery both re-derive the same fence sequence; a skipped or
+  // repeated generation is a protocol violation, not a race to tolerate
+  if (generation != generation_ + 1) return Status::BAD_ARG;
+  if (writer_index < 0) return Status::BAD_ARG;
+  generation_ = generation;
+  writer_index_ = writer_index;
+  std::vector<uint8_t> op{OP_PROMOTE};
+  put_i64(op, generation);
+  put_i64(op, writer_index);
+  append_log(op);
+  return Status::OK;
+}
+
 Status CommitteeLedger::commit_model(const Digest& new_model_hash,
                                      int64_t epoch) {
   if (!pending_) return Status::NOT_READY;
@@ -443,6 +459,12 @@ Status CommitteeLedger::apply_serialized(const std::vector<uint8_t>& op) {
       int64_t ep = r.i64();
       if (!r.ok || ep != epoch_) return Status::BAD_ARG;
       return force_aggregate();
+    }
+    case OP_PROMOTE: {
+      int64_t gen = r.i64();
+      int64_t idx = r.i64();
+      if (!r.ok) return Status::BAD_ARG;
+      return promote_writer(gen, idx);
     }
     case OP_RESEAT: {
       int64_t ep = r.i64();

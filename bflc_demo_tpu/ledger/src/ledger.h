@@ -117,6 +117,18 @@ class CommitteeLedger {
   Status reseat_committee(const std::vector<std::string>& addrs);
   bool round_closed() const { return closed_; }
 
+  // --- writer fencing (split-brain defense) ---
+  // Record a writer promotion IN the replicated log: the fence (generation)
+  // must advance by exactly one per promotion.  Replicas replaying the
+  // chain agree on the current writer; a server observing a higher fence
+  // than its own must self-demote (enforced in comm.ledger_service — the
+  // reference gets the equivalent no-fork guarantee from PBFT,
+  // README.md:162-183).  Valid at any epoch, including genesis: a writer
+  // can die before round 0 commits.
+  Status promote_writer(int64_t generation, int64_t writer_index);
+  int64_t generation() const { return generation_; }
+  int64_t writer_index() const { return writer_index_; }
+
   // --- aggregation handshake with the compute plane ---
   bool aggregate_ready() const { return pending_.has_value(); }
   const PendingAggregate* pending() const {
@@ -177,6 +189,8 @@ class CommitteeLedger {
   std::map<std::string, std::vector<float>> scores_;     // scorer -> slot scores
   std::optional<PendingAggregate> pending_;
   bool closed_ = false;                            // round closed early
+  int64_t generation_ = 0;                         // writer fence
+  int64_t writer_index_ = 0;                       // current writer's slot
 
   std::vector<std::vector<uint8_t>> ops_;  // serialized accepted mutations
   std::vector<Digest> log_;                // chained digests, log_[i] covers ops_[0..i]

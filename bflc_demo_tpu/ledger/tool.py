@@ -35,7 +35,8 @@ from bflc_demo_tpu.ledger.pyledger import PyLedger
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 _OP_NAMES = {1: "register", 2: "upload", 3: "scores", 4: "commit",
-             5: "close_round", 6: "force_aggregate", 7: "reseat_committee"}
+             5: "close_round", 6: "force_aggregate", 7: "reseat_committee",
+             8: "promote_writer"}
 
 
 def iter_wal_ops(path: str) -> Iterator[Tuple[int, bytes]]:
@@ -98,6 +99,9 @@ def decode_op(op: bytes) -> dict:
                 a, off = s_at(off)
                 addrs.append(a)
             out["committee"] = addrs
+        elif code == 8:
+            out["generation"], = struct.unpack_from("<q", body, 0)
+            out["writer_index"], = struct.unpack_from("<q", body, 8)
     except (struct.error, ValueError, UnicodeDecodeError) as e:
         out["malformed"] = f"{type(e).__name__}: {e}"
     return out

@@ -16,8 +16,8 @@ whole FL round as one SPMD program over a `jax.sharding.Mesh`:
 from bflc_demo_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, client_axis_mesh, local_device_count)
 from bflc_demo_tpu.parallel.fedavg import (  # noqa: F401
-    sharded_fedavg, ring_score_matrix, sharded_protocol_round,
-    make_sharded_protocol_round)
+    sharded_fedavg, ring_score_matrix, committee_score_matrix,
+    sharded_protocol_round, make_sharded_protocol_round)
 from bflc_demo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention, make_sp_transformer_forward)
 from bflc_demo_tpu.parallel.tp import (  # noqa: F401

@@ -152,9 +152,98 @@ def ring_score_matrix(apply_fn: ApplyFn, params: Pytree, deltas_local: Pytree,
     return rows
 
 
+def _first_k_indices(mask: jax.Array, k: int) -> jax.Array:
+    """(k,) ascending indices of the first k True entries of a (N,) mask.
+
+    Static output shape for a data-dependent set — the committee/uploader
+    slot lists the C×K scoring path gathers by.  A stable argsort of the
+    negated mask puts True entries first in index order (the spec'd
+    address-ascending total order, core.aggregate docstring).
+    """
+    order = jnp.argsort(~mask, stable=True)
+    return order[:k]
+
+
+def _gather_client_slots(tree_local: Pytree, idx: jax.Array, my: jax.Array,
+                         n_local: int, axis: str = AXIS) -> Pytree:
+    """Inside shard_map: gather k global client rows, replicated everywhere.
+
+    tree_local leaves are (n_local, ...) client-sharded; idx (k,) global
+    client ids.  Each device contributes its resident rows, a psum merges
+    them — k × leaf-row collective traffic, independent of N.
+    """
+    owner = idx // n_local
+    off = idx % n_local
+
+    def leaf(l):
+        picked = l[off]                                   # (k, ...)
+        m = (owner == my).reshape((-1,) + (1,) * (l.ndim - 1))
+        return jax.lax.psum(jnp.where(m, picked, jnp.zeros_like(picked)),
+                            axis)
+    return jax.tree_util.tree_map(leaf, tree_local)
+
+
+def committee_score_matrix(apply_fn: ApplyFn, params: Pytree,
+                           deltas_local: Pytree, lr, xs: jax.Array,
+                           ys: jax.Array, n_devices: int,
+                           committee_mask: jax.Array,
+                           uploader_mask: jax.Array, comm_count: int,
+                           k_up: int, chunk: int = 0) -> jax.Array:
+    """Inside shard_map: the C×K scoring the reference actually does.
+
+    The reference scores only committee members against only the K uploaded
+    candidates (main.py:212-217) — C×K evaluations.  The ring path scores
+    every resident client against every candidate (N×N) and then the
+    decision discards all but the committee rows and uploader columns.  This
+    path keeps the FLOPs at the protocol's scale:
+
+    - gather the K candidate deltas (replicated psum, K × model traffic);
+    - gather the C committee clients' eval shards (replicated psum,
+      C_pad × shard traffic, C_pad = C rounded up to a multiple of the
+      device count);
+    - each device evaluates its assigned C_pad/n_devices committee slots
+      against all K candidates — C_pad×K evals TOTAL across the mesh (vs
+      the ring's N×N), spread evenly;
+    - all_gather the (C_pad/n_devices, K) parts and scatter into a sparse
+      replicated (N, N) matrix: nonzero only at (committee row, uploader
+      column) — exactly the region the decision procedure and the ledger
+      audit read; every other entry is 0.
+
+    Returns the replicated (N, N) score matrix.
+    """
+    n_local = xs.shape[0]
+    n = n_local * n_devices
+    my = jax.lax.axis_index(AXIS)
+    up_idx = _first_k_indices(uploader_mask, k_up)            # (K,)
+    comm_idx = _first_k_indices(committee_mask, comm_count)   # (C,)
+
+    cands = _gather_client_slots(deltas_local, up_idx, my, n_local)
+
+    c_per = -(-comm_count // n_devices)                       # ceil, static
+    c_pad = c_per * n_devices
+    pad_idx = jnp.concatenate(
+        [comm_idx, jnp.broadcast_to(comm_idx[:1], (c_pad - comm_count,))])
+    valid = jnp.arange(c_pad) < comm_count
+
+    xs_comm = _gather_client_slots(xs, pad_idx, my, n_local)  # (C_pad, ...)
+    ys_comm = _gather_client_slots(ys, pad_idx, my, n_local)
+    xs_mine = jax.lax.dynamic_slice_in_dim(xs_comm, my * c_per, c_per, 0)
+    ys_mine = jax.lax.dynamic_slice_in_dim(ys_comm, my * c_per, c_per, 0)
+
+    part = _score_block(apply_fn, params, cands, lr, xs_mine, ys_mine,
+                        chunk)                                # (c_per, K)
+    parts = jax.lax.all_gather(part, AXIS, tiled=True)        # (C_pad, K)
+    vals = jnp.where(valid[:, None], parts, 0.0)
+    mat = jnp.zeros((n, n), jnp.float32)
+    # padded slots duplicate comm_idx[0] but add 0 — scatter-add is safe
+    return mat.at[pad_idx[:, None], up_idx[None, :]].add(vals)
+
+
 class ShardedRoundResult(NamedTuple):
     params: Pytree              # new global model (replicated)
-    score_matrix: jax.Array     # (N, N) scorer x candidate
+    score_matrix: jax.Array     # (N, N) scorer x candidate; on the C×K
+                                # scoring path nonzero only at (committee
+                                # row, uploader column)
     medians: jax.Array          # (N,)
     selected: jax.Array         # (N,) bool
     order: jax.Array            # (N,) candidate slots best-first
@@ -172,6 +261,9 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                                 secure: bool = False,
                                 secure_dh: bool = False,
                                 secure_clip: float = 64.0,
+                                scoring: str = "committee",
+                                comm_count: int = 0,
+                                needed_update_count: int = 0,
                                 ) -> Callable[..., ShardedRoundResult]:
     """Build the jitted full-round SPMD program for a fixed geometry.
 
@@ -197,11 +289,28 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
       size via lax.map — peak activations ∝ chunk, not clients/device;
     - remat: jax.checkpoint the per-client training step (recompute forward
       activations in the backward pass — the HBM<->FLOPs trade).
+
+    scoring selects the committee-evaluation schedule:
+    - "committee" (default): the reference's C×K — only committee shards
+      evaluate, only the K uploaded candidates are evaluated
+      (committee_score_matrix; requires static comm_count and
+      needed_update_count).  The result's score_matrix is sparse: nonzero
+      exactly at the (committee row, uploader column) region the decision
+      and the ledger audit consume.
+    - "ring": every resident client scores every candidate via the
+      ppermute ring (N×N — the dense matrix, useful for diagnostics and
+      as the differential oracle for the committee path).
     """
     n_devices = mesh.shape[AXIS]
     if client_num % n_devices:
         raise ValueError(f"client_num {client_num} not divisible by mesh "
                          f"axis {n_devices}")
+    if scoring not in ("committee", "ring"):
+        raise ValueError(f"scoring must be 'committee'|'ring', "
+                         f"got {scoring!r}")
+    if scoring == "committee" and not (comm_count and needed_update_count):
+        raise ValueError("scoring='committee' needs static comm_count and "
+                         "needed_update_count")
     n_local_static = client_num // n_devices
     if (client_chunk and client_chunk < n_local_static
             and n_local_static % client_chunk):
@@ -243,11 +352,17 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
             deltas_local, costs_local = jax.vmap(train_one)(xs, ys)
         deltas_local = _ensure_varying(deltas_local)
 
-        # 2. ring committee scoring -> local rows, then gather the tiny
-        #    (N, N) matrix everywhere for the replicated decision
-        rows = ring_score_matrix(apply_fn, params, deltas_local, lr, xs, ys,
-                                 n_devices, chunk=client_chunk)
-        score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)   # (N, N)
+        # 2. committee scoring -> replicated (N, N) matrix for the
+        #    replicated decision: C×K sparse (default) or the dense ring
+        if scoring == "committee":
+            score_matrix = committee_score_matrix(
+                apply_fn, params, deltas_local, lr, xs, ys, n_devices,
+                committee_mask, uploader_mask, comm_count,
+                needed_update_count, chunk=client_chunk)
+        else:
+            rows = ring_score_matrix(apply_fn, params, deltas_local, lr,
+                                     xs, ys, n_devices, chunk=client_chunk)
+            score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)
         costs = jax.lax.all_gather(costs_local, AXIS, tiled=True)   # (N,)
 
         # 3. replicated decision: median over committee rows, spec'd total
@@ -326,6 +441,7 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
                              client_chunk: int = 0, remat: bool = False,
                              secure: bool = False,
                              secure_clip: float = 1024.0,
+                             scoring: str = "committee",
                              ) -> Callable[..., MultiRoundResult]:
     """R protocol rounds as ONE XLA program — the amortised data plane.
 
@@ -361,6 +477,9 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
         raise ValueError(
             f"needed_update_count ({needed_update_count}) must be >= "
             f"comm_count ({comm_count}) for the batched multi-round program")
+    if scoring not in ("committee", "ring"):
+        raise ValueError(f"scoring must be 'committee'|'ring', "
+                         f"got {scoring!r}")
     n = client_num
     k_sel = aggregate_count
     k_up = needed_update_count
@@ -400,10 +519,16 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
                 deltas_local, costs_local = jax.vmap(t_one)(xs, ys)
             deltas_local = _ensure_varying(deltas_local)
 
-            rows = ring_score_matrix(apply_fn, params_round, deltas_local,
-                                     lr, xs, ys, n_devices,
-                                     chunk=client_chunk)
-            score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)
+            if scoring == "committee":
+                score_matrix = committee_score_matrix(
+                    apply_fn, params_round, deltas_local, lr, xs, ys,
+                    n_devices, comm_mask, uploader_mask, comm_count, k_up,
+                    chunk=client_chunk)
+            else:
+                rows = ring_score_matrix(apply_fn, params_round,
+                                         deltas_local, lr, xs, ys,
+                                         n_devices, chunk=client_chunk)
+                score_matrix = jax.lax.all_gather(rows, AXIS, tiled=True)
             costs = jax.lax.all_gather(costs_local, AXIS, tiled=True)
 
             med = median_scores(score_matrix, comm_mask)
@@ -468,10 +593,16 @@ def sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, params: Pytree,
                            n_samples: jax.Array, uploader_mask: jax.Array,
                            committee_mask: jax.Array, *, lr: float,
                            batch_size: int, local_epochs: int,
-                           aggregate_count: int) -> ShardedRoundResult:
-    """One-shot convenience wrapper over `make_sharded_protocol_round`."""
+                           aggregate_count: int,
+                           scoring: str = "committee") -> ShardedRoundResult:
+    """One-shot convenience wrapper over `make_sharded_protocol_round`.
+
+    Static C/K for the committee scoring schedule are read off the concrete
+    masks (this wrapper takes real arrays, not tracers)."""
     fn = make_sharded_protocol_round(
         mesh, apply_fn, client_num=int(xs.shape[0]), lr=lr,
         batch_size=batch_size, local_epochs=local_epochs,
-        aggregate_count=aggregate_count)
+        aggregate_count=aggregate_count, scoring=scoring,
+        comm_count=int(jnp.sum(committee_mask)),
+        needed_update_count=int(jnp.sum(uploader_mask)))
     return fn(params, xs, ys, n_samples, uploader_mask, committee_mask)

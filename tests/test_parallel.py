@@ -58,7 +58,8 @@ def test_sharded_fedavg_matches_apply_selection():
 
 
 class TestShardedProtocolRound:
-    def _run(self, n_clients=16, n_dev=8, shard=120, bs=40, k=6, seed=3):
+    def _run(self, n_clients=16, n_dev=8, shard=120, bs=40, k=6, seed=3,
+             scoring="committee"):
         rng = np.random.default_rng(seed)
         mesh = client_axis_mesh(n_dev)
         xs, ys = _client_batch(rng, n_clients, shard)
@@ -69,11 +70,13 @@ class TestShardedProtocolRound:
         res = sharded_protocol_round(
             mesh, MODEL.apply, MODEL.init_params(0), xs, ys, ns,
             uploader, committee, lr=0.01, batch_size=bs, local_epochs=1,
-            aggregate_count=k)
+            aggregate_count=k, scoring=scoring)
         return rng, xs, ys, ns, uploader, committee, res
 
     def test_matches_single_device_semantics(self):
-        _, xs, ys, ns, uploader, committee, res = self._run()
+        # the dense-oracle path: the ring scores every (scorer, candidate)
+        # pair, so the whole matrix is comparable against the host loop
+        _, xs, ys, ns, uploader, committee, res = self._run(scoring="ring")
         params = MODEL.init_params(0)
         # reference: per-client local_train + score loop + core.aggregate
         deltas, costs = [], []
@@ -135,3 +138,116 @@ class TestShardedProtocolRound:
         np.testing.assert_array_equal(outs[0].selected, outs[1].selected)
         np.testing.assert_allclose(outs[0].params["W"], outs[1].params["W"],
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestCommitteeScoring:
+    """The C×K scoring schedule (reference main.py:212-217: only committee
+    members score, only the K uploads get scored) against the dense ring."""
+
+    def _round(self, scoring, n_clients=16, n_dev=8, seed=3):
+        rng = np.random.default_rng(seed)
+        mesh = client_axis_mesh(n_dev)
+        xs, ys = _client_batch(rng, n_clients, 120)
+        ns = jnp.full((n_clients,), 120, jnp.int32)
+        uploader = jnp.asarray([True] * 10 + [False] * (n_clients - 10))
+        committee = jnp.asarray(
+            [False] * 10 + [True] * 4 + [False] * (n_clients - 14))
+        res = sharded_protocol_round(
+            mesh, MODEL.apply, MODEL.init_params(0), xs, ys, ns,
+            uploader, committee, lr=0.01, batch_size=40, local_epochs=1,
+            aggregate_count=6, scoring=scoring)
+        return uploader, committee, res
+
+    def test_decision_equivalent_to_ring(self):
+        """Same round under both schedules: identical selection, order,
+        medians at uploader slots, model, and identical score values on the
+        (committee row, uploader column) region both schedules compute."""
+        up, cm, ring = self._round("ring")
+        _, _, comm = self._round("committee")
+        np.testing.assert_array_equal(ring.selected, comm.selected)
+        np.testing.assert_array_equal(ring.order, comm.order)
+        upm = np.asarray(up)
+        np.testing.assert_allclose(np.asarray(ring.medians)[upm],
+                                   np.asarray(comm.medians)[upm], atol=1e-6)
+        np.testing.assert_allclose(ring.params["W"], comm.params["W"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(ring.global_loss, comm.global_loss,
+                                   rtol=1e-6)
+        region = np.ix_(np.flatnonzero(np.asarray(cm)),
+                        np.flatnonzero(upm))
+        np.testing.assert_allclose(np.asarray(ring.score_matrix)[region],
+                                   np.asarray(comm.score_matrix)[region],
+                                   atol=1e-6)
+
+    def test_sparse_outside_scored_region(self):
+        """Committee-path matrix is exactly zero outside committee rows x
+        uploader columns (nothing else was evaluated — that IS the saving)."""
+        up, cm, res = self._round("committee")
+        m = np.asarray(res.score_matrix).copy()
+        m[np.ix_(np.flatnonzero(np.asarray(cm)),
+                 np.flatnonzero(np.asarray(up)))] = 0.0
+        assert np.all(m == 0.0)
+
+    def test_scoring_flops_scale_with_committee_not_clients(self):
+        """XLA cost analysis on scoring-only programs: the ring burns
+        ~N×N evaluations, the committee schedule ~max(C, n_dev)×K — the
+        FLOP ratio must reflect it (VERDICT r3 item 3's 'Done' criterion).
+
+        Uses a model big enough (MLP, ~26k params) that candidate-eval
+        FLOPs dominate the committee path's gather/scatter bookkeeping —
+        on the 10-parameter softmax model the bookkeeping is the bigger
+        term and the ratio says nothing about eval scheduling."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from bflc_demo_tpu.eval.mfu import cost_analysis_flops
+        from bflc_demo_tpu.models import make_mlp
+        from bflc_demo_tpu.parallel.fedavg import (
+            AXIS, committee_score_matrix, ring_score_matrix)
+
+        n_dev, k_up, c = 4, 10, 4
+        model = make_mlp(input_shape=(64,), hidden=128, num_classes=2)
+        mesh = client_axis_mesh(n_dev)
+        params = model.init_params(0)
+
+        def flops(scoring, n_clients):
+            rng = np.random.default_rng(0)
+            xs = jnp.asarray(rng.standard_normal(
+                (n_clients, 120, 64)).astype(np.float32))
+            ys = jnp.asarray(np.eye(2, dtype=np.float32)[
+                rng.integers(0, 2, (n_clients, 120))])
+            deltas = jax.tree_util.tree_map(
+                lambda l: jnp.asarray(rng.standard_normal(
+                    (n_clients,) + l.shape).astype(np.float32)), params)
+            up = jnp.asarray([True] * k_up + [False] * (n_clients - k_up))
+            cm = jnp.asarray([False] * k_up + [True] * c
+                             + [False] * (n_clients - k_up - c))
+
+            def body(p, d, x, y, upm, cmm):
+                if scoring == "ring":
+                    rows = ring_score_matrix(model.apply, p, d, 0.01, x, y,
+                                             n_dev)
+                    return jax.lax.all_gather(rows, AXIS, tiled=True)
+                return committee_score_matrix(model.apply, p, d, 0.01, x, y,
+                                              n_dev, cmm, upm, c, k_up)
+            fn = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P("clients"), P("clients"), P("clients"),
+                          P(), P()),
+                out_specs=P(), check_vma=False)
+            compiled = jax.jit(fn).lower(params, deltas, xs, ys, up,
+                                         cm).compile()
+            return cost_analysis_flops(compiled)
+
+        # Caveat on absolute numbers: XLA's cost analysis counts a
+        # fori_loop body ONCE (trip counts are opaque to it), so the ring
+        # program's reported flops are one hop's worth — multiply by n_dev
+        # for the true total.  The N-scaling comparison below is immune to
+        # that: it compares like against like at two client counts.
+        r16, r32 = flops("ring", 16), flops("ring", 32)
+        c16, c32 = flops("committee", 16), flops("committee", 32)
+        # ring: clients/device doubles -> per-hop evals quadruple
+        assert r32 > 2.5 * r16, (r16, r32)
+        # committee: still c_pad x K evals — N-invariant up to gather cost
+        assert c32 < 1.5 * c16, (c16, c32)
+        # and the true totals at N=16: ring = n_dev hops x r16 vs c16
+        assert c16 < (n_dev * r16) / 3, (n_dev * r16, c16)
