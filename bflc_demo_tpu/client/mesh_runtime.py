@@ -49,6 +49,28 @@ def _addr(i: int) -> str:
     return f"0x{i:040x}"
 
 
+def _exec_plain_round(round_fn, args, compiled_round, estimate_flops):
+    """Dispatch one plain (non-secure) round, AOT-compiling once if asked.
+
+    Returns (result, compiled_round, flops_or_None): flops is non-None only
+    on the dispatch that compiled.  Executing the compiled object bypasses
+    the builder's wrapper, so its mask popcount guard re-runs here
+    explicitly.
+    """
+    flops = None
+    if estimate_flops and compiled_round is None:
+        from bflc_demo_tpu.eval.mfu import cost_analysis_flops
+        compiled_round = round_fn._jitted.lower(
+            *args, round_fn._dummy).compile()
+        flops = cost_analysis_flops(compiled_round)
+    if compiled_round is not None:
+        round_fn._check_masks(args[4], args[5])
+        res = compiled_round(*args, round_fn._dummy)
+    else:
+        res = round_fn(*args)
+    return res, compiled_round, flops
+
+
 def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
                  rounds, rounds_per_dispatch, seed, client_chunk, remat,
                  sizes_np, checkpoint_dir, checkpoint_every, tracer,
@@ -356,13 +378,10 @@ def run_federated_mesh(model: Model,
                 args += (_secure_key(list(range(n))),)
                 res = round_fn(*args)
             else:
-                if estimate_flops and compiled_round is None:
-                    from bflc_demo_tpu.eval.mfu import cost_analysis_flops
-                    compiled_round = round_fn._jitted.lower(
-                        *args, round_fn._dummy).compile()
-                    flops_per_round = cost_analysis_flops(compiled_round)
-                res = (compiled_round(*args, round_fn._dummy)
-                       if compiled_round is not None else round_fn(*args))
+                res, compiled_round, f = _exec_plain_round(
+                    round_fn, args, compiled_round, estimate_flops)
+                if f is not None:
+                    flops_per_round = f
             up_slots, comm_slots = uploader_ids, committee_ids
         else:
             # stream this round's participant shards onto the mesh;
@@ -378,13 +397,10 @@ def run_federated_mesh(model: Model,
                 args += (_secure_key(active),)
                 res = round_fn(*args)
             else:
-                if estimate_flops and compiled_round is None:
-                    from bflc_demo_tpu.eval.mfu import cost_analysis_flops
-                    compiled_round = round_fn._jitted.lower(
-                        *args, round_fn._dummy).compile()
-                    flops_per_round = cost_analysis_flops(compiled_round)
-                res = (compiled_round(*args, round_fn._dummy)
-                       if compiled_round is not None else round_fn(*args))
+                res, compiled_round, f = _exec_plain_round(
+                    round_fn, args, compiled_round, estimate_flops)
+                if f is not None:
+                    flops_per_round = f
             up_slots = list(range(k))
             comm_slots = list(range(k, k + c))
         params = res.params

@@ -24,6 +24,7 @@ from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
@@ -261,7 +262,7 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                                 secure: bool = False,
                                 secure_dh: bool = False,
                                 secure_clip: float = 64.0,
-                                scoring: str = "committee",
+                                scoring: str = "auto",
                                 comm_count: int = 0,
                                 needed_update_count: int = 0,
                                 ) -> Callable[..., ShardedRoundResult]:
@@ -291,12 +292,18 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
       activations in the backward pass — the HBM<->FLOPs trade).
 
     scoring selects the committee-evaluation schedule:
-    - "committee" (default): the reference's C×K — only committee shards
+    - "auto" (default): "committee" when both static counts are given,
+      else "ring".  Callers that don't know the committee geometry
+      statically always get a working program — the round-4 post-mortem:
+      a hard raise here broke the external driver contract
+      (__graft_entry__.dryrun_multichip) while every internal call site
+      had been updated, so the breakage shipped unexecuted.
+    - "committee": the reference's C×K — only committee shards
       evaluate, only the K uploaded candidates are evaluated
       (committee_score_matrix; requires static comm_count and
-      needed_update_count).  The result's score_matrix is sparse: nonzero
-      exactly at the (committee row, uploader column) region the decision
-      and the ledger audit consume.
+      needed_update_count, raises without them).  The result's
+      score_matrix is sparse: nonzero exactly at the (committee row,
+      uploader column) region the decision and the ledger audit consume.
     - "ring": every resident client scores every candidate via the
       ppermute ring (N×N — the dense matrix, useful for diagnostics and
       as the differential oracle for the committee path).
@@ -305,12 +312,26 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
     if client_num % n_devices:
         raise ValueError(f"client_num {client_num} not divisible by mesh "
                          f"axis {n_devices}")
-    if scoring not in ("committee", "ring"):
-        raise ValueError(f"scoring must be 'committee'|'ring', "
+    if scoring not in ("auto", "committee", "ring"):
+        raise ValueError(f"scoring must be 'auto'|'committee'|'ring', "
                          f"got {scoring!r}")
+    if scoring == "auto":
+        if bool(comm_count) != bool(needed_update_count):
+            raise ValueError(
+                f"scoring='auto' got a half-specified committee geometry "
+                f"(comm_count={comm_count}, needed_update_count="
+                f"{needed_update_count}): pass both for the C×K committee "
+                f"schedule or neither for the ring fallback")
+        scoring = "committee" if comm_count else "ring"
     if scoring == "committee" and not (comm_count and needed_update_count):
         raise ValueError("scoring='committee' needs static comm_count and "
                          "needed_update_count")
+    if not (0 <= comm_count <= client_num
+            and 0 <= needed_update_count <= client_num):
+        raise ValueError(
+            f"comm_count {comm_count} / needed_update_count "
+            f"{needed_update_count} must be in [0, client_num="
+            f"{client_num}]")
     n_local_static = client_num // n_devices
     if (client_chunk and client_chunk < n_local_static
             and n_local_static % client_chunk):
@@ -404,17 +425,55 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
         in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
         out_specs=P(), check_vma=False)
     jfn = jax.jit(fn)
+
+    _mask_memo: dict = {}
+
+    def _check_masks(uploader_mask, committee_mask):
+        # the committee schedule gathers exactly the static C/K slots; a
+        # concrete mask whose popcount disagrees would silently score the
+        # wrong clients (ADVICE r4: _first_k_indices pads with False slots)
+        if scoring != "committee":
+            return
+        memo_key = (id(uploader_mask), id(committee_mask))
+        if memo_key in _mask_memo:
+            return      # same arrays already verified (streaming runtimes
+                        # reuse static masks every round — skip the re-sync)
+        for name, m, want in (("uploader_mask", uploader_mask,
+                               needed_update_count),
+                              ("committee_mask", committee_mask,
+                               comm_count)):
+            if isinstance(m, jax.core.Tracer):
+                return                   # under an outer trace: caller's jit
+            got = int(np.asarray(m).sum())
+            if got != want:
+                raise ValueError(
+                    f"{name} has {got} True entries but the program was "
+                    f"built for a static count of {want}")
+        if len(_mask_memo) >= 16:
+            _mask_memo.pop(next(iter(_mask_memo)))
+        # strong refs keep the arrays alive so the ids can't be recycled
+        _mask_memo[memo_key] = (uploader_mask, committee_mask)
+
     if secure:
-        return jfn                      # caller supplies the trailing key
+        def sec(params, xs, ys, n_samples, uploader_mask, committee_mask,
+                secure_key):
+            _check_masks(uploader_mask, committee_mask)
+            return jfn(params, xs, ys, n_samples, uploader_mask,
+                       committee_mask, secure_key)
+        sec._jitted = jfn
+        sec._check_masks = _check_masks
+        return sec
     _dummy = jax.random.PRNGKey(0)      # untouched when secure=False
 
     def plain(params, xs, ys, n_samples, uploader_mask, committee_mask):
+        _check_masks(uploader_mask, committee_mask)
         return jfn(params, xs, ys, n_samples, uploader_mask, committee_mask,
                    _dummy)
     # AOT surface for cost analysis (eval.mfu): lower/compile the round
     # with real args once, read XLA's FLOPs estimate, reuse the executable
     plain._jitted = jfn
     plain._dummy = _dummy
+    plain._check_masks = _check_masks
     return plain
 
 
@@ -477,6 +536,15 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
         raise ValueError(
             f"needed_update_count ({needed_update_count}) must be >= "
             f"comm_count ({comm_count}) for the batched multi-round program")
+    if client_num - comm_count < needed_update_count:
+        # committee members are excluded from the uploader draw, so only
+        # n - C candidates exist; with fewer than K the top-K mask has
+        # < K True entries and _first_k_indices would silently score
+        # never-uploaded deltas into the "sparse" matrix
+        raise ValueError(
+            f"client_num - comm_count ({client_num - comm_count}) must be "
+            f">= needed_update_count ({needed_update_count}): the uploader "
+            f"draw excludes committee members")
     if scoring not in ("committee", "ring"):
         raise ValueError(f"scoring must be 'committee'|'ring', "
                          f"got {scoring!r}")

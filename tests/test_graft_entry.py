@@ -1,0 +1,47 @@
+"""Driver-surface guard: `__graft_entry__` must ALWAYS work.
+
+Round-4 post-mortem: `make_sharded_protocol_round` grew mandatory kwargs
+(static comm_count / needed_update_count for the new default committee
+scoring schedule); every internal call site was updated but the externally
+visible driver entry point was not, so `dryrun_multichip` raised before any
+compute and the round shipped zero multi-device evidence
+(MULTICHIP_r04.json rc=1 — a regression from green in rounds 2-3).
+Nothing in tests/ executed the entry surface, so nothing could catch it.
+
+These tests execute the REAL driver surface — the same module, the same
+functions, the same call paths the driver runs — so an API change that
+breaks the contract fails CI instead of silently zeroing out the round's
+evidence.  Reference behavior being evidenced downstream: the replicated
+committee round of CommitteePrecompiled.cpp:349-456.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    """entry() returns (fn, args) and jax.jit(fn)(*args) executes."""
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    out = jax.block_until_ready(out)
+    assert out.shape == (256, 2)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dryrun_multichip_two_devices():
+    """The full multichip dryrun executes on a 2-device mesh.
+
+    This is the exact function the driver calls (with n=8); n=2 exercises
+    every geometry branch (FL round incl. committee scoring, dp x tp, ring
+    attention, MoE, sp x tp, pp, 1F1B, secure aggregation) at the smallest
+    mesh that has real collectives.  conftest.py pins 8 virtual CPU devices,
+    so this runs in-process.
+    """
+    graft.dryrun_multichip(2)

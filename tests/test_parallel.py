@@ -251,3 +251,72 @@ class TestCommitteeScoring:
         assert c32 < 1.5 * c16, (c16, c32)
         # and the true totals at N=16: ring = n_dev hops x r16 vs c16
         assert c16 < (n_dev * r16) / 3, (n_dev * r16, c16)
+
+
+class TestRoundBuilderValidation:
+    """Static-geometry guards on make_sharded_protocol_round (round-4
+    post-mortem: a silent-wrong or hard-raise geometry must fail loudly at
+    BUILD time or be caught at CALL time, never score the wrong clients)."""
+
+    def _build(self, **kw):
+        from bflc_demo_tpu.parallel.fedavg import make_sharded_protocol_round
+        mesh = client_axis_mesh(4)
+        base = dict(client_num=8, lr=0.01, batch_size=20, local_epochs=1,
+                    aggregate_count=2)
+        base.update(kw)
+        return make_sharded_protocol_round(mesh, MODEL.apply, **base)
+
+    def test_auto_without_counts_falls_back_to_ring(self):
+        """The external-driver contract: no static counts still builds a
+        working (ring) program — the exact call shape that broke r4."""
+        rng = np.random.default_rng(0)
+        xs, ys = _client_batch(rng, 8, 40)
+        ns = jnp.full((8,), 40, jnp.int32)
+        up = jnp.asarray([True] * 4 + [False] * 4)
+        cm = jnp.asarray([False] * 6 + [True] * 2)
+        res = self._build()(MODEL.init_params(0), xs, ys, ns, up, cm)
+        assert res.score_matrix.shape == (8, 8)
+        # dense matrix == ring schedule ran (committee would zero non-
+        # committee rows); row 0 is a non-committee scorer
+        assert np.any(np.asarray(res.score_matrix)[0] != 0.0)
+
+    def test_auto_half_specified_raises(self):
+        with pytest.raises(ValueError, match="half-specified"):
+            self._build(comm_count=2)
+        with pytest.raises(ValueError, match="half-specified"):
+            self._build(needed_update_count=4)
+
+    def test_committee_without_counts_raises(self):
+        with pytest.raises(ValueError, match="needs static"):
+            self._build(scoring="committee")
+
+    def test_counts_out_of_range_raise(self):
+        for bad in (dict(comm_count=-1, needed_update_count=4),
+                    dict(comm_count=2, needed_update_count=9),
+                    dict(comm_count=9, needed_update_count=4)):
+            with pytest.raises(ValueError, match="must be in"):
+                self._build(**bad)
+
+    def test_wrong_mask_popcount_rejected_at_call(self):
+        """A concrete mask whose popcount disagrees with the static C/K
+        would make _first_k_indices score never-uploaded deltas (ADVICE r4
+        low) — the wrapper must reject it before dispatch."""
+        fn = self._build(comm_count=2, needed_update_count=4)
+        rng = np.random.default_rng(0)
+        xs, ys = _client_batch(rng, 8, 40)
+        ns = jnp.full((8,), 40, jnp.int32)
+        up3 = jnp.asarray([True] * 3 + [False] * 5)       # 3 != K=4
+        cm = jnp.asarray([False] * 6 + [True] * 2)
+        with pytest.raises(ValueError, match="uploader_mask has 3"):
+            fn(MODEL.init_params(0), xs, ys, ns, up3, cm)
+
+    def test_multi_round_rejects_trainer_starvation(self):
+        """client_num - comm_count < K: the uploader draw (which excludes
+        committee members) could never yield K uploaders."""
+        from bflc_demo_tpu.parallel.fedavg import make_multi_round_program
+        mesh = client_axis_mesh(4)
+        with pytest.raises(ValueError, match="excludes committee"):
+            make_multi_round_program(
+                mesh, MODEL.apply, client_num=8, lr=0.01, batch_size=20,
+                local_epochs=1, aggregate_count=2, comm_count=4,
+                needed_update_count=6, rounds_per_dispatch=2)
