@@ -19,6 +19,14 @@ Uploader choice: the reference's "first come 10" (.cpp:239-244) is an
 asynchrony artifact; here a seeded permutation of the trainers picks the
 round's uploaders, then uploads run in ascending client order so ledger slot
 order equals the device's index-ascending tiebreak.
+
+TRUST-MODEL DIVERGENCE (documented, PARITY.md "Trust-model divergences"):
+in the reference each committee member scores on its own machine and signs
+its own score tx (main.py:196-228).  Here committee rows are computed
+centrally on the coordinator's mesh — the price of the one-program round.
+The ledger still re-runs the decision on the recorded rows (divergence
+raises), but a malicious coordinator could fabricate rows; use
+client/process_runtime.py when committee members distrust the coordinator.
 """
 
 from __future__ import annotations
@@ -49,6 +57,24 @@ def _addr(i: int) -> str:
     return f"0x{i:040x}"
 
 
+def _fresh_mask_key():
+    """A shared-key secure-aggregation run key from OS entropy.
+
+    NEVER derived from the public run seed (round-4 advisor finding: a
+    seed-derived mask key lets anyone who knows the config unmask
+    individual deltas — privacy by obscurity).  Consequence, documented:
+    shared-key secure runs are NOT bit-reproducible across invocations in
+    their mask bits; the aggregated results still are, because the masks
+    cancel exactly in the merge.  64 bits of os.urandom saturate the
+    threefry key space.
+    """
+    import os as _os
+    w = int.from_bytes(_os.urandom(8), "little")
+    return jax.random.fold_in(
+        jax.random.PRNGKey(np.uint32(w & 0xFFFFFFFF)),
+        np.uint32(w >> 32))
+
+
 def _exec_plain_round(round_fn, args, compiled_round, estimate_flops):
     """Dispatch one plain (non-secure) round, AOT-compiling once if asked.
 
@@ -74,7 +100,8 @@ def _exec_plain_round(round_fn, args, compiled_round, estimate_flops):
 def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
                  rounds, rounds_per_dispatch, seed, client_chunk, remat,
                  sizes_np, checkpoint_dir, checkpoint_every, tracer,
-                 secure=False, secure_clip=1024.0, verbose=False):
+                 secure=False, secure_wallets=None, secure_clip=1024.0,
+                 verbose=False):
     """R-rounds-per-dispatch execution with post-hoc ledger replay + audit.
 
     The device program (parallel.make_multi_round_program) samples uploaders,
@@ -86,6 +113,7 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
     from bflc_demo_tpu.parallel.fedavg import make_multi_round_program
 
     n = cfg.client_num
+    dh = secure_wallets is not None
     program = make_multi_round_program(
         mesh, model.apply, client_num=n, lr=cfg.learning_rate,
         batch_size=cfg.batch_size, local_epochs=cfg.local_epochs,
@@ -93,7 +121,7 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
         needed_update_count=cfg.needed_update_count,
         rounds_per_dispatch=rounds_per_dispatch,
         client_chunk=client_chunk, remat=remat, secure=secure,
-        secure_clip=secure_clip)
+        secure_dh=dh, secure_clip=secure_clip)
 
     loss_history, round_times = [], []
     t0 = time.perf_counter()
@@ -104,8 +132,19 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
         comm_mask0 = np.zeros(n, bool)
         comm_mask0[committee_ids] = True
         key, sub = jax.random.split(key)
-        res = program(params, xs, ys, ns, jnp.asarray(comm_mask0), sub,
-                      sponsor.x, sponsor.y)
+        args = (params, xs, ys, ns, jnp.asarray(comm_mask0), sub,
+                sponsor.x, sponsor.y)
+        if secure:
+            # trailing mask argument, independent of the sampling key: one
+            # DH pair-seed matrix per dispatch (the round context makes
+            # each dispatch's seeds distinct) or a fresh OS-entropy key;
+            # the program folds the in-dispatch round counter per round
+            if dh:
+                from bflc_demo_tpu.parallel.secure import derive_pair_seeds
+                args += (derive_pair_seeds(secure_wallets, ledger.epoch),)
+            else:
+                args += (_fresh_mask_key(),)
+        res = program(*args)
         params = res.params
         # host side: replay + audit R rounds into the ledger
         up_masks = np.asarray(res.uploader_masks)
@@ -230,17 +269,14 @@ def run_federated_mesh(model: Model,
     as the pairwise-masked fixed-point psum (parallel.secure) so no observer
     of an individual delta contribution learns it.  With `secure_wallets`
     (one comm.identity.Wallet per client) the masks are keyed by per-pair
-    X25519 — the aggregator cannot strip them; without, a per-round shared
-    PRNG key (privacy against outside observers only).  Per-round dispatch
-    path only (rounds_per_dispatch=1).
+    X25519 — the aggregator cannot strip them; without, a shared PRNG key
+    drawn from OS entropy at run start (privacy against outside observers
+    only; mask bits are therefore not reproducible from `seed` — by
+    design).  Both modes compose with rounds_per_dispatch>1: the batched
+    program re-keys each round by folding the scan counter (one DH
+    derivation or one fresh key per dispatch).
     """
     cfg.validate()
-    if secure_aggregation and rounds_per_dispatch > 1 \
-            and secure_wallets is not None:
-        raise ValueError("DH secure aggregation requires "
-                         "rounds_per_dispatch=1 (the per-round X25519 pair "
-                         "matrix is derived on the host); shared-key mode "
-                         "batches (omit secure_wallets)")
     if estimate_flops and (secure_aggregation or rounds_per_dispatch > 1):
         # fail loudly rather than report flops_per_round=0 / mfu()=0.0 for
         # a benchmark that asked for the metric
@@ -329,10 +365,15 @@ def run_federated_mesh(model: Model,
                             client_chunk, remat, sizes_np,
                             checkpoint_dir, checkpoint_every,
                             tracer or _NULL, secure_aggregation,
-                            secure_clip, verbose)
+                            secure_wallets, secure_clip, verbose)
 
     from bflc_demo_tpu.utils.tracing import NULL_TRACER
     tracer = tracer or NULL_TRACER
+    # shared-key secure mode: ONE fresh OS-entropy run key, folded per
+    # epoch — never derived from the public `seed` (see _fresh_mask_key)
+    run_mask_key = (_fresh_mask_key()
+                    if secure_aggregation and secure_wallets is None
+                    else None)
     loss_history, round_times = [], []
     # estimate_flops: AOT-compile the round with the REAL first-round args,
     # read XLA's cost analysis (the MFU numerator, eval.mfu), and reuse the
@@ -363,9 +404,7 @@ def run_federated_mesh(model: Model,
                 from bflc_demo_tpu.parallel.secure import derive_pair_seeds
                 return derive_pair_seeds(
                     [secure_wallets[i] for i in slot_clients], epoch)
-            return jax.random.fold_in(
-                jax.random.PRNGKey(np.uint32(seed) ^ np.uint32(0x5EC)),
-                epoch)
+            return jax.random.fold_in(run_mask_key, epoch)
 
         if participation == "full":
             uploader_mask = np.zeros(n, bool)

@@ -77,7 +77,7 @@ def _cpu_spawn_env():
 
 def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  stall_timeout_s: float, wal_path: str, tls_dir: str,
-                 verbose: bool) -> None:
+                 standby_keys: dict, quorum: int, verbose: bool) -> None:
     _force_cpu_jax()
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
     tls = None
@@ -86,7 +86,9 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
         tls = server_context(tls_dir)
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
                           stall_timeout_s=stall_timeout_s,
-                          wal_path=wal_path, tls=tls, verbose=verbose)
+                          wal_path=wal_path, tls=tls,
+                          standby_keys=standby_keys, quorum=quorum,
+                          verbose=verbose)
     port_q.put(server.port)
     server.serve_forever()
 
@@ -134,11 +136,21 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
         from bflc_demo_tpu.comm.tls import client_context
         tls = client_context(tls_dir)
     client = FailoverClient(endpoints, timeout_s=120.0, tls=tls)
-    reply = client.request("register", addr=wallet.address,
-                           pubkey=wallet.public_bytes.hex(),
-                           tag=_sign(wallet, "register", 0, b""))
-    if not reply["ok"] and reply.get("status") not in ("ALREADY_REGISTERED",
-                                                       "DUPLICATE"):
+    reg_deadline = time.monotonic() + 120.0
+    while True:
+        reply = client.request("register", addr=wallet.address,
+                               pubkey=wallet.public_bytes.hex(),
+                               tag=_sign(wallet, "register", 0, b""))
+        if reply["ok"] or reply.get("status") in ("ALREADY_REGISTERED",
+                                                  "DUPLICATE"):
+            break
+        if reply.get("status") == "REPLICATION_TIMEOUT" \
+                and time.monotonic() < reg_deadline:
+            # quorum mode: the op is in the writer's chain but followers
+            # haven't acked yet (e.g. a standby still subscribing at
+            # startup) — transient; retry until it reports as in
+            time.sleep(0.5)
+            continue
         raise RuntimeError(f"register failed: {reply}")
 
     trained_epoch = scored_epoch = cfg.initial_trained_epoch
@@ -230,11 +242,13 @@ def _replica_proc(host: str, port: int, cfg_kw: dict, until_ops: int,
 
 def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                   index: int, port_q, stall_timeout_s: float,
-                  tls_dir: str, verbose: bool) -> None:
+                  tls_dir: str, wallet_seed: bytes, standby_keys: dict,
+                  quorum: int, verbose: bool) -> None:
     """Hot standby: follow the writer's op stream, promote on its death
     (comm.failover.Standby).  Reports its serving port, then blocks."""
     _force_cpu_jax()
     from bflc_demo_tpu.comm.failover import Standby
+    from bflc_demo_tpu.comm.identity import Wallet
     tls_c = tls_s = None
     if tls_dir:
         from bflc_demo_tpu.comm.tls import client_context, server_context
@@ -242,7 +256,10 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
     standby = Standby(ProtocolConfig(**cfg_kw),
                       endpoints + [("127.0.0.1", 0)], index,
                       stall_timeout_s=stall_timeout_s,
-                      tls_client=tls_c, tls_server=tls_s, verbose=verbose)
+                      tls_client=tls_c, tls_server=tls_s,
+                      wallet=Wallet.from_seed(wallet_seed),
+                      standby_keys=standby_keys, quorum=quorum,
+                      verbose=verbose)
     # the placeholder self-endpoint gets the real bound port
     standby.endpoints[index] = (standby.host, standby.port)
     port_q.put(standby.port)
@@ -284,6 +301,7 @@ def run_federated_processes(
         standbys: int = 0,
         kill_writer_at_epoch: Optional[int] = None,
         tls_dir: str = "",
+        quorum: int = 0,
         timeout_s: float = 600.0,
         init_seed: int = 0,
         verbose: bool = False) -> ProcessFederationResult:
@@ -304,6 +322,10 @@ def run_federated_processes(
     kill_writer_at_epoch: SIGKILL the PRIMARY coordinator process once the
     federation reaches this epoch (requires standbys >= 1) — the no-single-
     point-of-failure drill: the promoted standby must finish the run.
+    quorum: acknowledge storage mutations only after this many followers
+    (standbys/replicas) applied them — acknowledged ops then survive
+    writer death (comm.ledger_service quorum-ack; requires at least that
+    many subscribers or every mutation times out).
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -337,11 +359,19 @@ def run_federated_processes(
     port_q = ctx.Queue()
     host = "127.0.0.1"
     standby_procs: List = []
+    # standby identities: deterministic wallets from the run's master seed;
+    # only their PUBLIC keys reach the writer (the demotion allowlist —
+    # promotion evidence must be signed by one of these)
+    from bflc_demo_tpu.comm.identity import Wallet
+    standby_seeds = {s + 1: master_seed + b"|standby|"
+                     + struct.pack("<q", s + 1) for s in range(standbys)}
+    standby_keys = {idx: Wallet.from_seed(sd).public_bytes
+                    for idx, sd in standby_seeds.items()}
     with _cpu_spawn_env():
         server = ctx.Process(target=_server_proc,
                              args=(cfg_kw, initial_blob, port_q,
                                    stall_timeout_s, wal_path, tls_dir,
-                                   verbose),
+                                   standby_keys, quorum, verbose),
                              daemon=True)
         server.start()
         port = port_q.get(timeout=60)
@@ -353,7 +383,9 @@ def run_federated_processes(
             sb_q = ctx.Queue()
             sp = ctx.Process(target=_standby_proc,
                              args=(cfg_kw, list(endpoints), s + 1, sb_q,
-                                   stall_timeout_s, tls_dir, verbose),
+                                   stall_timeout_s, tls_dir,
+                                   standby_seeds[s + 1], standby_keys,
+                                   quorum, verbose),
                              daemon=True)
             sp.start()
             endpoints.append((host, sb_q.get(timeout=60)))

@@ -22,16 +22,25 @@ This module closes it:
   connect, which distinguishes "alive, not yet serving" from "gone").
   Highest live priority wins; everyone else re-follows the winner;
 - promotion is FENCED: the promoting standby appends a promote_writer op
-  (generation N+1) to the replicated chain itself before serving.  Clients
-  carry the highest generation they have seen on every request
-  (FailoverClient.gen); a pre-partition writer still at generation N
-  self-demotes (answers STALE_WRITER, closes) the moment any such request
-  reaches it, and a standby never follows a writer whose generation is
-  behind its own chain.  An asymmetric partition can still let the old
-  writer accept ops while isolated, but on heal exactly one chain survives:
-  the fenced one — the old writer's divergent suffix is abandoned and its
-  honest clients' signed ops replay idempotently against the promoted
-  writer.  (The reference gets no-fork from PBFT quorums; this is the
+  (generation N+1) to the replicated chain itself before serving, and —
+  when provisioned with an identity (`wallet`) — mints SIGNED promotion
+  evidence binding (generation, op position, pre-promotion chain head,
+  standby index) under Ed25519.  The promoted writer attaches the evidence
+  to every reply; clients carry the highest generation they have seen plus
+  its proof on every request (FailoverClient.gen / .gen_ev).  A
+  pre-partition writer still at generation N self-demotes (answers
+  STALE_WRITER, closes) only on VERIFIED evidence — signature by a
+  provisioned standby key AND chain-prefix binding against its own log —
+  never on a bare integer (that was a one-message DoS, ADVICE r4).
+  Fencing is enforced from BOTH sides: clients additionally reject any
+  reply whose generation is behind their fence, so a stale writer that
+  never receives evidence still cannot retain fenced clients.  A standby
+  never follows a writer whose generation is behind its own chain.  An
+  asymmetric partition can still let the old writer accept ops while
+  isolated, but on heal exactly one chain survives: the fenced one — the
+  old writer's divergent suffix is abandoned and its honest clients'
+  signed ops replay idempotently against the promoted writer.  (The
+  reference gets no-fork from PBFT quorums; this is the
   fail-stop-plus-fencing equivalent without a quorum round per op.);
 - the standby binds its serving socket AT START, so clients that fail over
   early sit in the listen backlog until promotion finishes — no
@@ -93,10 +102,17 @@ class FailoverClient:
         self._cur = 0
         self._client: Optional[CoordinatorClient] = None
         # highest writer generation observed in any reply; sent back as the
-        # `fence` on every request, so a partitioned-then-healed stale
-        # writer self-demotes the moment any client that saw the promotion
-        # talks to it (comm.ledger_service fencing)
+        # `fence` on every request — with the promoted writer's SIGNED
+        # promotion evidence (`gen_ev`) when we hold it, so a
+        # partitioned-then-healed stale writer self-demotes the moment any
+        # client that saw the promotion talks to it (comm.ledger_service
+        # verifies the evidence; a bare integer no longer demotes anyone).
+        # The client also enforces the fence itself: a reply whose `gen` is
+        # BEHIND ours comes from a stale writer and is rejected like a
+        # connection failure — split-brain protection that needs no
+        # cooperation from the stale side.
         self.gen = 0
+        self.gen_ev: Optional[dict] = None
 
     @property
     def current_endpoint(self) -> Endpoint:
@@ -106,6 +122,8 @@ class FailoverClient:
         last: Optional[Exception] = None
         attempts = self._max_cycles * len(self._eps)
         fields.setdefault("fence", self.gen)
+        if self.gen_ev is not None:
+            fields.setdefault("fence_ev", self.gen_ev)
         for attempt in range(attempts):
             try:
                 if self._client is None:
@@ -115,13 +133,32 @@ class FailoverClient:
                         tls=self._tls)
                 reply = self._client.request(method, **fields)
                 g = reply.get("gen")
+                ev = reply.get("gen_ev")
                 if isinstance(g, int) and g > self.gen:
                     self.gen = g
                     fields["fence"] = self.gen
+                    self.gen_ev = ev if isinstance(ev, dict) else None
+                    if self.gen_ev is not None:
+                        fields["fence_ev"] = self.gen_ev
+                elif (isinstance(ev, dict) and self.gen_ev is None
+                      and int(ev.get("gen", -1)) == self.gen):
+                    self.gen_ev = ev       # learn the proof retroactively
+                    fields.setdefault("fence_ev", self.gen_ev)
                 if reply.get("status") == "STALE_WRITER":
                     # the endpoint just demoted itself on our fence — it is
                     # not the writer; rotate like a connection failure
                     last = ConnectionError("stale writer demoted")
+                    self.close()
+                    self._cur = (self._cur + 1) % len(self._eps)
+                    continue
+                if isinstance(g, int) and g < self.gen:
+                    # CLIENT-SIDE fencing: this endpoint is a pre-partition
+                    # writer that has not (or cannot — no evidence reached
+                    # it) demoted itself.  Never accept its reply: ops
+                    # accepted on its divergent suffix are abandoned on
+                    # heal.  Rotate to the promoted writer.
+                    last = ConnectionError(
+                        f"stale writer: reply gen {g} < fence {self.gen}")
                     self.close()
                     self._cur = (self._cur + 1) % len(self._eps)
                     continue
@@ -160,6 +197,10 @@ class Standby:
                  stall_timeout_s: float = 10.0,
                  tls_client=None, tls_server=None,
                  wal_path: str = "",
+                 wallet=None,
+                 standby_keys: Optional[Dict[int, bytes]] = None,
+                 quorum: int = 0,
+                 quorum_timeout_s: float = 5.0,
                  verbose: bool = False):
         if not 1 <= index < len(endpoints):
             raise ValueError(f"standby index {index} out of range for "
@@ -177,6 +218,21 @@ class Standby:
         # log first (pyledger.py:76-87 / ledger.cpp), so the promoted
         # writer's WAL holds the complete chain, not a mid-stream suffix
         self.wal_path = wal_path
+        # identity for SIGNED promotion evidence (comm.identity.Wallet):
+        # without it a promotion still serves failed-over clients, but the
+        # pre-partition writer cannot be made to self-demote on heal —
+        # clients then rely solely on their own reply-gen fencing
+        self.wallet = wallet
+        # index -> Ed25519 pub of ALL provisioned standbys, handed to the
+        # LedgerServer this standby becomes, so a LATER promotion can fence
+        # it in turn
+        self.standby_keys: Dict[int, bytes] = dict(standby_keys or {})
+        # carried into the LedgerServer this standby becomes: a promoted
+        # writer must keep the deployment's quorum-ack durability contract
+        # (losing it exactly after a failover would reopen the
+        # acknowledged-op-loss window in the regime it exists for)
+        self.quorum = quorum
+        self.quorum_timeout_s = quorum_timeout_s
         self.verbose = verbose
         self.ledger = make_ledger(cfg, backend=ledger_backend)
         self._blobs: Dict[bytes, bytes] = {}
@@ -285,6 +341,13 @@ class Standby:
                     raise RuntimeError(
                         f"standby rejected op {msg['i']}: {st.name} — "
                         f"writer/replica divergence, refusing to continue")
+                # confirm the apply upstream: the writer's quorum-ack mode
+                # counts these before acknowledging mutations to clients
+                # (best-effort — a lost ack only delays, never corrupts)
+                try:
+                    send_msg(sub.sock, {"ack": int(msg["i"])})
+                except (WireError, OSError):
+                    pass
                 try:
                     self._sync_state(ctl)
                 except (ConnectionError, WireError, OSError):
@@ -409,6 +472,12 @@ class Standby:
                                         self.index)
         if st != LedgerStatus.OK:
             raise RuntimeError(f"promotion fence rejected: {st.name}")
+        evidence = None
+        if self.wallet is not None:
+            from bflc_demo_tpu.comm.ledger_service import \
+                make_promotion_evidence
+            evidence = make_promotion_evidence(self.ledger, self.wallet,
+                                               self.index)
         missing = [u.payload_hash.hex()[:12]
                    for u in self.ledger.query_all_updates()
                    if u.payload_hash not in self._blobs]
@@ -426,6 +495,10 @@ class Standby:
             sock=self._sock,
             tls=self.tls_server,
             wal_path=self.wal_path,
+            standby_keys=self.standby_keys,
+            promotion_evidence=evidence,
+            quorum=self.quorum,
+            quorum_timeout_s=self.quorum_timeout_s,
             verbose=self.verbose)
         # open enrollment on the promoted writer: a client the directory
         # missed re-presents its (self-authenticating) pubkey on register

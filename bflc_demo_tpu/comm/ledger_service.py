@@ -46,6 +46,99 @@ from bflc_demo_tpu.protocol.constants import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import unpack_pytree, pack_entries
 
 
+# --- admission-control gas (reference: CommitteePrecompiled.cpp:143,151,
+# 468-469 meters every storage op — a DoS bound on the node).  Storage ops
+# (register/upload/scores) charge a per-sender, per-epoch budget at the
+# socket boundary, AFTER signature verification (gas binds to a proven
+# identity, not a claimed address) and BEFORE any state mutation; queries
+# are free.  Uploads charge per payload byte so a client cannot stream
+# unbounded blob traffic inside one epoch's allowance.
+GAS_REGISTER = 1_000
+GAS_UPLOAD_BASE = 1_000
+GAS_SCORES = 500
+
+_PROMO_MAGIC = b"BFLCPROM1"
+
+
+def chain_head_at(ledger, upto: int) -> bytes:
+    """Digest of the op hash chain after ops[0..upto-1] (b"" at upto=0).
+
+    Recomputed from canonical op bytes via the common `log_op` surface, so
+    it works on both the native and Python ledger backends (the chain rule
+    matches ledger.cpp append_log / pyledger._append_log: each head is
+    SHA-256(prev_head || op)).
+    """
+    h = b""
+    for i in range(upto):
+        d = hashlib.sha256()
+        if h:
+            d.update(h)
+        d.update(ledger.log_op(i))
+        h = d.digest()
+    return h
+
+
+def _promotion_evidence_bytes(gen: int, ix: int, prev_head: bytes,
+                              standby_index: int) -> bytes:
+    return (_PROMO_MAGIC + struct.pack("<qqI", gen, ix, standby_index)
+            + prev_head)
+
+
+def make_promotion_evidence(ledger, wallet, standby_index: int) -> dict:
+    """Signed, chain-bound proof of a promotion this standby just fenced.
+
+    Call AFTER `promote_writer` appended its op (the op sits at position
+    log_size-1).  The evidence binds (generation, op position, the chain
+    head digest immediately BEFORE the promote op, the standby's identity)
+    under the standby's Ed25519 signature.  Any party holding the standby's
+    public key and the shared chain prefix can verify it
+    (`verify_promotion_evidence`) — in particular the pre-partition writer,
+    whose own ops[0..ix-1] are byte-identical to the promoted chain's
+    prefix (the standby replayed them from that very writer).
+    """
+    ix = ledger.log_size() - 1
+    prev = chain_head_at(ledger, ix)
+    gen = ledger.generation
+    sig = wallet.sign(_promotion_evidence_bytes(gen, ix, prev,
+                                                standby_index))
+    return {"gen": gen, "ix": ix, "prev": prev.hex(),
+            "sb": standby_index, "sig": sig.hex()}
+
+
+def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
+    """True iff `ev` proves a promotion PAST `ledger`'s generation on a
+    chain sharing this ledger's prefix, signed by a provisioned standby.
+
+    The three checks together close the round-4 advisor DoS (a bare
+    client-supplied fence integer could demote any writer):
+    - signature: only a holder of a provisioned standby key can produce it;
+    - generation: stale/duplicate evidence (gen <= ours) proves nothing;
+    - chain binding: prev_head must equal OUR head at the claimed position,
+      so evidence from a different deployment (or a fabricated chain)
+      cannot fence this writer.
+    """
+    try:
+        gen, ix, sb = int(ev["gen"]), int(ev["ix"]), int(ev["sb"])
+        prev = bytes.fromhex(ev["prev"])
+        sig = bytes.fromhex(ev["sig"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    if gen <= ledger.generation or not 0 <= ix <= ledger.log_size():
+        return False
+    pub = (standby_keys or {}).get(sb)
+    if pub is None:
+        return False
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+        Ed25519PublicKey
+    try:
+        Ed25519PublicKey.from_public_bytes(pub).verify(
+            sig, _promotion_evidence_bytes(gen, ix, prev, sb))
+    except (InvalidSignature, ValueError):
+        return False
+    return chain_head_at(ledger, ix) == prev
+
+
 def _aggregate_flat(global_flat: Dict[str, np.ndarray],
                     delta_flats: List[Dict[str, np.ndarray]],
                     n_samples: List[int], selected: List[int],
@@ -88,6 +181,11 @@ class LedgerServer:
                  resume_blobs: Optional[Dict[bytes, bytes]] = None,
                  sock: Optional[socket.socket] = None,
                  tls=None,
+                 standby_keys: Optional[Dict[int, bytes]] = None,
+                 promotion_evidence: Optional[dict] = None,
+                 gas_budget_per_epoch: Optional[int] = None,
+                 quorum: int = 0,
+                 quorum_timeout_s: float = 5.0,
                  verbose: bool = False):
         """resume_ledger/resume_blobs/sock: the promotion surface
         (comm.failover.Standby) — a server constructed over a replica's
@@ -126,6 +224,29 @@ class LedgerServer:
         # schema, rebuilt only when the model changes (not per upload)
         self._model_schema = {k: (a.shape, a.dtype) for k, a in
                               unpack_pytree(initial_model_blob).items()}
+        # gas: per-sender per-epoch storage-op budget (None = auto: 50
+        # model-blob-sized uploads' worth — generous for honest traffic,
+        # finite for spam; 0 disables metering).  Bounds what one identity
+        # can make the coordinator store/hash per epoch, the role gas plays
+        # in the reference's substrate.
+        self._gas_budget = (50 * (GAS_UPLOAD_BASE
+                                  + len(initial_model_blob))
+                            if gas_budget_per_epoch is None
+                            else gas_budget_per_epoch)
+        self._gas: Dict[str, Tuple[int, int]] = {}
+        # quorum-ack replication (the PBFT-commit analogue, CP flavor):
+        # with quorum=Q > 0 a storage mutation is only ACKNOWLEDGED to its
+        # client after >= Q live subscribers confirmed applying every op up
+        # to and including it.  An acknowledged op therefore survives any
+        # single writer death with Q >= 1 (the promoted standby provably
+        # holds it) — closing the acknowledged-op-loss window of pure
+        # asynchronous streaming.  On timeout the reply is
+        # REPLICATION_TIMEOUT: the op is in the local chain (an honest
+        # retry gets DUPLICATE = progress once replicas catch up), but the
+        # client must not yet treat it as durable.  Q=0 = async (default).
+        self._quorum = quorum
+        self._quorum_timeout_s = quorum_timeout_s
+        self._sub_acked: Dict[object, int] = {}
         self._last_seen: Dict[str, float] = {}
         # replay rejection at the auth layer, not merely ledger idempotency
         # — the SAME ReplayGuard class AuthenticatedLedger uses, so the two
@@ -134,12 +255,21 @@ class LedgerServer:
         self._last_progress = time.monotonic()
         self._rounds_completed = 0
         self._stop = threading.Event()
-        # split-brain defense: set when a request arrives carrying a fence
-        # (writer generation) HIGHER than this ledger's — someone promoted
-        # past us while we were partitioned.  The server self-demotes: it
-        # answers that one request with STALE_WRITER, then closes, so every
-        # later connect is refused and clients rotate to the real writer.
+        # split-brain defense: set when a request arrives carrying VERIFIED
+        # promotion evidence for a generation HIGHER than this ledger's —
+        # someone provably promoted past us while we were partitioned.  The
+        # server self-demotes: it answers that one request with
+        # STALE_WRITER, then closes, so every later connect is refused and
+        # clients rotate to the real writer.  A bare fence integer without
+        # evidence is IGNORED (round-4 advisor: it was a one-message DoS).
         self.fenced = threading.Event()
+        # index -> Ed25519 public bytes of provisioned standbys: the only
+        # identities whose promotion evidence can demote this writer
+        self._standby_keys: Dict[int, bytes] = dict(standby_keys or {})
+        # set on a server constructed BY a promotion (comm.failover):
+        # attached to every reply so clients learn the fence + its proof
+        # passively and can present it to a stale writer
+        self._promotion_evidence = promotion_evidence
         self._threads: List[threading.Thread] = []
 
         if sock is not None:
@@ -216,20 +346,40 @@ class LedgerServer:
                 except (TypeError, ValueError):
                     fence = -1
                 if fence > self.ledger.generation:
-                    # a higher writer generation exists: self-demote.  The
-                    # reply tells the caller who is stale; the close makes
-                    # every other client see connection-refused and rotate.
-                    reply = {"ok": False, "status": "STALE_WRITER",
-                             "gen": self.ledger.generation,
-                             "observed_fence": fence}
-                    try:
-                        send_msg(conn, reply)
-                    finally:
-                        self.fenced.set()
-                        self.close()
-                    return
+                    # a higher writer generation is CLAIMED.  Demote only on
+                    # verified promotion evidence (signed by a provisioned
+                    # standby, chained to our own log prefix) — a bare
+                    # integer from any client must not be able to kill the
+                    # writer (round-4 advisor DoS).  Unverifiable claims are
+                    # served normally; a genuinely stale writer still loses
+                    # its clients because every reply carries `gen` and
+                    # FailoverClient rejects replies behind its own fence.
+                    ev = msg.get("fence_ev")
+                    if isinstance(ev, dict) and verify_promotion_evidence(
+                            ev, self.ledger, self._standby_keys):
+                        reply = {"ok": False, "status": "STALE_WRITER",
+                                 "gen": self.ledger.generation,
+                                 "observed_fence": fence}
+                        try:
+                            send_msg(conn, reply)
+                        finally:
+                            self.fenced.set()
+                            self.close()
+                        return
                 try:
                     reply = self._dispatch(method, msg)
+                    post_size = reply.pop("_post_size", None)
+                    if (self._quorum
+                            and post_size is not None
+                            and reply.get("ok")
+                            and not self._await_quorum(post_size)):
+                        # the op is in the local chain but not provably on
+                        # quorum replicas: do NOT acknowledge durability.
+                        # The client's signed retry is safe (DUPLICATE =
+                        # progress) once followers catch up.
+                        reply = {"ok": False,
+                                 "status": "REPLICATION_TIMEOUT",
+                                 "error": "op not yet on quorum replicas"}
                 except Exception as e:      # noqa: BLE001 — any dispatch
                     # failure (including a RuntimeError thrown by
                     # aggregation inside the scores handler) must produce an
@@ -238,8 +388,12 @@ class LedgerServer:
                     # timeout even though its own op may have been accepted
                     reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                 # every reply carries the writer generation so clients learn
-                # the current fence passively and propagate it on requests
+                # the current fence passively and propagate it on requests;
+                # a promoted writer also attaches the signed proof so
+                # clients can demote the stale one on contact
                 reply.setdefault("gen", self.ledger.generation)
+                if self._promotion_evidence is not None:
+                    reply.setdefault("gen_ev", self._promotion_evidence)
                 send_msg(conn, reply)
         except (WireError, OSError):
             pass
@@ -251,20 +405,73 @@ class LedgerServer:
 
     def _stream_ops(self, conn: socket.socket, start: int) -> None:
         """Push canonical op bytes from `start` onward until the peer goes
-        away — the replica feed (WAL-identical bytes, ledger.cpp op codec)."""
-        next_i = start
-        while not self._stop.is_set():
+        away — the replica feed (WAL-identical bytes, ledger.cpp op codec).
+
+        The connection is full-duplex: a dedicated reader drains the
+        subscriber's `{"ack": i}` frames (sent by Standby after each
+        successful apply) into `_sub_acked` — unconditionally, so an
+        acking follower can never wedge on a filled send buffer — and the
+        quorum waiters are notified.
+        """
+        sub_id = object()
+        with self._cv:
+            self._sub_acked[sub_id] = -1
+        reader = threading.Thread(target=self._ack_reader,
+                                  args=(conn, sub_id), daemon=True)
+        reader.start()
+        try:
+            next_i = start
+            while not self._stop.is_set():
+                with self._cv:
+                    size = self.ledger.log_size()
+                    ops = [self.ledger.log_op(i)
+                           for i in range(next_i, min(size, next_i + 256))]
+                    if not ops:
+                        self._cv.wait(timeout=0.5)
+                        continue
+                for i, op in enumerate(ops):
+                    send_msg(conn, {"i": next_i + i, "op": op.hex()})
+                next_i += len(ops)
+        finally:
             with self._cv:
-                size = self.ledger.log_size()
-                ops = [self.ledger.log_op(i) for i in range(next_i,
-                                                            min(size,
-                                                                next_i + 256))]
-                if not ops:
-                    self._cv.wait(timeout=0.5)
+                self._sub_acked.pop(sub_id, None)
+                self._cv.notify_all()
+
+    def _ack_reader(self, conn: socket.socket, sub_id: object) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                try:
+                    i = int(msg.get("ack", -1))
+                except (TypeError, ValueError):
                     continue
-            for i, op in enumerate(ops):
-                send_msg(conn, {"i": next_i + i, "op": op.hex()})
-            next_i += len(ops)
+                with self._cv:
+                    if sub_id in self._sub_acked \
+                            and i > self._sub_acked[sub_id]:
+                        self._sub_acked[sub_id] = i
+                        self._cv.notify_all()
+        except (WireError, OSError):
+            return
+
+    def _await_quorum(self, post_size: int) -> bool:
+        """Block until >= quorum subscribers acked through op index
+        post_size-1 (the requester's own op, snapshotted at append time),
+        or the timeout passes.  `Condition.wait` fully releases the
+        (R)lock, so followers keep pulling and acking while we wait."""
+        target = post_size - 1
+        deadline = time.monotonic() + self._quorum_timeout_s
+        with self._cv:
+            while not self._stop.is_set():
+                n = sum(1 for a in self._sub_acked.values() if a >= target)
+                if n >= self._quorum:
+                    return True
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._cv.wait(rem)
+        return False
 
     # ------------------------------------------------------------- dispatch
     def _touch(self, addr: str) -> None:
@@ -292,7 +499,62 @@ class LedgerServer:
         self._replay.consume(self.ledger.epoch, epoch,
                              bytes.fromhex(tag_hex))
 
+    def _charge_gas(self, addr: str, cost: int) -> bool:
+        """Debit `cost` from addr's current-epoch budget; False = broke.
+
+        Call with the lock held, and — when require_auth — only AFTER the
+        request's signature verified as a fresh valid tag: gas must bind
+        to a PROVEN identity, or any connected peer could drain a victim's
+        budget by spoofing their address (round-5 review finding).  The
+        residual pre-auth cost per request (hashing the wire payload to
+        check the tag) is bounded by the wire frame cap and the serial
+        per-connection loop.
+
+        The ledger epoch advancing resets every sender's allowance (the
+        reference's per-tx gas refreshes per tx; per-epoch is the
+        equivalent granularity — one epoch is one round of legitimate
+        storage traffic)."""
+        if not self._gas_budget:
+            return True
+        ep = self.ledger.epoch
+        last_ep, used = self._gas.get(addr, (ep, 0))
+        if last_ep != ep:
+            used = 0
+        if used + cost > self._gas_budget:
+            # no insert on the reject path: unknown addrs must not be able
+            # to grow the table by going straight over budget
+            if addr in self._gas:
+                self._gas[addr] = (ep, used)
+            return False
+        if addr not in self._gas and len(self._gas) >= 8192:
+            # bound the meter table against address-rotation spam: drop
+            # stale-epoch entries first, then evict oldest same-epoch
+            # entries until under the cap (dicts preserve insert order)
+            self._gas = {a: (e, u) for a, (e, u) in self._gas.items()
+                         if e == ep}
+            while len(self._gas) >= 8192:
+                self._gas.pop(next(iter(self._gas)))
+        self._gas[addr] = (ep, used + cost)
+        return True
+
+    _OUT_OF_GAS = {"ok": False, "status": "OUT_OF_GAS",
+                   "error": "per-epoch storage budget exhausted"}
+
+    _MUTATING = ("register", "upload", "scores")
+
     def _dispatch(self, method: str, m: dict) -> dict:
+        with self._lock:            # RLock: the inner re-acquires freely
+            reply = self._dispatch_inner(method, m)
+            if method in self._MUTATING and reply.get("ok"):
+                # snapshot THIS op's chain position while still holding
+                # the lock: the quorum wait must target the requester's
+                # own op, not whatever a concurrent writer appended after
+                # (review finding: waiting on the live head misreports
+                # durability under concurrency)
+                reply["_post_size"] = self.ledger.log_size()
+        return reply
+
+    def _dispatch_inner(self, method: str, m: dict) -> dict:
         with self._lock:
             if method == "register":
                 addr = m["addr"]
@@ -315,6 +577,9 @@ class LedgerServer:
                                 "error": "bad signature" if
                                 v == LedgerStatus.BAD_ARG else
                                 "replayed tag"}
+                # post-auth: the signature proved the sender IS addr
+                if not self._charge_gas(addr, GAS_REGISTER):
+                    return dict(self._OUT_OF_GAS)
                 st = self.ledger.register_node(addr)
                 if st == LedgerStatus.OK:
                     self._consume_tag(0, m.get("tag", ""))
@@ -349,6 +614,11 @@ class LedgerServer:
                     return {"ok": False, "status": v.name,
                             "error": "bad signature" if
                             v == LedgerStatus.BAD_ARG else "replayed tag"}
+                # post-auth (fresh valid tag proved the sender): charge
+                # base + payload bytes so one identity cannot stream
+                # unbounded blob traffic within an epoch's allowance
+                if not self._charge_gas(addr, GAS_UPLOAD_BASE + len(blob)):
+                    return dict(self._OUT_OF_GAS)
                 # structural admission check (post-auth so unsigned spam
                 # can't buy blob decodes): a delta whose leaves don't match
                 # the current model must die HERE, not later inside an
@@ -393,6 +663,8 @@ class LedgerServer:
                     return {"ok": False, "status": v.name,
                             "error": "bad signature" if
                             v == LedgerStatus.BAD_ARG else "replayed tag"}
+                if not self._charge_gas(addr, GAS_SCORES):
+                    return dict(self._OUT_OF_GAS)
                 st = self.ledger.upload_scores(addr, int(m["epoch"]), scores)
                 if st == LedgerStatus.OK:
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))

@@ -34,11 +34,16 @@ SERVER_KEY = "server.key"
 
 
 def provision_tls(cert_dir: str, common_name: str = "127.0.0.1",
-                  days: int = 365) -> Tuple[str, str, str]:
+                  days: int = 365,
+                  include_loopback: bool = True) -> Tuple[str, str, str]:
     """Write (or reuse) ca.pem / server.pem / server.key under cert_dir.
 
     Returns the three paths.  The server cert carries SANs for the common
-    name and 127.0.0.1/localhost so loopback deployments verify cleanly.
+    name and (unless include_loopback=False — e.g. provisioning for a real
+    remote host) 127.0.0.1/localhost so loopback deployments verify
+    cleanly.  Clients enforce the SAN match (client_context keeps
+    check_hostname on), so a cert provisioned for one host is useless for
+    impersonating another even inside the same CA.
     """
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
@@ -68,10 +73,11 @@ def provision_tls(cert_dir: str, common_name: str = "127.0.0.1",
                .sign(ca_key, hashes.SHA256()))
 
     srv_key = ec.generate_private_key(ec.SECP256R1())
-    sans = [x509.DNSName("localhost"), x509.DNSName(common_name)
-            if not _is_ip(common_name) else
-            x509.IPAddress(ipaddress.ip_address(common_name))]
-    sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    sans = [x509.DNSName(common_name) if not _is_ip(common_name)
+            else x509.IPAddress(ipaddress.ip_address(common_name))]
+    if include_loopback:
+        sans.insert(0, x509.DNSName("localhost"))
+        sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
     srv_cert = (x509.CertificateBuilder()
                 .subject_name(x509.Name([x509.NameAttribute(
                     NameOID.COMMON_NAME, common_name)]))
@@ -119,7 +125,11 @@ def client_context(cert_dir: str) -> ssl.SSLContext:
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2
     ctx.load_verify_locations(os.path.join(cert_dir, CA_PEM))
-    # loopback deployments connect by IP; the cert carries the IP SAN
-    ctx.check_hostname = False
+    # Full server identity: the presented cert must chain to the CA AND
+    # carry a SAN matching the address the client dialed (ssl validates IP
+    # SANs under check_hostname too — provision_tls always includes the
+    # 127.0.0.1 IP SAN plus the deployment's common name).  CA membership
+    # alone would let any CA-signed cert impersonate any server.
+    ctx.check_hostname = True
     ctx.verify_mode = ssl.CERT_REQUIRED
     return ctx

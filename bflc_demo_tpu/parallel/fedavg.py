@@ -499,6 +499,7 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
                              rounds_per_dispatch: int,
                              client_chunk: int = 0, remat: bool = False,
                              secure: bool = False,
+                             secure_dh: bool = False,
                              secure_clip: float = 1024.0,
                              scoring: str = "committee",
                              ) -> Callable[..., MultiRoundResult]:
@@ -511,17 +512,27 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
     round (.cpp:443-455 semantics) and the sponsor eval all run under a
     `lax.scan` over rounds.
 
-    secure=True: the merge is the pairwise-masked fixed-point psum with a
-    per-round mask key folded from each scan step's PRNG key — SHARED-KEY
-    mode only (privacy against observers without the round key; the DH
-    matrix needs host X25519 per round and therefore stays on the
-    per-round dispatch path).  The host ledger replays and AUDITS each round
-    afterwards (client/mesh_runtime.py `rounds_per_dispatch`): the op log
-    remains the authority, the device is its optimistic executor, and any
-    decision divergence raises.
+    secure=True: the merge is the pairwise-masked fixed-point psum.  The
+    program takes a trailing mask argument the uploader-sampling rng_key
+    never touches (the round-4 advisor finding: deriving masks from the
+    public run seed reduced the privacy property to obscurity):
+    - secure_dh=False (shared-key): a replicated PRNG key, freshly drawn
+      by the host per dispatch; round r's masks fold the scan counter in
+      (secure_fedavg_body round_tweak), so R rounds share one input with
+      independent masks.
+    - secure_dh=True: the (N, N, 8) X25519 pair-seed matrix
+      (parallel.secure.derive_pair_seeds) — ONE DH derivation per
+      dispatch; the scan counter re-keys each round's masks while the
+      aggregator still cannot strip any client's mask (it is not party to
+      any pair exchange).
+    The host ledger replays and AUDITS each round afterwards
+    (client/mesh_runtime.py `rounds_per_dispatch`): the op log remains the
+    authority, the device is its optimistic executor, and any decision
+    divergence raises.
 
     Returned fn signature:
         fn(params, xs, ys, n_samples, committee_mask0, rng_key, xte, yte)
+    — plus a trailing `mask_key` / `pair_seeds` argument when secure=True —
     with xs/ys/n_samples sharded over the client axis; committee_mask0 (N,)
     bool and the test set replicated.
     """
@@ -552,11 +563,13 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
     k_sel = aggregate_count
     k_up = needed_update_count
 
-    def body(params, xs, ys, n_samples, comm_mask0, rng_key, xte, yte):
+    def body(params, xs, ys, n_samples, comm_mask0, rng_key, xte, yte,
+             mask_arg):
         n_local = xs.shape[0]
         my = jax.lax.axis_index(AXIS)
 
-        def round_step(carry, r_key):
+        def round_step(carry, key_and_ctr):
+            r_key, r_idx = key_and_ctr
             params_round, comm_mask = carry
 
             def train_one(x, y):
@@ -610,13 +623,13 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
                                               (n_local,))
             if secure:
                 from bflc_demo_tpu.parallel.secure import secure_fedavg_body
-                # independent stream from the uploader draw: fold a fixed
-                # tweak into this round's key
-                mask_key = jax.random.fold_in(r_key, 0x5EC)
+                # masks come from the host-supplied mask_arg (never the
+                # public uploader-draw key); the scan counter re-keys
+                # every round of the dispatch
                 new_params = secure_fedavg_body(
                     params_round, deltas_local, n_samples, sel_local, lr,
-                    mask_key, axis=AXIS, n_total=n, clip=secure_clip,
-                    dh_mode=False)
+                    mask_arg, axis=AXIS, n_total=n, clip=secure_clip,
+                    dh_mode=secure_dh, round_tweak=r_idx)
             else:
                 new_params = _psum_fedavg_body(params_round, deltas_local,
                                                n_samples, sel_local, lr)
@@ -641,8 +654,9 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
             return (new_params, comm_next), outs
 
         keys = jax.random.split(rng_key, rounds_per_dispatch)
+        ctrs = jnp.arange(rounds_per_dispatch, dtype=jnp.uint32)
         (final_params, _), outs = jax.lax.scan(
-            round_step, (params, comm_mask0), keys)
+            round_step, (params, comm_mask0), (keys, ctrs))
         (uploader_masks, comm_masks, score_ms, meds, sels, orders, costs_all,
          losses, dfps, pfps, accs) = outs
         return MultiRoundResult(final_params, uploader_masks, comm_masks,
@@ -651,9 +665,18 @@ def make_multi_round_program(mesh: Mesh, apply_fn: ApplyFn, *,
 
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P()),
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(), P()),
         out_specs=P(), check_vma=False)
-    return jax.jit(fn)
+    jfn = jax.jit(fn)
+    if secure:
+        return jfn                      # caller supplies the trailing
+                                        # mask key / pair-seed matrix
+    _dummy = jax.random.PRNGKey(0)      # untouched when secure=False
+
+    def plain(params, xs, ys, n_samples, comm_mask0, rng_key, xte, yte):
+        return jfn(params, xs, ys, n_samples, comm_mask0, rng_key, xte,
+                   yte, _dummy)
+    return plain
 
 
 def sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, params: Pytree,

@@ -82,12 +82,19 @@ def _client_mask(round_key: jax.Array, i: jax.Array, n: int,
 
 
 def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
-                    shape, leaf_idx: int) -> jax.Array:
+                    shape, leaf_idx: int,
+                    tweak: jax.Array | None = None) -> jax.Array:
     """DH-keyed variant of `_client_mask`: the pair key comes from the
     (N, N, 8) uint32 seed matrix (X25519-derived, `derive_pair_seeds`)
     instead of a shared round key.  Seed symmetry (seeds[i,j] == seeds[j,i])
     gives both endpoints the same mask; the signed sum cancels identically;
     `leaf_idx` de-duplicates same-shape leaves exactly as in _client_mask.
+
+    `tweak` (optional, traced) re-keys the whole mask family without a new
+    DH exchange — the batched multi-round program folds its scan round
+    counter here so every round of one dispatch draws independent masks
+    from ONE pair-seed matrix while keeping the aggregator-cannot-strip
+    property (both endpoints fold the same public counter).
 
     All 8 words (the full 256-bit hashed shared secret) are chain-folded
     into the key, so per-pair mask secrecy is bounded by the 256-bit DH
@@ -103,6 +110,8 @@ def _client_mask_dh(pair_seeds: jax.Array, i: jax.Array, n: int,
         key = base
         for word in range(8):           # static unroll: 8 words, fixed
             key = jax.random.fold_in(key, s[word])
+        if tweak is not None:
+            key = jax.random.fold_in(key, tweak)
         key = jax.random.fold_in(key, leaf_idx)
         m = _pair_mask(key, shape)
         contrib = jnp.where(j > i, m, jnp.uint32(0) - m)
@@ -226,7 +235,8 @@ def secure_masked_sum(mesh: Mesh, values: Pytree, round_key: jax.Array,
 def secure_fedavg_body(params: Pytree, deltas_local: Pytree,
                        n_local: jax.Array, sel_local: jax.Array, lr,
                        key_or_seeds: jax.Array, *, axis: str, n_total: int,
-                       clip: float, dh_mode: bool) -> Pytree:
+                       clip: float, dh_mode: bool,
+                       round_tweak: jax.Array | None = None) -> Pytree:
     """Inside-shard_map secure FedAvg — callable from an ENCLOSING shard_map
     (the full-round program, parallel/fedavg.py) so the protocol round can
     blind its merge without a second dispatch.  The single definition of the
@@ -235,14 +245,20 @@ def secure_fedavg_body(params: Pytree, deltas_local: Pytree,
 
     deltas_local/n_local/sel_local: this device's client shard (leading axis
     n_total/axis_size).  key_or_seeds: replicated round key (shared-key
-    mode) or the (N, N, 8) DH seed matrix.  Capacity: weighted values are
-    bounded by `clip` (weights sum to 1), which must stay below the int32
-    fixed-point ceiling — checked statically here.
+    mode) or the (N, N, 8) DH seed matrix.  round_tweak (optional, traced):
+    a per-round counter folded into every mask key so a lax.scan over
+    rounds reuses ONE key/seed input with independent masks each round
+    (both modes).  Capacity: weighted values are bounded by `clip` (weights
+    sum to 1), which must stay below the int32 fixed-point ceiling —
+    checked statically here.
     """
     if clip >= float(1 << (31 - _FRAC_BITS)):
         raise ValueError(
             f"fixed-point capacity exceeded: clip {clip:g} >= "
             f"{1 << (31 - _FRAC_BITS)}")
+    if not dh_mode and round_tweak is not None:
+        key_or_seeds = jax.random.fold_in(key_or_seeds, round_tweak)
+        round_tweak = None
     my = jax.lax.axis_index(axis)
     n_loc = jax.tree_util.tree_leaves(deltas_local)[0].shape[0]
     w = n_local.astype(jnp.float32) * sel_local.astype(jnp.float32)
@@ -270,7 +286,7 @@ def secure_fedavg_body(params: Pytree, deltas_local: Pytree,
             client = my * n_loc + local_idx
             q = jnp.round(fx_all[local_idx] * _SCALE).astype(jnp.int32)
             mask = (_client_mask_dh(key_or_seeds, client, n_total, shape,
-                                    leaf_idx)
+                                    leaf_idx, tweak=round_tweak)
                     if dh_mode else
                     _client_mask(key_or_seeds, client, n_total, shape,
                                  leaf_idx))

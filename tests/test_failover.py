@@ -333,3 +333,329 @@ class TestFailoverClient:
                                 max_cycles=2)
         with pytest.raises(ConnectionError):
             client.request("info")
+
+
+class _Partition:
+    """A killable TCP forwarder: the standby's only path to the writer.
+
+    Closing it simulates an asymmetric partition — the standby loses the
+    writer (probes refused at the proxy port) while direct clients keep
+    talking to the still-alive writer on its real address.
+    """
+
+    def __init__(self, target):
+        import socket as _socket
+        self._target = target
+        self._socks = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._lsock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.host, self.port = self._lsock.getsockname()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        import socket as _socket
+        while not self._stop.is_set():
+            try:
+                a, _ = self._lsock.accept()
+                b = _socket.create_connection(self._target, timeout=5.0)
+            except OSError:
+                return
+            with self._lock:
+                self._socks += [a, b]
+            for src, dst in ((a, b), (b, a)):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def cut(self):
+        self._stop.set()
+        with self._lock:
+            socks, self._socks = self._socks, []
+        for s in [self._lsock] + socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class TestPromotionEvidence:
+    """The fence is now EVIDENCE, not a bare integer (ADVICE r4 medium:
+    any client could demote any writer with one message).  Demotion
+    requires a promotion record signed by a provisioned standby identity
+    and hash-bound to the writer's own chain prefix."""
+
+    def _ledgers(self):
+        """A writer ledger with a few ops and a standby replica of it."""
+        from bflc_demo_tpu.ledger import make_ledger
+        writer = make_ledger(CFG, backend="python")
+        for i in range(CFG.client_num):
+            writer.register_node(f"0x{i:040x}")
+        standby = make_ledger(CFG, backend="python")
+        for i in range(writer.log_size()):
+            assert standby.apply_op(writer.log_op(i)).name == "OK"
+        return writer, standby
+
+    def test_evidence_verifies_and_rejects_tampering(self):
+        from bflc_demo_tpu.comm.identity import Wallet
+        from bflc_demo_tpu.comm.ledger_service import (
+            make_promotion_evidence, verify_promotion_evidence)
+        writer, standby = self._ledgers()
+        w = Wallet.from_seed(b"standby-ev-1")
+        keys = {1: w.public_bytes}
+        assert standby.promote_writer(1, 1).name == "OK"
+        ev = make_promotion_evidence(standby, w, 1)
+        assert verify_promotion_evidence(ev, writer, keys)
+        # divergent suffix on the writer does not break prefix binding
+        writer.close_round()
+        assert verify_promotion_evidence(ev, writer, keys)
+        # tampering: signature, generation, unknown signer, foreign chain
+        bad = dict(ev, sig="00" * 64)
+        assert not verify_promotion_evidence(bad, writer, keys)
+        assert not verify_promotion_evidence(dict(ev, gen=0), writer, keys)
+        assert not verify_promotion_evidence(ev, writer, {})
+        assert not verify_promotion_evidence(
+            ev, writer, {1: Wallet.from_seed(b"other").public_bytes})
+        foreign, _ = self._ledgers()
+        from bflc_demo_tpu.ledger import make_ledger
+        other_chain = make_ledger(CFG, backend="python")
+        other_chain.register_node("0x" + "9" * 40)
+        assert not verify_promotion_evidence(ev, other_chain, keys)
+
+    def test_bare_fence_no_longer_demotes(self):
+        """The DoS is closed: fence=<huge int> with no evidence gets a
+        normal reply and the writer keeps serving."""
+        from bflc_demo_tpu.comm.identity import Wallet
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           standby_keys={1: Wallet.from_seed(
+                               b"sb").public_bytes})
+        srv.start()
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+        try:
+            r = c.request("info", fence=999)
+            assert r["ok"] and r.get("status") != "STALE_WRITER"
+            assert not srv.fenced.is_set()
+            # server still alive for the next client
+            c2 = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+            assert c2.request("info")["ok"]
+            c2.close()
+        finally:
+            c.close()
+            srv.close()
+
+    def test_forged_evidence_rejected_at_the_socket(self):
+        """Evidence signed by a NON-provisioned key must not demote."""
+        from bflc_demo_tpu.comm.identity import Wallet
+        from bflc_demo_tpu.comm.ledger_service import (
+            CoordinatorClient, make_promotion_evidence)
+        real = Wallet.from_seed(b"sb-real")
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           standby_keys={1: real.public_bytes})
+        srv.start()
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+        try:
+            # attacker replays the server's own chain into a fake standby
+            # ledger, "promotes" it, signs with its OWN key
+            from bflc_demo_tpu.ledger import make_ledger
+            attacker = Wallet.from_seed(b"attacker")
+            fake = make_ledger(CFG, backend="python")
+            assert fake.promote_writer(1, 1).name == "OK"
+            ev = make_promotion_evidence(fake, attacker, 1)
+            r = c.request("info", fence=1, fence_ev=ev)
+            assert r["ok"] and not srv.fenced.is_set()
+        finally:
+            c.close()
+            srv.close()
+
+
+class TestSplitBrainDrill:
+    """VERDICT r4 item 4: partition the writer from its standby, force an
+    election, heal, and assert exactly ONE surviving committed history."""
+
+    def test_partition_promote_heal_single_history(self):
+        from bflc_demo_tpu.comm.identity import Wallet
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"splitbrain-master-01")
+        sb_wallet = Wallet.from_seed(b"splitbrain-standby-1")
+        keys = {1: sb_wallet.public_bytes}
+        srv = LedgerServer(CFG, _init_blob(), directory=directory,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           standby_keys=keys)
+        srv.start()
+        proxy = _Partition((srv.host, srv.port))
+        standby = Standby(CFG, [(proxy.host, proxy.port),
+                                ("127.0.0.1", 0)], 1,
+                          heartbeat_s=0.3, stall_timeout_s=60.0,
+                          ledger_backend="python", wallet=sb_wallet,
+                          standby_keys=keys)
+        standby.endpoints[1] = (standby.host, standby.port)
+        threading.Thread(target=standby.run, daemon=True).start()
+
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        direct = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+        try:
+            for w in wallets[:-1]:
+                r = direct.request("register", addr=w.address,
+                                   pubkey=w.public_bytes.hex(),
+                                   tag=_sign(w, "register", 0, b""))
+                assert r["ok"], r
+            size_before = srv.ledger.log_size()
+            deadline = time.monotonic() + 20
+            while standby.ledger.log_size() < size_before:
+                assert time.monotonic() < deadline, "standby lagging"
+                time.sleep(0.05)
+
+            # PARTITION: the standby loses the writer; direct clients
+            # don't.  The standby elects itself and promotes (gen 1).
+            proxy.cut()
+            assert standby.promoted.wait(timeout=30), "no promotion"
+
+            # the isolated old writer accepts a DIVERGENT op meanwhile
+            w_div = wallets[-1]
+            r = direct.request("register", addr=w_div.address,
+                               pubkey=w_div.public_bytes.hex(),
+                               tag=_sign(w_div, "register", 0, b""))
+            assert r["ok"], r
+            assert srv.ledger.log_size() == size_before + 1
+            assert standby.ledger.log_op(size_before) != \
+                srv.ledger.log_op(size_before)      # genuine fork
+
+            # HEAL, phase 1 — a fenced client WITHOUT evidence meets the
+            # stale writer: client-side fencing rejects the reply and
+            # rotates; the writer is NOT demoted (no DoS, no evidence)
+            promoted_ep = (standby.host, standby.port)
+            informed = FailoverClient([(srv.host, srv.port), promoted_ep],
+                                      timeout_s=10.0)
+            informed.gen = 1            # saw the promotion, lost the proof
+            r = informed.request("info")
+            assert r["gen"] == 1        # answered by the PROMOTED writer
+            assert not srv.fenced.is_set()
+            # ... and the reply carried the evidence, learned retroactively
+            assert informed.gen_ev is not None
+
+            # HEAL, phase 2 — the same client retries against the stale
+            # writer, now WITH evidence: the writer verifies and demotes
+            informed._cur = 0
+            informed.close()
+            r2 = informed.request("info")
+            assert r2["gen"] == 1
+            assert srv.fenced.wait(timeout=10), "stale writer not fenced"
+
+            # exactly one surviving history: the promoted chain.  The
+            # divergent client re-registers against it idempotently.
+            r3 = informed.request("register", addr=w_div.address,
+                                  pubkey=w_div.public_bytes.hex(),
+                                  tag=_sign(w_div, "register", 0, b""))
+            assert r3["ok"] or r3["status"] == "DUPLICATE"
+            assert standby.ledger.verify_log()
+            # old writer refuses all connections now (fenced is set just
+            # before the socket closes — poll past that window)
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    probe = CoordinatorClient(srv.host, srv.port,
+                                              timeout_s=2.0)
+                    probe.close()
+                except (ConnectionError, OSError):
+                    break
+                assert time.monotonic() < deadline, \
+                    "stale writer still accepting connections"
+                time.sleep(0.05)
+        finally:
+            informed.close()
+            direct.close()
+            standby.stop()
+            srv.close()
+
+
+class TestQuorumAck:
+    """Quorum-ack replication (the PBFT-commit analogue, CP flavor): with
+    quorum=Q the writer acknowledges a storage mutation only after >= Q
+    subscribers confirmed applying it — an acknowledged op provably
+    survives the writer's death."""
+
+    def test_acknowledged_op_is_on_the_standby(self):
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           quorum=1, quorum_timeout_s=10.0)
+        srv.start()
+        standby = Standby(CFG, [(srv.host, srv.port), ("127.0.0.1", 0)], 1,
+                          heartbeat_s=0.3, stall_timeout_s=60.0,
+                          require_auth=False, ledger_backend="python")
+        standby.endpoints[1] = (standby.host, standby.port)
+        threading.Thread(target=standby.run, daemon=True).start()
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=15.0)
+        try:
+            # wait for the standby's subscription to land
+            deadline = time.monotonic() + 10
+            while not srv._sub_acked:
+                assert time.monotonic() < deadline, "standby never followed"
+                time.sleep(0.05)
+            for i in range(CFG.client_num):
+                r = c.request("register", addr=f"0x{i:040x}")
+                assert r["ok"], r
+                # THE guarantee: the op is already applied on the standby
+                # at the moment the client sees ok — no polling window
+                assert standby.ledger.log_size() >= srv.ledger.log_size()
+        finally:
+            c.close()
+            standby.stop()
+            srv.close()
+
+    def test_no_quorum_means_replication_timeout_then_retry_succeeds(self):
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           quorum=1, quorum_timeout_s=0.5)
+        srv.start()
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=15.0)
+        standby = None
+        try:
+            r = c.request("register", addr="0x" + "01" * 20)
+            assert not r["ok"] and r["status"] == "REPLICATION_TIMEOUT", r
+            # the op IS in the local chain (durability was withheld, not
+            # the mutation) — a later follower replicates it, after which
+            # the retry reports the op as present
+            assert srv.ledger.num_registered == 1
+            standby = Standby(CFG, [(srv.host, srv.port),
+                                    ("127.0.0.1", 0)], 1,
+                              heartbeat_s=0.3, stall_timeout_s=60.0,
+                              require_auth=False, ledger_backend="python")
+            standby.endpoints[1] = (standby.host, standby.port)
+            threading.Thread(target=standby.run, daemon=True).start()
+            deadline = time.monotonic() + 15
+            while True:
+                r2 = c.request("register", addr="0x" + "01" * 20)
+                if r2["status"] == "ALREADY_REGISTERED":
+                    break               # rejected-but-in == progress
+                assert time.monotonic() < deadline, r2
+                time.sleep(0.2)
+            while standby.ledger.num_registered < 1:
+                assert time.monotonic() < deadline, "standby never caught up"
+                time.sleep(0.1)
+        finally:
+            c.close()
+            if standby is not None:
+                standby.stop()
+            srv.close()

@@ -345,3 +345,102 @@ class TestProcessFederation:
         assert res.rounds_completed >= 3
         assert sorted(res.recovered_clients) == [0, 5]
         assert res.replica_report["ok"]
+
+
+class TestGasMetering:
+    """Admission-control cost metering (reference parity: every storage op
+    is gas-metered, CommitteePrecompiled.cpp:143,151,468-469).  Storage
+    ops debit a per-sender, per-epoch budget at the socket boundary,
+    AFTER signature verification (gas binds to a proven identity — a
+    spoofed address must not drain a victim's budget) and BEFORE any
+    state mutation, so one identity cannot make the coordinator store
+    unbounded traffic; queries stay free."""
+
+    def test_budget_exhaustion_rejects_storage_ops(self):
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           gas_budget_per_epoch=2_500)
+        srv.start()
+        from bflc_demo_tpu.comm.ledger_service import GAS_REGISTER
+        assert 2 * GAS_REGISTER <= 2_500 < 3 * GAS_REGISTER
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+        try:
+            addr = "0x" + "ab" * 20
+            r1 = c.request("register", addr=addr)
+            assert r1["ok"]
+            r2 = c.request("register", addr=addr)       # rejected: still
+            assert r2["status"] == "ALREADY_REGISTERED"  # costs gas
+            r3 = c.request("register", addr=addr)
+            assert r3["status"] == "OUT_OF_GAS" and not r3["ok"]
+            # queries remain free — the server still answers
+            assert c.request("info")["ok"]
+            # and an unmetered sender is unaffected
+            assert c.request("register", addr="0x" + "cd" * 20)["ok"]
+        finally:
+            c.close()
+            srv.close()
+
+    def test_upload_gas_scales_with_blob_bytes(self):
+        """A giant blob from one sender exhausts its own budget without
+        touching the ledger or the blob store."""
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           gas_budget_per_epoch=10_000)
+        srv.start()
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+        try:
+            for i in range(CFG.client_num):
+                assert c.request("register", addr=f"0x{i:040x}")["ok"]
+            committee = set(c.request("committee")["committee"])
+            trainer = next(f"0x{i:040x}" for i in range(CFG.client_num)
+                           if f"0x{i:040x}" not in committee)
+            big = bytes(64 * 1024)                     # 64 KiB >> budget
+            digest = hashlib.sha256(big).digest()
+            r = c.request("upload", addr=trainer, blob=big.hex(),
+                          hash=digest.hex(), n=10, cost=1.0, epoch=0)
+            assert r["status"] == "OUT_OF_GAS"
+            assert srv.ledger.update_count == 0
+            # the sender's legitimate-sized retry this epoch is also out
+            # of gas (the budget is spent) — but a DIFFERENT sender works
+            blob = pack_pytree({"W": np.ones((5, 2), np.float32),
+                                "b": np.zeros((2,), np.float32)})
+            other = next(a for i in range(CFG.client_num)
+                         if (a := f"0x{i:040x}") not in committee
+                         and a != trainer)
+            d2 = hashlib.sha256(blob).digest()
+            r2 = c.request("upload", addr=other, blob=blob.hex(),
+                           hash=d2.hex(), n=10, cost=1.0, epoch=0)
+            assert r2["ok"], r2
+        finally:
+            c.close()
+            srv.close()
+
+    def test_spoofed_address_cannot_drain_victim_budget(self):
+        """Gas binds to a PROVEN identity: a forged-signature request
+        naming a victim's address is rejected before any charge, so the
+        victim's own ops still fit their budget."""
+        wallets, directory = provision_wallets(2, b"gas-auth-master-01")
+        victim, attacker = wallets
+        srv = LedgerServer(CFG, _init_blob(), directory=directory,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           gas_budget_per_epoch=1_500)   # one register
+        srv.start()
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=10.0)
+        try:
+            # attacker spams registers AS the victim with its own key
+            for _ in range(5):
+                r = c.request(
+                    "register", addr=victim.address,
+                    pubkey=victim.public_bytes.hex(),
+                    tag=attacker.sign(_op_bytes(
+                        "register", victim.address, 0, b"")).hex())
+                assert not r["ok"] and r["status"] == "BAD_ARG"
+            # the victim's genuine register still has budget
+            r = c.request("register", addr=victim.address,
+                          pubkey=victim.public_bytes.hex(),
+                          tag=victim.sign(_op_bytes(
+                              "register", victim.address, 0, b"")).hex())
+            assert r["ok"], r
+        finally:
+            c.close()
+            srv.close()

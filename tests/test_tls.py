@@ -131,3 +131,22 @@ class TestTLS:
         with pytest.raises(ssl.SSLError):
             CoordinatorClient(tls_server.host, tls_server.port,
                               tls=client_context(other))
+
+    def test_wrong_hostname_rejected(self, tmp_path):
+        """Server identity is the SAN match, not CA membership (VERDICT r4
+        weak #2a): a cert validly signed by the trusted CA but provisioned
+        for a DIFFERENT host must fail the client handshake."""
+        import ssl
+        d = str(tmp_path / "otherhost")
+        provision_tls(d, common_name="db.internal.example",
+                      include_loopback=False)
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           tls=server_context(d))
+        srv.start()
+        try:
+            with pytest.raises(ssl.SSLCertVerificationError):
+                CoordinatorClient(srv.host, srv.port,
+                                  tls=client_context(d))
+        finally:
+            srv.close()
