@@ -25,8 +25,11 @@ in the reference each committee member scores on its own machine and signs
 its own score tx (main.py:196-228).  Here committee rows are computed
 centrally on the coordinator's mesh — the price of the one-program round.
 The ledger still re-runs the decision on the recorded rows (divergence
-raises), but a malicious coordinator could fabricate rows; use
-client/process_runtime.py when committee members distrust the coordinator.
+raises), but a malicious coordinator could fabricate rows; when committee
+members distrust the coordinator use client/process_runtime.py, or the
+mesh-executor with score attestation
+(run_federated_mesh_processes(attest_scores=True) — members re-score and
+sign their rows before the ledger accepts the round).
 """
 
 from __future__ import annotations

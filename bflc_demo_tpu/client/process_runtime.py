@@ -503,7 +503,8 @@ def run_federated_processes(
 # ------------------------------------------------- mesh-executor federation
 def _executor_proc(cfg_kw: dict, model_factory: str, factory_kw: dict,
                    rounds: int, port_q, n_virtual_devices: int,
-                   stall_timeout_s: float, verbose: bool) -> None:
+                   stall_timeout_s: float, attest_scores: bool,
+                   verbose: bool) -> None:
     """Coordinator process that OWNS the device mesh: each round is one
     SPMD program (comm.executor_service.MeshExecutorServer)."""
     if n_virtual_devices > 1:
@@ -515,15 +516,80 @@ def _executor_proc(cfg_kw: dict, model_factory: str, factory_kw: dict,
     from bflc_demo_tpu.comm.executor_service import MeshExecutorServer
     server = MeshExecutorServer(
         ProtocolConfig(**cfg_kw), model_factory, factory_kw,
-        rounds=rounds, stall_timeout_s=stall_timeout_s, verbose=verbose)
+        rounds=rounds, stall_timeout_s=stall_timeout_s,
+        attest_scores=attest_scores, verbose=verbose)
     port_q.put(server.port)
     server.serve_forever()
+
+
+def attest_score_row(client, wallet, model, template, cfg,
+                     x_np: np.ndarray, y_np: np.ndarray, pa: dict) -> bool:
+    """Re-score a pending round's candidates on OUR shard; sign on match.
+
+    Trust locality (reference main.py:196-228: committee members score on
+    their own machines): the device-computed row is only admitted to the
+    ledger once the member reproduced it from the candidate deltas against
+    its own data.  A coordinator that fabricated the row fails the
+    comparison, the member refuses to sign, and the round aborts
+    server-side (comm.executor_service._collect_attestations).
+
+    Returns True when an attestation was submitted; False when the round
+    moved on under us; raises RuntimeError on a row mismatch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bflc_demo_tpu.comm.identity import _op_bytes
+    from bflc_demo_tpu.core.scoring import score_candidates
+    from bflc_demo_tpu.data.partition import one_hot
+    from bflc_demo_tpu.utils.serialization import (restore_pytree,
+                                                   unpack_pytree)
+
+    epoch, s_pad = pa["epoch"], int(pa["s_pad"])
+    mr = client.request("model")
+    if mr["epoch"] != epoch:
+        return False                    # round turned over; re-poll
+    gparams = restore_pytree(
+        template, unpack_pytree(bytes.fromhex(mr["blob"])))
+    deltas = []
+    for h in pa["hashes"]:
+        br = client.request("blob", hash=h)
+        if not br.get("ok"):
+            return False
+        deltas.append(restore_pytree(
+            template, unpack_pytree(bytes.fromhex(br["blob"]))))
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *deltas)
+    # reproduce the staging pad exactly (client/staging.py cyc): our shard
+    # cycled to the fleet-wide max size
+    reps = -(-s_pad // len(x_np))
+    xp = np.concatenate([x_np] * reps)[:s_pad]
+    xp = (xp.astype(np.int32) if np.issubdtype(xp.dtype, np.integer)
+          else xp.astype(np.float32))
+    yp = np.concatenate([y_np] * reps)[:s_pad]
+    mine = np.asarray(score_candidates(
+        model.apply, gparams, stacked, cfg.learning_rate,
+        jnp.asarray(xp), jnp.asarray(one_hot(yp, model.num_classes))))
+    row = np.asarray(pa["row"], np.float64)
+    # accuracy quantum is 1/s_pad; allow two flipped samples of
+    # device-vs-host reassociation slack
+    if np.max(np.abs(mine - row)) > 2.0 / s_pad + 1e-6:
+        raise RuntimeError(
+            f"epoch {epoch}: device score row {row.tolist()} does not "
+            f"match local recomputation {mine.tolist()} — refusing to "
+            f"attest (tampered or corrupt coordinator scoring)")
+    payload = struct.pack(f"<{len(row)}d", *row)
+    client.request(
+        "attest", addr=wallet.address, epoch=epoch,
+        scores=[float(v) for v in row],
+        tag=wallet.sign(_op_bytes(
+            "scores", wallet.address, epoch, payload)).hex())
+    return True
 
 
 def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
                       model_factory: str, factory_kw: dict,
                       x: np.ndarray, y: np.ndarray, cfg_kw: dict,
-                      rounds: int) -> None:
+                      rounds: int, attest_scores: bool = False) -> None:
     """Thin driver for the mesh-executor deployment: register, stage the
     shard ONCE, then watch rounds progress and verify the committed model
     on the local shard each epoch."""
@@ -563,12 +629,19 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
 
     xj = jnp.asarray(np.asarray(x))
     yj = jnp.asarray(one_hot(np.asarray(y), model.num_classes))
+    cfg = ProtocolConfig(**cfg_kw)
+    x_np, y_np = np.asarray(x), np.asarray(y)
     seen = 0
     known_log = 0
     while True:
         pr = client.request("progress")
         if pr.get("error"):
             raise RuntimeError(f"executor failed: {pr['error']}")
+        if attest_scores:
+            pa = client.request("round_pending", addr=wallet.address)
+            if pa.get("epoch") is not None:
+                attest_score_row(client, wallet, model, template, cfg,
+                                 x_np, y_np, pa)
         # cheap "info" first: only fetch the (potentially multi-MB) model
         # blob when a new epoch actually committed
         if client.request("info")["epoch"] > seen:
@@ -597,6 +670,7 @@ def run_federated_mesh_processes(
         master_seed: bytes = b"mesh-executor-master-0001",
         n_virtual_devices: int = 0,
         stall_timeout_s: float = 120.0,
+        attest_scores: bool = False,
         timeout_s: float = 600.0,
         verbose: bool = False) -> ProcessFederationResult:
     """The composed deployment: OS-process clients drive rounds over the
@@ -606,6 +680,10 @@ def run_federated_mesh_processes(
 
     n_virtual_devices: CPU-mesh width for the executor child (tests); 0
     leaves the platform's real device count (TPU benches).
+    attest_scores: score-attestation trust locality — every committee
+    member's process re-scores the round's candidates on its own shard
+    and signs its row before the ledger accepts the round
+    (comm.executor_service._collect_attestations).
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -632,7 +710,8 @@ def run_federated_mesh_processes(
         server = ctx.Process(
             target=_executor_proc,
             args=(cfg_kw, model_factory, factory_kw, rounds, port_q,
-                  n_virtual_devices, stall_timeout_s, verbose),
+                  n_virtual_devices, stall_timeout_s, attest_scores,
+                  verbose),
             daemon=True)
         server.start()
         port = port_q.get(timeout=120)
@@ -643,7 +722,7 @@ def run_federated_mesh_processes(
                 target=_thin_client_proc,
                 args=(host, port, master_seed + struct.pack("<q", i),
                       model_factory, factory_kw, np.asarray(sx),
-                      np.asarray(sy), cfg_kw, rounds),
+                      np.asarray(sy), cfg_kw, rounds, attest_scores),
                 daemon=True)
             p.start()
             clients.append(p)

@@ -29,6 +29,13 @@ trust with data but not with the protocol (the signed op log still pins
 registration/staging identity and every round's decisions).  Silos that do
 not trust the executor with raw data keep the CPU-local process federation
 or the secure-aggregation mesh path (parallel.secure) instead.
+
+Score attestation (`attest_scores=True`) additionally removes the
+centralized-scoring divergence (PARITY.md "Trust-model divergences" #1):
+the executor must collect an Ed25519 attestation from every committee
+member — who re-scores the round's candidate deltas against its own
+shard — before the round reaches the ledger; a fabricated score row gets
+no signature and the round aborts.
 """
 
 from __future__ import annotations
@@ -64,7 +71,8 @@ class MeshExecutorServer(LedgerServer):
                  factory_kw: Optional[dict] = None, *,
                  rounds: int = 5, mesh=None, seed: int = 0,
                  init_seed: int = 0, client_chunk: int = 0,
-                 remat: bool = False, **server_kw):
+                 remat: bool = False, attest_scores: bool = False,
+                 attest_timeout_s: float = 60.0, **server_kw):
         import bflc_demo_tpu.models as models
 
         self.model = getattr(models, model_factory)(**(factory_kw or {}))
@@ -81,6 +89,19 @@ class MeshExecutorServer(LedgerServer):
         self._runner: Optional[threading.Thread] = None
         self.rounds_done = 0
         self.runner_error: Optional[str] = None
+        # score attestation (closes the centralized-scoring trust
+        # divergence, PARITY.md "Trust-model divergences" #1): before a
+        # round's decision reaches the ledger, every committee member's
+        # process must fetch the K candidate deltas, RE-SCORE them locally
+        # against its own shard, check the device-computed row matches,
+        # and sign it (the same Ed25519 scores codec the ledger path
+        # verifies).  A coordinator that fabricates a row gets no
+        # signature and the round aborts.
+        self.attest_scores = attest_scores
+        self.attest_timeout_s = attest_timeout_s
+        self._pending_attest: Optional[dict] = None
+        self._attested: Dict[str, str] = {}      # addr -> sig hex (epoch's)
+        self.attest_log: Dict[int, Dict[str, str]] = {}
 
     # ----------------------------------------------------------- dispatch
     def _dispatch(self, method: str, m: dict) -> dict:
@@ -113,6 +134,43 @@ class MeshExecutorServer(LedgerServer):
         if method == "progress":
             return {"ok": True, "rounds_done": self.rounds_done,
                     "rounds": self.rounds, "error": self.runner_error}
+        if method == "round_pending":
+            # a committee member asks whether a round awaits its attestation
+            with self._lock:
+                p = self._pending_attest
+                addr = m.get("addr", "")
+                if p is None or addr not in p["rows"] \
+                        or addr in self._attested:
+                    return {"ok": True, "epoch": None}
+                return {"ok": True, "epoch": p["epoch"],
+                        "s_pad": p["s_pad"], "hashes": p["hashes"],
+                        "row": p["rows"][addr]}
+        if method == "attest":
+            with self._lock:
+                p = self._pending_attest
+                addr = m.get("addr", "")
+                if p is None or int(m.get("epoch", -1)) != p["epoch"]:
+                    return {"ok": False, "status": "WRONG_EPOCH"}
+                if addr not in p["rows"]:
+                    return {"ok": False, "status": "NOT_COMMITTEE"}
+                scores = [float(s) for s in m["scores"]]
+                row = p["rows"][addr]
+                if len(scores) != len(row) or any(
+                        abs(a - b) > 1e-6 for a, b in zip(scores, row)):
+                    # the client signed a different row than the device
+                    # computed — surfaced, never silently accepted
+                    return {"ok": False, "status": "ROW_MISMATCH"}
+                import struct as _struct
+                payload = _struct.pack(f"<{len(scores)}d", *scores)
+                if self.require_auth and not self.directory.verify(
+                        addr, _op_bytes("scores", addr, p["epoch"], payload),
+                        bytes.fromhex(m.get("tag", ""))):
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "bad signature"}
+                self._attested[addr] = m.get("tag", "")
+                self._cv.notify_all()
+                return {"ok": True,
+                        "missing": len(p["rows"]) - len(self._attested)}
         return super()._dispatch(method, m)
 
     # -------------------------------------------------------- round runner
@@ -136,6 +194,54 @@ class MeshExecutorServer(LedgerServer):
             if self.verbose:
                 print(f"[executor] runner failed: {self.runner_error}",
                       flush=True)
+
+    def _collect_attestations(self, epoch, addrs, uploader_ids,
+                              committee_ids, delta_fps, score_rows,
+                              cand_deltas, s_pad) -> None:
+        """Publish the round's scoring evidence and block until every
+        committee member re-scored and SIGNED its row (or raise).
+
+        Evidence: the K candidate deltas become fetchable blobs keyed by
+        their on-device fingerprints (the same ids the ledger will record),
+        plus each member's device-computed row.  The member recomputes the
+        row from the blobs against its own shard (trust locality — the
+        scorer, not the aggregator, vouches for the score) and signs the
+        exact scores-op payload.  Missing/refused attestation = the round
+        never reaches the ledger.
+        """
+        import jax
+
+        from bflc_demo_tpu.ops.fingerprint import fingerprint_to_bytes
+
+        cands_host = jax.device_get(cand_deltas)
+        hashes = []
+        with self._lock:
+            for j, uid in enumerate(uploader_ids):
+                one = jax.tree_util.tree_map(lambda l: np.asarray(l[j]),
+                                             cands_host)
+                fp = fingerprint_to_bytes(delta_fps[uid])
+                self._blobs[fp] = pack_pytree(one)
+                hashes.append(fp.hex())
+            self._pending_attest = {
+                "epoch": epoch, "s_pad": int(s_pad), "hashes": hashes,
+                "rows": {addrs[c]: [float(score_rows[c, u])
+                                    for u in uploader_ids]
+                         for c in committee_ids}}
+            self._attested = {}
+            deadline = time.monotonic() + self.attest_timeout_s
+            while len(self._attested) < len(committee_ids):
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    missing = [a for a in self._pending_attest["rows"]
+                               if a not in self._attested]
+                    self._pending_attest = None
+                    raise RuntimeError(
+                        f"epoch {epoch}: committee members {missing} did "
+                        f"not attest their score rows — refusing to commit "
+                        f"the round")
+                self._cv.wait(rem)
+            self.attest_log[epoch] = dict(self._attested)
+            self._pending_attest = None
 
     def _run_rounds_inner(self) -> None:
         import jax
@@ -174,7 +280,8 @@ class MeshExecutorServer(LedgerServer):
             aggregate_count=cfg.aggregate_count,
             client_chunk=self._client_chunk, remat=self._remat,
             comm_count=cfg.comm_count,
-            needed_update_count=cfg.needed_update_count)
+            needed_update_count=cfg.needed_update_count,
+            expose_candidates=self.attest_scores)
 
         params = self._params
         rng = np.random.default_rng(self.seed)
@@ -198,6 +305,12 @@ class MeshExecutorServer(LedgerServer):
             score_rows = np.asarray(res.score_matrix)
             avg_costs = np.asarray(res.avg_costs)
             sel_device = np.flatnonzero(np.asarray(res.selected))
+
+            if self.attest_scores:
+                self._collect_attestations(epoch, addrs, uploader_ids,
+                                           committee_ids, delta_fps,
+                                           score_rows, res.cand_deltas,
+                                           xs_np.shape[1])
 
             with self._lock:
                 # full participation: client ids ARE the device slots

@@ -134,14 +134,30 @@ class FailoverClient:
                 reply = self._client.request(method, **fields)
                 g = reply.get("gen")
                 ev = reply.get("gen_ev")
-                if isinstance(g, int) and g > self.gen:
+                ev_gen = -1
+                if isinstance(ev, dict):
+                    try:
+                        ev_gen = int(ev.get("gen", -1))
+                    except (TypeError, ValueError):
+                        ev = None      # malformed evidence from a broken
+                                       # or hostile peer: ignore, don't die
+                # Raise our fence only on a reply that CARRIES the signed
+                # promotion evidence for that generation.  A bare integer
+                # must not poison the client (round-5 review: one hostile
+                # reply with gen=999 would otherwise make us reject the
+                # legitimate writer forever).  We can't fully verify the
+                # evidence (no chain), but requiring its presence +
+                # structural match means only a party holding a plausible
+                # promotion record moves our fence — and the old writer
+                # verifies it cryptographically before demoting.
+                if isinstance(g, int) and g > self.gen \
+                        and isinstance(ev, dict) and ev_gen == g:
                     self.gen = g
+                    self.gen_ev = ev
                     fields["fence"] = self.gen
-                    self.gen_ev = ev if isinstance(ev, dict) else None
-                    if self.gen_ev is not None:
-                        fields["fence_ev"] = self.gen_ev
+                    fields["fence_ev"] = self.gen_ev
                 elif (isinstance(ev, dict) and self.gen_ev is None
-                      and int(ev.get("gen", -1)) == self.gen):
+                      and ev_gen == self.gen):
                     self.gen_ev = ev       # learn the proof retroactively
                     fields.setdefault("fence_ev", self.gen_ev)
                 if reply.get("status") == "STALE_WRITER":
@@ -307,8 +323,19 @@ class Standby:
         try:
             sub = CoordinatorClient(host, port, timeout_s=self.heartbeat_s,
                                     tls=self.tls_client)
-            send_msg(sub.sock, {"method": "subscribe",
-                                "from": self.ledger.log_size()})
+            sub_msg = {"method": "subscribe",
+                       "from": self.ledger.log_size()}
+            if self.wallet is not None:
+                # prove the standby identity so this subscription's acks
+                # count toward the writer's durability quorum
+                import struct as _struct
+                from bflc_demo_tpu.comm.ledger_service import \
+                    LedgerServer as _LS
+                sub_msg["sb"] = self.index
+                sub_msg["tag"] = self.wallet.sign(
+                    _LS._SUB_MAGIC + _struct.pack(
+                        "<Iq", self.index, sub_msg["from"])).hex()
+            send_msg(sub.sock, sub_msg)
             ctl = CoordinatorClient(host, port, timeout_s=10.0,
                                     tls=self.tls_client)
             # fence check: never follow a writer whose generation is behind

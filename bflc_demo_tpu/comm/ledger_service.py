@@ -247,6 +247,8 @@ class LedgerServer:
         self._quorum = quorum
         self._quorum_timeout_s = quorum_timeout_s
         self._sub_acked: Dict[object, int] = {}
+        self._sub_sent: Dict[object, int] = {}
+        self._sub_eligible: Dict[object, bool] = {}
         self._last_seen: Dict[str, float] = {}
         # replay rejection at the auth layer, not merely ledger idempotency
         # — the SAME ReplayGuard class AuthenticatedLedger uses, so the two
@@ -339,7 +341,8 @@ class LedgerServer:
                     return
                 method = msg.get("method", "")
                 if method == "subscribe":
-                    self._stream_ops(conn, int(msg.get("from", 0)))
+                    self._stream_ops(conn, int(msg.get("from", 0)),
+                                     self._verify_subscriber(msg))
                     return
                 try:
                     fence = int(msg.get("fence", -1))
@@ -371,7 +374,6 @@ class LedgerServer:
                     post_size = reply.pop("_post_size", None)
                     if (self._quorum
                             and post_size is not None
-                            and reply.get("ok")
                             and not self._await_quorum(post_size)):
                         # the op is in the local chain but not provably on
                         # quorum replicas: do NOT acknowledge durability.
@@ -403,7 +405,8 @@ class LedgerServer:
             except OSError:
                 pass
 
-    def _stream_ops(self, conn: socket.socket, start: int) -> None:
+    def _stream_ops(self, conn: socket.socket, start: int,
+                    quorum_eligible: bool) -> None:
         """Push canonical op bytes from `start` onward until the peer goes
         away — the replica feed (WAL-identical bytes, ledger.cpp op codec).
 
@@ -411,11 +414,17 @@ class LedgerServer:
         subscriber's `{"ack": i}` frames (sent by Standby after each
         successful apply) into `_sub_acked` — unconditionally, so an
         acking follower can never wedge on a filled send buffer — and the
-        quorum waiters are notified.
+        quorum waiters are notified.  quorum_eligible marks whether this
+        subscriber's acks may count toward the durability quorum (it
+        proved a provisioned standby identity at subscribe time — an
+        anonymous peer could otherwise void the guarantee by acking
+        without persisting anything).
         """
         sub_id = object()
         with self._cv:
             self._sub_acked[sub_id] = -1
+            self._sub_sent[sub_id] = start - 1
+            self._sub_eligible[sub_id] = quorum_eligible
         reader = threading.Thread(target=self._ack_reader,
                                   args=(conn, sub_id), daemon=True)
         reader.start()
@@ -432,9 +441,13 @@ class LedgerServer:
                 for i, op in enumerate(ops):
                     send_msg(conn, {"i": next_i + i, "op": op.hex()})
                 next_i += len(ops)
+                with self._cv:
+                    self._sub_sent[sub_id] = next_i - 1
         finally:
             with self._cv:
                 self._sub_acked.pop(sub_id, None)
+                self._sub_sent.pop(sub_id, None)
+                self._sub_eligible.pop(sub_id, None)
                 self._cv.notify_all()
 
     def _ack_reader(self, conn: socket.socket, sub_id: object) -> None:
@@ -448,23 +461,37 @@ class LedgerServer:
                 except (TypeError, ValueError):
                     continue
                 with self._cv:
-                    if sub_id in self._sub_acked \
-                            and i > self._sub_acked[sub_id]:
+                    if sub_id not in self._sub_acked:
+                        return
+                    # clamp: a subscriber cannot ack ops it was never
+                    # sent (an inflated index would fake durability)
+                    i = min(i, self._sub_sent.get(sub_id, -1))
+                    if i > self._sub_acked[sub_id]:
                         self._sub_acked[sub_id] = i
                         self._cv.notify_all()
         except (WireError, OSError):
             return
 
     def _await_quorum(self, post_size: int) -> bool:
-        """Block until >= quorum subscribers acked through op index
-        post_size-1 (the requester's own op, snapshotted at append time),
-        or the timeout passes.  `Condition.wait` fully releases the
-        (R)lock, so followers keep pulling and acking while we wait."""
+        """Block until >= quorum ELIGIBLE subscribers acked through op
+        index post_size-1 (the requester's own op, snapshotted at append
+        time), or the timeout passes.  `Condition.wait` fully releases
+        the (R)lock, so followers keep pulling and acking while we wait.
+
+        Eligibility: when standby identities are provisioned, only
+        subscribers that authenticated as one count — an anonymous
+        subscriber acking everything must not void the durability
+        guarantee.  With no standby_keys configured (closed/test setups),
+        every subscriber counts.
+        """
         target = post_size - 1
         deadline = time.monotonic() + self._quorum_timeout_s
         with self._cv:
             while not self._stop.is_set():
-                n = sum(1 for a in self._sub_acked.values() if a >= target)
+                n = sum(1 for s, a in self._sub_acked.items()
+                        if a >= target and
+                        (self._sub_eligible.get(s, False)
+                         or not self._standby_keys))
                 if n >= self._quorum:
                     return True
                 rem = deadline - time.monotonic()
@@ -472,6 +499,31 @@ class LedgerServer:
                     return False
                 self._cv.wait(rem)
         return False
+
+    _SUB_MAGIC = b"BFLCSUB1"
+
+    def _verify_subscriber(self, msg: dict) -> bool:
+        """True iff the subscribe message proves a provisioned standby
+        identity: Ed25519 over (magic, standby index, start offset).
+        Only such subscribers' acks count toward the durability quorum."""
+        try:
+            sb = int(msg.get("sb", -1))
+            start = int(msg.get("from", 0))
+            sig = bytes.fromhex(msg.get("tag", ""))
+        except (TypeError, ValueError):
+            return False
+        pub = self._standby_keys.get(sb)
+        if pub is None or not sig:
+            return False
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+            Ed25519PublicKey
+        try:
+            Ed25519PublicKey.from_public_bytes(pub).verify(
+                sig, self._SUB_MAGIC + struct.pack("<Iq", sb, start))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
 
     # ------------------------------------------------------------- dispatch
     def _touch(self, addr: str) -> None:
@@ -545,12 +597,19 @@ class LedgerServer:
     def _dispatch(self, method: str, m: dict) -> dict:
         with self._lock:            # RLock: the inner re-acquires freely
             reply = self._dispatch_inner(method, m)
-            if method in self._MUTATING and reply.get("ok"):
+            if method in self._MUTATING and (
+                    reply.get("ok")
+                    or reply.get("status") in ("DUPLICATE",
+                                               "ALREADY_REGISTERED")):
                 # snapshot THIS op's chain position while still holding
                 # the lock: the quorum wait must target the requester's
                 # own op, not whatever a concurrent writer appended after
                 # (review finding: waiting on the live head misreports
-                # durability under concurrency)
+                # durability under concurrency).  DUPLICATE-class replies
+                # get the snapshot too — callers treat "already in" as
+                # progress, so a retry after REPLICATION_TIMEOUT must not
+                # skip the quorum wait and reopen the loss window (the
+                # op sits at or below the current head).
                 reply["_post_size"] = self.ledger.log_size()
         return reply
 

@@ -74,12 +74,9 @@ def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
     # untrendable on a contended shared-CPU host): std + CV over the warm
     # rounds, and the warm median as the outlier-robust central value
     if warm:
-        var = sum((t - warm_mean) ** 2 for t in warm) / len(warm)
-        warm_std = var ** 0.5
-        srt = sorted(warm)
-        mid = len(srt) // 2
-        warm_median = (srt[mid] if len(srt) % 2
-                       else 0.5 * (srt[mid - 1] + srt[mid]))
+        import statistics
+        warm_std = statistics.pstdev(warm)
+        warm_median = statistics.median(warm)
     else:
         warm_std, warm_median = 0.0, mean_round
     out = {

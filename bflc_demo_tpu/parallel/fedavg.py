@@ -252,6 +252,10 @@ class ShardedRoundResult(NamedTuple):
     global_loss: jax.Array      # mean avg_cost of selected (.cpp:416-425)
     delta_fps: jax.Array        # (N, 8) uint32 on-device payload fingerprints
     params_fp: jax.Array        # (8,) uint32 fingerprint of the new model
+    cand_deltas: Pytree = ()    # expose_candidates=True: the K uploaded
+                                # deltas, stacked ascending-uploader-id,
+                                # replicated — the evidence committee
+                                # clients re-score to attest their rows
 
 
 def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
@@ -265,6 +269,7 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
                                 scoring: str = "auto",
                                 comm_count: int = 0,
                                 needed_update_count: int = 0,
+                                expose_candidates: bool = False,
                                 ) -> Callable[..., ShardedRoundResult]:
     """Build the jitted full-round SPMD program for a fixed geometry.
 
@@ -326,6 +331,9 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
     if scoring == "committee" and not (comm_count and needed_update_count):
         raise ValueError("scoring='committee' needs static comm_count and "
                          "needed_update_count")
+    if expose_candidates and scoring != "committee":
+        raise ValueError("expose_candidates requires the committee "
+                         "scoring schedule (static K)")
     if not (0 <= comm_count <= client_num
             and 0 <= needed_update_count <= client_num):
         raise ValueError(
@@ -413,8 +421,17 @@ def make_sharded_protocol_round(mesh: Mesh, apply_fn: ApplyFn, *,
         fps_local = fingerprint_stacked(deltas_local)            # (n, 8)
         delta_fps = jax.lax.all_gather(fps_local, AXIS, tiled=True)
         params_fp = fingerprint_pytree(new_params)
+        cands_out = ()
+        if expose_candidates:
+            # the K uploaded deltas, replicated: committee clients fetch
+            # these as blobs and independently re-score their own row
+            # (score-attestation trust locality, comm.executor_service)
+            up_idx = _first_k_indices(uploader_mask, needed_update_count)
+            cands_out = _gather_client_slots(deltas_local, up_idx, my,
+                                             n_local)
         return ShardedRoundResult(new_params, score_matrix, med, sel, order,
-                                  costs, g_loss, delta_fps, params_fp)
+                                  costs, g_loss, delta_fps, params_fp,
+                                  cands_out)
 
     # Every output is replicated by construction (decision inputs come from
     # all_gather, the model from psum); the vma checker can't infer that

@@ -659,3 +659,55 @@ class TestQuorumAck:
             if standby is not None:
                 standby.stop()
             srv.close()
+
+    def test_anonymous_acker_cannot_fake_quorum(self):
+        """Round-5 review: quorum durability must not be voidable by an
+        anonymous subscriber blasting inflated acks.  With standby keys
+        provisioned, only SIGNED standby subscriptions count — and acks
+        are clamped to ops actually streamed."""
+        import struct as _struct
+
+        from bflc_demo_tpu.comm.identity import Wallet
+        from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                       LedgerServer)
+        from bflc_demo_tpu.comm.wire import send_msg
+        sb_wallet = Wallet.from_seed(b"quorum-sb-1")
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           quorum=1, quorum_timeout_s=1.0,
+                           standby_keys={1: sb_wallet.public_bytes})
+        srv.start()
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=15.0)
+        liar = None
+        standby = None
+        try:
+            # an anonymous subscriber that acks everything, instantly
+            liar = CoordinatorClient(srv.host, srv.port, timeout_s=5.0)
+            send_msg(liar.sock, {"method": "subscribe", "from": 0})
+            send_msg(liar.sock, {"ack": 10 ** 18})
+            time.sleep(0.3)
+            r = c.request("register", addr="0x" + "aa" * 20)
+            assert r["status"] == "REPLICATION_TIMEOUT", r
+
+            # a REAL standby (signed subscription) satisfies the quorum
+            standby = Standby(CFG, [(srv.host, srv.port),
+                                    ("127.0.0.1", 0)], 1,
+                              heartbeat_s=0.3, stall_timeout_s=60.0,
+                              require_auth=False, ledger_backend="python",
+                              wallet=sb_wallet)
+            standby.endpoints[1] = (standby.host, standby.port)
+            threading.Thread(target=standby.run, daemon=True).start()
+            deadline = time.monotonic() + 15
+            while True:
+                r2 = c.request("register", addr="0x" + "aa" * 20)
+                if r2["status"] == "ALREADY_REGISTERED":
+                    break               # replicated: rejected-but-in
+                assert time.monotonic() < deadline, r2
+                time.sleep(0.3)
+        finally:
+            c.close()
+            if liar is not None:
+                liar.close()
+            if standby is not None:
+                standby.stop()
+            srv.close()

@@ -67,3 +67,137 @@ class TestExecutorServerInThread:
             c.close()
         finally:
             srv.close()
+
+
+class TestScoreAttestation:
+    """Score-attestation trust locality (VERDICT r4 missing #2): committee
+    members re-score the round's candidates on their OWN shard and sign
+    their row before the ledger accepts the round.  A coordinator that
+    fabricates a row gets no signature and the round aborts."""
+
+    def _setup(self, server_cls, attest_timeout_s=30.0):
+        import hashlib as hl
+
+        from bflc_demo_tpu.comm.identity import provision_wallets, _op_bytes
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        from bflc_demo_tpu.utils.serialization import pack_entries
+
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"attest-master-0001")
+        srv = server_cls(CFG, "make_softmax_regression", rounds=1,
+                         attest_scores=True,
+                         attest_timeout_s=attest_timeout_s,
+                         directory=directory, stall_timeout_s=600.0,
+                         ledger_backend="python")
+        srv.start()
+        rng = np.random.default_rng(7)
+        shards = {}
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=30.0)
+        for i, w in enumerate(wallets):
+            size = 40 if i == 0 else 32     # ragged: force cyclic padding
+            x = rng.standard_normal((size, 5)).astype(np.float32)
+            y = rng.integers(0, 2, (size,)).astype(np.int32)
+            shards[w.address] = (x, y)
+            r = c.request("register", addr=w.address,
+                          pubkey=w.public_bytes.hex(),
+                          tag=w.sign(_op_bytes("register", w.address, 0,
+                                               b"")).hex())
+            assert r["ok"], r
+        for w in wallets:
+            x, y = shards[w.address]
+            xb = pack_entries({"x": x})
+            yb = pack_entries({"y": y})
+            payload = hl.sha256(xb).digest() + hl.sha256(yb).digest()
+            r = c.request("stage", addr=w.address, x=xb.hex(), y=yb.hex(),
+                          tag=w.sign(_op_bytes("stage", w.address, 0,
+                                               payload)).hex())
+            assert r["ok"], r
+        return srv, c, wallets, shards
+
+    def test_attested_round_commits_and_logs_signatures(self):
+        import time as _t
+
+        from bflc_demo_tpu.client.process_runtime import attest_score_row
+        from bflc_demo_tpu.comm.executor_service import MeshExecutorServer
+        from bflc_demo_tpu.models import make_softmax_regression
+
+        model = make_softmax_regression()
+        template = model.init_params(0)
+        srv, c, wallets, shards = self._setup(MeshExecutorServer)
+        try:
+            deadline = _t.monotonic() + 60
+            attested = 0
+            while _t.monotonic() < deadline:
+                pr = c.request("progress")
+                assert not pr.get("error"), pr
+                if pr["rounds_done"] >= 1:
+                    break
+                for w in wallets:
+                    pa = c.request("round_pending", addr=w.address)
+                    if pa.get("epoch") is not None:
+                        x, y = shards[w.address]
+                        assert attest_score_row(c, w, model, template,
+                                                CFG, x, y, pa)
+                        attested += 1
+                _t.sleep(0.1)
+            assert c.request("progress")["rounds_done"] == 1
+            assert attested == CFG.comm_count
+            # the signed rows are recorded per epoch, one per member
+            assert len(srv.attest_log[0]) == CFG.comm_count
+        finally:
+            c.close()
+            srv.close()
+
+    def test_tampered_row_refused_and_round_aborts(self):
+        """The coordinator perturbs one committee row after the mesh
+        computed it: the member's local recomputation disagrees, it
+        REFUSES to sign, and the round never reaches the ledger."""
+        import time as _t
+
+        import pytest as _pytest
+
+        from bflc_demo_tpu.client.process_runtime import attest_score_row
+        from bflc_demo_tpu.comm.executor_service import MeshExecutorServer
+        from bflc_demo_tpu.models import make_softmax_regression
+
+        class TamperingExecutor(MeshExecutorServer):
+            def _collect_attestations(self, epoch, addrs, uploader_ids,
+                                      committee_ids, delta_fps, score_rows,
+                                      cand_deltas, s_pad):
+                rows = np.array(score_rows, copy=True)
+                rows[committee_ids[0], uploader_ids[0]] += 0.25
+                super()._collect_attestations(
+                    epoch, addrs, uploader_ids, committee_ids, delta_fps,
+                    rows, cand_deltas, s_pad)
+
+        model = make_softmax_regression()
+        template = model.init_params(0)
+        srv, c, wallets, shards = self._setup(TamperingExecutor,
+                                              attest_timeout_s=4.0)
+        try:
+            refused = 0
+            deadline = _t.monotonic() + 45
+            while _t.monotonic() < deadline:
+                pr = c.request("progress")
+                if pr.get("error"):
+                    break
+                for w in wallets:
+                    pa = c.request("round_pending", addr=w.address)
+                    if pa.get("epoch") is None:
+                        continue
+                    x, y = shards[w.address]
+                    try:
+                        attest_score_row(c, w, model, template, CFG, x, y,
+                                         pa)
+                    except RuntimeError as e:
+                        assert "does not match" in str(e)
+                        refused += 1
+                _t.sleep(0.1)
+            err = c.request("progress").get("error") or ""
+            assert "did not attest" in err, err
+            assert refused >= 1
+            assert c.request("progress")["rounds_done"] == 0
+            assert c.request("info")["epoch"] == 0   # nothing committed
+        finally:
+            c.close()
+            srv.close()
