@@ -41,13 +41,20 @@ def main(argv=None) -> int:
         kw["cfg"] = cfg
     if opts.runtime == "processes":
         # the reference's deployment shape from the CLI: OS-process fleet,
-        # optional hot standbys + TLS
+        # optional hot standbys + TLS + quorum-ack durability
         if opts.standbys:
             kw["standbys"] = opts.standbys
         if opts.tls_dir:
             kw["tls_dir"] = opts.tls_dir
-    elif opts.standbys or opts.tls_dir:
-        print("--standbys/--tls-dir apply to --runtime processes",
+        if opts.quorum:
+            if opts.quorum > opts.standbys:
+                print("--quorum needs at least that many --standbys "
+                      "(only authenticated standby subscriptions count "
+                      "toward the durability quorum)", file=sys.stderr)
+                return 2
+            kw["quorum"] = opts.quorum
+    elif opts.standbys or opts.tls_dir or opts.quorum:
+        print("--standbys/--tls-dir/--quorum apply to --runtime processes",
               file=sys.stderr)
         return 2
     if opts.secure:

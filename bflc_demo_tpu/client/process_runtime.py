@@ -103,7 +103,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                  model_factory: str, factory_kw: dict,
                  x: np.ndarray, y_onehot: np.ndarray, cfg_kw: dict,
                  rounds: int, crash_at_epoch: Optional[int],
-                 tls_dir: str = "") -> None:
+                 tls_dir: str = "",
+                 standby_keys: Optional[dict] = None) -> None:
     """One federated client: register -> role loop -> train/score -> exit.
 
     Runs the same state machine as client/runtime.FLNode.step (itself the
@@ -135,7 +136,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     if tls_dir:
         from bflc_demo_tpu.comm.tls import client_context
         tls = client_context(tls_dir)
-    client = FailoverClient(endpoints, timeout_s=120.0, tls=tls)
+    client = FailoverClient(endpoints, timeout_s=120.0, tls=tls,
+                            standby_keys=standby_keys)
     reg_deadline = time.monotonic() + 120.0
     while True:
         reply = client.request("register", addr=wallet.address,
@@ -398,7 +400,7 @@ def run_federated_processes(
                 args=(list(endpoints), master_seed + struct.pack("<q", i),
                       model_factory, factory_kw,
                       np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
-                      rounds, crash_at.get(i), tls_dir),
+                      rounds, crash_at.get(i), tls_dir, standby_keys),
                 daemon=True)
             p.start()
             clients.append(p)
@@ -559,13 +561,13 @@ def attest_score_row(client, wallet, model, template, cfg,
         deltas.append(restore_pytree(
             template, unpack_pytree(bytes.fromhex(br["blob"]))))
     stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *deltas)
-    # reproduce the staging pad exactly (client/staging.py cyc): our shard
-    # cycled to the fleet-wide max size
-    reps = -(-s_pad // len(x_np))
-    xp = np.concatenate([x_np] * reps)[:s_pad]
-    xp = (xp.astype(np.int32) if np.issubdtype(xp.dtype, np.integer)
-          else xp.astype(np.float32))
-    yp = np.concatenate([y_np] * reps)[:s_pad]
+    # reproduce the staging pad exactly via the SAME helpers the staging
+    # plane uses (client/staging.cyc_pad / cast_features — a hand-rolled
+    # copy here could silently drift and misread honest rounds as
+    # tampering)
+    from bflc_demo_tpu.client.staging import cast_features, cyc_pad
+    xp = cast_features(cyc_pad(x_np, s_pad))
+    yp = cyc_pad(y_np, s_pad)
     mine = np.asarray(score_candidates(
         model.apply, gparams, stacked, cfg.learning_rate,
         jnp.asarray(xp), jnp.asarray(one_hot(yp, model.num_classes))))

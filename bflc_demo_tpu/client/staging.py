@@ -43,16 +43,28 @@ def stage_padded_arrays(shard_xs: Sequence[np.ndarray],
                          f"at least one sample")
     sizes = np.asarray([len(sx) for sx in shard_xs], np.int64)
     s_pad = int(sizes.max())
-
-    def cyc(a: np.ndarray) -> np.ndarray:
-        reps = -(-s_pad // len(a))
-        return np.concatenate([np.asarray(a)] * reps)[:s_pad]
-
-    xs = np.stack([cyc(sx) for sx in shard_xs])
-    xs = (xs.astype(np.int32) if np.issubdtype(xs.dtype, np.integer)
-          else xs.astype(np.float32))
-    ys = np.stack([one_hot(cyc(sy), num_classes) for sy in shard_ys])
+    xs = np.stack([cyc_pad(sx, s_pad) for sx in shard_xs])
+    xs = cast_features(xs)
+    ys = np.stack([one_hot(cyc_pad(sy, s_pad), num_classes)
+                   for sy in shard_ys])
     return xs, ys, sizes
+
+
+def cyc_pad(a: np.ndarray, s_pad: int) -> np.ndarray:
+    """Cyclically repeat `a` along axis 0 to exactly s_pad rows — THE
+    padding rule of the staging plane.  Committee members re-pad their own
+    shard with this same function when attesting score rows
+    (client/process_runtime.attest_score_row), so the device's padded
+    evaluation and the member's local recomputation cannot drift."""
+    reps = -(-s_pad // len(a))
+    return np.concatenate([np.asarray(a)] * reps)[:s_pad]
+
+
+def cast_features(xs: np.ndarray) -> np.ndarray:
+    """Feature dtype rule shared by staging and attestation: integer
+    features (token ids) stay int32; everything else float32."""
+    return (xs.astype(np.int32) if np.issubdtype(xs.dtype, np.integer)
+            else xs.astype(np.float32))
 
 
 def largest_divisor_device_count(n_slots: int) -> int:

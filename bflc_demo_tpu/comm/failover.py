@@ -92,7 +92,8 @@ class FailoverClient:
     """
 
     def __init__(self, endpoints: List[Endpoint], timeout_s: float = 30.0,
-                 max_cycles: int = 6, tls=None):
+                 max_cycles: int = 6, tls=None,
+                 standby_keys: Optional[Dict[int, bytes]] = None):
         if not endpoints:
             raise ValueError("need at least one endpoint")
         self._eps = list(endpoints)
@@ -101,6 +102,12 @@ class FailoverClient:
         self._tls = tls
         self._cur = 0
         self._client: Optional[CoordinatorClient] = None
+        # provisioned standby pubkeys: with these the client VERIFIES the
+        # Ed25519 signature on promotion evidence before moving its fence
+        # (a forged {gen, gen_ev} dict from a hostile endpoint must not
+        # poison us into rejecting the legitimate writer); without them
+        # only the structural check applies (wallet-less deployments)
+        self._standby_keys = dict(standby_keys or {})
         # highest writer generation observed in any reply; sent back as the
         # `fence` on every request — with the promoted writer's SIGNED
         # promotion evidence (`gen_ev`) when we hold it, so a
@@ -141,15 +148,21 @@ class FailoverClient:
                     except (TypeError, ValueError):
                         ev = None      # malformed evidence from a broken
                                        # or hostile peer: ignore, don't die
+                if isinstance(ev, dict) and self._standby_keys:
+                    from bflc_demo_tpu.comm.ledger_service import \
+                        verify_promotion_signature
+                    if not verify_promotion_signature(ev,
+                                                      self._standby_keys):
+                        ev = None      # forged/unsigned: never moves us
                 # Raise our fence only on a reply that CARRIES the signed
                 # promotion evidence for that generation.  A bare integer
                 # must not poison the client (round-5 review: one hostile
                 # reply with gen=999 would otherwise make us reject the
-                # legitimate writer forever).  We can't fully verify the
-                # evidence (no chain), but requiring its presence +
-                # structural match means only a party holding a plausible
-                # promotion record moves our fence — and the old writer
-                # verifies it cryptographically before demoting.
+                # legitimate writer forever).  With provisioned standby
+                # keys the signature is VERIFIED above; without them the
+                # structural match is the (documented, weaker) bar — and
+                # the old writer always verifies cryptographically before
+                # demoting.
                 if isinstance(g, int) and g > self.gen \
                         and isinstance(ev, dict) and ev_gen == g:
                     self.gen = g
@@ -326,16 +339,24 @@ class Standby:
             sub_msg = {"method": "subscribe",
                        "from": self.ledger.log_size()}
             if self.wallet is not None:
-                # prove the standby identity so this subscription's acks
-                # count toward the writer's durability quorum
+                sub_msg["sb"] = self.index
+            send_msg(sub.sock, sub_msg)
+            if self.wallet is not None:
+                # challenge-response: prove the standby identity so this
+                # subscription's acks count toward the writer's durability
+                # quorum (the nonce makes captured handshakes unreplayable)
                 import struct as _struct
                 from bflc_demo_tpu.comm.ledger_service import \
                     LedgerServer as _LS
-                sub_msg["sb"] = self.index
-                sub_msg["tag"] = self.wallet.sign(
-                    _LS._SUB_MAGIC + _struct.pack(
-                        "<Iq", self.index, sub_msg["from"])).hex()
-            send_msg(sub.sock, sub_msg)
+                sub.sock.settimeout(10.0)      # handshake, not heartbeat
+                ch = recv_msg(sub.sock)
+                sub.sock.settimeout(self.heartbeat_s)
+                if not isinstance(ch, dict) or "challenge" not in ch:
+                    raise WriterDead("subscriber handshake: no challenge")
+                sig = self.wallet.sign(
+                    _LS._SUB_MAGIC + bytes.fromhex(ch["challenge"])
+                    + _struct.pack("<Iq", self.index, sub_msg["from"]))
+                send_msg(sub.sock, {"tag": sig.hex()})
             ctl = CoordinatorClient(host, port, timeout_s=10.0,
                                     tls=self.tls_client)
             # fence check: never follow a writer whose generation is behind

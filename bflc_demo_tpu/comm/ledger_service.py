@@ -105,6 +105,32 @@ def make_promotion_evidence(ledger, wallet, standby_index: int) -> dict:
             "sb": standby_index, "sig": sig.hex()}
 
 
+def verify_promotion_signature(ev, standby_keys) -> bool:
+    """Signature-only check of promotion evidence — what a CLIENT can
+    verify without holding the chain.  True iff the evidence parses and
+    its Ed25519 signature is by the provisioned standby it names.  (The
+    chain-prefix binding is the WRITER's additional check,
+    `verify_promotion_evidence`.)"""
+    try:
+        gen, ix, sb = int(ev["gen"]), int(ev["ix"]), int(ev["sb"])
+        prev = bytes.fromhex(ev["prev"])
+        sig = bytes.fromhex(ev["sig"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    pub = (standby_keys or {}).get(sb)
+    if pub is None:
+        return False
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+        Ed25519PublicKey
+    try:
+        Ed25519PublicKey.from_public_bytes(pub).verify(
+            sig, _promotion_evidence_bytes(gen, ix, prev, sb))
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
 def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
     """True iff `ev` proves a promotion PAST `ledger`'s generation on a
     chain sharing this ledger's prefix, signed by a provisioned standby.
@@ -117,26 +143,12 @@ def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
       so evidence from a different deployment (or a fabricated chain)
       cannot fence this writer.
     """
-    try:
-        gen, ix, sb = int(ev["gen"]), int(ev["ix"]), int(ev["sb"])
-        prev = bytes.fromhex(ev["prev"])
-        sig = bytes.fromhex(ev["sig"])
-    except (KeyError, TypeError, ValueError):
+    if not verify_promotion_signature(ev, standby_keys):
         return False
+    gen, ix = int(ev["gen"]), int(ev["ix"])
     if gen <= ledger.generation or not 0 <= ix <= ledger.log_size():
         return False
-    pub = (standby_keys or {}).get(sb)
-    if pub is None:
-        return False
-    from cryptography.exceptions import InvalidSignature
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
-        Ed25519PublicKey
-    try:
-        Ed25519PublicKey.from_public_bytes(pub).verify(
-            sig, _promotion_evidence_bytes(gen, ix, prev, sb))
-    except (InvalidSignature, ValueError):
-        return False
-    return chain_head_at(ledger, ix) == prev
+    return chain_head_at(ledger, ix) == bytes.fromhex(ev["prev"])
 
 
 def _aggregate_flat(global_flat: Dict[str, np.ndarray],
@@ -341,8 +353,11 @@ class LedgerServer:
                     return
                 method = msg.get("method", "")
                 if method == "subscribe":
-                    self._stream_ops(conn, int(msg.get("from", 0)),
-                                     self._verify_subscriber(msg))
+                    start = int(msg.get("from", 0))
+                    eligible = ("sb" in msg and
+                                self._subscriber_handshake(conn, msg,
+                                                           start))
+                    self._stream_ops(conn, start, eligible)
                     return
                 try:
                     fence = int(msg.get("fence", -1))
@@ -422,6 +437,10 @@ class LedgerServer:
         """
         sub_id = object()
         with self._cv:
+            # clamp the claimed start to the real log: a subscriber that
+            # "starts" at 10**18 must not become able to ack (and fake
+            # durability for) ops it was never sent
+            start = max(0, min(start, self.ledger.log_size()))
             self._sub_acked[sub_id] = -1
             self._sub_sent[sub_id] = start - 1
             self._sub_eligible[sub_id] = quorum_eligible
@@ -438,11 +457,15 @@ class LedgerServer:
                     if not ops:
                         self._cv.wait(timeout=0.5)
                         continue
+                    # advance the sent watermark BEFORE the (lock-free)
+                    # send: a follower acks each op exactly once, and an
+                    # ack racing the post-batch update would be clamped
+                    # down and lost forever (spurious REPLICATION_TIMEOUT
+                    # for an op that really replicated)
+                    self._sub_sent[sub_id] = next_i + len(ops) - 1
                 for i, op in enumerate(ops):
                     send_msg(conn, {"i": next_i + i, "op": op.hex()})
                 next_i += len(ops)
-                with self._cv:
-                    self._sub_sent[sub_id] = next_i - 1
         finally:
             with self._cv:
                 self._sub_acked.pop(sub_id, None)
@@ -502,25 +525,45 @@ class LedgerServer:
 
     _SUB_MAGIC = b"BFLCSUB1"
 
-    def _verify_subscriber(self, msg: dict) -> bool:
-        """True iff the subscribe message proves a provisioned standby
-        identity: Ed25519 over (magic, standby index, start offset).
-        Only such subscribers' acks count toward the durability quorum."""
+    def _subscriber_handshake(self, conn: socket.socket, msg: dict,
+                              start: int) -> bool:
+        """Challenge-response proof of a provisioned standby identity.
+
+        The server issues a fresh random challenge; the subscriber signs
+        (magic || challenge || standby index || start) with its Ed25519
+        key.  Only such subscribers' acks count toward the durability
+        quorum.  A fixed signed subscribe message would be replayable on a
+        plaintext link (round-5 review) — the per-connection nonce makes
+        every captured handshake worthless.  On any failure the peer still
+        streams, just without quorum eligibility.
+        """
+        import os as _os
         try:
             sb = int(msg.get("sb", -1))
-            start = int(msg.get("from", 0))
-            sig = bytes.fromhex(msg.get("tag", ""))
         except (TypeError, ValueError):
             return False
         pub = self._standby_keys.get(sb)
-        if pub is None or not sig:
+        challenge = _os.urandom(16)
+        try:
+            send_msg(conn, {"challenge": challenge.hex()})
+            conn.settimeout(10.0)
+            reply = recv_msg(conn)
+            conn.settimeout(None)
+        except (WireError, OSError):
+            return False
+        if pub is None or not isinstance(reply, dict):
+            return False
+        try:
+            sig = bytes.fromhex(reply.get("tag", ""))
+        except (TypeError, ValueError):
             return False
         from cryptography.exceptions import InvalidSignature
         from cryptography.hazmat.primitives.asymmetric.ed25519 import \
             Ed25519PublicKey
         try:
             Ed25519PublicKey.from_public_bytes(pub).verify(
-                sig, self._SUB_MAGIC + struct.pack("<Iq", sb, start))
+                sig, self._SUB_MAGIC + challenge
+                + struct.pack("<Iq", sb, start))
             return True
         except (InvalidSignature, ValueError):
             return False

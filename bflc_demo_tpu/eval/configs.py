@@ -39,6 +39,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      process_factory: str = "",
                      factory_kw: Optional[dict] = None,
                      standbys: int = 0, tls_dir: str = "",
+                     quorum: int = 0,
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -75,7 +76,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         return run_federated_processes(
             process_factory, shards, test_set, cfg, rounds=rounds,
             factory_kw=factory_kw or {}, standbys=standbys,
-            tls_dir=tls_dir, verbose=verbose)
+            tls_dir=tls_dir, quorum=quorum, verbose=verbose)
     raise ValueError(f"runtime must be mesh|host|threaded|processes, "
                      f"got {runtime!r}")
 

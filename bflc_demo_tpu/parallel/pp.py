@@ -24,6 +24,23 @@ Both schedules live under shard_map: stage s owns depth/pp consecutive
 blocks (stacked block params sharded over "pp"), activations flow
 stage-to-stage with `lax.ppermute` (cotangents ride the reverse
 permutation), stage 0 embeds, the last stage pools/classifies.
+
+Why there is NO interleaved-virtual-stage (Megatron bubble/v) schedule
+here — a deliberate design decision, not a gap: interleaving pays off in
+eager/async pipelines where a warmup/drain slot is truly idle hardware,
+so splitting each device into v chunks converts idle slots into work.
+Under XLA the whole schedule is ONE compiled program of masked grid
+steps: an "idle" slot still executes its masked arithmetic, so the real
+overhead is the invalid-slot fraction — steps/(useful steps).  The
+non-interleaved 1F1B grid runs M + 2(p-1) steps of one fwd + one bwd
+unit per device; an interleaved masked grid over v*p virtual stages runs
+M + 2(v*p - 1) steps of v fwd + v bwd units per device — strictly MORE
+wasted masked compute, not less, for every v > 1.  The lever that
+matters at fixed HBM in this formulation is the one 1F1B already
+provides (live-activation window 2p-1, independent of M: raise M to
+shrink the invalid fraction), plus XLA's own DMA/compute overlap of the
+ppermute chain.  `schedule_stats` / `bubble_at_memory_budget` model
+exactly this accounting.
 """
 
 from __future__ import annotations

@@ -36,6 +36,7 @@ class RunOptions:
     plot_path: str = ""              # write a run-evidence PNG here
     standbys: int = 0                # processes runtime: hot standbys
     tls_dir: str = ""                # processes runtime: TLS cert dir
+    quorum: int = 0                  # processes runtime: quorum-ack
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 
