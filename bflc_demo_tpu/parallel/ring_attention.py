@@ -158,26 +158,23 @@ def _ring_pallas_vjp_bwd(axis_name, interpret, residuals, g):
 _ring_attention_pallas.defvjp(_ring_pallas_vjp_fwd, _ring_pallas_vjp_bwd)
 
 
-def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
-                                ) -> Callable[[Pytree, jax.Array], jax.Array]:
-    """Sequence-parallel classifier forward over the mesh's 'sp' axis.
-
-    tokens: (B, S) with S divisible by the sp axis size; params replicated.
-    Per-token work (embed/LN/MLP) runs on local sequence shards; attention is
-    the ring; the padding-aware mean-pool becomes a masked psum.
-    """
+def _sp_local_forward(mesh: Mesh, cfg: TransformerConfig):
+    """(n_sp, shard_forward) — the ONE definition of the per-shard sp
+    forward both the inference and training factories build on, so the
+    wiring (seq validation, ring impl selection, pos offset, pooled psum)
+    cannot drift between them."""
     n_sp = mesh.shape[SP_AXIS]
     if cfg.seq_len % n_sp:
         raise ValueError(f"seq_len {cfg.seq_len} not divisible by sp axis "
                          f"{n_sp}")
     s_blk = cfg.seq_len // n_sp
-
     # the transformer's attention_impl selects the ring's inner step too:
     # einsum (default) or the streaming-carry flash kernel per hop
-    ring_impl = {"einsum": "einsum", "pallas": "pallas",
-                 "pallas_interpret": "pallas_interpret"}[cfg.attention_impl]
+    if cfg.attention_impl not in ("einsum", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+    ring_impl = cfg.attention_impl
 
-    def body(params, tokens_blk):
+    def shard_forward(params, tokens_blk):
         my = jax.lax.axis_index(SP_AXIS)
 
         def attn_fn(q, k, v, kv_mask):
@@ -188,7 +185,77 @@ def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
                                    pos_offset=my * s_blk,
                                    pool_psum_axis=SP_AXIS)
 
-    fn = shard_map(body, mesh=mesh,
+    return n_sp, shard_forward
+
+
+def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
+                                ) -> Callable[[Pytree, jax.Array], jax.Array]:
+    """Sequence-parallel classifier forward over the mesh's 'sp' axis.
+
+    tokens: (B, S) with S divisible by the sp axis size; params replicated.
+    Per-token work (embed/LN/MLP) runs on local sequence shards; attention is
+    the ring; the padding-aware mean-pool becomes a masked psum.
+    """
+    _, shard_forward = _sp_local_forward(mesh, cfg)
+    fn = shard_map(shard_forward, mesh=mesh,
                    in_specs=(P(), P(None, SP_AXIS)),
                    out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+def make_sp_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float,
+                       ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                     "tuple[Pytree, jax.Array]"]:
+    """One SGD step of the sequence-parallel transformer — long-context
+    TRAINING, not just inference: gradients flow backward through the
+    ring (autodiff of the ppermute/fori_loop einsum ring, or the flash
+    ring's custom vjp when cfg.attention_impl selects pallas).
+
+    step(params, tokens (B, S), labels_onehot (B, C))
+        -> (new_params, loss)   with S divisible by the sp axis.
+
+    Gradient assembly — the replicated-vs-sharded split that makes the
+    result EQUAL to the single-device gradient (tested against a
+    RANDOMIZED head; the default zero-init head makes every body
+    gradient zero and any equivalence check vacuous):
+    - every device differentiates its LOCAL program (its sequence shard
+      through embed/pos/blocks/ln_f, then the psum'd pool and the
+      replicated head);
+    - head_w/head_b act AFTER the psum'd pool on a replicated value, so
+      every device already holds exactly the full gradient — pass
+      through unchanged;
+    - body leaves (embed, pos, blocks, ln_f) sit BEHIND the pooling
+      psum.  Under `check_vma=False` shard_map AD cannot assume the
+      pool's cotangent is replicated, so psum transposes to psum and
+      every device's body cotangent arrives n_sp x its true value (each
+      raw per-device grad ~= n_sp x that shard's contribution).  The
+      correct total is therefore psum(grad) / n_sp — measured, not
+      assumed: the equivalence test pins it against the single-device
+      gradient leaf by leaf.
+    """
+    n_sp, shard_forward = _sp_local_forward(mesh, cfg)
+
+    def body(params, tokens_blk, labels):
+        def loss_fn(p):
+            logits = shard_forward(p, tokens_blk)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        replicated = ("head_w", "head_b")
+        inv = 1.0 / n_sp
+        new_params = {}
+        for name, leaf in params.items():
+            g = grads[name]
+            if name not in replicated:
+                g = jax.tree_util.tree_map(
+                    lambda t: jax.lax.psum(t, SP_AXIS) * inv, g)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda w, d: w - jnp.asarray(lr, w.dtype)
+                * d.astype(w.dtype), leaf, g)
+        return new_params, loss
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P(None, SP_AXIS), P()),
+                   out_specs=(P(), P()), check_vma=False)
     return jax.jit(fn)
