@@ -192,7 +192,11 @@ def transformer_forward(params: Pytree, tokens: jax.Array,
     num = (x * pad[..., None]).sum(1)
     den = pad.sum(-1, keepdims=True)
     if pool_psum_axis is not None:
-        num = jax.lax.psum(num, pool_psum_axis)
+        # psum_exact: correct backward under check_vma=False shard_map
+        # (plain psum's transpose would inflate every body cotangent by
+        # the axis size — ops/collectives.py); den is integer, no grad
+        from bflc_demo_tpu.ops.collectives import psum_exact
+        num = psum_exact(num, pool_psum_axis)
         den = jax.lax.psum(den, pool_psum_axis)
     pooled = num / jnp.maximum(den, 1).astype(jnp.float32)
     return pooled @ params["head_w"] + params["head_b"]

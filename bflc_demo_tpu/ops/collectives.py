@@ -1,0 +1,68 @@
+"""Collectives with exact transposes for replicated cotangents.
+
+Why this exists: under `shard_map(..., check_vma=False)` (which the ring
+and pipeline schedules require — the vma checker cannot infer replication
+through `lax.fori_loop` / `dynamic_update_slice`), JAX cannot know that a
+psum's output cotangent is replicated, so it transposes psum to psum: the
+backward multiplies every upstream cotangent by the axis size.  A single
+terminal psum (the sp pool) can be repaired with one scalar division, but
+COMPOSED parallelism (sp x tp: a psum("tp") inside every sublayer, on the
+branch of a residual add) inflates branch and skip cotangents
+differently — no per-leaf scalar fixes that.
+
+`psum_exact` is a psum whose backward is the mathematically exact
+transpose FOR THE REPLICATED-COTANGENT CASE: out = sum_i x_i is consumed
+identically on every device, so dL/dx_i = ct for each contributor — the
+cotangent passes through unchanged.  PRECONDITION (the caller's
+obligation, true everywhere this framework uses it): everything
+downstream of the psum computes identically on all devices of that axis,
+i.e. the output cotangent really is replicated.  Using it where the
+cotangent is device-varying would silently drop cross-device terms.
+
+`fanout_exact` is its dual (Megatron's f to psum_exact's g): identity in
+the forward, psum in the backward.  Use it where a REPLICATED activation
+fans out into per-device-sliced branches (e.g. the layer-norm output
+feeding column-parallel QKV/MLP weights): each device's backward
+produces only its own slice's cotangent contribution, and the true
+cotangent of the replicated input is the SUM of all slices' terms —
+without the psum-on-backward, every leaf upstream of the branch loses
+the cross-slice gradient terms entirely.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_exact(x: jax.Array, axis_name: str) -> jax.Array:
+    return jax.lax.psum(x, axis_name)
+
+
+def _fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _bwd(axis_name, _, ct):
+    return (ct,)
+
+
+psum_exact.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def fanout_exact(x: jax.Array, axis_name: str) -> jax.Array:
+    return x
+
+
+def _fan_fwd(x, axis_name):
+    return x, None
+
+
+def _fan_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+fanout_exact.defvjp(_fan_fwd, _fan_bwd)

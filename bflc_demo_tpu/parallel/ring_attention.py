@@ -203,6 +203,38 @@ def make_sp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
     return jax.jit(fn)
 
 
+def sp_sgd_update(shard_forward, params: Pytree, tokens_blk: jax.Array,
+                  labels: jax.Array, lr: float,
+                  replicated=("head_w", "head_b")):
+    """The ONE sequence-parallel gradient-assembly + SGD body, shared by
+    the sp and sp x tp train steps (inside shard_map).
+
+    shard_forward(params, tokens_blk) must build its collectives from
+    psum_exact/fanout_exact (ops/collectives.py) so per-device cotangents
+    are TRUE values.  Then: `replicated` leaves (the classifier head,
+    acting after the sp-pooled replicated value) already hold the full
+    gradient on every device; every other leaf gets only its own
+    sequence shard's contribution and one psum over 'sp' assembles the
+    total — without touching any tp sharding the leaves may carry.
+    """
+    def loss_fn(p):
+        logits = shard_forward(p, tokens_blk)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new_params = {}
+    for name, leaf in params.items():
+        g = grads[name]
+        if name not in replicated:
+            g = jax.tree_util.tree_map(
+                lambda t: jax.lax.psum(t, SP_AXIS), g)
+        new_params[name] = jax.tree_util.tree_map(
+            lambda w, d: w - jnp.asarray(lr, w.dtype) * d.astype(w.dtype),
+            leaf, g)
+    return new_params, loss
+
+
 def make_sp_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      "tuple[Pytree, jax.Array]"]:
@@ -225,35 +257,19 @@ def make_sp_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float,
       every device already holds exactly the full gradient — pass
       through unchanged;
     - body leaves (embed, pos, blocks, ln_f) sit BEHIND the pooling
-      psum.  Under `check_vma=False` shard_map AD cannot assume the
-      pool's cotangent is replicated, so psum transposes to psum and
-      every device's body cotangent arrives n_sp x its true value (each
-      raw per-device grad ~= n_sp x that shard's contribution).  The
-      correct total is therefore psum(grad) / n_sp — measured, not
-      assumed: the equivalence test pins it against the single-device
-      gradient leaf by leaf.
+      psum.  The pool uses `psum_exact` (ops/collectives.py), whose
+      backward is the exact transpose for replicated cotangents — under
+      `check_vma=False` a plain psum would transpose to psum and inflate
+      every body cotangent by n_sp.  Each device's grad is then exactly
+      its shard's contribution; one plain psum over 'sp' assembles the
+      total.  The equivalence test pins this against the single-device
+      gradient leaf by leaf (with a RANDOMIZED head — the zero-init head
+      makes body grads zero and the check vacuous).
     """
     n_sp, shard_forward = _sp_local_forward(mesh, cfg)
 
     def body(params, tokens_blk, labels):
-        def loss_fn(p):
-            logits = shard_forward(p, tokens_blk)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            return -jnp.mean(jnp.sum(labels * logp, axis=-1))
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        replicated = ("head_w", "head_b")
-        inv = 1.0 / n_sp
-        new_params = {}
-        for name, leaf in params.items():
-            g = grads[name]
-            if name not in replicated:
-                g = jax.tree_util.tree_map(
-                    lambda t: jax.lax.psum(t, SP_AXIS) * inv, g)
-            new_params[name] = jax.tree_util.tree_map(
-                lambda w, d: w - jnp.asarray(lr, w.dtype)
-                * d.astype(w.dtype), leaf, g)
-        return new_params, loss
+        return sp_sgd_update(shard_forward, params, tokens_blk, labels, lr)
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(P(), P(None, SP_AXIS), P()),

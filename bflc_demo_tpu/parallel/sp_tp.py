@@ -39,7 +39,9 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bflc_demo_tpu.models.transformer import TransformerConfig, layer_norm
-from bflc_demo_tpu.parallel.ring_attention import ring_attention, SP_AXIS
+from bflc_demo_tpu.ops.collectives import fanout_exact, psum_exact
+from bflc_demo_tpu.parallel.ring_attention import (SP_AXIS, ring_attention,
+                                                   sp_sgd_update)
 from bflc_demo_tpu.parallel.tp import transformer_partition_specs
 
 Pytree = Any
@@ -57,35 +59,37 @@ def _tp_block(x: jax.Array, pad: jax.Array, bp: Pytree,
     h_loc, dh = cfg.heads // n_tp, cfg.head_dim
     dt = cfg.dtype
     y = layer_norm(x, bp["ln1"], dt)
+    # fanout_exact (Megatron's f): the replicated normed activation feeds
+    # PER-DEVICE head slices; its true cotangent is the sum of every
+    # slice's term, which the backward psum restores — without it, all
+    # leaves upstream of this branch lose the cross-slice gradients
+    y = fanout_exact(y, TP_AXIS)
     q = (y @ bp["wq"].astype(dt)).reshape(b, s, h_loc, dh)
     k = (y @ bp["wk"].astype(dt)).reshape(b, s, h_loc, dh)
     v = (y @ bp["wv"].astype(dt)).reshape(b, s, h_loc, dh)
-    ring_impl = {"einsum": "einsum", "pallas": "pallas",
-                 "pallas_interpret": "pallas_interpret"}[cfg.attention_impl]
-    o = ring_attention(q, k, v, pad, SP_AXIS, impl=ring_impl)
-    x = x + jax.lax.psum(o.reshape(b, s, h_loc * dh) @ bp["wo"].astype(dt),
-                         TP_AXIS)
+    o = ring_attention(q, k, v, pad, SP_AXIS, impl=cfg.attention_impl)
+    # psum_exact: identical forward to lax.psum, exact backward for the
+    # replicated cotangent this residual stream carries — plain psum's
+    # check_vma=False transpose would inflate the BRANCH cotangent by
+    # n_tp at every sublayer while the skip path stays unscaled, which no
+    # per-leaf normalisation can repair (ops/collectives.py)
+    x = x + psum_exact(o.reshape(b, s, h_loc * dh) @ bp["wo"].astype(dt),
+                       TP_AXIS)
     y = layer_norm(x, bp["ln2"], dt)
+    y = fanout_exact(y, TP_AXIS)           # f before the sliced MLP
     y = jax.nn.gelu(y @ bp["w1"].astype(dt) + bp["b1"].astype(dt))
-    return x + (jax.lax.psum(y @ bp["w2"].astype(dt), TP_AXIS)
+    return x + (psum_exact(y @ bp["w2"].astype(dt), TP_AXIS)
                 + bp["b2"].astype(dt))
 
 
-def make_sp_tp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
-                                   ) -> Callable[[Pytree, jax.Array],
-                                                 jax.Array]:
-    """Classifier forward with sequence sharded over "sp" and weights over
-    "tp".  tokens: (B, S); params in the init_transformer_params layout
-    (dense blocks — MoE routes its experts over "ep" instead, parallel/ep.py).
-
-    Params may arrive replicated or already tp-sharded: the in_specs are the
-    same transformer_partition_specs the GSPMD path uses, so jit reshards
-    as needed and a checkpointed model drops in unchanged.
-    """
+def _sp_tp_shard_forward(mesh: Mesh, cfg: TransformerConfig):
+    """The ONE per-device sp x tp forward both factories build on."""
     n_sp, n_tp = mesh.shape[SP_AXIS], mesh.shape[TP_AXIS]
     if cfg.moe_experts:
         raise ValueError("sp x tp composes the dense transformer; shard MoE "
                          "experts over 'ep' (parallel/ep.py) instead")
+    if cfg.attention_impl not in ("einsum", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
     for name, val, div in (("seq_len", cfg.seq_len, n_sp),
                            ("heads", cfg.heads, n_tp),
                            ("vocab_size", cfg.vocab_size, n_tp),
@@ -107,20 +111,71 @@ def make_sp_tp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
             mine[..., None],
             params["embed"].astype(dt)[jnp.clip(loc, 0, v_blk - 1)],
             jnp.zeros((), dt))
-        x = jax.lax.psum(x, TP_AXIS)
+        x = psum_exact(x, TP_AXIS)
         x = x + jax.lax.dynamic_slice_in_dim(
             params["pos"].astype(dt), my_sp * s_blk, s_blk, axis=0)[None]
         for bp in params["blocks"]:
             x = _tp_block(x, pad, bp, cfg, n_tp)
         x = layer_norm(x, params["ln_f"], jnp.float32)
-        num = jax.lax.psum((x * pad[..., None]).sum(1), SP_AXIS)
+        num = psum_exact((x * pad[..., None]).sum(1), SP_AXIS)
         den = jax.lax.psum(pad.sum(-1, keepdims=True), SP_AXIS)
         pooled = num / jnp.maximum(den, 1).astype(jnp.float32)
         return pooled @ params["head_w"] + params["head_b"]
 
     param_specs = transformer_partition_specs(
         {"blocks": (None,) * cfg.depth}, TP_AXIS)
+    return body, param_specs
+
+
+def make_sp_tp_transformer_forward(mesh: Mesh, cfg: TransformerConfig,
+                                   ) -> Callable[[Pytree, jax.Array],
+                                                 jax.Array]:
+    """Classifier forward with sequence sharded over "sp" and weights over
+    "tp".  tokens: (B, S); params in the init_transformer_params layout
+    (dense blocks — MoE routes its experts over "ep" instead, parallel/ep.py).
+
+    Params may arrive replicated or already tp-sharded: the in_specs are the
+    same transformer_partition_specs the GSPMD path uses, so jit reshards
+    as needed and a checkpointed model drops in unchanged.
+    """
+    body, param_specs = _sp_tp_shard_forward(mesh, cfg)
     fn = shard_map(body, mesh=mesh,
                    in_specs=(param_specs, P(None, SP_AXIS)),
                    out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+def make_sp_tp_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float,
+                          ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                        "tuple[Pytree, jax.Array]"]:
+    """One SGD step of the composed sp x tp transformer: long-context
+    TRAINING where gradients flow backward through BOTH the KV ring
+    (ppermute transpose) and the per-sublayer tensor-parallel reductions.
+
+    step(params, tokens (B, S), labels_onehot (B, C)) -> (new, loss),
+    with params replicated or tp-sharded (transformer_partition_specs).
+
+    Every collective in the forward is `psum_exact`, so per-device
+    cotangents are TRUE values (see ops/collectives.py — plain psum's
+    check_vma=False transpose inflates branch-vs-skip cotangents
+    differently at every sublayer, which no per-leaf scalar repairs).
+    Gradient assembly is then uniform:
+    - head_w/head_b act after the sp-pooled replicated value: every
+      device already holds the full gradient — pass through;
+    - every other leaf gets contributions only from the device's OWN
+      sequence shard (tp-sharded leaves: for its own head/feature slice;
+      replicated leaves: identical across tp) — one psum over 'sp'
+      assembles the total without touching the tp layout.
+    Equivalence against the single-device step (randomized head — the
+    zero-init head would make the check vacuous) is the test.
+    """
+    body, param_specs = _sp_tp_shard_forward(mesh, cfg)
+
+    def train_body(params, tokens_blk, labels):
+        # the ONE shared sp gradient-assembly/SGD body (ring_attention)
+        return sp_sgd_update(body, params, tokens_blk, labels, lr)
+
+    fn = shard_map(train_body, mesh=mesh,
+                   in_specs=(param_specs, P(None, SP_AXIS), P()),
+                   out_specs=(param_specs, P()), check_vma=False)
     return jax.jit(fn)
