@@ -235,6 +235,41 @@ def sp_sgd_update(shard_forward, params: Pytree, tokens_blk: jax.Array,
     return new_params, loss
 
 
+def make_dp_sp_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float,
+                          dp_axis: str = "dp",
+                          ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                        "tuple[Pytree, jax.Array]"]:
+    """SGD over a ("dp", "sp") mesh: batches shard over dp, sequences over
+    sp — long sequences AND large batches in one program.
+
+    step(params, tokens (B, S), labels_onehot (B, C)) -> (new, loss) with
+    B divisible by the dp axis and S by the sp axis; params replicated.
+
+    Per (dp-row, sp-shard) device: the sp gradient assembly of
+    `sp_sgd_update` (psum over sp, head pass-through) yields that dp
+    row's full gradient for its batch slice; the dp dimension then
+    averages — a pmean over dp for every leaf (the global loss is the
+    mean over the global batch = mean over rows of per-row means for
+    equal slices), and the reported loss pmeans identically.
+    """
+    n_sp, shard_forward = _sp_local_forward(mesh, cfg)
+
+    def body(params, tokens_blk, labels_blk):
+        new_params, loss = sp_sgd_update(shard_forward, params, tokens_blk,
+                                         labels_blk, lr)
+        # undo the per-row update, average gradients over dp, re-apply:
+        # equivalently, average the UPDATED params over dp (SGD is linear
+        # in the gradient at fixed starting params)
+        new_params = jax.tree_util.tree_map(
+            lambda t: jax.lax.pmean(t, dp_axis), new_params)
+        return new_params, jax.lax.pmean(loss, dp_axis)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P(dp_axis, SP_AXIS), P(dp_axis)),
+                   out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
 def make_sp_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      "tuple[Pytree, jax.Array]"]:
