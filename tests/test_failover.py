@@ -711,3 +711,55 @@ class TestQuorumAck:
             if standby is not None:
                 standby.stop()
             srv.close()
+
+    def test_quorum_two_standbys(self):
+        """Q=2: both standbys must ack before a mutation acknowledges —
+        and once both follow, mutations go through."""
+        from bflc_demo_tpu.comm.identity import Wallet
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        w1, w2 = Wallet.from_seed(b"q2-sb-1"), Wallet.from_seed(b"q2-sb-2")
+        keys = {1: w1.public_bytes, 2: w2.public_bytes}
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           quorum=2, quorum_timeout_s=1.0,
+                           standby_keys=keys)
+        srv.start()
+        eps = [(srv.host, srv.port), ("127.0.0.1", 0), ("127.0.0.1", 0)]
+        sbs = []
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=15.0)
+        try:
+            sb1 = Standby(CFG, list(eps), 1, heartbeat_s=0.3,
+                          stall_timeout_s=60.0, require_auth=False,
+                          ledger_backend="python", wallet=w1,
+                          standby_keys=keys)
+            sbs.append(sb1)
+            threading.Thread(target=sb1.run, daemon=True).start()
+            deadline = time.monotonic() + 10
+            while not srv._sub_acked:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            # only ONE eligible follower: Q=2 not met
+            r = c.request("register", addr="0x" + "bb" * 20)
+            assert r["status"] == "REPLICATION_TIMEOUT", r
+            sb2 = Standby(CFG, list(eps), 2, heartbeat_s=0.3,
+                          stall_timeout_s=60.0, require_auth=False,
+                          ledger_backend="python", wallet=w2,
+                          standby_keys=keys)
+            sbs.append(sb2)
+            threading.Thread(target=sb2.run, daemon=True).start()
+            deadline = time.monotonic() + 15
+            while True:
+                r2 = c.request("register", addr="0x" + "bb" * 20)
+                if r2["status"] == "ALREADY_REGISTERED":
+                    break
+                assert time.monotonic() < deadline, r2
+                time.sleep(0.3)
+            for sb in sbs:
+                while sb.ledger.num_registered < 1:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.1)
+        finally:
+            c.close()
+            for sb in sbs:
+                sb.stop()
+            srv.close()
