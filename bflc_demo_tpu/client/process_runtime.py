@@ -75,15 +75,29 @@ def _cpu_spawn_env():
                 os.environ[k] = v
 
 
+
+def _client_tls(tls_dir: str):
+    """ssl context for dialing the coordinator, or None when TLS is off —
+    the ONE construction point for client-side contexts in this module."""
+    if not tls_dir:
+        return None
+    from bflc_demo_tpu.comm.tls import client_context
+    return client_context(tls_dir)
+
+
+def _server_tls(tls_dir: str):
+    if not tls_dir:
+        return None
+    from bflc_demo_tpu.comm.tls import server_context
+    return server_context(tls_dir)
+
+
 def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  stall_timeout_s: float, wal_path: str, tls_dir: str,
                  standby_keys: dict, quorum: int, verbose: bool) -> None:
     _force_cpu_jax()
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
-    tls = None
-    if tls_dir:
-        from bflc_demo_tpu.comm.tls import server_context
-        tls = server_context(tls_dir)
+    tls = _server_tls(tls_dir)
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
                           stall_timeout_s=stall_timeout_s,
                           wal_path=wal_path, tls=tls,
@@ -132,11 +146,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     wallet = Wallet.from_seed(wallet_seed)
     xj, yj = jnp.asarray(x), jnp.asarray(y_onehot)
 
-    tls = None
-    if tls_dir:
-        from bflc_demo_tpu.comm.tls import client_context
-        tls = client_context(tls_dir)
-    client = FailoverClient(endpoints, timeout_s=120.0, tls=tls,
+    client = FailoverClient(endpoints, timeout_s=120.0,
+                            tls=_client_tls(tls_dir),
                             standby_keys=standby_keys)
     reg_deadline = time.monotonic() + 120.0
     while True:
@@ -229,10 +240,7 @@ def _replica_proc(host: str, port: int, cfg_kw: dict, until_ops: int,
                   out_q, tls_dir: str = "") -> None:
     _force_cpu_jax()
     from bflc_demo_tpu.comm.ledger_service import replicate
-    tls = None
-    if tls_dir:
-        from bflc_demo_tpu.comm.tls import client_context
-        tls = client_context(tls_dir)
+    tls = _client_tls(tls_dir)
     try:
         replica = replicate(host, port, ProtocolConfig(**cfg_kw),
                             until_ops=until_ops, timeout_s=120.0, tls=tls)
@@ -251,10 +259,7 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
     _force_cpu_jax()
     from bflc_demo_tpu.comm.failover import Standby
     from bflc_demo_tpu.comm.identity import Wallet
-    tls_c = tls_s = None
-    if tls_dir:
-        from bflc_demo_tpu.comm.tls import client_context, server_context
-        tls_c, tls_s = client_context(tls_dir), server_context(tls_dir)
+    tls_c, tls_s = _client_tls(tls_dir), _server_tls(tls_dir)
     standby = Standby(ProtocolConfig(**cfg_kw),
                       endpoints + [("127.0.0.1", 0)], index,
                       stall_timeout_s=stall_timeout_s,
@@ -409,11 +414,8 @@ def run_federated_processes(
     xte, yte = test_set
     xte_j = jnp.asarray(xte)
     yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
-    sponsor_tls = None
-    if tls_dir:
-        from bflc_demo_tpu.comm.tls import client_context
-        sponsor_tls = client_context(tls_dir)
-    sponsor = FailoverClient(endpoints, timeout_s=120.0, tls=sponsor_tls)
+    sponsor = FailoverClient(endpoints, timeout_s=120.0,
+                             tls=_client_tls(tls_dir))
     history: List[Tuple[int, float]] = []
     seen_epoch = 0              # model at epoch 0 is the uncommitted init
     writer_killed = False
@@ -506,7 +508,7 @@ def run_federated_processes(
 def _executor_proc(cfg_kw: dict, model_factory: str, factory_kw: dict,
                    rounds: int, port_q, n_virtual_devices: int,
                    stall_timeout_s: float, attest_scores: bool,
-                   verbose: bool) -> None:
+                   tls_dir: str, verbose: bool) -> None:
     """Coordinator process that OWNS the device mesh: each round is one
     SPMD program (comm.executor_service.MeshExecutorServer)."""
     if n_virtual_devices > 1:
@@ -516,10 +518,11 @@ def _executor_proc(cfg_kw: dict, model_factory: str, factory_kw: dict,
             f"{n_virtual_devices}").strip()
     _force_cpu_jax()
     from bflc_demo_tpu.comm.executor_service import MeshExecutorServer
+    tls = _server_tls(tls_dir)
     server = MeshExecutorServer(
         ProtocolConfig(**cfg_kw), model_factory, factory_kw,
         rounds=rounds, stall_timeout_s=stall_timeout_s,
-        attest_scores=attest_scores, verbose=verbose)
+        attest_scores=attest_scores, tls=tls, verbose=verbose)
     port_q.put(server.port)
     server.serve_forever()
 
@@ -591,7 +594,8 @@ def attest_score_row(client, wallet, model, template, cfg,
 def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
                       model_factory: str, factory_kw: dict,
                       x: np.ndarray, y: np.ndarray, cfg_kw: dict,
-                      rounds: int, attest_scores: bool = False) -> None:
+                      rounds: int, attest_scores: bool = False,
+                      tls_dir: str = "") -> None:
     """Thin driver for the mesh-executor deployment: register, stage the
     shard ONCE, then watch rounds progress and verify the committed model
     on the local shard each epoch."""
@@ -612,7 +616,8 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
     model = getattr(models, model_factory)(**factory_kw)
     template = model.init_params(0)
     wallet = Wallet.from_seed(wallet_seed)
-    client = CoordinatorClient(host, port, timeout_s=120.0)
+    client = CoordinatorClient(host, port, timeout_s=120.0,
+                               tls=_client_tls(tls_dir))
     r = client.request("register", addr=wallet.address,
                        pubkey=wallet.public_bytes.hex(),
                        tag=_sign(wallet, "register", 0, b""))
@@ -673,6 +678,7 @@ def run_federated_mesh_processes(
         n_virtual_devices: int = 0,
         stall_timeout_s: float = 120.0,
         attest_scores: bool = False,
+        tls_dir: str = "",
         timeout_s: float = 600.0,
         verbose: bool = False) -> ProcessFederationResult:
     """The composed deployment: OS-process clients drive rounds over the
@@ -686,12 +692,19 @@ def run_federated_mesh_processes(
     member's process re-scores the round's candidates on its own shard
     and signs its row before the ledger accepts the round
     (comm.executor_service._collect_attestations).
+    tls_dir: when set, provisions a CA + server cert there and EVERY
+    control-plane byte — registration, staging (the raw shards!), model
+    fetches, attestations, the sponsor — rides TLS with full server
+    identity verification; plaintext clients are rejected.
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
     factory_kw = factory_kw or {}
     t_start = time.monotonic()
+    if tls_dir:
+        from bflc_demo_tpu.comm.tls import provision_tls
+        provision_tls(tls_dir)
 
     import jax.numpy as jnp
 
@@ -713,7 +726,7 @@ def run_federated_mesh_processes(
             target=_executor_proc,
             args=(cfg_kw, model_factory, factory_kw, rounds, port_q,
                   n_virtual_devices, stall_timeout_s, attest_scores,
-                  verbose),
+                  tls_dir, verbose),
             daemon=True)
         server.start()
         port = port_q.get(timeout=120)
@@ -724,7 +737,8 @@ def run_federated_mesh_processes(
                 target=_thin_client_proc,
                 args=(host, port, master_seed + struct.pack("<q", i),
                       model_factory, factory_kw, np.asarray(sx),
-                      np.asarray(sy), cfg_kw, rounds, attest_scores),
+                      np.asarray(sy), cfg_kw, rounds, attest_scores,
+                      tls_dir),
                 daemon=True)
             p.start()
             clients.append(p)
@@ -733,7 +747,8 @@ def run_federated_mesh_processes(
     xte, yte = test_set
     xte_j = jnp.asarray(xte)
     yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
-    sponsor = CoordinatorClient(host, port, timeout_s=120.0)
+    sponsor = CoordinatorClient(host, port, timeout_s=120.0,
+                                tls=_client_tls(tls_dir))
     history: List[Tuple[int, float]] = []
     seen_epoch = 0
     deadline = time.monotonic() + timeout_s

@@ -201,3 +201,24 @@ class TestScoreAttestation:
         finally:
             c.close()
             srv.close()
+
+
+@pytest.mark.slow
+class TestMeshExecutorTLS:
+    def test_mesh_executor_over_tls(self, tmp_path):
+        """The composed deployment fully TLS-encrypted: staged raw shards,
+        model fetches, and attestation traffic all ride the encrypted
+        control plane (the reference's Channel-TLS property on the
+        mesh-executor shape)."""
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_mesh_processes
+        from bflc_demo_tpu.data import load_occupancy, iid_shards
+
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(xtr[:1500], ytr[:1500], CFG.client_num)
+        res = run_federated_mesh_processes(
+            "make_softmax_regression", shards, (xte[:500], yte[:500]), CFG,
+            rounds=3, n_virtual_devices=3, timeout_s=420.0,
+            attest_scores=True, tls_dir=str(tmp_path / "certs"))
+        assert res.rounds_completed >= 3
+        assert res.best_accuracy() > 0.80, res.accuracy_history
