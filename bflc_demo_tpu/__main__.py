@@ -53,9 +53,23 @@ def main(argv=None) -> int:
                       "toward the durability quorum)", file=sys.stderr)
                 return 2
             kw["quorum"] = opts.quorum
-    elif opts.standbys or opts.tls_dir or opts.quorum:
-        print("--standbys/--tls-dir/--quorum apply to --runtime processes",
-              file=sys.stderr)
+        if opts.attest_scores:
+            # never silently drop a requested trust feature
+            print("--attest-scores applies to --runtime executor",
+                  file=sys.stderr)
+            return 2
+    elif opts.runtime == "executor":
+        if opts.tls_dir:
+            kw["tls_dir"] = opts.tls_dir
+        if opts.attest_scores:
+            kw["attest_scores"] = True
+        if opts.standbys or opts.quorum:
+            print("--standbys/--quorum apply to --runtime processes",
+                  file=sys.stderr)
+            return 2
+    elif opts.standbys or opts.tls_dir or opts.quorum or opts.attest_scores:
+        print("--standbys/--tls-dir/--quorum/--attest-scores apply to the "
+              "processes/executor runtimes", file=sys.stderr)
         return 2
     if opts.secure:
         if opts.config != "config4":

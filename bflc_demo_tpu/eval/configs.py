@@ -39,7 +39,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      process_factory: str = "",
                      factory_kw: Optional[dict] = None,
                      standbys: int = 0, tls_dir: str = "",
-                     quorum: int = 0,
+                     quorum: int = 0, attest_scores: bool = False,
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -47,7 +47,10 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     host: per-client dispatches, reference-shaped event loop;
     threaded: true-concurrency thread-per-client with failure recovery;
     processes: real OS processes over the socket coordinator (the
-    reference's deployment shape; optional hot standbys + TLS).
+    reference's deployment shape; optional hot standbys + TLS + quorum);
+    executor: the composed deployment — OS-process clients stage shards
+    over the socket while the coordinator runs every round as ONE SPMD
+    program on its device mesh (optional TLS + score attestation).
     mesh_kw (participation/client_chunk/remat/...) only apply to 'mesh'.
     """
     if runtime == "mesh":
@@ -77,8 +80,18 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
             process_factory, shards, test_set, cfg, rounds=rounds,
             factory_kw=factory_kw or {}, standbys=standbys,
             tls_dir=tls_dir, quorum=quorum, verbose=verbose)
-    raise ValueError(f"runtime must be mesh|host|threaded|processes, "
-                     f"got {runtime!r}")
+    if runtime == "executor":
+        if not process_factory:
+            raise ValueError("this preset does not support the 'executor' "
+                             "runtime (no model factory registered)")
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_mesh_processes
+        return run_federated_mesh_processes(
+            process_factory, shards, test_set, cfg, rounds=rounds,
+            factory_kw=factory_kw or {}, tls_dir=tls_dir,
+            attest_scores=attest_scores, verbose=verbose)
+    raise ValueError(f"runtime must be mesh|host|threaded|processes|"
+                     f"executor, got {runtime!r}")
 
 
 def _split(x, y, test_frac=0.2, seed=0):

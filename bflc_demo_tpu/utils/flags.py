@@ -27,7 +27,7 @@ _PROTOCOL_FIELDS = {f.name: f.type for f in
 class RunOptions:
     config: str = "config1"          # eval.configs preset name
     rounds: int = 10
-    runtime: str = "mesh"            # mesh | host | threaded | processes
+    runtime: str = "mesh"            # mesh|host|threaded|processes|executor
     ledger_backend: str = "auto"     # auto | native | python
     seed: int = 0
     checkpoint_dir: str = ""
@@ -37,6 +37,7 @@ class RunOptions:
     standbys: int = 0                # processes runtime: hot standbys
     tls_dir: str = ""                # processes runtime: TLS cert dir
     quorum: int = 0                  # processes runtime: quorum-ack
+    attest_scores: bool = False      # executor runtime: score attestation
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 
