@@ -47,10 +47,10 @@ def main(argv=None) -> int:
         if opts.tls_dir:
             kw["tls_dir"] = opts.tls_dir
         if opts.quorum:
-            if opts.quorum > opts.standbys:
-                print("--quorum needs at least that many --standbys "
-                      "(only authenticated standby subscriptions count "
-                      "toward the durability quorum)", file=sys.stderr)
+            if opts.standbys < opts.quorum + 1:
+                print("--quorum Q needs --standbys >= Q+1 (the promoted "
+                      "writer must retain Q followers to keep "
+                      "acknowledging after a failover)", file=sys.stderr)
                 return 2
             kw["quorum"] = opts.quorum
         if opts.attest_scores:

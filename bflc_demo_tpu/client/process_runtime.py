@@ -331,14 +331,22 @@ def run_federated_processes(
     point-of-failure drill: the promoted standby must finish the run.
     quorum: acknowledge storage mutations only after this many followers
     (standbys/replicas) applied them — acknowledged ops then survive
-    writer death (comm.ledger_service quorum-ack; requires at least that
-    many subscribers or every mutation times out).
+    writer death (comm.ledger_service quorum-ack).  Requires
+    standbys >= quorum + 1: after a failover the PROMOTED writer needs
+    quorum remaining followers (the re-follow path gives it the
+    surviving standbys), or every post-promotion mutation would
+    REPLICATION_TIMEOUT forever.
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
     if kill_writer_at_epoch is not None and standbys < 1:
         raise ValueError("kill_writer_at_epoch requires standbys >= 1")
+    if quorum and standbys < quorum + 1:
+        raise ValueError(
+            f"quorum={quorum} requires standbys >= {quorum + 1}: a "
+            f"promoted writer must retain {quorum} followers to keep "
+            f"acknowledging mutations after a failover")
     crash_at = crash_at or {}
     factory_kw = factory_kw or {}
     t_start = time.monotonic()
@@ -415,7 +423,8 @@ def run_federated_processes(
     xte_j = jnp.asarray(xte)
     yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
     sponsor = FailoverClient(endpoints, timeout_s=120.0,
-                             tls=_client_tls(tls_dir))
+                             tls=_client_tls(tls_dir),
+                             standby_keys=standby_keys)
     history: List[Tuple[int, float]] = []
     seen_epoch = 0              # model at epoch 0 is the uncommitted init
     writer_killed = False
@@ -583,11 +592,19 @@ def attest_score_row(client, wallet, model, template, cfg,
             f"match local recomputation {mine.tolist()} — refusing to "
             f"attest (tampered or corrupt coordinator scoring)")
     payload = struct.pack(f"<{len(row)}d", *row)
-    client.request(
+    r = client.request(
         "attest", addr=wallet.address, epoch=epoch,
         scores=[float(v) for v in row],
         tag=wallet.sign(_op_bytes(
             "scores", wallet.address, epoch, payload)).hex())
+    if not r.get("ok"):
+        if r.get("status") == "WRONG_EPOCH":
+            return False               # round turned over under us; re-poll
+        # a rejected attestation must fail LOUDLY with the server's reason,
+        # not surface attest_timeout_s later as a misleading
+        # "member did not attest" (round-5 review)
+        raise RuntimeError(
+            f"epoch {epoch}: attestation rejected by the coordinator: {r}")
     return True
 
 

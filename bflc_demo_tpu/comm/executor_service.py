@@ -215,12 +215,14 @@ class MeshExecutorServer(LedgerServer):
 
         cands_host = jax.device_get(cand_deltas)
         hashes = []
+        fp_keys = []
         with self._lock:
             for j, uid in enumerate(uploader_ids):
                 one = jax.tree_util.tree_map(lambda l: np.asarray(l[j]),
                                              cands_host)
                 fp = fingerprint_to_bytes(delta_fps[uid])
                 self._blobs[fp] = pack_pytree(one)
+                fp_keys.append(fp)
                 hashes.append(fp.hex())
             self._pending_attest = {
                 "epoch": epoch, "s_pad": int(s_pad), "hashes": hashes,
@@ -242,6 +244,11 @@ class MeshExecutorServer(LedgerServer):
                 self._cv.wait(rem)
             self.attest_log[epoch] = dict(self._attested)
             self._pending_attest = None
+            # the evidence blobs served their purpose (every member
+            # re-scored and signed); without this prune a long run grows
+            # by K model-sized blobs per round until the coordinator OOMs
+            for fp in fp_keys:
+                self._blobs.pop(fp, None)
 
     def _run_rounds_inner(self) -> None:
         import jax

@@ -51,13 +51,18 @@ This module closes it:
   DUPLICATE ("already in") for an op whose reply was lost, which callers
   treat as progress.
 
-Known window (documented, not hidden): if the writer dies after streaming
-an upload op but before the standby fetched that update's payload blob, the
-promoted writer holds the update record without its payload.  An honest
-uploader that never saw its reply retries and re-supplies the blob (the
-upload handler re-accepts payloads for DUPLICATE uploads); an uploader that
-already got its reply will not, and that round can only complete via the
-stall-recovery path once the round closes over the remaining updates.
+Known window (documented, not hidden): in ASYNC mode (quorum=0), if the
+writer dies after streaming an upload op but before the standby mirrored
+that update's payload blob (fetched per-op, bypassing the QueryAllUpdates
+round gate), the promoted writer holds the update record without its
+payload.  An honest uploader that never saw its reply retries and
+re-supplies the blob (the upload handler re-accepts payloads for
+DUPLICATE uploads); an uploader that already got its reply will not, and
+that round can only complete via the stall-recovery path once the round
+closes over the remaining updates.  In QUORUM mode this window is CLOSED:
+the standby acks an upload only after mirroring its payload, so an
+acknowledged upload provably survives writer death with its blob
+(regression-tested in tests/test_failover.py).
 """
 
 from __future__ import annotations
@@ -384,28 +389,68 @@ class Standby:
                     raise WriterDead(str(e))
                 if msg is None:
                     raise WriterDead("op stream closed")
-                st = self.ledger.apply_op(bytes.fromhex(msg["op"]))
+                op_bytes = bytes.fromhex(msg["op"])
+                st = self.ledger.apply_op(op_bytes)
                 if st != LedgerStatus.OK:
                     raise RuntimeError(
                         f"standby rejected op {msg['i']}: {st.name} — "
                         f"writer/replica divergence, refusing to continue")
-                # confirm the apply upstream: the writer's quorum-ack mode
-                # counts these before acknowledging mutations to clients
+                try:
+                    self._sync_state(ctl)
+                except (ConnectionError, WireError, OSError):
+                    if not self._writer_alive(writer):
+                        raise WriterDead("state sync failed")
+                    continue            # sideband incomplete: no ack yet
+                if not self._mirror_upload_payload(op_bytes, ctl):
+                    # an UPLOAD op's payload could not be mirrored yet — do
+                    # NOT ack: a quorum-acknowledged upload must survive
+                    # writer death WITH its payload, or the acknowledged
+                    # client never retries and the round wedges after
+                    # promotion (round-5 review).  Acks are cumulative
+                    # watermarks, so a later op's ack covers this one once
+                    # the blob lands on a retry.
+                    if not self._writer_alive(writer):
+                        raise WriterDead("payload mirror failed")
+                    continue
+                # confirm apply + mirror upstream: the writer's quorum-ack
+                # mode counts these before acknowledging mutations
                 # (best-effort — a lost ack only delays, never corrupts)
                 try:
                     send_msg(sub.sock, {"ack": int(msg["i"])})
                 except (WireError, OSError):
                     pass
-                try:
-                    self._sync_state(ctl)
-                except (ConnectionError, WireError, OSError):
-                    # the op is applied; blobs resync on the next loop or
-                    # from retrying clients after promotion
-                    if not self._writer_alive(writer):
-                        raise WriterDead("state sync failed")
         finally:
             sub.close()
             ctl.close()
+
+    _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
+
+    def _mirror_upload_payload(self, op_bytes: bytes,
+                               ctl: CoordinatorClient) -> bool:
+        """Fetch an upload op's payload blob by hash, bypassing the
+        QueryAllUpdates round gate (which hides mid-round updates from
+        `_sync_state`'s scan).  True = nothing to do or blob mirrored;
+        False = this op's payload is still missing (caller withholds the
+        quorum ack).  Non-upload ops always return True."""
+        if not op_bytes or op_bytes[0] != self._UPLOAD_OPCODE:
+            return True
+        from bflc_demo_tpu.ledger.tool import decode_op
+        try:
+            ph = bytes.fromhex(decode_op(op_bytes)["payload_hash"])
+        except (KeyError, ValueError):
+            return True                 # undecodable: not a payload op
+        if ph in self._blobs:
+            return True
+        try:
+            r = ctl.request("blob", hash=ph.hex())
+        except (ConnectionError, WireError, OSError):
+            return False
+        if r.get("ok"):
+            blob = bytes.fromhex(r["blob"])
+            if hashlib.sha256(blob).digest() == ph:
+                self._blobs[ph] = blob
+                return True
+        return False
 
     def _sync_state(self, ctl: CoordinatorClient) -> None:
         """Mirror hash-referenced sideband state from the writer.

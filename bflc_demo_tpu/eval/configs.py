@@ -53,6 +53,20 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     program on its device mesh (optional TLS + score attestation).
     mesh_kw (participation/client_chunk/remat/...) only apply to 'mesh'.
     """
+    # never silently drop a requested trust/fault-tolerance feature: a
+    # caller that asked for standbys/quorum/attestation must get them or
+    # an error, not a run without them (mirrors the CLI's guards)
+    inapplicable = []
+    if runtime != "processes":
+        inapplicable += [("standbys", standbys), ("quorum", quorum)]
+    if runtime != "executor":
+        inapplicable += [("attest_scores", attest_scores)]
+    if runtime not in ("processes", "executor") and tls_dir:
+        inapplicable += [("tls_dir", tls_dir)]
+    bad = [n for n, v in inapplicable if v]
+    if bad:
+        raise ValueError(f"options {bad} do not apply to the "
+                         f"{runtime!r} runtime")
     if runtime == "mesh":
         return run_federated_mesh(model, shards, test_set, cfg,
                                   rounds=rounds, seed=seed,

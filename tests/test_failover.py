@@ -763,3 +763,43 @@ class TestQuorumAck:
             for sb in sbs:
                 sb.stop()
             srv.close()
+
+    def test_acknowledged_upload_payload_is_on_the_standby(self):
+        """Round-5 review: the ack must cover the upload's PAYLOAD, not
+        just the op — an acknowledged uploader never retries, so a
+        promoted standby missing the blob would wedge the round.  At the
+        moment the client sees ok, the standby holds the blob."""
+        import hashlib as hl
+
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           quorum=1, quorum_timeout_s=10.0)
+        srv.start()
+        standby = Standby(CFG, [(srv.host, srv.port), ("127.0.0.1", 0)], 1,
+                          heartbeat_s=0.3, stall_timeout_s=60.0,
+                          require_auth=False, ledger_backend="python")
+        standby.endpoints[1] = (standby.host, standby.port)
+        threading.Thread(target=standby.run, daemon=True).start()
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=20.0)
+        try:
+            deadline = time.monotonic() + 10
+            while not srv._sub_acked:
+                assert time.monotonic() < deadline, "standby never followed"
+                time.sleep(0.05)
+            for i in range(CFG.client_num):
+                assert c.request("register", addr=f"0x{i:040x}")["ok"]
+            committee = set(c.request("committee")["committee"])
+            trainer = next(f"0x{i:040x}" for i in range(CFG.client_num)
+                           if f"0x{i:040x}" not in committee)
+            blob = _delta_blob(1.5)
+            digest = hl.sha256(blob).digest()
+            r = c.request("upload", addr=trainer, blob=blob.hex(),
+                          hash=digest.hex(), n=10, cost=1.0, epoch=0)
+            assert r["ok"], r
+            # acknowledged => the payload is already mirrored
+            assert standby._blobs.get(digest) == blob
+        finally:
+            c.close()
+            standby.stop()
+            srv.close()
