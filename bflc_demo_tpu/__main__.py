@@ -53,6 +53,20 @@ def main(argv=None) -> int:
                       "acknowledging after a failover)", file=sys.stderr)
                 return 2
             kw["quorum"] = opts.quorum
+        if opts.bft_validators:
+            if opts.bft_validators < 1:
+                print(f"--bft-validators must be positive, got "
+                      f"{opts.bft_validators}", file=sys.stderr)
+                return 2
+            # the reference geometry is 4 (f=1); fewer than 4 still binds
+            # ops to independent re-execution but tolerates no liar
+            from bflc_demo_tpu.protocol.constants import (
+                bft_fault_tolerance)
+            if bft_fault_tolerance(opts.bft_validators) < 1:
+                print(f"note: --bft-validators {opts.bft_validators} "
+                      f"gives f=0 (no Byzantine tolerance); the "
+                      f"reference geometry is 4", file=sys.stderr)
+            kw["bft_validators"] = opts.bft_validators
         if opts.attest_scores:
             # never silently drop a requested trust feature
             print("--attest-scores applies to --runtime executor",
@@ -63,13 +77,15 @@ def main(argv=None) -> int:
             kw["tls_dir"] = opts.tls_dir
         if opts.attest_scores:
             kw["attest_scores"] = True
-        if opts.standbys or opts.quorum:
-            print("--standbys/--quorum apply to --runtime processes",
-                  file=sys.stderr)
+        if opts.standbys or opts.quorum or opts.bft_validators:
+            print("--standbys/--quorum/--bft-validators apply to "
+                  "--runtime processes", file=sys.stderr)
             return 2
-    elif opts.standbys or opts.tls_dir or opts.quorum or opts.attest_scores:
-        print("--standbys/--tls-dir/--quorum/--attest-scores apply to the "
-              "processes/executor runtimes", file=sys.stderr)
+    elif opts.standbys or opts.tls_dir or opts.quorum \
+            or opts.attest_scores or opts.bft_validators:
+        print("--standbys/--tls-dir/--quorum/--bft-validators/"
+              "--attest-scores apply to the processes/executor runtimes",
+              file=sys.stderr)
         return 2
     if opts.secure:
         if opts.config != "config4":

@@ -94,7 +94,9 @@ def _server_tls(tls_dir: str):
 
 def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  stall_timeout_s: float, wal_path: str, tls_dir: str,
-                 standby_keys: dict, quorum: int, verbose: bool) -> None:
+                 standby_keys: dict, quorum: int,
+                 bft_endpoints: list, bft_keys: dict,
+                 verbose: bool) -> None:
     _force_cpu_jax()
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
     tls = _server_tls(tls_dir)
@@ -102,9 +104,29 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                           stall_timeout_s=stall_timeout_s,
                           wal_path=wal_path, tls=tls,
                           standby_keys=standby_keys, quorum=quorum,
+                          bft_validators=[tuple(e) for e in bft_endpoints]
+                          or None,
+                          bft_keys=bft_keys or None,
                           verbose=verbose)
     port_q.put(server.port)
     server.serve_forever()
+
+
+def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
+                    port_q, validator_keys: dict, verbose: bool) -> None:
+    """One BFT commit-quorum member (comm.bft.ValidatorNode): an
+    independent replica + wallet that re-executes every op and co-signs
+    commit certificates — the reference analogue of one PBFT chain node.
+    Peer keys let it admit certified backlog when rejoining mid-run."""
+    _force_cpu_jax()
+    from bflc_demo_tpu.comm.bft import ValidatorNode
+    from bflc_demo_tpu.comm.identity import Wallet
+    node = ValidatorNode(ProtocolConfig(**cfg_kw),
+                         Wallet.from_seed(wallet_seed), index,
+                         validator_keys=validator_keys,
+                         verbose=verbose)
+    port_q.put(node.port)
+    node.serve_forever()
 
 
 def _sign(wallet, kind: str, epoch: int, payload: bytes) -> str:
@@ -118,7 +140,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                  x: np.ndarray, y_onehot: np.ndarray, cfg_kw: dict,
                  rounds: int, crash_at_epoch: Optional[int],
                  tls_dir: str = "",
-                 standby_keys: Optional[dict] = None) -> None:
+                 standby_keys: Optional[dict] = None,
+                 bft_keys: Optional[dict] = None) -> None:
     """One federated client: register -> role loop -> train/score -> exit.
 
     Runs the same state machine as client/runtime.FLNode.step (itself the
@@ -148,7 +171,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
 
     client = FailoverClient(endpoints, timeout_s=120.0,
                             tls=_client_tls(tls_dir),
-                            standby_keys=standby_keys)
+                            standby_keys=standby_keys,
+                            bft_keys=bft_keys)
     reg_deadline = time.monotonic() + 120.0
     while True:
         reply = client.request("register", addr=wallet.address,
@@ -157,7 +181,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
         if reply["ok"] or reply.get("status") in ("ALREADY_REGISTERED",
                                                   "DUPLICATE"):
             break
-        if reply.get("status") == "REPLICATION_TIMEOUT" \
+        if reply.get("status") in ("REPLICATION_TIMEOUT", "CERT_TIMEOUT") \
                 and time.monotonic() < reg_deadline:
             # quorum mode: the op is in the writer's chain but followers
             # haven't acked yet (e.g. a standby still subscribing at
@@ -253,7 +277,8 @@ def _replica_proc(host: str, port: int, cfg_kw: dict, until_ops: int,
 def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                   index: int, port_q, stall_timeout_s: float,
                   tls_dir: str, wallet_seed: bytes, standby_keys: dict,
-                  quorum: int, verbose: bool) -> None:
+                  quorum: int, bft_endpoints: list, bft_keys: dict,
+                  verbose: bool) -> None:
     """Hot standby: follow the writer's op stream, promote on its death
     (comm.failover.Standby).  Reports its serving port, then blocks."""
     _force_cpu_jax()
@@ -266,6 +291,9 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                       tls_client=tls_c, tls_server=tls_s,
                       wallet=Wallet.from_seed(wallet_seed),
                       standby_keys=standby_keys, quorum=quorum,
+                      bft_validators=[tuple(e) for e in bft_endpoints]
+                      or None,
+                      bft_keys=bft_keys or None,
                       verbose=verbose)
     # the placeholder self-endpoint gets the real bound port
     standby.endpoints[index] = (standby.host, standby.port)
@@ -309,6 +337,7 @@ def run_federated_processes(
         kill_writer_at_epoch: Optional[int] = None,
         tls_dir: str = "",
         quorum: int = 0,
+        bft_validators: int = 0,
         timeout_s: float = 600.0,
         init_seed: int = 0,
         verbose: bool = False) -> ProcessFederationResult:
@@ -336,6 +365,13 @@ def run_federated_processes(
     quorum remaining followers (the re-follow path gives it the
     surviving standbys), or every post-promotion mutation would
     REPLICATION_TIMEOUT forever.
+    bft_validators: spawn this many BFT commit-quorum validator processes
+    (comm.bft) — the reference's PBFT node fleet; 4 reproduces its f=1
+    geometry.  Every op must then gather bft_quorum(n) validator
+    co-signatures before the writer may acknowledge it, the op stream
+    carries the certificates, standbys refuse uncertified appends, and
+    every client verifies the certificate on each mutating ack — a
+    Byzantine writer cannot bind fabricated state (tests/test_bft.py).
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -382,11 +418,32 @@ def run_federated_processes(
                      + struct.pack("<q", s + 1) for s in range(standbys)}
     standby_keys = {idx: Wallet.from_seed(sd).public_bytes
                     for idx, sd in standby_seeds.items()}
+    # BFT validator fleet: deterministic identities from the run's master
+    # seed (same derivation as comm.bft.provision_validators, so only the
+    # PUBLIC keys need distributing)
+    bft_keys: Dict[int, bytes] = {}
+    bft_endpoints: List[Tuple[str, int]] = []
+    validator_procs: List = []
+    if bft_validators:
+        from bflc_demo_tpu.comm.bft import provision_validators
+        _, bft_keys = provision_validators(bft_validators, master_seed)
     with _cpu_spawn_env():
+        for v in range(bft_validators):
+            v_q = ctx.Queue()
+            vp = ctx.Process(
+                target=_validator_proc,
+                args=(cfg_kw, master_seed + b"|bft-validator|"
+                      + struct.pack("<q", v), v, v_q, bft_keys, verbose),
+                daemon=True)
+            vp.start()
+            bft_endpoints.append((host, v_q.get(timeout=60)))
+            validator_procs.append(vp)
+
         server = ctx.Process(target=_server_proc,
                              args=(cfg_kw, initial_blob, port_q,
                                    stall_timeout_s, wal_path, tls_dir,
-                                   standby_keys, quorum, verbose),
+                                   standby_keys, quorum,
+                                   bft_endpoints, bft_keys, verbose),
                              daemon=True)
         server.start()
         port = port_q.get(timeout=60)
@@ -400,7 +457,8 @@ def run_federated_processes(
                              args=(cfg_kw, list(endpoints), s + 1, sb_q,
                                    stall_timeout_s, tls_dir,
                                    standby_seeds[s + 1], standby_keys,
-                                   quorum, verbose),
+                                   quorum, bft_endpoints, bft_keys,
+                                   verbose),
                              daemon=True)
             sp.start()
             endpoints.append((host, sb_q.get(timeout=60)))
@@ -413,7 +471,8 @@ def run_federated_processes(
                 args=(list(endpoints), master_seed + struct.pack("<q", i),
                       model_factory, factory_kw,
                       np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
-                      rounds, crash_at.get(i), tls_dir, standby_keys),
+                      rounds, crash_at.get(i), tls_dir, standby_keys,
+                      bft_keys),
                 daemon=True)
             p.start()
             clients.append(p)
@@ -424,7 +483,8 @@ def run_federated_processes(
     yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
     sponsor = FailoverClient(endpoints, timeout_s=120.0,
                              tls=_client_tls(tls_dir),
-                             standby_keys=standby_keys)
+                             standby_keys=standby_keys,
+                             bft_keys=bft_keys or None)
     history: List[Tuple[int, float]] = []
     seen_epoch = 0              # model at epoch 0 is the uncommitted init
     writer_killed = False
@@ -500,6 +560,9 @@ def run_federated_processes(
         for sp in standby_procs:
             sp.terminate()
             sp.join(timeout=10)
+        for vp in validator_procs:
+            vp.terminate()
+            vp.join(timeout=10)
 
     crashed = [i for i in crash_at
                if clients[i].exitcode not in (0, None)]

@@ -94,19 +94,54 @@ class FailoverClient:
     next endpoint is tried; a full silent cycle backs off briefly.  Request
     retry across endpoints is safe because every mutation is signed and
     idempotent at the ledger (DUPLICATE for already-applied ops).
+
+    SECURITY — standby_keys (ADVICE r5): without provisioned standby
+    public keys the client accepts promotion evidence on structural match
+    alone, so ONE hostile or compromised endpoint replying
+    ``{gen: 999, gen_ev: {gen: 999, ...}}`` permanently poisons the fence
+    and makes the client reject the legitimate writer — the exact
+    one-message DoS the evidence scheme closes when keys exist.  Any
+    deployment with more than one endpoint (i.e. anywhere failover is
+    real) should provision `standby_keys`; constructing one without them
+    emits a RuntimeWarning rather than silently running forgeable.
+
+    bft_keys / bft_quorum (round 6): when the deployment runs BFT commit
+    certificates (comm.bft), provisioning the validator public keys makes
+    the client REJECT any mutating ack that does not carry a certificate
+    with `bft_quorum` authentic validator signatures — a writer that
+    dropped, forged, or forked the op cannot fake the ack (it does not
+    hold the validators' keys), so the reply is treated as a connection
+    failure and the client rotates/raises instead of trusting it.
     """
+
+    _BFT_ACKED = ("register", "upload", "scores")
 
     def __init__(self, endpoints: List[Endpoint], timeout_s: float = 30.0,
                  max_cycles: int = 6, tls=None,
-                 standby_keys: Optional[Dict[int, bytes]] = None):
+                 standby_keys: Optional[Dict[int, bytes]] = None,
+                 bft_keys: Optional[Dict[int, bytes]] = None,
+                 bft_quorum: Optional[int] = None):
         if not endpoints:
             raise ValueError("need at least one endpoint")
+        if len(endpoints) > 1 and not standby_keys:
+            import warnings
+            warnings.warn(
+                "FailoverClient with multiple endpoints but no "
+                "standby_keys: promotion evidence is accepted on "
+                "structural match alone, so one hostile endpoint can "
+                "poison this client's fence (one-message DoS) — provision "
+                "the standby public keys", RuntimeWarning, stacklevel=2)
         self._eps = list(endpoints)
         self._timeout_s = timeout_s
         self._max_cycles = max_cycles
         self._tls = tls
         self._cur = 0
         self._client: Optional[CoordinatorClient] = None
+        self._bft_keys = dict(bft_keys or {})
+        if self._bft_keys and bft_quorum is None:
+            from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
+            bft_quorum = _bq(len(self._bft_keys))
+        self._bft_quorum = bft_quorum or 0
         # provisioned standby pubkeys: with these the client VERIFIES the
         # Ed25519 signature on promotion evidence before moving its fence
         # (a forged {gen, gen_ev} dict from a hostile endpoint must not
@@ -196,6 +231,36 @@ class FailoverClient:
                     self.close()
                     self._cur = (self._cur + 1) % len(self._eps)
                     continue
+                if (self._bft_keys and method in self._BFT_ACKED
+                        and (reply.get("ok")
+                             or reply.get("status") in
+                             ("DUPLICATE", "ALREADY_REGISTERED"))):
+                    # BFT acceptance: a mutating ack must carry a commit
+                    # certificate with a quorum of authentic validator
+                    # signatures binding THE op this request implies
+                    # (expected_op_hash reconstructs its canonical bytes
+                    # from our own fields).  A hostile writer that
+                    # silently dropped the op cannot mint one, and
+                    # replaying a certificate it earned for a DIFFERENT
+                    # op fails the op binding — reject either like a dead
+                    # endpoint.  DUPLICATE-class replies are acks too
+                    # (callers treat "already in" as progress and never
+                    # retry), so they get the same bar, or a Byzantine
+                    # writer would just spell its forged ack "DUPLICATE"
+                    # instead of "OK".
+                    from bflc_demo_tpu.comm.bft import (
+                        expected_op_hash, verify_certificate_sigs)
+                    if not verify_certificate_sigs(
+                            reply.get("cert"), self._bft_quorum,
+                            self._bft_keys,
+                            op_hash=expected_op_hash(method, fields)):
+                        last = ConnectionError(
+                            f"{method}: ack without a valid commit "
+                            f"certificate for this op (uncertified or "
+                            f"replayed-certificate state rejected)")
+                        self.close()
+                        self._cur = (self._cur + 1) % len(self._eps)
+                        continue
                 return reply
             except (ConnectionError, WireError, OSError) as e:
                 last = e
@@ -235,6 +300,10 @@ class Standby:
                  standby_keys: Optional[Dict[int, bytes]] = None,
                  quorum: int = 0,
                  quorum_timeout_s: float = 5.0,
+                 bft_validators: Optional[List[Endpoint]] = None,
+                 bft_keys: Optional[Dict[int, bytes]] = None,
+                 bft_quorum: Optional[int] = None,
+                 bft_timeout_s: float = 10.0,
                  verbose: bool = False):
         if not 1 <= index < len(endpoints):
             raise ValueError(f"standby index {index} out of range for "
@@ -254,9 +323,22 @@ class Standby:
         self.wal_path = wal_path
         # identity for SIGNED promotion evidence (comm.identity.Wallet):
         # without it a promotion still serves failed-over clients, but the
-        # pre-partition writer cannot be made to self-demote on heal —
-        # clients then rely solely on their own reply-gen fencing
+        # deployment loses ALL split-brain protection (ADVICE r5): the
+        # pre-partition writer cannot be made to self-demote on heal, AND
+        # clients never raise their fence either — FailoverClient only
+        # moves its fence on replies that carry promotion evidence, which
+        # a wallet-less promotion cannot mint.  A healed stale writer
+        # keeps serving its divergent chain to any client that reaches it.
         self.wallet = wallet
+        if wallet is None:
+            import warnings
+            warnings.warn(
+                f"Standby(index={index}) constructed WITHOUT a wallet: "
+                f"promotions will carry no signed evidence, so a healed "
+                f"pre-partition writer is never fenced and client-side "
+                f"reply-gen fencing never activates — this deployment "
+                f"has no split-brain protection", RuntimeWarning,
+                stacklevel=2)
         # index -> Ed25519 pub of ALL provisioned standbys, handed to the
         # LedgerServer this standby becomes, so a LATER promotion can fence
         # it in turn
@@ -267,9 +349,29 @@ class Standby:
         # acknowledged-op-loss window in the regime it exists for)
         self.quorum = quorum
         self.quorum_timeout_s = quorum_timeout_s
+        # --- BFT commit certificates (comm.bft): with validator keys
+        # provisioned this standby REJECTS any streamed op that does not
+        # carry a certificate quorum-signed over ITS OWN chain prefix (a
+        # Byzantine writer cannot make honest replicas replicate forged
+        # state), mirrors the certificate map, and on promotion certifies
+        # its own fence op with the validator quorum before serving.
+        self.bft_validators = list(bft_validators or [])
+        self.bft_keys: Dict[int, bytes] = dict(bft_keys or {})
+        if self.bft_keys and bft_quorum is None:
+            from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
+            bft_quorum = _bq(len(self.bft_keys))
+        self.bft_quorum = bft_quorum or 0
+        self.bft_timeout_s = bft_timeout_s
+        self._certs: Dict[int, dict] = {}
         self.verbose = verbose
         self.ledger = make_ledger(cfg, backend=ledger_backend)
         self._blobs: Dict[bytes, bytes] = {}
+        # quorum-ack correctness (ADVICE r5 medium): upload ops whose
+        # payload blob is not yet mirrored, by chain index.  Outgoing acks
+        # are CLAMPED below the lowest pending index — acks are cumulative
+        # watermarks on the writer, so acking op j would otherwise
+        # silently certify an unmirrored upload i<j as durable.
+        self._pending_payload: Dict[int, bytes] = {}
         self._model_blob: Optional[bytes] = None
         self._directory = PublicDirectory() if require_auth else None
         # sync gating: only hit the writer's sideband endpoints when the
@@ -378,50 +480,115 @@ class Standby:
             raise WriterDead(str(e))
         try:
             self._sync_state(ctl)
+            last_applied = self.ledger.log_size() - 1
             while not self._stop.is_set():
                 try:
                     msg = recv_msg(sub.sock)
                 except (TimeoutError, socket.timeout):
                     if not self._writer_alive(writer):
                         raise WriterDead("probe failed")
+                    # idle stream: keep retrying any unmirrored upload
+                    # payloads so a transient blob-fetch failure heals
+                    # WITHOUT waiting for the next op (the clamped ack
+                    # below then advances past it)
+                    if self._pending_payload:
+                        self._retry_pending_payloads(ctl)
+                        self._send_ack(sub, last_applied)
                     continue
                 except (WireError, OSError) as e:
                     raise WriterDead(str(e))
                 if msg is None:
                     raise WriterDead("op stream closed")
                 op_bytes = bytes.fromhex(msg["op"])
+                op_index = self.ledger.log_size()
+                if self.bft_keys:
+                    # BFT mode: an append binds here only with a commit
+                    # certificate quorum-signed over OUR chain prefix —
+                    # a Byzantine writer streaming forged/forked/
+                    # uncertified state is refused, not replicated
+                    self._require_certificate(msg, op_index, op_bytes)
                 st = self.ledger.apply_op(op_bytes)
                 if st != LedgerStatus.OK:
                     raise RuntimeError(
                         f"standby rejected op {msg['i']}: {st.name} — "
                         f"writer/replica divergence, refusing to continue")
+                last_applied = op_index
+                if op_bytes and op_bytes[0] == self._UPLOAD_OPCODE:
+                    # an applied upload is UNDURABLE until its payload
+                    # blob lands — register it as pending BEFORE anything
+                    # below can fail/continue, so every outgoing ack is
+                    # clamped under it (ADVICE r5: acks are cumulative
+                    # watermarks on the writer; acking any later op would
+                    # silently certify this one as durable without its
+                    # payload, and the acknowledged client never retries
+                    # — the round wedges after promotion).  The sync-
+                    # failure `continue` path skips the mirror entirely;
+                    # registering first keeps the clamp sound there too.
+                    self._pending_payload[op_index] = op_bytes
                 try:
                     self._sync_state(ctl)
                 except (ConnectionError, WireError, OSError):
                     if not self._writer_alive(writer):
                         raise WriterDead("state sync failed")
                     continue            # sideband incomplete: no ack yet
-                if not self._mirror_upload_payload(op_bytes, ctl):
-                    # an UPLOAD op's payload could not be mirrored yet — do
-                    # NOT ack: a quorum-acknowledged upload must survive
-                    # writer death WITH its payload, or the acknowledged
-                    # client never retries and the round wedges after
-                    # promotion (round-5 review).  Acks are cumulative
-                    # watermarks, so a later op's ack covers this one once
-                    # the blob lands on a retry.
-                    if not self._writer_alive(writer):
-                        raise WriterDead("payload mirror failed")
-                    continue
+                self._retry_pending_payloads(ctl)
+                if op_index in self._pending_payload and \
+                        not self._writer_alive(writer):
+                    raise WriterDead("payload mirror failed")
                 # confirm apply + mirror upstream: the writer's quorum-ack
                 # mode counts these before acknowledging mutations
                 # (best-effort — a lost ack only delays, never corrupts)
-                try:
-                    send_msg(sub.sock, {"ack": int(msg["i"])})
-                except (WireError, OSError):
-                    pass
+                self._send_ack(sub, last_applied)
         finally:
             sub.close()
             ctl.close()
+
+    def _retry_pending_payloads(self, ctl: CoordinatorClient) -> None:
+        """Re-attempt the blob fetch for every pending upload op, lowest
+        index first (the ack clamp lifts exactly as the holes fill)."""
+        for i in sorted(self._pending_payload):
+            if self._mirror_upload_payload(self._pending_payload[i], ctl):
+                del self._pending_payload[i]
+            else:
+                break                   # still missing: later retries moot
+
+    def _send_ack(self, sub: CoordinatorClient, last_applied: int) -> None:
+        """Ack the highest op this replica DURABLY holds: the latest
+        applied op, clamped below any upload whose payload blob is still
+        unmirrored (cumulative-watermark semantics upstream)."""
+        ack = last_applied
+        if self._pending_payload:
+            ack = min(ack, min(self._pending_payload) - 1)
+        if ack < 0:
+            return
+        try:
+            send_msg(sub.sock, {"ack": int(ack)})
+        except (WireError, OSError):
+            pass
+
+    def _require_certificate(self, msg: dict, op_index: int,
+                             op_bytes: bytes) -> None:
+        """Verify + mirror the streamed op's commit certificate; raises
+        RuntimeError (refusal, not failover) when it is absent/invalid."""
+        from bflc_demo_tpu.comm.bft import verify_certificate
+        from bflc_demo_tpu.protocol.types import CommitCertificate
+        cert_wire = msg.get("cert")
+        cert = None
+        if isinstance(cert_wire, dict):
+            try:
+                cert = CommitCertificate.from_wire(cert_wire)
+            except ValueError:
+                cert = None
+        prev = (self.ledger.log_head() if self.ledger.log_size()
+                else b"\0" * 32)
+        if cert is None or not verify_certificate(
+                cert, index=op_index, prev_head=prev, op=op_bytes,
+                quorum=self.bft_quorum, validator_keys=self.bft_keys):
+            raise RuntimeError(
+                f"standby {self.index}: op {msg.get('i')} arrived without "
+                f"a valid commit certificate — Byzantine or misconfigured "
+                f"writer, refusing to replicate uncertified state")
+        self._certs[op_index] = cert_wire
 
     _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
 
@@ -554,6 +721,36 @@ class Standby:
         return -1
 
     # ------------------------------------------------------------ promotion
+    def _certify_promotion(self) -> None:
+        """Gather a validator quorum certificate for the just-appended
+        promote op; a promotion that cannot certify must NOT serve (BFT
+        clients would reject every ack, and rightly so).  This doubles as
+        leader arbitration: validators sign one op per chain position, so
+        two standbys racing to promote at the same index cannot both win.
+        """
+        from bflc_demo_tpu.comm.bft import CertificateAssembler
+        from bflc_demo_tpu.comm.ledger_service import chain_head_at
+        ix = self.ledger.log_size() - 1
+        op = self.ledger.log_op(ix)
+        prev = chain_head_at(self.ledger, ix) or b"\0" * 32
+        assembler = CertificateAssembler(
+            self.bft_validators, self.bft_keys, self.bft_quorum,
+            timeout_s=self.bft_timeout_s, tls=None,
+            # a validator that lagged the dead writer resyncs from this
+            # standby's mirrored certificates (auth evidence died with
+            # the writer; the certs carry the quorum's admission)
+            backlog_fn=lambda j: (self.ledger.log_op(j), None,
+                                  self._certs.get(j)))
+        try:
+            cert = assembler.certify(ix, op, None, prev)
+        finally:
+            assembler.close()
+        if cert is None:
+            raise RuntimeError(
+                f"standby {self.index}: promotion fence op gathered no "
+                f"validator quorum — refusing to serve uncertified")
+        self._certs[ix] = cert.to_wire()
+
     def _promote_and_serve(self) -> None:
         if self._model_blob is None:
             raise RuntimeError("cannot promote: no model blob mirrored yet")
@@ -565,12 +762,21 @@ class Standby:
                                         self.index)
         if st != LedgerStatus.OK:
             raise RuntimeError(f"promotion fence rejected: {st.name}")
+        if self.bft_keys:
+            self._certify_promotion()
         evidence = None
         if self.wallet is not None:
             from bflc_demo_tpu.comm.ledger_service import \
                 make_promotion_evidence
             evidence = make_promotion_evidence(self.ledger, self.wallet,
                                                self.index)
+            if self.bft_keys:
+                # the evidence CITES the highest certified op — which in
+                # BFT mode is the promote op itself (this standby refused
+                # every uncertified append and just certified its fence),
+                # so a verifier knows the promotion extends quorum-signed
+                # history, not a private fork
+                evidence["cert_ix"] = self.ledger.log_size() - 1
         missing = [u.payload_hash.hex()[:12]
                    for u in self.ledger.query_all_updates()
                    if u.payload_hash not in self._blobs]
@@ -592,6 +798,11 @@ class Standby:
             promotion_evidence=evidence,
             quorum=self.quorum,
             quorum_timeout_s=self.quorum_timeout_s,
+            bft_validators=self.bft_validators or None,
+            bft_keys=self.bft_keys or None,
+            bft_quorum=self.bft_quorum or None,
+            bft_timeout_s=self.bft_timeout_s,
+            resume_certs=dict(self._certs) if self.bft_keys else None,
             verbose=self.verbose)
         # open enrollment on the promoted writer: a client the directory
         # missed re-presents its (self-authenticating) pubkey on register

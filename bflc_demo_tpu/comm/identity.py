@@ -31,21 +31,47 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import os
 import struct
 from typing import Dict, List, Sequence, Tuple
 
 from bflc_demo_tpu.ledger.base import LedgerStatus
 
-try:                                    # baked into this image; gate anyway
+try:                                    # prefer the C-backed implementation
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey, Ed25519PublicKey)
     from cryptography.hazmat.primitives.asymmetric.x25519 import (
         X25519PrivateKey, X25519PublicKey)
     from cryptography.hazmat.primitives import serialization as _ser
     from cryptography.exceptions import InvalidSignature
-    HAVE_ED25519 = True
-except ImportError:                     # pragma: no cover
-    HAVE_ED25519 = False
+    ED25519_BACKEND = "cryptography"
+except ImportError:
+    # hosts without the `cryptography` wheel (this jax image, for one) fall
+    # back to the from-first-principles implementation — same key, tag and
+    # DH bytes (RFC-vector-tested), so wallets interoperate across backends
+    ED25519_BACKEND = "pure-python"
+
+from bflc_demo_tpu.comm import pure25519 as _pure
+
+# asymmetric identity is always available now that a pure-Python backend
+# exists; the flag survives for callers that gated on it historically
+HAVE_ED25519 = True
+
+
+def verify_signature(public_bytes: bytes, message: bytes,
+                     signature: bytes) -> bool:
+    """THE Ed25519 verification chokepoint: every tag, promotion-evidence
+    and commit-certificate check in the repo funnels here, so the two
+    backends cannot drift between enforcement points.  Never raises on
+    malformed input — a hostile peer's garbage is a False, not a crash."""
+    if ED25519_BACKEND == "cryptography":
+        try:
+            Ed25519PublicKey.from_public_bytes(public_bytes).verify(
+                signature, message)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+    return _pure.ed25519_verify(public_bytes, message, signature)
 
 
 class KeyRing:
@@ -67,13 +93,6 @@ class KeyRing:
         return hmac.compare_digest(self.mac(address, op_bytes), tag)
 
 
-def _require_ed25519():
-    if not HAVE_ED25519:                # pragma: no cover
-        raise RuntimeError(
-            "asymmetric identity requires the 'cryptography' package; "
-            "use KeyRing (HMAC) where it is unavailable")
-
-
 def address_of(public_bytes: bytes) -> str:
     """Self-authenticating address: 0x + first 20 bytes of sha256(pubkey) —
     the Ethereum-style derivation, so an address claim is checkable against
@@ -87,34 +106,44 @@ class Wallet:
     The get_batch_accounts.sh equivalent (one PEM per client,
     README.md:348-359): `Wallet.from_seed` provisions deterministically for
     tests; `Wallet.generate` draws fresh OS randomness for real use.
+
+    Constructed from RAW 32-byte private keys so the wallet is
+    backend-portable: the same bytes yield identical public keys,
+    signatures (Ed25519 is deterministic) and DH secrets under the
+    `cryptography` wheel and the pure-Python fallback.
     """
 
-    def __init__(self, sign_key: "Ed25519PrivateKey",
-                 dh_key: "X25519PrivateKey"):
-        _require_ed25519()
-        self._sign = sign_key
-        self._dh = dh_key
-        self.public_bytes = sign_key.public_key().public_bytes(
-            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
-        self.dh_public_bytes = dh_key.public_key().public_bytes(
-            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+    def __init__(self, sign_private: bytes, dh_private: bytes):
+        if len(sign_private) != 32 or len(dh_private) != 32:
+            raise ValueError("wallet private keys must be 32 raw bytes")
+        self._sign_sk = bytes(sign_private)
+        self._dh_sk = bytes(dh_private)
+        if ED25519_BACKEND == "cryptography":
+            self._sign = Ed25519PrivateKey.from_private_bytes(self._sign_sk)
+            self._dh = X25519PrivateKey.from_private_bytes(self._dh_sk)
+            self.public_bytes = self._sign.public_key().public_bytes(
+                _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+            self.dh_public_bytes = self._dh.public_key().public_bytes(
+                _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        else:
+            self.public_bytes = _pure.ed25519_public(self._sign_sk)
+            self.dh_public_bytes = _pure.x25519_public(self._dh_sk)
         self.address = address_of(self.public_bytes)
 
     @classmethod
     def generate(cls) -> "Wallet":
-        _require_ed25519()
-        return cls(Ed25519PrivateKey.generate(), X25519PrivateKey.generate())
+        return cls(os.urandom(32), os.urandom(32))
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "Wallet":
-        _require_ed25519()
         sk = hashlib.sha256(b"bflc-ed25519|" + seed).digest()
         dk = hashlib.sha256(b"bflc-x25519|" + seed).digest()
-        return cls(Ed25519PrivateKey.from_private_bytes(sk),
-                   X25519PrivateKey.from_private_bytes(dk))
+        return cls(sk, dk)
 
     def sign(self, op_bytes: bytes) -> bytes:
-        return self._sign.sign(op_bytes)
+        if ED25519_BACKEND == "cryptography":
+            return self._sign.sign(op_bytes)
+        return _pure.ed25519_sign(self._sign_sk, op_bytes)
 
     # signer surface shared with KeyRing so FLNode/sign_* helpers take either
     def mac(self, address: str, op_bytes: bytes) -> bytes:
@@ -128,8 +157,11 @@ class Wallet:
         """X25519 shared secret with another wallet, hashed with `context`
         (e.g. the round number) — both endpoints derive the same bytes; the
         coordinator, holding neither private key, cannot."""
-        shared = self._dh.exchange(X25519PublicKey.from_public_bytes(
-            their_dh_public))
+        if ED25519_BACKEND == "cryptography":
+            shared = self._dh.exchange(X25519PublicKey.from_public_bytes(
+                their_dh_public))
+        else:
+            shared = _pure.x25519_exchange(self._dh_sk, their_dh_public)
         return hashlib.sha256(b"bflc-pair|" + shared + b"|" + context
                               ).digest()
 
@@ -143,13 +175,10 @@ class PublicDirectory:
     """
 
     def __init__(self):
-        _require_ed25519()
-        self._keys: Dict[str, "Ed25519PublicKey"] = {}
         self._raw: Dict[str, bytes] = {}
 
     def enroll(self, public_bytes: bytes) -> str:
         addr = address_of(public_bytes)
-        self._keys[addr] = Ed25519PublicKey.from_public_bytes(public_bytes)
         self._raw[addr] = bytes(public_bytes)
         return addr
 
@@ -160,17 +189,13 @@ class PublicDirectory:
         return dict(self._raw)
 
     def knows(self, address: str) -> bool:
-        return address in self._keys
+        return address in self._raw
 
     def verify(self, address: str, op_bytes: bytes, tag: bytes) -> bool:
-        key = self._keys.get(address)
-        if key is None:
+        pub = self._raw.get(address)
+        if pub is None:
             return False
-        try:
-            key.verify(tag, op_bytes)
-            return True
-        except InvalidSignature:
-            return False
+        return verify_signature(pub, op_bytes, tag)
 
 
 def provision_wallets(n: int, master_seed: bytes,

@@ -120,15 +120,9 @@ def verify_promotion_signature(ev, standby_keys) -> bool:
     pub = (standby_keys or {}).get(sb)
     if pub is None:
         return False
-    from cryptography.exceptions import InvalidSignature
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
-        Ed25519PublicKey
-    try:
-        Ed25519PublicKey.from_public_bytes(pub).verify(
-            sig, _promotion_evidence_bytes(gen, ix, prev, sb))
-        return True
-    except (InvalidSignature, ValueError):
-        return False
+    from bflc_demo_tpu.comm.identity import verify_signature
+    return verify_signature(pub, _promotion_evidence_bytes(gen, ix, prev,
+                                                           sb), sig)
 
 
 def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
@@ -198,6 +192,11 @@ class LedgerServer:
                  gas_budget_per_epoch: Optional[int] = None,
                  quorum: int = 0,
                  quorum_timeout_s: float = 5.0,
+                 bft_validators: Optional[List[Tuple[str, int]]] = None,
+                 bft_keys: Optional[Dict[int, bytes]] = None,
+                 bft_quorum: Optional[int] = None,
+                 bft_timeout_s: float = 10.0,
+                 resume_certs: Optional[Dict[int, dict]] = None,
                  verbose: bool = False):
         """resume_ledger/resume_blobs/sock: the promotion surface
         (comm.failover.Standby) — a server constructed over a replica's
@@ -284,6 +283,54 @@ class LedgerServer:
         # attached to every reply so clients learn the fence + its proof
         # passively and can present it to a stale writer
         self._promotion_evidence = promotion_evidence
+        # --- BFT commit certificates (comm.bft): when validators are
+        # provisioned, an op BINDS only once a quorum of them re-executed
+        # it and co-signed; the ack carries the certificate, the op stream
+        # publishes only certified ops, and an uncertifiable op answers
+        # CERT_TIMEOUT (the mutation sits in the local chain, unbound —
+        # honest retries are DUPLICATE = progress once the quorum heals).
+        self._bft = None
+        self._certs: Dict[int, dict] = dict(resume_certs or {})
+        # op-hash -> certificate: the reply-binding index.  An ack (OK or
+        # DUPLICATE-class) must carry the certificate of THE op the
+        # request implies, or a Byzantine writer could replay any old
+        # certificate on a forged ack — clients verify the binding
+        # (comm.bft.expected_op_hash / verify_certificate_sigs).
+        self._certs_by_ophash: Dict[str, dict] = {
+            c["op_hash"]: c for c in self._certs.values()
+            if isinstance(c, dict) and "op_hash" in c}
+        # serialises certification (strictly sequential: each certificate
+        # chains on the previous head); concurrent mutation threads take
+        # turns extending the watermark — plain mutual exclusion, no
+        # wakeup protocol
+        self._cert_lock = threading.Lock()
+        self._op_auth: Dict[int, dict] = {}
+        if bft_validators:
+            from bflc_demo_tpu.comm.bft import CertificateAssembler
+            from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
+            q = bft_quorum if bft_quorum is not None \
+                else _bq(len(bft_validators))
+            if not 0 < q <= len(bft_validators):
+                raise ValueError(f"bft_quorum {q} out of range for "
+                                 f"{len(bft_validators)} validators")
+            self._bft = CertificateAssembler(
+                bft_validators, bft_keys or {}, q,
+                timeout_s=bft_timeout_s, tls=None,
+                backlog_fn=self._bft_backlog)
+            # a resumed (promoted) chain arrives fully certified — the
+            # standby refused uncertified appends and certified its own
+            # fence op before constructing this server
+            self._certified_size = self.ledger.log_size()
+            self._cert_head = self.ledger.log_head() \
+                if self._certified_size else b"\0" * 32
+            if self._certified_size and \
+                    len(self._certs) < self._certified_size:
+                raise ValueError(
+                    f"BFT resume: {self._certified_size} chain ops but "
+                    f"only {len(self._certs)} certificates")
+        else:
+            self._certified_size = 0
+            self._cert_head = b"\0" * 32
         self._threads: List[threading.Thread] = []
 
         if sock is not None:
@@ -316,6 +363,8 @@ class LedgerServer:
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
+        if self._bft is not None:
+            self._bft.close()
         try:
             self._sock.close()
         except OSError:
@@ -387,6 +436,30 @@ class LedgerServer:
                 try:
                     reply = self._dispatch(method, msg)
                     post_size = reply.pop("_post_size", None)
+                    if self._bft is not None and post_size is not None:
+                        # BFT mode: the ack may only carry state that a
+                        # validator quorum co-signed — certify the ops this
+                        # request appended (and any predecessors) first
+                        cert = self._ensure_certified(post_size)
+                        if cert is None:
+                            reply = {"ok": False, "status": "CERT_TIMEOUT",
+                                     "error": "no validator quorum "
+                                              "co-signed the op"}
+                            post_size = None
+                        else:
+                            # attach the certificate of THIS request's op
+                            # (reconstructed from its own fields), not
+                            # merely the newest one: for DUPLICATE-class
+                            # replies the op bound earlier, and a client
+                            # rightly rejects a certificate that does not
+                            # bind the op it asked about
+                            from bflc_demo_tpu.comm.bft import \
+                                expected_op_hash
+                            oh = expected_op_hash(method, msg)
+                            if oh is not None:
+                                cert = self._certs_by_ophash.get(
+                                    oh.hex(), None)
+                            reply["cert"] = cert
                     if (self._quorum
                             and post_size is not None
                             and not self._await_quorum(post_size)):
@@ -420,6 +493,75 @@ class LedgerServer:
             except OSError:
                 pass
 
+    # ------------------------------------------------- commit certificates
+    def _bft_backlog(self, j: int):
+        """(op bytes, auth evidence, certificate) for chain position j —
+        the resync surface a lagging or REJOINING validator replays
+        through.  Auth evidence is this process's memory; after a
+        promotion it is gone for pre-promotion ops, so the certificate
+        rides along (a quorum already re-verified those tags) and, for
+        register ops, the self-authenticating pubkey is recovered from
+        the directory so the rejoining validator's own directory stays
+        complete for FRESH client traffic."""
+        with self._lock:
+            op = self.ledger.log_op(j)
+            auth = self._op_auth.get(j)
+            if auth is None and op and op[0] == 1:      # register opcode
+                try:
+                    (n,) = struct.unpack_from("<q", op, 1)
+                    addr = op[9:9 + n].decode()
+                    pub = self.directory.export_raw().get(addr)
+                    if pub is not None:
+                        auth = {"pubkey": pub.hex()}
+                except (struct.error, UnicodeDecodeError):
+                    pass
+            return op, auth, self._certs.get(j)
+
+    def _ensure_certified(self, upto: int,
+                          timeout_s: Optional[float] = None,
+                          ) -> Optional[dict]:
+        """Drive certification of ops [certified_size, upto); returns the
+        wire certificate of op upto-1 or None on quorum failure.
+
+        Serialised on _cert_lock (certification is strictly sequential —
+        each certificate chains on the previous head); concurrent
+        mutation threads block here and take over the watermark in turn.
+        Votes are gathered WITHOUT the ledger lock, so reads and other
+        dispatches proceed meanwhile.
+        """
+        if self._bft is None:
+            return None
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self._bft.timeout_s)
+        with self._cert_lock:
+            while self._certified_size < upto:
+                if self._stop.is_set():
+                    return None
+                i = self._certified_size
+                prev = self._cert_head
+                with self._lock:
+                    op = self.ledger.log_op(i)
+                    auth = self._op_auth.get(i)
+                cert = self._bft.certify(i, op, auth, prev)
+                if cert is None:
+                    if time.monotonic() > deadline:
+                        return None
+                    # transient quorum failure: retry within budget, but
+                    # never hot-spin — a refused connect fails in
+                    # microseconds and would otherwise hammer the
+                    # validator endpoints for the whole timeout
+                    time.sleep(0.2)
+                    continue
+                from bflc_demo_tpu.comm.bft import next_head
+                wire = cert.to_wire()
+                self._certs[i] = wire
+                self._certs_by_ophash[wire["op_hash"]] = wire
+                self._cert_head = next_head(prev, op)
+                self._certified_size = i + 1
+                with self._cv:
+                    self._cv.notify_all()   # wake gated op-stream pushers
+            return self._certs.get(upto - 1)
+
     def _stream_ops(self, conn: socket.socket, start: int,
                     quorum_eligible: bool) -> None:
         """Push canonical op bytes from `start` onward until the peer goes
@@ -452,6 +594,11 @@ class LedgerServer:
             while not self._stop.is_set():
                 with self._cv:
                     size = self.ledger.log_size()
+                    if self._bft is not None:
+                        # BFT mode: publish only CERTIFIED ops — a standby
+                        # must never replicate (or ack durability for)
+                        # state no validator quorum co-signed
+                        size = min(size, self._certified_size)
                     ops = [self.ledger.log_op(i)
                            for i in range(next_i, min(size, next_i + 256))]
                     if not ops:
@@ -464,7 +611,10 @@ class LedgerServer:
                     # for an op that really replicated)
                     self._sub_sent[sub_id] = next_i + len(ops) - 1
                 for i, op in enumerate(ops):
-                    send_msg(conn, {"i": next_i + i, "op": op.hex()})
+                    frame = {"i": next_i + i, "op": op.hex()}
+                    if self._bft is not None:
+                        frame["cert"] = self._certs.get(next_i + i)
+                    send_msg(conn, frame)
                 next_i += len(ops)
         finally:
             with self._cv:
@@ -557,16 +707,9 @@ class LedgerServer:
             sig = bytes.fromhex(reply.get("tag", ""))
         except (TypeError, ValueError):
             return False
-        from cryptography.exceptions import InvalidSignature
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import \
-            Ed25519PublicKey
-        try:
-            Ed25519PublicKey.from_public_bytes(pub).verify(
-                sig, self._SUB_MAGIC + challenge
-                + struct.pack("<Iq", sb, start))
-            return True
-        except (InvalidSignature, ValueError):
-            return False
+        from bflc_demo_tpu.comm.identity import verify_signature
+        return verify_signature(pub, self._SUB_MAGIC + challenge
+                                + struct.pack("<Iq", sb, start), sig)
 
     # ------------------------------------------------------------- dispatch
     def _touch(self, addr: str) -> None:
@@ -685,6 +828,12 @@ class LedgerServer:
                 st = self.ledger.register_node(addr)
                 if st == LedgerStatus.OK:
                     self._consume_tag(0, m.get("tag", ""))
+                    # auth evidence for the BFT validators: they must
+                    # re-verify the client's tag against THEIR directory
+                    # mirror or a hostile writer could fabricate this op
+                    self._op_auth[self.ledger.log_size() - 1] = {
+                        "tag": m.get("tag", ""),
+                        "pubkey": m.get("pubkey", "")}
                 self._touch(addr)
                 self._note_progress(st)
                 return {"ok": st == LedgerStatus.OK, "status": st.name,
@@ -735,6 +884,11 @@ class LedgerServer:
                 if st == LedgerStatus.OK:
                     self._blobs[digest] = blob
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
+                    # f64 originals ride along: the op stores f32, the tag
+                    # signs f64 — validators re-check both (comm.bft)
+                    self._op_auth[self.ledger.log_size() - 1] = {
+                        "tag": m.get("tag", ""), "n": int(m["n"]),
+                        "cost": float(m["cost"])}
                 elif st == LedgerStatus.DUPLICATE:
                     # an honest retry (e.g. across a writer failover) whose
                     # original reply was lost: the record is in the ledger —
@@ -770,6 +924,8 @@ class LedgerServer:
                 st = self.ledger.upload_scores(addr, int(m["epoch"]), scores)
                 if st == LedgerStatus.OK:
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
+                    self._op_auth[self.ledger.log_size() - 1] = {
+                        "tag": m.get("tag", ""), "scores": scores}
                 self._touch(addr)
                 self._note_progress(st)
                 if st == LedgerStatus.OK and self.ledger.aggregate_ready():
@@ -794,7 +950,10 @@ class LedgerServer:
                         "log_size": self.ledger.log_size(),
                         "log_head": self.ledger.log_head().hex(),
                         "gen": self.ledger.generation,
-                        "writer_index": self.ledger.writer_index}
+                        "writer_index": self.ledger.writer_index,
+                        "certified_size": (self._certified_size
+                                           if self._bft is not None
+                                           else None)}
             if method == "log_range":
                 start, end = int(m["start"]), int(m["end"])
                 size = self.ledger.log_size()
@@ -899,6 +1058,20 @@ class LedgerServer:
         liveness comes from request recency, not shared memory."""
         while not self._stop.is_set():
             time.sleep(min(self.stall_timeout_s / 4, 1.0))
+            if self._bft is not None \
+                    and self._certified_size < self.ledger.log_size():
+                # sweep ops appended outside a client request (recovery
+                # ops below; a request thread that died mid-certify):
+                # certification is the publication gate for the op
+                # stream, so nothing may linger uncertified.  Guarded and
+                # with a tick-sized budget so an unreachable quorum costs
+                # this thread one bounded attempt per tick instead of the
+                # full bft timeout — stall RECOVERY below must keep its
+                # stall_timeout_s/4 cadence regardless of validator
+                # health (review finding: the unbounded sweep starved it)
+                self._ensure_certified(
+                    self.ledger.log_size(),
+                    timeout_s=min(self.stall_timeout_s / 4, 1.0))
             with self._lock:
                 if self.ledger.epoch < 0:
                     continue

@@ -1,0 +1,218 @@
+"""Pure-Python Ed25519 (RFC 8032) + X25519 (RFC 7748) fallback backend.
+
+The asymmetric identity layer (comm.identity) is the reference's trust
+model — the coordinator verifies but cannot forge — and round 6's BFT
+commit certificates extend it to validator co-signing.  That layer must not
+evaporate on hosts where the `cryptography` wheel is absent (this image
+bakes in the jax toolchain, not OpenSSL bindings), so this module provides
+the same two primitives from first principles over Python integers, the
+same way the native ledger carries its own SHA-256 (ledger/src/sha256.cpp)
+instead of assuming a crypto runtime.
+
+Compatibility contract (exercised by tests/test_identity.py whenever both
+backends are importable): byte-identical public keys, signatures and DH
+shared secrets for the same raw private keys — Ed25519 is deterministic
+per RFC 8032 and X25519 clamps the scalar the same way, so a wallet
+provisioned under one backend verifies under the other.
+
+Performance: a scalar multiplication is ~1 ms of bigint arithmetic — three
+orders of magnitude slower than libsodium, irrelevant for control-plane
+signing rates (tens of ops per federated round), and not a side-channel
+surface worth hardening here (coordinator-side verification handles only
+public data; test deployments on crypto-less hosts accept the caveat).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_P = 2 ** 255 - 19                      # the curve25519 field prime
+_L = 2 ** 252 + 27742317777372353535851937790883648493   # group order
+_D = (-121665 * pow(121666, _P - 2, _P)) % _P            # edwards d
+
+
+def _inv(x: int) -> int:
+    return pow(x, _P - 2, _P)
+
+
+# ---------------------------------------------------------------- ed25519
+# Points are extended homogeneous coordinates (X, Y, Z, T) with x = X/Z,
+# y = Y/Z, x*y = T/Z — the standard complete addition law, so no special
+# cases for doubling or the identity.
+
+def _pt_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % _P
+    b = (y1 + x1) * (y2 + x2) % _P
+    c = 2 * t1 * t2 * _D % _P
+    d = 2 * z1 * z2 % _P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _pt_mul(s: int, p):
+    q = (0, 1, 1, 0)                    # neutral element
+    while s > 0:
+        if s & 1:
+            q = _pt_add(q, p)
+        p = _pt_add(p, p)
+        s >>= 1
+    return q
+
+
+def _pt_equal(p, q) -> bool:
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return ((x1 * z2 - x2 * z1) % _P == 0
+            and (y1 * z2 - y2 * z1) % _P == 0)
+
+
+def _recover_x(y: int, sign: int):
+    """x from the curve equation given y and the sign bit; None if y is
+    not on the curve (RFC 8032 §5.1.3 decoding)."""
+    if y >= _P:
+        return None
+    x2 = (y * y - 1) * _inv(_D * y * y + 1) % _P
+    if x2 == 0:
+        return None if sign else 0
+    x = pow(x2, (_P + 3) // 8, _P)
+    if (x * x - x2) % _P != 0:
+        x = x * pow(2, (_P - 1) // 4, _P) % _P
+    if (x * x - x2) % _P != 0:
+        return None
+    if (x & 1) != sign:
+        x = _P - x
+    return x
+
+
+_GY = 4 * _inv(5) % _P
+_GX = _recover_x(_GY, 0)
+_G = (_GX, _GY, 1, _GX * _GY % _P)      # the base point
+
+
+def _compress(p) -> bytes:
+    x, y, z, _ = p
+    zi = _inv(z)
+    x, y = x * zi % _P, y * zi % _P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def _decompress(s: bytes):
+    if len(s) != 32:
+        return None
+    y = int.from_bytes(s, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % _P)
+
+
+def _expand_seed(seed: bytes):
+    """RFC 8032 §5.1.5: seed -> (clamped scalar, nonce prefix)."""
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def ed25519_public(seed: bytes) -> bytes:
+    """32-byte public key for a 32-byte private seed."""
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
+    a, _ = _expand_seed(seed)
+    return _compress(_pt_mul(a, _G))
+
+
+def ed25519_sign(seed: bytes, message: bytes) -> bytes:
+    """Deterministic 64-byte signature (RFC 8032 §5.1.6)."""
+    a, prefix = _expand_seed(seed)
+    pub = _compress(_pt_mul(a, _G))
+    r = int.from_bytes(hashlib.sha512(prefix + message).digest(),
+                       "little") % _L
+    r_enc = _compress(_pt_mul(r, _G))
+    h = int.from_bytes(hashlib.sha512(r_enc + pub + message).digest(),
+                       "little") % _L
+    s = (r + h * a) % _L
+    return r_enc + int.to_bytes(s, 32, "little")
+
+
+def ed25519_verify(public: bytes, message: bytes, signature: bytes) -> bool:
+    """True iff `signature` is a valid signature of `message` by `public`
+    (RFC 8032 §5.1.7; cofactorless equation, matching modern verifiers on
+    honestly-generated signatures).  Never raises on malformed inputs."""
+    if len(public) != 32 or len(signature) != 64:
+        return False
+    a_pt = _decompress(public)
+    r_pt = _decompress(signature[:32])
+    if a_pt is None or r_pt is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= _L:                         # malleability rejection
+        return False
+    h = int.from_bytes(hashlib.sha512(signature[:32] + public
+                                      + message).digest(), "little") % _L
+    return _pt_equal(_pt_mul(s, _G), _pt_add(r_pt, _pt_mul(h, a_pt)))
+
+
+# ----------------------------------------------------------------- x25519
+def _clamp(k: bytes) -> int:
+    b = bytearray(k)
+    b[0] &= 248
+    b[31] &= 127
+    b[31] |= 64
+    return int.from_bytes(bytes(b), "little")
+
+
+def _x25519_ladder(k: int, u: int) -> int:
+    """Montgomery ladder (RFC 7748 §5) — constant structure, variable-time
+    bigints (see module docstring for why that is acceptable here)."""
+    x1 = u
+    x2, z2, x3, z3 = 1, 0, u, 1
+    swap = 0
+    for t in reversed(range(255)):
+        kt = (k >> t) & 1
+        if swap ^ kt:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = x1 * z3 * z3 % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + 121665 * e) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    return x2 * pow(z2, _P - 2, _P) % _P
+
+
+def x25519_exchange(private: bytes, peer_public: bytes) -> bytes:
+    """Shared secret u-coordinate for (our scalar, their public)."""
+    if len(private) != 32 or len(peer_public) != 32:
+        raise ValueError("x25519 keys must be 32 bytes")
+    u = int.from_bytes(peer_public, "little") & ((1 << 255) - 1)
+    out = _x25519_ladder(_clamp(private), u)
+    if out == 0:                        # small-order peer point
+        raise ValueError("x25519: degenerate shared secret")
+    return int.to_bytes(out, 32, "little")
+
+
+def x25519_public(private: bytes) -> bytes:
+    """Public u-coordinate for a 32-byte scalar (base point u=9)."""
+    if len(private) != 32:
+        raise ValueError("x25519 keys must be 32 bytes")
+    return int.to_bytes(_x25519_ladder(_clamp(private), 9), 32, "little")

@@ -63,6 +63,17 @@ def synthesize_occupancy(n: int = 8143, seed: int = 0,
     return x.astype(np.float32), y
 
 
+def occupancy_source() -> str:
+    """'csv' when a real datatraining.txt is reachable through the default
+    path chain, else 'synthetic'.  Accuracy bars calibrate per source: the
+    reference's 0.9214 plateau is a property of the REAL distribution; the
+    seeded stand-in is more linearly separable but worse-conditioned (raw
+    light/CO2 scales), so its fixed-lr trajectory oscillates and peaks
+    differently — tests assert the matching band, never silently cross."""
+    return "csv" if any(p and os.path.exists(p)
+                        for p in _default_paths()) else "synthetic"
+
+
 def load_occupancy(test_fraction: float = 0.25, seed: int = 42,
                    path: str | None = None,
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:

@@ -39,7 +39,8 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      process_factory: str = "",
                      factory_kw: Optional[dict] = None,
                      standbys: int = 0, tls_dir: str = "",
-                     quorum: int = 0, attest_scores: bool = False,
+                     quorum: int = 0, bft_validators: int = 0,
+                     attest_scores: bool = False,
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -58,7 +59,8 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     # an error, not a run without them (mirrors the CLI's guards)
     inapplicable = []
     if runtime != "processes":
-        inapplicable += [("standbys", standbys), ("quorum", quorum)]
+        inapplicable += [("standbys", standbys), ("quorum", quorum),
+                         ("bft_validators", bft_validators)]
     if runtime != "executor":
         inapplicable += [("attest_scores", attest_scores)]
     if runtime not in ("processes", "executor") and tls_dir:
@@ -93,7 +95,8 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         return run_federated_processes(
             process_factory, shards, test_set, cfg, rounds=rounds,
             factory_kw=factory_kw or {}, standbys=standbys,
-            tls_dir=tls_dir, quorum=quorum, verbose=verbose)
+            tls_dir=tls_dir, quorum=quorum,
+            bft_validators=bft_validators, verbose=verbose)
     if runtime == "executor":
         if not process_factory:
             raise ValueError("this preset does not support the 'executor' "

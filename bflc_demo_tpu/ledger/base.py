@@ -1,19 +1,64 @@
-"""Shared ledger types: status codes, record views, constants.
+"""Shared ledger types: status codes, record views, constants — and the
+canonical CLIENT-op encoders.
 
 Status codes mirror the guard set of the reference contract
 (CommitteePrecompiled.cpp:215-297) — where the contract silently drops a bad
 transaction after a clog line, this ledger returns a typed status.
+
+The encoders are THE Python definition of the register/upload/scores wire
+bytes (byte-identical to ledger.cpp serialize_*): PyLedger appends through
+them, and comm.bft reconstructs them from request fields to bind commit
+certificates to ops — one definition, so the append path and the
+certificate-binding path cannot drift.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import List
+import struct
+from typing import List, Sequence
 
 import numpy as np
 
 ADDR_CAP = 128   # max address string length crossing the C ABI (incl. NUL)
+
+# op codec opcodes (pyledger mirrors ledger.cpp's table; the full set
+# lives there — only the client-originated three need shared encoders)
+OP_REGISTER, OP_UPLOAD, OP_SCORES = 1, 2, 3
+
+
+def _put_str(b: bytearray, s: str) -> None:
+    raw = s.encode()
+    b += struct.pack("<q", len(raw)) + raw
+
+
+def encode_register_op(addr: str) -> bytes:
+    op = bytearray([OP_REGISTER])
+    _put_str(op, addr)
+    return bytes(op)
+
+
+def encode_upload_op(sender: str, payload_hash: bytes, n_samples: int,
+                     avg_cost: float, epoch: int) -> bytes:
+    op = bytearray([OP_UPLOAD])
+    _put_str(op, sender)
+    op += bytes(payload_hash)
+    op += struct.pack("<q", n_samples)
+    op += struct.pack("<f", np.float32(avg_cost))
+    op += struct.pack("<q", epoch)
+    return bytes(op)
+
+
+def encode_scores_op(sender: str, epoch: int,
+                     scores: Sequence[float]) -> bytes:
+    op = bytearray([OP_SCORES])
+    _put_str(op, sender)
+    op += struct.pack("<q", epoch)
+    op += struct.pack("<q", len(scores))
+    for s in scores:
+        op += struct.pack("<f", np.float32(s))
+    return bytes(op)
 
 
 class LedgerStatus(enum.IntEnum):

@@ -161,6 +161,9 @@ class NativeLedger:
         self._h = lib.bflc_ledger_new(client_num, comm_count, aggregate_count,
                                       needed_update_count, genesis_epoch)
         self._needed = needed_update_count
+        # kept for validate_op's byte-identical Python mirror
+        self._init_args = (client_num, comm_count, aggregate_count,
+                           needed_update_count, genesis_epoch)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -328,6 +331,26 @@ class NativeLedger:
     def apply_op(self, op: bytes) -> LedgerStatus:
         buf = (ctypes.c_uint8 * len(op))(*op)
         return LedgerStatus(self._lib.bflc_apply_op(self._h, buf, len(op)))
+
+    def validate_op(self, op: bytes) -> LedgerStatus:
+        """Would apply_op(op) succeed here, without mutating state?
+
+        The C ABI has no state snapshot, so this replays the full op log
+        into a fresh PyLedger (byte-identical by construction — the
+        differential-tested mirror) and probes there: O(log) per call.
+        BFT validators that validate every op should therefore run the
+        python backend (comm.bft.ValidatorNode defaults to it); this path
+        exists so the surface is complete on both backends.
+        """
+        from bflc_demo_tpu.ledger.pyledger import PyLedger
+        mirror = PyLedger(*self._init_args)
+        for i in range(self.log_size()):
+            st = mirror.apply_op(self.log_op(i))
+            if st != LedgerStatus.OK:       # cannot happen on a valid chain
+                raise RuntimeError(
+                    f"native->python mirror replay rejected op {i}: "
+                    f"{st.name}")
+        return mirror.validate_op(op)
 
     # --- write-ahead log ---
     def attach_wal(self, path: str) -> bool:

@@ -16,7 +16,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from bflc_demo_tpu.ledger.base import LedgerStatus, UpdateInfo, PendingInfo
+from bflc_demo_tpu.ledger.base import (LedgerStatus, PendingInfo,
+                                       UpdateInfo, encode_register_op,
+                                       encode_scores_op, encode_upload_op)
 
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES, _OP_COMMIT = 1, 2, 3, 4
 _OP_CLOSE, _OP_FORCE, _OP_RESEAT, _OP_PROMOTE = 5, 6, 7, 8
@@ -123,9 +125,7 @@ class PyLedger:
             return LedgerStatus.ALREADY_REGISTERED
         self._roles[addr] = "trainer"
         self._reg_order.append(addr)
-        op = bytearray([_OP_REGISTER])
-        _put_str(op, addr)
-        self._append_log(bytes(op))
+        self._append_log(encode_register_op(addr))
         if (len(self._reg_order) == self.client_num
                 and self._epoch == self.genesis_epoch):
             for a in self._reg_order[: self.comm_count]:
@@ -160,13 +160,8 @@ class PyLedger:
         self._update_slot[sender] = len(self._updates)
         self._updates.append(UpdateInfo(sender, bytes(payload_hash),
                                         n_samples, float(avg_cost)))
-        op = bytearray([_OP_UPLOAD])
-        _put_str(op, sender)
-        op += bytes(payload_hash)
-        op += struct.pack("<q", n_samples)
-        op += struct.pack("<f", np.float32(avg_cost))
-        op += struct.pack("<q", epoch)
-        self._append_log(bytes(op))
+        self._append_log(encode_upload_op(sender, payload_hash, n_samples,
+                                          avg_cost, epoch))
         return LedgerStatus.OK
 
     def upload_scores(self, sender: str, epoch: int,
@@ -194,13 +189,7 @@ class PyLedger:
         if self._pending is not None:
             return LedgerStatus.NOT_READY
         self._scores[sender] = vals
-        op = bytearray([_OP_SCORES])
-        _put_str(op, sender)
-        op += struct.pack("<q", epoch)
-        op += struct.pack("<q", len(scores))
-        for s in scores:
-            op += struct.pack("<f", np.float32(s))
-        self._append_log(bytes(op))
+        self._append_log(encode_scores_op(sender, epoch, scores))
         self._maybe_fire()
         return LedgerStatus.OK
 
@@ -403,6 +392,44 @@ class PyLedger:
 
     def log_op(self, i: int) -> bytes:
         return self._ops[i]
+
+    # --- validate-without-apply (the BFT validator hook, comm.bft) ---
+    def _snapshot(self):
+        """Cheap copy of every mutable field apply_op can touch.  Lists of
+        frozen dataclasses copy shallowly; score rows copy per-row because
+        upload_scores stores caller lists."""
+        return (self._epoch, self._model_hash, self._last_loss,
+                list(self._reg_order), dict(self._roles),
+                list(self._updates), dict(self._update_slot),
+                {k: list(v) for k, v in self._scores.items()},
+                self._pending, self._closed, self._generation,
+                self._writer_index, len(self._ops))
+
+    def _restore(self, snap) -> None:
+        (self._epoch, self._model_hash, self._last_loss, self._reg_order,
+         self._roles, self._updates, self._update_slot, self._scores,
+         self._pending, self._closed, self._generation,
+         self._writer_index, n_ops) = snap
+        del self._ops[n_ops:]
+        del self._log[n_ops:]
+
+    def validate_op(self, op: bytes) -> LedgerStatus:
+        """Would `apply_op(op)` succeed HERE, without mutating state?
+
+        The BFT validator primitive: a replica independently re-executes
+        the decision procedure (epoch/role/cap/duplicate guards — the exact
+        guard set apply_op runs) against its own state and reports the
+        status, leaving its chain untouched either way.  Deterministic:
+        equal replicas return equal statuses for equal ops.  The WAL is
+        detached for the probe so a validation never journals anything.
+        """
+        snap = self._snapshot()
+        wal, self._wal = self._wal, None
+        try:
+            return self.apply_op(op)
+        finally:
+            self._restore(snap)
+            self._wal = wal
 
     def apply_op(self, op: bytes) -> LedgerStatus:
         """Deterministic replay of a serialized op (replica path)."""

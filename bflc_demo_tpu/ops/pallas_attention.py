@@ -445,7 +445,7 @@ def sharded_flash_attention(mesh, q, k, v, kv_mask, *, head_axis: str,
     q/k/v: (B, S, H, Dh) global; kv_mask: (B, S_kv).  H must divide the
     head-axis size (and B the batch-axis size when given).
     """
-    from jax import shard_map
+    from bflc_demo_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     b_spec = batch_axis

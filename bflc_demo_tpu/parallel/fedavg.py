@@ -26,14 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from bflc_demo_tpu.utils.compat import shard_map
 
 from bflc_demo_tpu.core.aggregate import median_scores, rank_desc_stable
 from bflc_demo_tpu.core.local_train import local_train_impl
 from bflc_demo_tpu.core.losses import accuracy
 from bflc_demo_tpu.ops.fingerprint import (fingerprint_pytree,
                                            fingerprint_stacked)
-from bflc_demo_tpu.parallel.mesh import pvary_compat
+from bflc_demo_tpu.parallel.mesh import leaf_vma, pvary_compat
 
 Pytree = Any
 ApplyFn = Callable[[Pytree, jax.Array], jax.Array]
@@ -50,7 +50,7 @@ def _ensure_varying(tree: Pytree, axis: str = AXIS) -> Pytree:
     trace-time metadata, so normalising it here is purely a type-level fix.
     """
     def fix(leaf):
-        if axis not in jax.typeof(leaf).vma:
+        if axis not in leaf_vma(leaf):
             return pvary_compat(leaf, (axis,))
         return leaf
     return jax.tree_util.tree_map(fix, tree)

@@ -24,14 +24,29 @@ def local_device_count() -> int:
 
 def pvary_compat(x, axis_names: Tuple[str, ...]):
     """Mark a value device-varying over axes, across jax's pvary->pcast
-    rename (pvary deprecated in 0.9; pcast is its replacement)."""
+    rename (pvary deprecated in 0.9; pcast is its replacement).  On jax
+    versions predating the vma type system (< 0.5) there is no annotation
+    to normalise and every value is implicitly varying: identity."""
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         try:
             return pcast(x, axis_names, to="varying")
         except TypeError:
             pass
-    return jax.lax.pvary(x, axis_names)
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axis_names)
+    return x
+
+
+def leaf_vma(leaf) -> frozenset:
+    """The axes `leaf` is annotated device-varying over; empty on jax
+    versions without the vma type system (callers then rely on
+    pvary_compat's identity fallback — nothing needs fixing)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset(getattr(typeof(leaf), "vma", ()) or ())
 
 
 def make_mesh(shape: Sequence[int], axis_names: Sequence[str],

@@ -49,7 +49,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from bflc_demo_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bflc_demo_tpu.models.transformer import (TransformerConfig,

@@ -22,7 +22,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from bflc_demo_tpu.utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bflc_demo_tpu.models.transformer import (TransformerConfig, NEG_INF,
@@ -57,7 +57,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if impl != "einsum":
         raise ValueError(f"impl must be einsum|pallas|pallas_interpret, "
                          f"got {impl!r}")
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     b, s, h, dh = q.shape
     scale = 1.0 / np.sqrt(dh)
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
@@ -108,7 +108,7 @@ def _ring_pallas_fwd_impl(q, k, v, kv_mask, axis_name, interpret):
     from bflc_demo_tpu.ops.pallas_attention import flash_attention_carry
     from bflc_demo_tpu.parallel.mesh import pvary_compat
 
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     b, s, h, dh = q.shape
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
     blk = 128

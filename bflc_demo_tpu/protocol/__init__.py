@@ -6,11 +6,18 @@ no consistency check.  Here the protocol genome lives in exactly one place
 (`constants.ProtocolConfig`) and every other layer imports it.
 """
 
-from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL  # noqa: F401
+from bflc_demo_tpu.protocol.constants import (  # noqa: F401
+    ProtocolConfig,
+    DEFAULT_PROTOCOL,
+    BFT_REFERENCE_VALIDATORS,
+    bft_fault_tolerance,
+    bft_quorum,
+)
 from bflc_demo_tpu.protocol.types import (  # noqa: F401
     Role,
     UpdateMeta,
     LocalUpdate,
     ScoreVector,
+    CommitCertificate,
     RoundResult,
 )

@@ -76,3 +76,37 @@ class ProtocolConfig:
 
 
 DEFAULT_PROTOCOL = ProtocolConfig().validate()
+
+
+# --- BFT commit-certificate geometry (reference: 4-node PBFT chain) -------
+#
+# The reference's substrate is a 4-node PBFT group: every state mutation
+# executes on all nodes and commits only with a 2f+1 quorum, so one
+# arbitrarily faulty node (f=1 at n=4) can neither fork history nor bind
+# fabricated state (README.md:162-183).  The TPU-native equivalent is the
+# commit-certificate layer (comm.bft): n validators independently re-execute
+# each op against their own replicas and co-sign; an op binds only with a
+# quorum certificate.  These two functions are the ONE place the quorum
+# arithmetic lives — writer, validators, standbys and clients must agree on
+# it exactly, or a correct deployment could deadlock (writer waiting for
+# more signatures than can exist) or, worse, accept thin certificates.
+
+BFT_REFERENCE_VALIDATORS = 4    # the reference chain's node count (f=1)
+
+
+def bft_fault_tolerance(n_validators: int) -> int:
+    """f: how many arbitrarily faulty validators n can tolerate (PBFT
+    n >= 3f+1, so f = floor((n-1)/3); n=4 -> f=1, the reference geometry).
+    n < 4 gives f=0: certificates still bind ops to independent
+    re-execution, but a single lying validator can block certification."""
+    if n_validators < 1:
+        raise ValueError(f"need at least 1 validator, got {n_validators}")
+    return (n_validators - 1) // 3
+
+
+def bft_quorum(n_validators: int) -> int:
+    """Signatures required for a commit certificate: n - f (== 2f+1 at the
+    exact n = 3f+1 geometries).  Any two quorums intersect in >= f+1
+    validators, at least one honest — two conflicting ops at the same chain
+    position can therefore never both certify (the no-fork argument)."""
+    return n_validators - bft_fault_tolerance(n_validators)

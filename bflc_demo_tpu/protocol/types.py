@@ -74,6 +74,49 @@ class ScoreVector:
 
 
 @dataclasses.dataclass(frozen=True)
+class CommitCertificate:
+    """Quorum proof that one op bound at one chain position (comm.bft).
+
+    The BFT equivalent of the reference's PBFT commit: `sigs` holds
+    Ed25519 signatures by distinct validators, each over the canonical
+    payload binding (index, chain head BEFORE the op, the op bytes'
+    digest, chain head AFTER the op) — see comm.bft.cert_payload.  An op
+    carries a valid certificate only if >= bft_quorum(n) validators
+    independently re-executed it against their own replicas and agreed on
+    the SAME prefix and result; two conflicting ops at one index can never
+    both certify (quorum intersection contains an honest validator, and an
+    honest validator votes at most once per index).
+    """
+
+    index: int                          # chain position of the op
+    prev_head: bytes                    # head digest before the op (32B)
+    op_hash: bytes                      # sha256 of the canonical op bytes
+    new_head: bytes                     # head digest after the op (32B)
+    sigs: Dict[int, bytes] = dataclasses.field(default_factory=dict)
+    # ^ validator index -> Ed25519 signature over cert_payload(...)
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {"i": self.index, "prev": self.prev_head.hex(),
+                "op_hash": self.op_hash.hex(), "head": self.new_head.hex(),
+                "sigs": {str(v): s.hex() for v, s in self.sigs.items()}}
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "CommitCertificate":
+        """Parse a peer-supplied dict; raises ValueError on malformed input
+        (callers at trust boundaries catch and treat as no-certificate)."""
+        try:
+            sigs = {int(v): bytes.fromhex(s)
+                    for v, s in dict(d["sigs"]).items()}
+            return cls(index=int(d["i"]),
+                       prev_head=bytes.fromhex(d["prev"]),
+                       op_hash=bytes.fromhex(d["op_hash"]),
+                       new_head=bytes.fromhex(d["head"]),
+                       sigs=sigs)
+        except (KeyError, TypeError, AttributeError) as e:
+            raise ValueError(f"malformed commit certificate: {e}") from e
+
+
+@dataclasses.dataclass(frozen=True)
 class RoundResult:
     """Outcome of one aggregation (reference Aggregate, .cpp:349-456)."""
 

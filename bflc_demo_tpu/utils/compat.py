@@ -1,0 +1,38 @@
+"""Version shims for the jax API surface this repo straddles.
+
+The parallel plane was written against the promoted `jax.shard_map`
+(`check_vma=` spelling); older toolchains ship it as
+`jax.experimental.shard_map.shard_map` with the `check_rep=` spelling and
+identical semantics for everything this repo uses (mesh/in_specs/out_specs,
+replication-check opt-out).  Every shard_map import in the tree goes
+through this ONE shim so an API move is a one-line fix, not a 6-file sweep.
+"""
+
+from __future__ import annotations
+
+try:                                    # jax >= 0.5: promoted to the top level
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                     # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """`jax.shard_map` with the `check_vma` spelling on every jax version
+    (mapped to `check_rep` where the older experimental API expects it)."""
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis from inside a shard_map body.
+    `jax.lax.axis_size` where it exists; `psum(1, axis)` — the historical
+    idiom, constant-folded to a Python int — on older jax."""
+    import jax
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -37,6 +37,7 @@ class RunOptions:
     standbys: int = 0                # processes runtime: hot standbys
     tls_dir: str = ""                # processes runtime: TLS cert dir
     quorum: int = 0                  # processes runtime: quorum-ack
+    bft_validators: int = 0          # processes runtime: BFT commit quorum
     attest_scores: bool = False      # executor runtime: score attestation
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True

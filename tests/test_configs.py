@@ -40,8 +40,13 @@ def test_config3_sampled_participation_tiny():
     _check(res, 2, 30, 3, 2)
 
 
+@pytest.mark.slow
 def test_config4_resnet_tiny():
-    """ResNet path with active participation + chunked remat training."""
+    """ResNet path with active participation + chunked remat training.
+
+    slow tier: ~2 min of CPU XLA compile for the remat ResNet program —
+    the two config4 tiny runs alone would eat a third of the tier-1 time
+    budget on a 2-core box (measured 132 s + 192 s of a 870 s budget)."""
     from bflc_demo_tpu.client import run_federated_mesh
     from bflc_demo_tpu.models import make_resnet18
     from bflc_demo_tpu.data.synthetic import synthetic_image_classification
@@ -54,11 +59,13 @@ def test_config4_resnet_tiny():
     _check(res, 1, 8, 3, 2)
 
 
+@pytest.mark.slow
 def test_config4_secure_tiny():
     """configs[3]'s secure-aggregation variant end-to-end: ResNet path with
     X25519-masked merge through active participation + chunked remat (the
-    exact plumbing config4(secure=True) selects, at CI-affordable shapes —
-    full-shape preset coverage is the slow tier below)."""
+    exact plumbing config4(secure=True) selects).  slow tier: see
+    test_config4_resnet_tiny — the masked-merge compile is the priciest
+    program in the suite (192 s measured on the 2-core CI box)."""
     from bflc_demo_tpu.client import run_federated_mesh
     from bflc_demo_tpu.comm.identity import provision_wallets
     from bflc_demo_tpu.models import make_resnet18

@@ -764,6 +764,99 @@ class TestQuorumAck:
                 sb.stop()
             srv.close()
 
+    def test_skipped_blob_is_not_certified_by_a_later_ack(self):
+        """ADVICE r5 (medium): acks are CUMULATIVE watermarks, so when the
+        blob fetch for upload op i transiently fails, acking any later op
+        j>i would silently certify op i as quorum-durable WITHOUT its
+        payload.  The standby must clamp every outgoing ack below the
+        lowest unmirrored upload index and retry the fetch — on the
+        pre-fix code this test fails at the REPLICATION_TIMEOUT
+        assertions (the later upload's ack covers the skipped one)."""
+        import hashlib as hl
+
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        from bflc_demo_tpu.ledger.tool import decode_op
+
+        class _FlakyBlobStandby(Standby):
+            """Injects transient blob-fetch failure for chosen digests."""
+
+            def __init__(self, *a, **kw):
+                self.fail_digests = set()       # payload-hash hex strings
+                super().__init__(*a, **kw)
+
+            def _mirror_upload_payload(self, op_bytes, ctl):
+                if op_bytes and op_bytes[0] == self._UPLOAD_OPCODE:
+                    try:
+                        ph = decode_op(op_bytes).get("payload_hash")
+                    except Exception:
+                        ph = None
+                    if ph in self.fail_digests:
+                        return False
+                return super()._mirror_upload_payload(op_bytes, ctl)
+
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           quorum=1, quorum_timeout_s=1.5)
+        srv.start()
+        standby = _FlakyBlobStandby(
+            CFG, [(srv.host, srv.port), ("127.0.0.1", 0)], 1,
+            heartbeat_s=0.3, stall_timeout_s=60.0, require_auth=False,
+            ledger_backend="python")
+        standby.endpoints[1] = (standby.host, standby.port)
+        threading.Thread(target=standby.run, daemon=True).start()
+        c = CoordinatorClient(srv.host, srv.port, timeout_s=20.0)
+        try:
+            deadline = time.monotonic() + 10
+            while not srv._sub_acked:
+                assert time.monotonic() < deadline, "standby never followed"
+                time.sleep(0.05)
+            for i in range(CFG.client_num):
+                assert c.request("register", addr=f"0x{i:040x}")["ok"]
+            committee = set(c.request("committee")["committee"])
+            trainers = [f"0x{i:040x}" for i in range(CFG.client_num)
+                        if f"0x{i:040x}" not in committee]
+            blob_a, blob_b = _delta_blob(1.0), _delta_blob(2.0)
+            dig_a = hl.sha256(blob_a).digest()
+            dig_b = hl.sha256(blob_b).digest()
+
+            # upload A's blob fetch fails transiently on the standby
+            standby.fail_digests.add(dig_a.hex())
+            r = c.request("upload", addr=trainers[0], blob=blob_a.hex(),
+                          hash=dig_a.hex(), n=10, cost=1.0, epoch=0)
+            assert r["status"] == "REPLICATION_TIMEOUT", r
+
+            # upload B mirrors fine; its ack must NOT cover A
+            r = c.request("upload", addr=trainers[1], blob=blob_b.hex(),
+                          hash=dig_b.hex(), n=11, cost=1.0, epoch=0)
+            assert r["status"] == "REPLICATION_TIMEOUT", \
+                f"later upload's ack leaked past the unmirrored blob: {r}"
+            # the A retry must STILL not report durable (pre-fix it
+            # answers DUPLICATE here because B's watermark covered it)
+            r = c.request("upload", addr=trainers[0], blob=blob_a.hex(),
+                          hash=dig_a.hex(), n=10, cost=1.0, epoch=0)
+            assert r["status"] == "REPLICATION_TIMEOUT", \
+                f"skipped upload certified without its payload: {r}"
+            assert standby._blobs.get(dig_a) is None
+
+            # the transient failure heals -> the standby retries the
+            # fetch, the clamp lifts, and the acks catch up cumulatively
+            standby.fail_digests.clear()
+            deadline = time.monotonic() + 20
+            while True:
+                r = c.request("upload", addr=trainers[0],
+                              blob=blob_a.hex(), hash=dig_a.hex(), n=10,
+                              cost=1.0, epoch=0)
+                if r["status"] == "DUPLICATE":
+                    break               # durably replicated: rejected-but-in
+                assert time.monotonic() < deadline, r
+                time.sleep(0.3)
+            assert standby._blobs.get(dig_a) == blob_a
+            assert standby._blobs.get(dig_b) == blob_b
+        finally:
+            c.close()
+            standby.stop()
+            srv.close()
+
     def test_acknowledged_upload_payload_is_on_the_standby(self):
         """Round-5 review: the ack must cover the upload's PAYLOAD, not
         just the op — an acknowledged uploader never retries, so a

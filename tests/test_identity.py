@@ -201,3 +201,70 @@ class TestIdentity:
                                 0) == LedgerStatus.OK
         assert led.epoch == 1
         assert led.verify_log()
+
+
+class TestPure25519Backend:
+    """The from-first-principles Ed25519/X25519 fallback (comm.pure25519)
+    must BE the RFC algorithms — pinned against the published test vectors
+    — and byte-compatible with the `cryptography` backend wherever both
+    exist, so wallets interoperate across hosts."""
+
+    def test_ed25519_rfc8032_vectors(self):
+        from bflc_demo_tpu.comm import pure25519 as p
+        sk = bytes.fromhex("9d61b19deffd5a60ba844af492ec2cc4"
+                           "4449c5697b326919703bac031cae7f60")
+        pk = bytes.fromhex("d75a980182b10ab7d54bfed3c964073a"
+                           "0ee172f3daa62325af021a68f707511a")
+        sig = bytes.fromhex(
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e0652249"
+            "01555fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe2465514143"
+            "8e7a100b")
+        assert p.ed25519_public(sk) == pk
+        assert p.ed25519_sign(sk, b"") == sig
+        assert p.ed25519_verify(pk, b"", sig)
+        assert not p.ed25519_verify(pk, b"x", sig)
+        sk2 = bytes.fromhex("4ccd089b28ff96da9db6c346ec114e0f"
+                            "5b8a319f35aba624da8cf6ed4fb8a6fb")
+        pk2 = bytes.fromhex("3d4017c3e843895a92b70aa74d1b7ebc"
+                            "9c982ccf2ec4968cc0cd55f12af4660c")
+        sig2 = bytes.fromhex(
+            "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb"
+            "69da085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d2916"
+            "12bb0c00")
+        assert p.ed25519_public(sk2) == pk2
+        assert p.ed25519_sign(sk2, b"\x72") == sig2
+        # malformed inputs are False, never exceptions
+        assert not p.ed25519_verify(b"\xff" * 32, b"", sig)
+        assert not p.ed25519_verify(pk, b"", b"\x00" * 64)
+        assert not p.ed25519_verify(pk, b"", sig[:-1])
+
+    def test_x25519_rfc7748_vector_and_dh_symmetry(self):
+        from bflc_demo_tpu.comm import pure25519 as p
+        k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"
+                          "62144c0ac1fc5a18506a2244ba449ac4")
+        u = bytes.fromhex("e6db6867583030db3594c1a424b15f7c"
+                          "726624ec26b3353b10a903a6d0ab1c4c")
+        out = bytes.fromhex("c3da55379de9c6908e94ea4df28d084f"
+                            "32eccf03491c71f754b4075577a28552")
+        assert p.x25519_exchange(k, u) == out
+        import hashlib
+        a = hashlib.sha256(b"dh-a").digest()
+        b = hashlib.sha256(b"dh-b").digest()
+        assert p.x25519_exchange(a, p.x25519_public(b)) == \
+            p.x25519_exchange(b, p.x25519_public(a))
+
+    def test_backends_interoperate_when_both_exist(self):
+        from bflc_demo_tpu.comm import identity as idm
+        from bflc_demo_tpu.comm import pure25519 as p
+        w = Wallet.from_seed(b"xbackend-1")
+        msg = b"cross-backend message"
+        sig = w.sign(msg)
+        # the pure backend verifies whatever the active backend signed
+        assert p.ed25519_verify(w.public_bytes, msg, sig)
+        # and the chokepoint agrees with it
+        assert idm.verify_signature(w.public_bytes, msg, sig)
+        if idm.ED25519_BACKEND == "cryptography":
+            # same seed -> same keys/sigs under both implementations
+            assert p.ed25519_public(w._sign_sk) == w.public_bytes
+            assert p.ed25519_sign(w._sign_sk, msg) == sig
+            assert p.x25519_public(w._dh_sk) == w.dh_public_bytes

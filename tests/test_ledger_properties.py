@@ -12,7 +12,10 @@ round").
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property fuzzing needs the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus, bindings
 from bflc_demo_tpu.protocol import ProtocolConfig

@@ -5,7 +5,10 @@ import hashlib
 import struct
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip(
+    "hypothesis", reason="fuzz cases here need the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.ledger.tool import main, iter_wal_ops, decode_op

@@ -59,7 +59,7 @@ def _host_proc(pid: int, nprocs: int, coord_port: int, cfg_kw: dict,
     try:
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from bflc_demo_tpu.utils.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         jax.config.update("jax_platforms", "cpu")

@@ -197,7 +197,7 @@ class TestCommitteeScoring:
         FLOPs dominate the committee path's gather/scatter bookkeeping —
         on the 10-parameter softmax model the bookkeeping is the bigger
         term and the ratio says nothing about eval scheduling."""
-        from jax import shard_map
+        from bflc_demo_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
         from bflc_demo_tpu.eval.mfu import cost_analysis_flops
         from bflc_demo_tpu.models import make_mlp

@@ -49,7 +49,7 @@ class TestRingPallasComposition:
 
     @pytest.mark.parametrize("n_sp", [2, 4])
     def test_pallas_ring_matches_einsum_ring(self, n_sp):
-        from jax import shard_map
+        from bflc_demo_tpu.utils.compat import shard_map
         mesh = make_mesh((n_sp,), (SP_AXIS,))
         rng = np.random.default_rng(13)
         q, k, v, mask = self._shard_qkv(rng, mesh)
@@ -71,7 +71,7 @@ class TestRingPallasComposition:
     def test_pallas_ring_gradients(self):
         """The custom vjp (einsum-ring recompute) produces the einsum
         ring's exact gradients."""
-        from jax import shard_map
+        from bflc_demo_tpu.utils.compat import shard_map
         mesh = make_mesh((2,), (SP_AXIS,))
         rng = np.random.default_rng(14)
         q, k, v, mask = self._shard_qkv(rng, mesh, s=32)
@@ -285,10 +285,15 @@ class TestSPTrainStep:
         labels = jnp.asarray(np.eye(model.config.num_classes,
                                     dtype=np.float32)[
             rng.integers(0, model.config.num_classes, 8)])
-        step = make_sp_train_step(mesh, model.config, lr=0.5)
+        # 15 steps at lr=0.1: the 5-step window the bar originally used is
+        # init-sensitive — jax PRNG draws differ across versions, and some
+        # inits transiently overshoot before descending (gradient EXACTNESS
+        # is pinned separately by test_matches_single_device_step; this bar
+        # is about learning, so give it a learning-scale window)
+        step = make_sp_train_step(mesh, model.config, lr=0.1)
         params = self._rand_head(model.init_params(6), seed=6)
         losses = []
-        for _ in range(5):
+        for _ in range(15):
             params, loss = step(params, tokens, labels)
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses

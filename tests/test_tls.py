@@ -13,6 +13,12 @@ import struct
 import numpy as np
 import pytest
 
+# cert provisioning is x509, which has no pure-Python fallback (unlike the
+# Ed25519/X25519 identity layer, comm.pure25519) — skip rather than fail on
+# hosts without the cryptography wheel
+pytest.importorskip("cryptography",
+                    reason="TLS cert provisioning needs cryptography.x509")
+
 from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                LedgerServer, replicate)
 from bflc_demo_tpu.comm.tls import (client_context, provision_tls,
