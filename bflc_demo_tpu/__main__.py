@@ -67,25 +67,45 @@ def main(argv=None) -> int:
                       f"gives f=0 (no Byzantine tolerance); the "
                       f"reference geometry is 4", file=sys.stderr)
             kw["bft_validators"] = opts.bft_validators
-        if opts.attest_scores:
+        if opts.chaos_seed >= 0:
+            # the seeded fault campaign (bflc_demo_tpu.chaos): randomized
+            # kills/partitions/delays with invariant monitors; replay any
+            # failure with the same --chaos-seed
+            kw["chaos_seed"] = opts.chaos_seed
+            kw["chaos_profile"] = opts.chaos_profile
+        if opts.attest_scores is not None:
             # never silently drop a requested trust feature
-            print("--attest-scores applies to --runtime executor",
+            print("--attest-scores applies to the mesh/executor runtimes",
                   file=sys.stderr)
             return 2
     elif opts.runtime == "executor":
         if opts.tls_dir:
             kw["tls_dir"] = opts.tls_dir
-        if opts.attest_scores:
-            kw["attest_scores"] = True
-        if opts.standbys or opts.quorum or opts.bft_validators:
-            print("--standbys/--quorum/--bft-validators apply to "
-                  "--runtime processes", file=sys.stderr)
+        if opts.attest_scores is not None:
+            kw["attest_scores"] = opts.attest_scores
+        if opts.standbys or opts.quorum or opts.bft_validators \
+                or opts.chaos_seed >= 0:
+            print("--standbys/--quorum/--bft-validators/--chaos-seed "
+                  "apply to --runtime processes", file=sys.stderr)
             return 2
+    elif opts.runtime == "mesh" and opts.attest_scores is not None \
+            and not (opts.standbys or opts.tls_dir or opts.quorum
+                     or opts.bft_validators or opts.chaos_seed >= 0):
+        if opts.attest_scores and not opts.secure:
+            # mesh attestation signs with wallets; only the config4
+            # --secure preset provisions them from the CLI.  Fail with
+            # guidance, not a mid-run ValueError traceback.
+            print("--attest-scores on the mesh runtime needs wallets: "
+                  "use --config config4 --secure, or --runtime executor "
+                  "(attestation is default-on there)", file=sys.stderr)
+            return 2
+        kw["attest_scores"] = opts.attest_scores
     elif opts.standbys or opts.tls_dir or opts.quorum \
-            or opts.attest_scores or opts.bft_validators:
+            or opts.attest_scores is not None or opts.bft_validators \
+            or opts.chaos_seed >= 0:
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
-              "--attest-scores apply to the processes/executor runtimes",
-              file=sys.stderr)
+              "--chaos-seed apply to the processes runtime; "
+              "--attest-scores to mesh/executor", file=sys.stderr)
         return 2
     if opts.secure:
         if opts.config != "config4":

@@ -1,0 +1,35 @@
+"""Fault-injection subsystem: seeded chaos campaigns against the real
+process federation (Jepsen-style randomized fault schedules with
+continuous invariant checking).
+
+The reference's whole reason to exist is that a PBFT chain keeps
+federated training live and un-forked while nodes fail; asserting that is
+cheap, demonstrating it is not.  This package closes the gap between
+asserted and demonstrated fault tolerance:
+
+- `schedule.FaultSchedule` — a deterministic fault campaign, replayable
+  from a single integer seed: process kills/restarts (writer, clients,
+  standbys, validators), network partition/heal windows, message
+  delay/drop windows at the socket boundary, torn-write injection at the
+  WAL;
+- `hooks.FaultInjector` — the wire-level half, installed process-locally
+  (comm.wire consults it on every frame);
+- `invariants.InvariantMonitor` — continuous checks while the campaign
+  runs: monotone epoch/generation progress, exactly one surviving
+  certified history (writer chain vs every validator replica), no
+  uncertified op binding, every acked upload durable with its blob;
+- `campaign.ChaosCampaign` — the driver that executes schedule events
+  against a live process federation and collects the report
+  (client/process_runtime.run_federated_processes(chaos_seed=...)).
+
+`tools/chaos_soak.py` runs a full campaign and emits a JSON artifact
+(seed, faults injected, invariant verdicts, final accuracy) so any
+failure is replayable by seed.
+"""
+
+from bflc_demo_tpu.chaos.schedule import FaultEvent, FaultSchedule, PROFILES
+from bflc_demo_tpu.chaos.hooks import FaultInjector, install_injector
+from bflc_demo_tpu.chaos.invariants import InvariantMonitor
+
+__all__ = ["FaultEvent", "FaultSchedule", "PROFILES", "FaultInjector",
+           "install_injector", "InvariantMonitor"]

@@ -1,0 +1,219 @@
+"""Campaign driver: executes a FaultSchedule against a live federation.
+
+Owned by client/process_runtime.run_federated_processes(chaos_seed=...):
+the parent registers every spawned role with a respawn thunk, then calls
+`tick()` from its sponsor poll loop — the driver fires due events (kills,
+restarts, WAL tears), runs the periodic invariant checks, and supervises
+the client fleet (a client that died to a fault storm is respawned, so a
+100-round campaign measures recovery, not attrition).  `finish()` waits
+out the settle tail, runs the strict final invariant checks, and returns
+the campaign report that rides on ProcessFederationResult.chaos_report.
+
+Execution-time safety rules (the schedule is generated blind; the driver
+sees the live fleet): a writer kill is skipped unless a standby with an
+index above the CURRENT writer remains alive to promote; a standby
+restart below the current writer index is skipped (it could never win an
+election it would try to claim); validator kills keep at most f
+concurrently dead.  Skipped events are reported, not hidden.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+from bflc_demo_tpu.chaos.invariants import (InvariantMonitor, load_ack_logs,
+                                            wait_certified)
+from bflc_demo_tpu.chaos.schedule import FaultSchedule
+
+
+class RoleHandle:
+    """A respawnable child process: role name + spawn thunk + live proc."""
+
+    def __init__(self, role: str, spawn_fn: Callable, proc):
+        self.role = role
+        self.spawn_fn = spawn_fn
+        self.proc = proc
+        self.restartable = True
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.is_alive()
+
+    def kill(self) -> None:
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.join(timeout=10)
+
+    def respawn(self) -> None:
+        self.proc = self.spawn_fn()
+
+
+class ChaosCampaign:
+    def __init__(self, schedule: FaultSchedule,
+                 monitor: InvariantMonitor, *, t0: float,
+                 wal_path: str = "", history_every_s: float = 4.0,
+                 verbose: bool = False):
+        self.schedule = schedule
+        self.monitor = monitor
+        self.t0 = t0
+        self.wal_path = wal_path
+        self.history_every_s = history_every_s
+        self.verbose = verbose
+        self.handles: Dict[str, RoleHandle] = {}
+        self._pending = list(schedule.events)       # sorted by t
+        self._last_history = 0.0
+        self._writer_index = 0                      # from the last info
+        self.executed: List[dict] = []
+        self.skipped: List[dict] = []
+        self.client_respawns = 0
+
+    # ------------------------------------------------------------ wiring
+    def register(self, role: str, spawn_fn: Callable, proc) -> None:
+        self.handles[role] = RoleHandle(role, spawn_fn, proc)
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[chaos +{time.time() - self.t0:6.1f}s] {msg}",
+                  flush=True)
+
+    # ------------------------------------------------------------- events
+    def _current_writer_role(self) -> str:
+        return ("writer" if self._writer_index == 0
+                else f"standby-{self._writer_index}")
+
+    def _skip(self, ev, why: str) -> None:
+        self.skipped.append({**ev.as_dict(), "why": why})
+        self._log(f"SKIP {ev.kind} {ev.target}: {why}")
+
+    def _exec_kill(self, ev) -> None:
+        target = ev.target
+        if target == "writer":
+            target = self._current_writer_role()
+            promotable = [h for r, h in self.handles.items()
+                          if r.startswith("standby-") and h.alive()
+                          and int(r.split("-")[1]) > self._writer_index]
+            if not promotable:
+                return self._skip(ev, "no promotable standby remains")
+        elif target.startswith("standby-") and \
+                int(target.split("-")[1]) == self._writer_index:
+            # the scheduled standby has since PROMOTED: this is a writer
+            # kill in disguise — apply the ladder rule or the campaign
+            # would decapitate the deployment with nobody to promote
+            promotable = [h for r, h in self.handles.items()
+                          if r.startswith("standby-") and h.alive()
+                          and int(r.split("-")[1]) > self._writer_index]
+            if not promotable:
+                return self._skip(ev, "target is the current writer and "
+                                      "no promotable standby remains")
+        h = self.handles.get(target)
+        if h is None or not h.alive():
+            return self._skip(ev, "target not alive")
+        if ev.target == "writer" or (
+                target.startswith("standby-")
+                and int(target.split("-")[1]) == self._writer_index):
+            # a killed writer never restarts: fencing makes its identity
+            # unserviceable; the ladder continues through the standbys
+            h.restartable = False
+        if target.startswith("validator-"):
+            dead = [r for r, hh in self.handles.items()
+                    if r.startswith("validator-") and not hh.alive()]
+            f = max((self.schedule.n_validators - 1) // 3, 0)
+            if len(dead) >= f:
+                return self._skip(ev, f"{len(dead)} validators already "
+                                      f"dead (f={f})")
+        h.kill()
+        self.executed.append(ev.as_dict())
+        self._log(f"KILL {target}")
+
+    def _exec_restart(self, ev) -> None:
+        h = self.handles.get(ev.target)
+        if h is None or h.alive():
+            return self._skip(ev, "target missing or still alive")
+        if not h.restartable:
+            return self._skip(ev, "role is fenced (was a writer)")
+        if ev.target.startswith("standby-") and \
+                int(ev.target.split("-")[1]) <= self._writer_index:
+            return self._skip(ev, "index at or below the current writer")
+        try:
+            h.respawn()
+        except Exception as e:          # noqa: BLE001 — a failed respawn
+            # is a campaign observation, not a driver crash
+            return self._skip(ev, f"respawn failed: {e}")
+        self.executed.append(ev.as_dict())
+        self._log(f"RESTART {ev.target}")
+
+    def _exec_tear_wal(self, ev) -> None:
+        from bflc_demo_tpu.chaos.hooks import tear_wal_tail
+        if not self.wal_path:
+            return self._skip(ev, "no WAL attached")
+        if tear_wal_tail(self.wal_path):
+            self.executed.append(ev.as_dict())
+            self._log("TEAR WAL tail")
+        else:
+            self._skip(ev, "WAL too small to tear")
+
+    # --------------------------------------------------------------- tick
+    def tick(self, probe, info: dict) -> None:
+        """Run from the sponsor poll loop: fire due events, keep the
+        invariant monitor fed, supervise the client fleet."""
+        try:
+            self._writer_index = int(info.get("writer_index", 0))
+        except (TypeError, ValueError):
+            pass
+        self.monitor.observe_info(info)
+        now = time.time() - self.t0
+        while self._pending and self._pending[0].t <= now:
+            ev = self._pending.pop(0)
+            if ev.kind == "kill":
+                self._exec_kill(ev)
+            elif ev.kind == "restart":
+                self._exec_restart(ev)
+            elif ev.kind == "tear_wal":
+                self._exec_tear_wal(ev)
+            else:
+                self._skip(ev, f"unknown event kind {ev.kind!r}")
+        if now - self._last_history >= self.history_every_s:
+            self._last_history = now
+            try:
+                self.monitor.check_history(probe, info)
+            except (ConnectionError, OSError):
+                pass                    # mid-fault probe failure: retried
+        # fleet supervision: a client felled by a fault storm (its
+        # FailoverClient exhausted every endpoint) respawns — signed,
+        # idempotent ops make the rejoin safe; exit code 0 = finished
+        for role, h in self.handles.items():
+            if not role.startswith("client-") or h.alive():
+                continue
+            if h.proc is not None and h.proc.exitcode == 0:
+                continue
+            pending_restart = any(
+                e.target == role and e.kind == "restart"
+                for e in self._pending[:8])
+            if pending_restart:
+                continue
+            exitcode = h.proc.exitcode if h.proc is not None else None
+            try:
+                h.respawn()
+                self.client_respawns += 1
+                self._log(f"SUPERVISE respawn {role} (exit {exitcode})")
+            except Exception:           # noqa: BLE001
+                pass
+
+    # -------------------------------------------------------------- final
+    def finish(self, probe, ack_log_paths: List[str],
+               settle_timeout_s: float = 30.0) -> dict:
+        info = wait_certified(probe, timeout_s=settle_timeout_s)
+        acked = load_ack_logs(ack_log_paths)
+        verdicts = self.monitor.final_check(probe, info, acked)
+        return {
+            "seed": self.schedule.seed,
+            "profile": self.schedule.profile,
+            "schedule": self.schedule.summary(),
+            "faults_executed": self.executed,
+            "faults_skipped": self.skipped,
+            "client_respawns": self.client_respawns,
+            "acked_uploads_checked": len(acked),
+            "invariant_checks": dict(self.monitor.checks),
+            "invariant_verdicts": verdicts,
+            "violations": list(self.monitor.violations),
+        }

@@ -1,0 +1,111 @@
+"""Process-local wire-fault injector — the in-process half of a campaign.
+
+Installed once at child startup (client/process_runtime passes the spec
+through the spawn args; `install_injector` wires it into comm.wire).
+Every frame send/receive then consults the injector:
+
+- **partition** windows raise WireError on frames to the blocked peers —
+  indistinguishable from a dead link, which is the point; the failover /
+  retry machinery must carry it;
+- **delay** windows sleep a fixed per-window latency with probability p;
+- **drop** windows raise WireError with probability p — a dropped
+  *reply* leaves the server having applied an op the client never saw
+  acknowledged, driving the signed-idempotent-retry (duplicate delivery)
+  path, which is how message duplication manifests on a stream transport.
+
+Peers are identified by their LISTENING port via getpeername(): every
+control-plane connection is dialed by the side that knows who it is
+calling (clients/standbys/writer dial listeners), so one-sided
+enforcement at the dialer severs the link.  Probabilistic decisions come
+from a generator seeded with (campaign seed, role): the schedule is a
+pure function of the seed; per-frame coin flips are seed-derived.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+
+class FaultInjector:
+    """Wire-level fault enforcement for one process (see module doc).
+
+    spec: {"t0": float, "role": str, "seed": int, "windows": [
+        {"start", "end", "mode", "ports": [int], "p", "delay_ms"}]}
+    with times in seconds relative to t0 (shared campaign epoch).
+    """
+
+    def __init__(self, spec: dict):
+        self.t0 = float(spec["t0"])
+        self.role = str(spec.get("role", "?"))
+        self.windows = list(spec.get("windows", []))
+        self._rng = random.Random(f"{int(spec.get('seed', 0))}|"
+                                  f"{self.role}")
+        self.injected = {"partition": 0, "delay": 0, "drop": 0}
+
+    @staticmethod
+    def _peer_port(sock) -> Optional[int]:
+        try:
+            return sock.getpeername()[1]
+        except OSError:
+            return None
+
+    def _apply(self, sock) -> None:
+        from bflc_demo_tpu.comm.wire import WireError
+        now = time.time() - self.t0
+        port = self._peer_port(sock)
+        for w in self.windows:
+            if not w["start"] <= now < w["end"]:
+                continue
+            ports = w.get("ports") or []
+            if ports and port not in ports:
+                continue
+            mode = w["mode"]
+            if mode == "partition":
+                self.injected["partition"] += 1
+                raise WireError(
+                    f"chaos[{self.role}]: partitioned from port {port}")
+            if mode == "delay" and self._rng.random() < w.get("p", 1.0):
+                self.injected["delay"] += 1
+                time.sleep(w.get("delay_ms", 0.0) / 1000.0)
+            elif mode == "drop" and self._rng.random() < w.get("p", 0.0):
+                self.injected["drop"] += 1
+                raise WireError(
+                    f"chaos[{self.role}]: frame dropped to port {port}")
+
+    # the comm.wire surface
+    def on_send(self, sock) -> None:
+        self._apply(sock)
+
+    def on_recv(self, sock) -> None:
+        self._apply(sock)
+
+
+def install_injector(spec: Optional[dict]) -> Optional[FaultInjector]:
+    """Install a FaultInjector for this process (None spec = no-op).
+    Called from child-process entry points (client/process_runtime)."""
+    if not spec:
+        return None
+    from bflc_demo_tpu.comm import wire
+    inj = FaultInjector(spec)
+    wire.set_fault_injector(inj)
+    return inj
+
+
+def tear_wal_tail(path: str, nbytes: int = 5) -> bool:
+    """Torn-write injection: truncate the WAL mid-record, simulating a
+    crash tearing the final journal write.  Recovery (replay_wal) must
+    skip the torn record and keep the intact prefix.  Returns True when
+    a tear was applied."""
+    import os
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    magic = 8                           # BFLCWAL1 header
+    if size <= magic + nbytes:
+        return False
+    with open(path, "rb+") as fh:
+        fh.truncate(size - nbytes)
+    return True

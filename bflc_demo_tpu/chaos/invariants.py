@@ -1,0 +1,260 @@
+"""Continuous invariant monitors for a chaos campaign.
+
+What the soak actually proves is not "it didn't crash" but that the
+system's safety contract held WHILE faults were firing:
+
+- **monotone progress**: the observed epoch and writer generation never
+  decrease (a regression would mean a resurrected stale writer or a
+  rolled-back commit);
+- **single certified history**: the writer's certified chain prefix and
+  every reachable validator's replica agree head-for-head — transient
+  divergence is legal only at the chain TIP (depth one, the repair
+  window); anything deeper is a fork;
+- **no uncertified bind**: certification must keep up with the chain
+  (certified_size == log_size once the campaign settles), and clients
+  independently enforce certificate-carrying acks (an uncertified ack
+  kills the client process, which the campaign surfaces);
+- **acked-upload durability**: every upload a client saw acknowledged is
+  present in the surviving chain, and the blob of every still-open
+  upload is fetchable from the serving writer.
+
+Monitors record violations instead of raising mid-campaign: a fault
+window may make a probe unreadable, so each check degrades to "skipped"
+when its subject is unreachable and the FINAL check (run after the
+schedule's fault-free settle tail) is strict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+_EMPTY = b"\0" * 32
+
+
+class InvariantMonitor:
+    """Parent-side monitor driven by the campaign loop.
+
+    `observe_info` runs on every sponsor poll (cheap); `check_history`
+    runs every few seconds (fetches new chain ops + probes validators);
+    `final_check` runs once after the campaign and is strict.
+    """
+
+    def __init__(self, validator_eps: List[Tuple[str, int]],
+                 bft_enabled: bool, verbose: bool = False):
+        self.validator_eps = list(validator_eps)
+        self.bft_enabled = bft_enabled
+        self.verbose = verbose
+        self.violations: List[str] = []
+        self.checks = {"info_polls": 0, "history_checks": 0,
+                       "validator_probes": 0, "tip_divergences_seen": 0}
+        self._max_epoch = -10 ** 9
+        self._max_gen = -1
+        self._ops: List[bytes] = []         # replayed writer chain
+        self._heads: List[bytes] = []
+
+    def _flag(self, msg: str) -> None:
+        self.violations.append(msg)
+        if self.verbose:
+            print(f"[chaos][INVARIANT] {msg}", flush=True)
+
+    # ------------------------------------------------------ cheap per-poll
+    def observe_info(self, info: dict) -> None:
+        self.checks["info_polls"] += 1
+        ep, gen = int(info.get("epoch", -999)), int(info.get("gen", 0))
+        if ep < self._max_epoch:
+            self._flag(f"epoch regressed: {self._max_epoch} -> {ep}")
+        if gen < self._max_gen:
+            self._flag(f"generation regressed: {self._max_gen} -> {gen}")
+        self._max_epoch = max(self._max_epoch, ep)
+        self._max_gen = max(self._max_gen, gen)
+        cs = info.get("certified_size")
+        if cs is not None and cs > int(info.get("log_size", 0)):
+            self._flag(f"certified_size {cs} exceeds log_size "
+                       f"{info.get('log_size')}")
+
+    # ------------------------------------------------------- chain replay
+    def _sync_chain(self, probe, upto: int) -> bool:
+        """Extend the replayed writer chain to `upto` ops via log_range."""
+        while len(self._ops) < upto:
+            start = len(self._ops)
+            end = min(upto, start + 512)
+            r = probe.request("log_range", start=start, end=end)
+            if not r.get("ok") or not r.get("ops"):
+                return False
+            for h in r["ops"]:
+                op = bytes.fromhex(h)
+                d = hashlib.sha256()
+                if self._heads:
+                    d.update(self._heads[-1])
+                d.update(op)
+                self._ops.append(op)
+                self._heads.append(d.digest())
+        return True
+
+    def _head_at(self, i: int) -> bytes:
+        return self._heads[i - 1] if i > 0 else _EMPTY
+
+    def _probe_validator(self, ep, at: int) -> Optional[dict]:
+        from bflc_demo_tpu.comm.bft import ValidatorClient
+        vc = ValidatorClient(ep, timeout_s=2.0)
+        try:
+            return vc.request("info", at=at)
+        except (ConnectionError, OSError):
+            return None
+        finally:
+            vc.close()
+
+    def check_history(self, probe, info: dict) -> None:
+        """Certified-prefix agreement: writer chain vs every reachable
+        validator replica.  Divergence is tolerated only at the tip
+        (depth one — the repair protocol's working window)."""
+        self.checks["history_checks"] += 1
+        cert_size = info.get("certified_size")
+        if cert_size is None:           # no BFT layer: compare full chain
+            cert_size = int(info.get("log_size", 0))
+        if not self._sync_chain(probe, cert_size):
+            return
+        for ep in self.validator_eps:
+            vinfo = self._probe_validator(
+                ep, at=0)               # sizes first, then targeted head
+            if vinfo is None:
+                continue
+            self.checks["validator_probes"] += 1
+            s = min(int(vinfo.get("log_size", 0)), cert_size)
+            if s <= 0:
+                continue
+            vh = self._probe_validator(ep, at=s)
+            if vh is None or "head_at" not in vh:
+                continue
+            if bytes.fromhex(vh["head_at"]) != self._head_at(s):
+                # tip divergence (depth one) is the repair window; a
+                # mismatch persisting below the tip is a fork
+                self.checks["tip_divergences_seen"] += 1
+                vh2 = self._probe_validator(ep, at=s - 1)
+                if vh2 is not None and "head_at" in vh2 and \
+                        bytes.fromhex(vh2["head_at"]) != \
+                        self._head_at(s - 1):
+                    self._flag(
+                        f"validator {ep} diverges from the certified "
+                        f"chain below the tip (index {s - 1}) — fork")
+
+    # ------------------------------------------------------------- final
+    def final_check(self, probe, info: dict,
+                    acked_uploads: List[dict]) -> dict:
+        """Strict end-of-campaign verdicts (after the settle tail)."""
+        verdicts: Dict[str, str] = {}
+
+        # no uncertified op bound (BFT deployments)
+        if self.bft_enabled:
+            cs, ls = info.get("certified_size"), info.get("log_size")
+            if cs == ls:
+                verdicts["no_uncertified_bind"] = "PASS"
+            else:
+                self._flag(f"final certified_size {cs} != log_size {ls}")
+                verdicts["no_uncertified_bind"] = "FAIL"
+
+        # single certified history: full-prefix equality now required
+        size = int(info.get("log_size", 0))
+        synced = self._sync_chain(probe, size)
+        agree, probed = True, 0
+        if synced:
+            if self._heads and info.get("log_head") and \
+                    self._heads[-1].hex() != info["log_head"]:
+                self._flag("replayed chain head != writer log_head")
+                agree = False
+            for ep in self.validator_eps:
+                vinfo = self._probe_validator(ep, at=0)
+                if vinfo is None:
+                    continue
+                probed += 1
+                s = min(int(vinfo.get("log_size", 0)), size)
+                vh = self._probe_validator(ep, at=s)
+                if vh is None or "head_at" not in vh:
+                    continue
+                if bytes.fromhex(vh["head_at"]) != self._head_at(s):
+                    self._flag(f"final: validator {ep} replica diverges "
+                               f"from the surviving chain at {s}")
+                    agree = False
+        verdicts["single_certified_history"] = \
+            "PASS" if (synced and agree) else \
+            ("FAIL" if not agree else "SKIP(unreachable)")
+        verdicts["validators_probed"] = str(probed)
+
+        # monotone progress verdict is the accumulated observation
+        verdicts["monotone_progress"] = (
+            "PASS" if not any("regressed" in v for v in self.violations)
+            else "FAIL")
+
+        # acked-upload durability: every client-acked upload is in the
+        # surviving chain; open-round uploads have fetchable blobs
+        verdicts["acked_upload_durability"] = self._check_acked(
+            probe, acked_uploads) if synced else "SKIP(chain unreadable)"
+        return verdicts
+
+    def _check_acked(self, probe, acked: List[dict]) -> str:
+        from bflc_demo_tpu.ledger.tool import decode_op
+        records = set()
+        open_hashes = []                # uploads after the last commit
+        for op in self._ops:
+            if not op:
+                continue
+            if op[0] == 2:              # upload opcode
+                try:
+                    d = decode_op(op)
+                    records.add((d["sender"], int(d["epoch"]),
+                                 d["payload_hash"]))
+                    open_hashes.append(d["payload_hash"])
+                except (KeyError, ValueError):
+                    continue
+            elif op[0] == 4:            # commit opcode closes the round
+                open_hashes = []
+        ok = True
+        for a in acked:
+            key = (a["addr"], int(a["epoch"]), a["hash"])
+            if key not in records:
+                self._flag(f"acked upload missing from the surviving "
+                           f"chain: {key}")
+                ok = False
+        for h in open_hashes:
+            try:
+                r = probe.request("blob", hash=h)
+            except (ConnectionError, OSError):
+                return "SKIP(writer unreachable)"
+            if not r.get("ok"):
+                self._flag(f"open-round upload {h[:12]} has no "
+                           f"fetchable payload blob")
+                ok = False
+        return "PASS" if ok else "FAIL"
+
+
+def load_ack_logs(paths: List[str]) -> List[dict]:
+    """Parse the per-client ack journals (one JSON object per line)."""
+    out = []
+    for p in paths:
+        try:
+            with open(p) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def wait_certified(probe, timeout_s: float = 30.0) -> dict:
+    """Post-campaign settle: wait for certification to catch the chain
+    tip (liveness — the repair protocol's obligation), returning the
+    final info dict."""
+    deadline = time.monotonic() + timeout_s
+    info = probe.request("info")
+    while info.get("certified_size") is not None and \
+            info["certified_size"] < info["log_size"]:
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.5)
+        info = probe.request("info")
+    return info

@@ -1,0 +1,286 @@
+"""Deterministic fault schedules: a whole chaos campaign from one seed.
+
+A `FaultSchedule` is a pure function of (seed, fleet shape, duration,
+profile): the same seed always produces the same fault campaign, so any
+soak failure is replayable by quoting one integer.  (The *interleaving*
+of faults with protocol progress still depends on wall-clock timing — the
+schedule pins what is injected and when, which is the reproducibility a
+randomized campaign can honestly offer.)
+
+Two kinds of faults come out of a schedule:
+
+- **driver events** (`events`): process kills/restarts and WAL tearing,
+  executed by the campaign driver in the parent process against the live
+  process table;
+- **wire windows** (`wire_windows`): per-role time windows of partition /
+  delay / frame-drop behavior, serialized into each child process at
+  spawn and enforced at the comm.wire frame boundary
+  (chaos.hooks.FaultInjector).
+
+Role names: "writer", "client-<i>", "standby-<k>" (k >= 1),
+"validator-<v>".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional
+
+#: profile knobs: mean seconds between faults of each class (None = class
+#: disabled), partition/delay window lengths, and drop/delay intensities.
+PROFILES: Dict[str, Dict[str, float]] = {
+    # a handful of gentle faults — tier-1 mini-soaks
+    "light": dict(client_kill_every=30.0, validator_kill_every=45.0,
+                  standby_kill_every=0.0, writer_kills=1,
+                  partition_every=30.0, partition_len=(2.0, 4.0),
+                  delay_every=25.0, delay_len=(3.0, 6.0),
+                  delay_ms=(20.0, 80.0), delay_p=0.5,
+                  drop_every=35.0, drop_len=(2.0, 4.0), drop_p=0.15,
+                  standby_partitions=0, tear_wal_p=0.5,
+                  restart_after=(2.0, 5.0)),
+    # the 100-round soak's default
+    "standard": dict(client_kill_every=20.0, validator_kill_every=35.0,
+                     standby_kill_every=90.0, writer_kills=2,
+                     partition_every=25.0, partition_len=(3.0, 7.0),
+                     delay_every=20.0, delay_len=(4.0, 8.0),
+                     delay_ms=(30.0, 120.0), delay_p=0.5,
+                     drop_every=30.0, drop_len=(3.0, 6.0), drop_p=0.2,
+                     standby_partitions=0, tear_wal_p=0.5,
+                     restart_after=(2.0, 6.0)),
+    # adds standby<->writer partitions (split-brain pressure) and higher
+    # fault rates — expect recovery machinery to earn its keep
+    "heavy": dict(client_kill_every=12.0, validator_kill_every=25.0,
+                  standby_kill_every=60.0, writer_kills=2,
+                  partition_every=18.0, partition_len=(3.0, 8.0),
+                  delay_every=15.0, delay_len=(4.0, 10.0),
+                  delay_ms=(50.0, 200.0), delay_p=0.6,
+                  drop_every=20.0, drop_len=(3.0, 7.0), drop_p=0.3,
+                  standby_partitions=2, tear_wal_p=0.7,
+                  restart_after=(2.0, 6.0)),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One driver-side fault: kill/restart a role, or tear the WAL."""
+
+    t: float                    # seconds from campaign t0
+    kind: str                   # "kill" | "restart" | "tear_wal"
+    target: str = ""            # role name ("" for tear_wal)
+
+    def as_dict(self) -> dict:
+        return {"t": round(self.t, 3), "kind": self.kind,
+                "target": self.target}
+
+
+@dataclasses.dataclass(frozen=True)
+class WireWindow:
+    """One wire-level fault window for a single role's outbound frames.
+
+    mode "partition": frames to `peers` raise (connection-level failure);
+    mode "delay": frames to `peers` sleep `delay_ms` with prob `p`;
+    mode "drop": frames to `peers` are dropped (raise) with prob `p`.
+    Empty `peers` means every peer.
+    """
+
+    start: float
+    end: float
+    mode: str                   # "partition" | "delay" | "drop"
+    peers: tuple = ()           # peer role names; () = all
+    p: float = 1.0
+    delay_ms: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {"start": round(self.start, 3), "end": round(self.end, 3),
+                "mode": self.mode, "peers": list(self.peers),
+                "p": self.p, "delay_ms": self.delay_ms}
+
+
+class FaultSchedule:
+    """The campaign: driver events + per-role wire windows, from a seed.
+
+    `grace_s` protects fleet bring-up (registration) and the tail
+    (`settle_frac`) is fault-free so every campaign ends with a healed
+    system — the invariant monitor's final checks then measure recovery,
+    not mid-fault noise.
+    """
+
+    def __init__(self, seed: int, *, duration_s: float, n_clients: int,
+                 n_standbys: int, n_validators: int,
+                 profile: str = "standard", grace_s: float = 10.0,
+                 settle_frac: float = 0.15):
+        if profile not in PROFILES:
+            raise ValueError(f"unknown chaos profile {profile!r}; "
+                             f"have {sorted(PROFILES)}")
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.n_clients = n_clients
+        self.n_standbys = n_standbys
+        self.n_validators = n_validators
+        self.profile = profile
+        self.grace_s = grace_s
+        self.events: List[FaultEvent] = []
+        self.wire_windows: Dict[str, List[WireWindow]] = {}
+        self._generate(random.Random(self.seed),
+                       PROFILES[profile], settle_frac)
+
+    # ------------------------------------------------------------ helpers
+    def _add_window(self, role: str, w: WireWindow) -> None:
+        self.wire_windows.setdefault(role, []).append(w)
+
+    def _times(self, rng: random.Random, every: float, lo: float,
+               hi: float) -> List[float]:
+        """Poisson-ish event times with mean spacing `every` in [lo, hi)."""
+        out = []
+        if not every:
+            return out
+        t = lo + rng.expovariate(1.0 / every)
+        while t < hi:
+            out.append(t)
+            t += rng.expovariate(1.0 / every)
+        return out
+
+    # ----------------------------------------------------------- generate
+    def _generate(self, rng: random.Random, p: Dict[str, float],
+                  settle_frac: float) -> None:
+        lo = self.grace_s
+        hi = max(lo, self.duration_s * (1.0 - settle_frac))
+        f = max((self.n_validators - 1) // 3, 0)
+        restart_lo, restart_hi = p["restart_after"]
+
+        def restart_delay():
+            return rng.uniform(restart_lo, restart_hi)
+
+        # client kills: kill a random client, restart it shortly after
+        for t in self._times(rng, p["client_kill_every"], lo, hi):
+            c = rng.randrange(self.n_clients)
+            self.events.append(FaultEvent(t, "kill", f"client-{c}"))
+            self.events.append(FaultEvent(t + restart_delay(), "restart",
+                                          f"client-{c}"))
+
+        # validator kills: never more than f concurrently dead, so the
+        # quorum stays reachable between faults (a >f outage is a
+        # documented unavailability, not what the soak measures) — the
+        # non-overlap comes from sequential windows
+        if self.n_validators and f >= 0:
+            t = lo + rng.uniform(0, p["validator_kill_every"] or 1.0)
+            while p["validator_kill_every"] and t < hi:
+                v = rng.randrange(self.n_validators)
+                dead_for = restart_delay() + rng.uniform(0.0, 3.0)
+                self.events.append(FaultEvent(t, "kill", f"validator-{v}"))
+                self.events.append(FaultEvent(t + dead_for, "restart",
+                                              f"validator-{v}"))
+                t += dead_for + rng.expovariate(
+                    1.0 / p["validator_kill_every"])
+
+        # writer kills: one per available standby at spread-out fractions
+        # of the run; the promoted standby becomes the next target
+        n_wk = min(int(p["writer_kills"]), self.n_standbys)
+        writer_kill_ts = []
+        for j in range(n_wk):
+            frac = (j + 1) / (n_wk + 1)
+            t = self.duration_s * frac * rng.uniform(0.9, 1.1)
+            t = min(max(t, lo), hi)
+            writer_kill_ts.append(t)
+            self.events.append(FaultEvent(t, "kill", "writer"))
+            if rng.random() < p["tear_wal_p"]:
+                self.events.append(FaultEvent(t + 0.1, "tear_wal"))
+
+        def near_writer_kill(t, margin=15.0):
+            return any(abs(t - wt) < margin for wt in writer_kill_ts)
+
+        # standby kills (restarted): never near a writer kill — the
+        # failover ladder must keep a rung
+        if self.n_standbys > 1:
+            for t in self._times(rng, p["standby_kill_every"], lo, hi):
+                if near_writer_kill(t):
+                    continue
+                k = rng.randrange(2, self.n_standbys + 1)   # keep sb-1
+                self.events.append(FaultEvent(t, "kill", f"standby-{k}"))
+                self.events.append(FaultEvent(t + restart_delay(),
+                                              "restart", f"standby-{k}"))
+
+        # partitions: writer <-> one validator (heals -> backlog resync),
+        # or one client fully isolated from the coordinator side
+        coordinator_roles = tuple(["writer"] + [f"standby-{k}"
+                                  for k in range(1, self.n_standbys + 1)])
+        for t in self._times(rng, p["partition_every"], lo, hi):
+            dur = rng.uniform(*p["partition_len"])
+            if self.n_validators and rng.random() < 0.5:
+                v = rng.randrange(self.n_validators)
+                self._add_window("writer", WireWindow(
+                    t, t + dur, "partition", (f"validator-{v}",)))
+            else:
+                c = rng.randrange(self.n_clients)
+                self._add_window(f"client-{c}", WireWindow(
+                    t, t + dur, "partition", coordinator_roles))
+
+        # heavy profile: partition a standby from the writer — split-brain
+        # pressure (the standby may attempt promotion; fencing + the BFT
+        # repair mandate must keep exactly one certified history)
+        for _ in range(int(p["standby_partitions"])):
+            if not self.n_standbys:
+                break
+            t = rng.uniform(lo, hi)
+            if near_writer_kill(t):
+                continue
+            k = rng.randrange(1, self.n_standbys + 1)
+            dur = rng.uniform(*p["partition_len"]) + 3.0
+            self._add_window(f"standby-{k}", WireWindow(
+                t, t + dur, "partition", ("writer",)))
+
+        # delay windows: client -> coordinator latency
+        for t in self._times(rng, p["delay_every"], lo, hi):
+            dur = rng.uniform(*p["delay_len"])
+            c = rng.randrange(self.n_clients)
+            self._add_window(f"client-{c}", WireWindow(
+                t, t + dur, "delay", coordinator_roles,
+                p=p["delay_p"], delay_ms=rng.uniform(*p["delay_ms"])))
+
+        # drop windows: lossy client -> coordinator link (a dropped reply
+        # forces the signed-idempotent-retry path: duplicate delivery)
+        for t in self._times(rng, p["drop_every"], lo, hi):
+            dur = rng.uniform(*p["drop_len"])
+            c = rng.randrange(self.n_clients)
+            self._add_window(f"client-{c}", WireWindow(
+                t, t + dur, "drop", coordinator_roles, p=p["drop_p"]))
+
+        self.events.sort(key=lambda e: e.t)
+
+    # ------------------------------------------------------------- export
+    def wire_spec(self, role: str, t0: float,
+                  port_of: Dict[str, int]) -> Optional[dict]:
+        """Concretize `role`'s wire windows against the fleet's listening
+        ports (role -> port), ready to serialize into the child process.
+        None when the role has no windows (no injector installed)."""
+        wins = self.wire_windows.get(role)
+        if not wins:
+            return None
+        out = []
+        for w in wins:
+            ports = [port_of[r] for r in w.peers if r in port_of]
+            if w.peers and not ports:
+                continue
+            d = w.as_dict()
+            d["ports"] = ports
+            out.append(d)
+        if not out:
+            return None
+        return {"t0": t0, "role": role, "seed": self.seed,
+                "windows": out}
+
+    def summary(self) -> dict:
+        """Counts per fault class — the soak artifact's provenance."""
+        kinds: Dict[str, int] = {}
+        for e in self.events:
+            key = (f"{e.kind}:{e.target.split('-')[0]}" if e.target
+                   else e.kind)
+            kinds[key] = kinds.get(key, 0) + 1
+        for role, wins in self.wire_windows.items():
+            for w in wins:
+                key = f"{w.mode}:{role.split('-')[0]}"
+                kinds[key] = kinds.get(key, 0) + 1
+        return {"seed": self.seed, "profile": self.profile,
+                "duration_s": self.duration_s, "faults": kinds,
+                "events": [e.as_dict() for e in self.events]}

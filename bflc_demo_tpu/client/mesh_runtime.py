@@ -27,9 +27,19 @@ centrally on the coordinator's mesh — the price of the one-program round.
 The ledger still re-runs the decision on the recorded rows (divergence
 raises), but a malicious coordinator could fabricate rows; when committee
 members distrust the coordinator use client/process_runtime.py, or the
-mesh-executor with score attestation
-(run_federated_mesh_processes(attest_scores=True) — members re-score and
-sign their rows before the ledger accepts the round).
+mesh-executor (run_federated_mesh_processes — members re-score and sign
+their rows in their OWN processes before the ledger accepts the round;
+default-on since round 7).
+
+Score attestation here (round 7, default-on when wallets exist): each
+round's committee rows are SIGNED with the members' Ed25519 wallets
+before the ledger accepts them and recorded in
+SimulationResult.attest_log — non-repudiable evidence of which rows
+entered each round's decision.  Being in-process, this binds identity to
+rows (any holder of the inputs can re-verify a signed row after the
+fact) but cannot place the scoring on a separate trust domain — that is
+exactly what the mesh-executor runtime adds; opt out with
+attest_scores=False for benchmarking.
 """
 
 from __future__ import annotations
@@ -58,6 +68,34 @@ from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
 
 def _addr(i: int) -> str:
     return f"0x{i:040x}"
+
+
+def _attest_rows(wallets, committee_ids, comm_slots, up_slots, score_rows,
+                 epoch: int, attest_log: dict) -> None:
+    """Wallet-sign each committee member's score row BEFORE it reaches
+    the ledger; verified round-trip, recorded in attest_log[epoch].
+
+    In-process this is signature evidence (identity -> row binding,
+    re-verifiable by any holder of the round inputs), not a second trust
+    domain — the mesh-executor runtime provides that.  A wallet that
+    fails to produce a verifying signature aborts the round here, so the
+    ledger only ever accepts attested rounds when attestation is on."""
+    import struct as _struct
+
+    from bflc_demo_tpu.comm.identity import _op_bytes, verify_signature
+    sigs = {}
+    for cid, cs in zip(committee_ids, comm_slots):
+        row = [float(score_rows[cs, us]) for us in up_slots]
+        payload = _struct.pack(f"<{len(row)}d", *row)
+        msg = _op_bytes("scores", _addr(cid), epoch, payload)
+        w = wallets[cid]
+        tag = w.sign(msg)
+        if not verify_signature(w.public_bytes, msg, tag):
+            raise RuntimeError(
+                f"epoch {epoch}: committee member {cid}'s score-row "
+                f"attestation failed verification — refusing the round")
+        sigs[_addr(cid)] = tag.hex()
+    attest_log[epoch] = sigs
 
 
 def _fresh_mask_key():
@@ -104,7 +142,8 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
                  rounds, rounds_per_dispatch, seed, client_chunk, remat,
                  sizes_np, checkpoint_dir, checkpoint_every, tracer,
                  secure=False, secure_wallets=None, secure_clip=1024.0,
-                 verbose=False):
+                 attest_scores=False, attest_wallets=None,
+                 attest_log=None, verbose=False):
     """R-rounds-per-dispatch execution with post-hoc ledger replay + audit.
 
     The device program (parallel.make_multi_round_program) samples uploaders,
@@ -170,6 +209,10 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
                     f"committee divergence at epoch {epoch}: "
                     f"ledger={ledger_comm} device={device_comm}")
             uploader_ids = sorted(np.flatnonzero(up_masks[r]).tolist())
+            if attest_scores:
+                # full-participation batched path: slot ids == client ids
+                _attest_rows(attest_wallets, ledger_comm, ledger_comm,
+                             uploader_ids, score_ms[r], epoch, attest_log)
             for cid in uploader_ids:
                 st = ledger.upload_local_update(
                     _addr(cid), fingerprint_to_bytes(dfps[r, cid]),
@@ -221,7 +264,8 @@ def _run_batched(model, cfg, mesh, ledger, params, xs, ys, ns, sponsor,
         ledger_log_head=ledger.log_head(),
         ledger_log_size=ledger.log_size(),
         n_devices=mesh.shape[AXIS],
-        ledger=ledger)
+        ledger=ledger,
+        attest_log=attest_log or None)
 
 
 def run_federated_mesh(model: Model,
@@ -250,6 +294,11 @@ def run_federated_mesh(model: Model,
                        # staying under the 2^15 fixed-point capacity —
                        # quantisation resolution is 2^-16 regardless
                        secure_clip: float = 1024.0,
+                       # score-row attestation: None = on exactly when
+                       # wallets exist (the secure-by-default posture);
+                       # False is the explicit benchmarking opt-out
+                       attest_scores: Optional[bool] = None,
+                       attest_wallets=None,
                        estimate_flops: bool = False,
                        local_optimizer=None,
                        verbose: bool = False) -> SimulationResult:
@@ -289,6 +338,19 @@ def run_federated_mesh(model: Model,
     if secure_wallets is not None and len(secure_wallets) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} wallets, "
                          f"got {len(secure_wallets)}")
+    # attestation resolution: default-on exactly when wallets exist (the
+    # trust feature must not silently disappear), explicit False opts out
+    attest_wallets = (attest_wallets if attest_wallets is not None
+                      else secure_wallets)
+    if attest_scores is None:
+        attest_scores = attest_wallets is not None
+    if attest_scores and attest_wallets is None:
+        raise ValueError("attest_scores=True needs wallets "
+                         "(attest_wallets or secure_wallets)")
+    if attest_wallets is not None and len(attest_wallets) != cfg.client_num:
+        raise ValueError(f"need {cfg.client_num} attest wallets, "
+                         f"got {len(attest_wallets)}")
+    attest_log: dict = {}
     if participation not in ("full", "active"):
         raise ValueError(f"participation must be 'full'|'active', "
                          f"got {participation!r}")
@@ -368,7 +430,9 @@ def run_federated_mesh(model: Model,
                             client_chunk, remat, sizes_np,
                             checkpoint_dir, checkpoint_every,
                             tracer or _NULL, secure_aggregation,
-                            secure_wallets, secure_clip, verbose)
+                            secure_wallets, secure_clip,
+                            attest_scores, attest_wallets, attest_log,
+                            verbose)
 
     from bflc_demo_tpu.utils.tracing import NULL_TRACER
     tracer = tracer or NULL_TRACER
@@ -457,6 +521,11 @@ def run_federated_mesh(model: Model,
                       delta_fps.nbytes + score_rows.nbytes + avg_costs.nbytes)
         tracer.event("round.device_done", epoch=epoch)
 
+        if attest_scores:
+            # wallet-sign the committee rows BEFORE the ledger replay —
+            # the ledger only accepts attested rounds
+            _attest_rows(attest_wallets, committee_ids, comm_slots,
+                         up_slots, score_rows, epoch, attest_log)
         # ascending == slot order; audit_round raises on any divergence
         audit_round(ledger, _addr, epoch, uploader_ids, committee_ids,
                     up_slots, comm_slots, delta_fps,
@@ -488,4 +557,5 @@ def run_federated_mesh(model: Model,
         ledger_log_size=ledger.log_size(),
         n_devices=mesh.shape[AXIS],
         ledger=ledger,
-        flops_per_round=flops_per_round)
+        flops_per_round=flops_per_round,
+        attest_log=attest_log or None)

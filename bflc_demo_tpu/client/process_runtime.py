@@ -76,6 +76,14 @@ def _cpu_spawn_env():
 
 
 
+def _install_chaos(chaos_spec) -> None:
+    """Install this process's wire-level fault injector (no-op without a
+    spec) — the chaos campaign's in-process half (chaos.hooks)."""
+    if chaos_spec:
+        from bflc_demo_tpu.chaos.hooks import install_injector
+        install_injector(chaos_spec)
+
+
 def _client_tls(tls_dir: str):
     """ssl context for dialing the coordinator, or None when TLS is off —
     the ONE construction point for client-side contexts in this module."""
@@ -96,8 +104,9 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  stall_timeout_s: float, wal_path: str, tls_dir: str,
                  standby_keys: dict, quorum: int,
                  bft_endpoints: list, bft_keys: dict,
-                 verbose: bool) -> None:
+                 verbose: bool, chaos_spec: Optional[dict] = None) -> None:
     _force_cpu_jax()
+    _install_chaos(chaos_spec)
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
     tls = _server_tls(tls_dir)
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
@@ -113,16 +122,23 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
 
 
 def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
-                    port_q, validator_keys: dict, verbose: bool) -> None:
+                    port_q, validator_keys: dict, verbose: bool,
+                    port: int = 0,
+                    chaos_spec: Optional[dict] = None) -> None:
     """One BFT commit-quorum member (comm.bft.ValidatorNode): an
     independent replica + wallet that re-executes every op and co-signs
     commit certificates — the reference analogue of one PBFT chain node.
-    Peer keys let it admit certified backlog when rejoining mid-run."""
-    _force_cpu_jax()
+    Peer keys let it admit certified backlog when rejoining mid-run; a
+    fixed `port` makes the role restartable under chaos (the writer's
+    endpoint list survives the restart).  No jax import: the validator
+    path is pure ledger + crypto, and a lean child restarts fast."""
+    os.environ["JAX_PLATFORMS"] = "cpu"  # in case a dep imports jax
+    _install_chaos(chaos_spec)
     from bflc_demo_tpu.comm.bft import ValidatorNode
     from bflc_demo_tpu.comm.identity import Wallet
     node = ValidatorNode(ProtocolConfig(**cfg_kw),
                          Wallet.from_seed(wallet_seed), index,
+                         port=port,
                          validator_keys=validator_keys,
                          verbose=verbose)
     port_q.put(node.port)
@@ -141,7 +157,10 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                  rounds: int, crash_at_epoch: Optional[int],
                  tls_dir: str = "",
                  standby_keys: Optional[dict] = None,
-                 bft_keys: Optional[dict] = None) -> None:
+                 bft_keys: Optional[dict] = None,
+                 chaos_spec: Optional[dict] = None,
+                 ack_log_path: str = "",
+                 request_timeout_s: float = 120.0) -> None:
     """One federated client: register -> role loop -> train/score -> exit.
 
     Runs the same state machine as client/runtime.FLNode.step (itself the
@@ -150,8 +169,17 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     With multiple endpoints the client rides FailoverClient: a dead writer
     means rotating to the promoted standby and retrying — every mutation is
     signed + idempotent (DUPLICATE = already in), so retries are safe.
+
+    ack_log_path: journal every ACKNOWLEDGED upload (one JSON line) — the
+    chaos invariant monitor's acked-upload-durability ground truth.
+    request_timeout_s: per-request socket timeout (chaos campaigns lower
+    it so a request wedged on a partitioned/backlogged endpoint rotates
+    onward in seconds, not minutes).
     """
     _force_cpu_jax()
+    _install_chaos(chaos_spec)
+    import json as _json
+
     import jax.numpy as jnp
 
     import bflc_demo_tpu.models as models
@@ -169,7 +197,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     wallet = Wallet.from_seed(wallet_seed)
     xj, yj = jnp.asarray(x), jnp.asarray(y_onehot)
 
-    client = FailoverClient(endpoints, timeout_s=120.0,
+    client = FailoverClient(endpoints, timeout_s=request_timeout_s,
                             tls=_client_tls(tls_dir),
                             standby_keys=standby_keys,
                             bft_keys=bft_keys)
@@ -226,6 +254,23 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                 # NOT_READY = round closed under recovery; wait it out
                 trained_epoch = epoch
                 acted = r["ok"]
+            if r.get("ok") and ack_log_path:
+                # journal the acknowledged upload: the chaos invariant
+                # monitor later proves it survived in the one certified
+                # history, with its payload durable
+                with open(ack_log_path, "a") as fh:
+                    fh.write(_json.dumps(
+                        {"addr": wallet.address, "epoch": epoch,
+                         "hash": digest.hex(), "n": n,
+                         "cost": float(cost)}) + "\n")
+            if r.get("status") == "BAD_ARG":
+                # a writer that failed over mid-registration can hold a
+                # directory hole for us ("bad signature") — re-present
+                # the self-authenticating registration (idempotent:
+                # ALREADY_REGISTERED at worst) and retry the op
+                client.request("register", addr=wallet.address,
+                               pubkey=wallet.public_bytes.hex(),
+                               tag=_sign(wallet, "register", 0, b""))
         elif st["role"] == "comm" and epoch > scored_epoch:
             ups = client.request("updates")["updates"]
             if ups:
@@ -254,6 +299,11 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                 if r.get("status") in ("OK", "WRONG_EPOCH", "DUPLICATE"):
                     scored_epoch = epoch
                     acted = r["ok"]
+                if r.get("status") == "BAD_ARG":
+                    # same directory-hole self-heal as the upload path
+                    client.request("register", addr=wallet.address,
+                                   pubkey=wallet.public_bytes.hex(),
+                                   tag=_sign(wallet, "register", 0, b""))
         if not acted:
             known_log = client.request("wait", log_size=known_log,
                                        timeout_s=2.0)["log_size"]
@@ -278,15 +328,21 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                   index: int, port_q, stall_timeout_s: float,
                   tls_dir: str, wallet_seed: bytes, standby_keys: dict,
                   quorum: int, bft_endpoints: list, bft_keys: dict,
-                  verbose: bool) -> None:
+                  verbose: bool, port: int = 0,
+                  chaos_spec: Optional[dict] = None) -> None:
     """Hot standby: follow the writer's op stream, promote on its death
-    (comm.failover.Standby).  Reports its serving port, then blocks."""
+    (comm.failover.Standby).  Reports its serving port, then blocks.  A
+    fixed `port` makes the role restartable under chaos (clients keep
+    their endpoint list); a restarted standby re-follows whatever peer
+    currently serves and rebuilds its replica from op 0."""
     _force_cpu_jax()
+    _install_chaos(chaos_spec)
     from bflc_demo_tpu.comm.failover import Standby
     from bflc_demo_tpu.comm.identity import Wallet
     tls_c, tls_s = _client_tls(tls_dir), _server_tls(tls_dir)
     standby = Standby(ProtocolConfig(**cfg_kw),
                       endpoints + [("127.0.0.1", 0)], index,
+                      port=port,
                       stall_timeout_s=stall_timeout_s,
                       tls_client=tls_c, tls_server=tls_s,
                       wallet=Wallet.from_seed(wallet_seed),
@@ -304,7 +360,7 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
 class ProcessFederationResult:
     def __init__(self, accuracy_history, rounds_completed, log_head,
                  log_size, recovered_clients, replica_report,
-                 wall_time_s: float = 0.0):
+                 wall_time_s: float = 0.0, chaos_report=None):
         self.accuracy_history = accuracy_history
         self.rounds_completed = rounds_completed
         self.ledger_log_head = log_head
@@ -312,6 +368,9 @@ class ProcessFederationResult:
         self.recovered_clients = recovered_clients
         self.replica_report = replica_report
         self.wall_time_s = wall_time_s
+        # chaos campaign report (chaos.campaign.ChaosCampaign.finish) or
+        # None when the run was fault-free
+        self.chaos_report = chaos_report
 
     @property
     def final_accuracy(self) -> float:
@@ -340,6 +399,11 @@ def run_federated_processes(
         bft_validators: int = 0,
         timeout_s: float = 600.0,
         init_seed: int = 0,
+        chaos_seed: Optional[int] = None,
+        chaos_profile: str = "standard",
+        chaos_duration_s: Optional[float] = None,
+        chaos_schedule=None,
+        chaos_dir: str = "",
         verbose: bool = False) -> ProcessFederationResult:
     """Run a full federation as (1 coordinator + N clients [+ standbys]
     [+ 1 replica]) OS processes.  Parent = sponsor.
@@ -372,6 +436,14 @@ def run_federated_processes(
     carries the certificates, standbys refuse uncertified appends, and
     every client verifies the certificate on each mutating ack — a
     Byzantine writer cannot bind fabricated state (tests/test_bft.py).
+    chaos_seed: run the federation under a seeded fault campaign
+    (bflc_demo_tpu.chaos): randomized process kills/restarts, partition/
+    delay/drop windows at the socket boundary, and WAL tearing, with
+    continuous invariant monitors; the report rides on
+    result.chaos_report (violations list empty = invariants held).
+    chaos_schedule overrides the generated schedule (tests);
+    chaos_duration_s bounds the fault window (default: 0.5 * timeout_s);
+    chaos_dir holds the per-client ack journals (tempdir by default).
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -407,7 +479,6 @@ def run_federated_processes(
     cfg_kw = {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)}
 
     ctx = mp.get_context("spawn")
-    port_q = ctx.Queue()
     host = "127.0.0.1"
     standby_procs: List = []
     # standby identities: deterministic wallets from the run's master seed;
@@ -427,61 +498,148 @@ def run_federated_processes(
     if bft_validators:
         from bflc_demo_tpu.comm.bft import provision_validators
         _, bft_keys = provision_validators(bft_validators, master_seed)
-    with _cpu_spawn_env():
-        for v in range(bft_validators):
-            v_q = ctx.Queue()
-            vp = ctx.Process(
-                target=_validator_proc,
-                args=(cfg_kw, master_seed + b"|bft-validator|"
-                      + struct.pack("<q", v), v, v_q, bft_keys, verbose),
-                daemon=True)
-            vp.start()
-            bft_endpoints.append((host, v_q.get(timeout=60)))
-            validator_procs.append(vp)
 
-        server = ctx.Process(target=_server_proc,
-                             args=(cfg_kw, initial_blob, port_q,
-                                   stall_timeout_s, wal_path, tls_dir,
-                                   standby_keys, quorum,
-                                   bft_endpoints, bft_keys, verbose),
-                             daemon=True)
-        server.start()
-        port = port_q.get(timeout=60)
-        endpoints = [(host, port)]
+    # --- chaos campaign wiring (bflc_demo_tpu.chaos): a seeded fault
+    # schedule, wire-level injector specs serialized into each child, and
+    # a driver+monitor the sponsor loop ticks.  Every role's spawn is a
+    # thunk so the campaign can kill AND restart it (fixed ports).
+    campaign = None
+    ack_paths: List[str] = []
+    chaos_t0 = time.time()
+    port_of: Dict[str, int] = {}
+    if chaos_seed is not None or chaos_schedule is not None:
+        from bflc_demo_tpu.chaos.campaign import ChaosCampaign
+        from bflc_demo_tpu.chaos.invariants import InvariantMonitor
+        from bflc_demo_tpu.chaos.schedule import FaultSchedule
+        if chaos_schedule is None:
+            chaos_schedule = FaultSchedule(
+                chaos_seed, duration_s=(chaos_duration_s
+                                        or timeout_s * 0.5),
+                n_clients=len(shards), n_standbys=standbys,
+                n_validators=bft_validators, profile=chaos_profile,
+                grace_s=20.0)
+        if not chaos_dir:
+            import tempfile
+            chaos_dir = tempfile.mkdtemp(prefix="bflc-chaos-")
+        os.makedirs(chaos_dir, exist_ok=True)
+        campaign = ChaosCampaign(
+            chaos_schedule,
+            InvariantMonitor([], bft_enabled=bool(bft_validators),
+                             verbose=verbose),
+            t0=chaos_t0, wal_path=wal_path, verbose=verbose)
 
-        # standbys spawn in priority order; each only needs the endpoints
-        # ABOVE it (election never looks past its own index)
-        for s in range(standbys):
-            sb_q = ctx.Queue()
-            sp = ctx.Process(target=_standby_proc,
-                             args=(cfg_kw, list(endpoints), s + 1, sb_q,
-                                   stall_timeout_s, tls_dir,
-                                   standby_seeds[s + 1], standby_keys,
-                                   quorum, bft_endpoints, bft_keys,
-                                   verbose),
-                             daemon=True)
-            sp.start()
-            endpoints.append((host, sb_q.get(timeout=60)))
-            standby_procs.append(sp)
+    def _wire(role: str):
+        return (chaos_schedule.wire_spec(role, chaos_t0, port_of)
+                if campaign is not None else None)
 
-        clients = []
-        for i, (sx, sy) in enumerate(shards):
-            p = ctx.Process(
-                target=_client_proc,
-                args=(list(endpoints), master_seed + struct.pack("<q", i),
-                      model_factory, factory_kw,
-                      np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
-                      rounds, crash_at.get(i), tls_dir, standby_keys,
-                      bft_keys),
-                daemon=True)
+    client_timeout_s = 15.0 if campaign is not None else 120.0
+
+    def _spawn_validator(v: int, vport: int = 0):
+        q = ctx.Queue()
+        p = ctx.Process(
+            target=_validator_proc,
+            args=(cfg_kw, master_seed + b"|bft-validator|"
+                  + struct.pack("<q", v), v, q, bft_keys, verbose,
+                  vport, _wire(f"validator-{v}")),
+            daemon=True)
+        with _cpu_spawn_env():
             p.start()
-            clients.append(p)
+        return p, q.get(timeout=60)
+
+    def _spawn_server():
+        q = ctx.Queue()
+        p = ctx.Process(target=_server_proc,
+                        args=(cfg_kw, initial_blob, q,
+                              stall_timeout_s, wal_path, tls_dir,
+                              standby_keys, quorum,
+                              bft_endpoints, bft_keys, verbose,
+                              _wire("writer")),
+                        daemon=True)
+        with _cpu_spawn_env():
+            p.start()
+        return p, q.get(timeout=60)
+
+    def _spawn_standby(s: int, endpoints_above, sbport: int = 0):
+        q = ctx.Queue()
+        p = ctx.Process(target=_standby_proc,
+                        args=(cfg_kw, list(endpoints_above), s, q,
+                              stall_timeout_s, tls_dir,
+                              standby_seeds[s], standby_keys,
+                              quorum, bft_endpoints, bft_keys,
+                              verbose, sbport, _wire(f"standby-{s}")),
+                        daemon=True)
+        with _cpu_spawn_env():
+            p.start()
+        return p, q.get(timeout=60)
+
+    def _spawn_client(i: int, sx, sy, endpoints_all):
+        ack = (os.path.join(chaos_dir, f"acks-{i}.jsonl")
+               if campaign is not None else "")
+        p = ctx.Process(
+            target=_client_proc,
+            args=(list(endpoints_all), master_seed + struct.pack("<q", i),
+                  model_factory, factory_kw,
+                  np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
+                  rounds, crash_at.get(i), tls_dir, standby_keys,
+                  bft_keys, _wire(f"client-{i}"), ack, client_timeout_s),
+            daemon=True)
+        with _cpu_spawn_env():
+            p.start()
+        return p, ack
+
+    for v in range(bft_validators):
+        vp, vport = _spawn_validator(v)
+        bft_endpoints.append((host, vport))
+        port_of[f"validator-{v}"] = vport
+        validator_procs.append(vp)
+        if campaign is not None:
+            campaign.register(f"validator-{v}",
+                              (lambda v=v, vport=vport:
+                               _spawn_validator(v, vport)[0]), vp)
+    if campaign is not None:
+        campaign.monitor.validator_eps = list(bft_endpoints)
+
+    server, port = _spawn_server()
+    endpoints = [(host, port)]
+    port_of["writer"] = port
+    if campaign is not None:
+        campaign.register("writer", _spawn_server, server)
+
+    # standbys spawn in priority order; each only needs the endpoints
+    # ABOVE it at spawn time (a restarted standby re-follows whoever
+    # serves via the same fixed port list)
+    for s in range(standbys):
+        eps_above = list(endpoints)
+        sp, sbport = _spawn_standby(s + 1, eps_above)
+        endpoints.append((host, sbport))
+        port_of[f"standby-{s + 1}"] = sbport
+        standby_procs.append(sp)
+        if campaign is not None:
+            campaign.register(
+                f"standby-{s + 1}",
+                (lambda s=s, eps=eps_above, sbport=sbport:
+                 _spawn_standby(s + 1, eps, sbport)[0]), sp)
+
+    clients = []
+    for i, (sx, sy) in enumerate(shards):
+        p, ack = _spawn_client(i, sx, sy, endpoints)
+        clients.append(p)
+        if ack:
+            ack_paths.append(ack)
+        if campaign is not None:
+            campaign.register(
+                f"client-{i}",
+                (lambda i=i, sx=sx, sy=sy, eps=list(endpoints):
+                 _spawn_client(i, sx, sy, eps)[0]), p)
 
     from bflc_demo_tpu.comm.failover import FailoverClient
     xte, yte = test_set
     xte_j = jnp.asarray(xte)
     yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
-    sponsor = FailoverClient(endpoints, timeout_s=120.0,
+    # under chaos the sponsor doubles as the campaign's probe: a request
+    # wedged on a bound-but-not-yet-serving standby must rotate onward in
+    # seconds or the event driver and invariant monitors go quiet
+    sponsor = FailoverClient(endpoints, timeout_s=client_timeout_s,
                              tls=_client_tls(tls_dir),
                              standby_keys=standby_keys,
                              bft_keys=bft_keys or None)
@@ -491,7 +649,16 @@ def run_federated_processes(
     deadline = time.monotonic() + timeout_s
     try:
         while time.monotonic() < deadline:
-            info = sponsor.request("info")
+            try:
+                info = sponsor.request("info")
+            except ConnectionError:
+                # every endpoint momentarily dark (a chaos writer kill
+                # mid-promotion): the deadline, not one bad poll, decides
+                # when the run is a failure
+                time.sleep(0.5)
+                continue
+            if campaign is not None:
+                campaign.tick(sponsor, info)
             if info["epoch"] > seen_epoch:
                 mr = sponsor.request("model")
                 if mr["epoch"] > seen_epoch:
@@ -525,6 +692,12 @@ def run_federated_processes(
                 f"process federation incomplete after {timeout_s}s "
                 f"({len(history)}/{rounds} rounds)")
         final = sponsor.request("info")
+        chaos_report = None
+        if campaign is not None:
+            # settle + strict final invariant checks (certification must
+            # catch the tip; one certified history; acked uploads durable)
+            chaos_report = campaign.finish(sponsor, ack_paths)
+            final = sponsor.request("info")
         final_ep = sponsor.current_endpoint
         replica_report = None
         if replicas > 0:
@@ -563,6 +736,13 @@ def run_federated_processes(
         for vp in validator_procs:
             vp.terminate()
             vp.join(timeout=10)
+        if campaign is not None:
+            # respawned processes live in the campaign handles, not the
+            # original lists — sweep them too
+            for h in campaign.handles.values():
+                if h.proc is not None and h.proc.is_alive():
+                    h.proc.terminate()
+                    h.proc.join(timeout=5)
 
     crashed = [i for i in crash_at
                if clients[i].exitcode not in (0, None)]
@@ -573,7 +753,8 @@ def run_federated_processes(
         log_size=final["log_size"],
         recovered_clients=crashed,
         replica_report=replica_report,
-        wall_time_s=time.monotonic() - t_start)
+        wall_time_s=time.monotonic() - t_start,
+        chaos_report=chaos_report)
 
 
 # ------------------------------------------------- mesh-executor federation
@@ -757,7 +938,7 @@ def run_federated_mesh_processes(
         master_seed: bytes = b"mesh-executor-master-0001",
         n_virtual_devices: int = 0,
         stall_timeout_s: float = 120.0,
-        attest_scores: bool = False,
+        attest_scores: Optional[bool] = None,
         tls_dir: str = "",
         timeout_s: float = 600.0,
         verbose: bool = False) -> ProcessFederationResult:
@@ -771,7 +952,10 @@ def run_federated_mesh_processes(
     attest_scores: score-attestation trust locality — every committee
     member's process re-scores the round's candidates on its own shard
     and signs its row before the ledger accepts the round
-    (comm.executor_service._collect_attestations).
+    (comm.executor_service._collect_attestations).  DEFAULT-ON (round 7:
+    every thin client holds a wallet, so the trust feature costs one
+    re-score per member per round); pass attest_scores=False as the
+    explicit benchmarking opt-out.
     tls_dir: when set, provisions a CA + server cert there and EVERY
     control-plane byte — registration, staging (the raw shards!), model
     fetches, attestations, the sponsor — rides TLS with full server
@@ -780,6 +964,8 @@ def run_federated_mesh_processes(
     cfg.validate()
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    if attest_scores is None:
+        attest_scores = True        # wallets always exist here: default-on
     factory_kw = factory_kw or {}
     t_start = time.monotonic()
     if tls_dir:

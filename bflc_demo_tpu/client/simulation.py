@@ -44,6 +44,8 @@ class SimulationResult:
     ledger: Any = None          # the live ledger (for checkpointing/inspection)
     flops_per_round: float = 0.0    # XLA cost-analysis FLOPs of ONE round's
     # compiled program (0 when not estimated) — the MFU numerator
+    attest_log: Any = None          # {epoch: {addr: sig_hex}} of wallet-
+    # signed committee score rows (mesh runtime attestation), else None
 
     def mfu(self, peak_flops: float) -> float:
         """Model FLOPs utilisation against `peak_flops` (whole data plane:

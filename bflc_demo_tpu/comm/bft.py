@@ -26,15 +26,40 @@ the *Byzantine* property for the writer itself:
   a valid certificate.  At the reference's 4-validator geometry this
   tolerates f=1 crashed OR lying validators (protocol.constants.bft_*).
 
+Liveness (round 7): certification no longer stalls permanently when
+validator replicas diverge at the chain tip (a writer that died
+mid-certify, a promotion racing the old writer's last op, or an outright
+equivocating writer).  Two repair paths restore progress:
+
+- **resync-and-retry**: a validator that bound a different op at the tip
+  accepts a quorum CERTIFICATE for the competing op as proof that the
+  canonical chain holds that op, rolls its replica back to the certified
+  prefix, re-applies, and re-votes (`ValidatorNode._admit_certified`);
+- **re-proposal**: when no certificate exists at all (votes split below
+  quorum), the proposer runs an abandon round at a higher ATTEMPT number:
+  each validator returns a signed statement of what it holds at the
+  position and promises to reject lower attempts; 2f+1 statements form a
+  repair proof whose MANDATE rule (any op reported by >= f+1 statements
+  must be re-proposed; at most one op can reach f+1 in a 2f+1 set) makes
+  re-votes safe — an op that could have certified is always the mandated
+  one.  Votes and certificates are attempt-tagged so old-attempt and
+  new-attempt signatures can never mix into a thin quorum
+  (`CertificateAssembler.certify` drives the loop; a proposer whose own
+  op loses the mandate learns the canonical op via
+  `CertificateAssembler.superseded_op` — a stale writer self-demotes, a
+  racing standby re-follows the winner).
+
 Deliberate non-goals, documented rather than implied (PARITY.md): the
 commit op's MODEL HASH is re-executed as a guard check but not re-derived
 (validators hold no payload blobs, so a writer lying about the FedAvg
 output hash is caught by committee score attestation + any-holder
-re-verification, not here); reads are not certified; and there is no view
-change — validators whose replicas a hostile writer managed to diverge
-(each applied a different op at one index; possible only while it holds
-valid client tags for BOTH ops) stall certification rather than elect a
-new writer, which is a liveness, never a safety, loss.
+re-verification, not here); reads are not certified; client-originated
+ops still require auth evidence (or an existing certificate) on the
+repair path — a repair proof authorizes the ROLLBACK, never an auth
+bypass; and the repair mandate's f+1 threshold protects any
+possibly-certified op against f lying validators OR an arbitrarily
+equivocating writer, but not both colluding at once (the same compound
+fault PBFT needs its second phase for — documented in PARITY.md).
 
 Deployment note: validator ports belong on the coordinator-side network
 segment (like standby subscriptions).  The drill in tests/test_bft.py is
@@ -72,21 +97,26 @@ _OP_REGISTER, _OP_UPLOAD, _OP_SCORES = 1, 2, 3
 
 
 def cert_payload_digest(index: int, prev_head: bytes, op_digest: bytes,
-                        new_head: bytes) -> bytes:
+                        new_head: bytes, attempt: int = 0) -> bytes:
     """THE byte layout a validator signs — the one encoder every signing
-    and verification site shares, so the layout cannot desynchronize."""
+    and verification site shares, so the layout cannot desynchronize.
+    The ATTEMPT number is part of the payload: one certificate's quorum
+    must all have signed at the same attempt, or a repair round could mix
+    pre- and post-repair votes for different ops into a thin quorum."""
     return (_CERT_MAGIC + struct.pack("<q", index)
-            + (prev_head or _EMPTY_HEAD) + op_digest + new_head)
+            + (prev_head or _EMPTY_HEAD) + op_digest + new_head
+            + struct.pack("<q", attempt))
 
 
 def cert_payload(index: int, prev_head: bytes, op: bytes,
-                 new_head: bytes) -> bytes:
+                 new_head: bytes, attempt: int = 0) -> bytes:
     """The byte string a validator signs: position + chain prefix + op
-    digest + resulting head.  Binding the PREFIX digest (not just the op)
-    is what makes certificates fork-proof — a signature minted on one
-    history is meaningless on any other."""
+    digest + resulting head (+ attempt).  Binding the PREFIX digest (not
+    just the op) is what makes certificates fork-proof — a signature
+    minted on one history is meaningless on any other."""
     return cert_payload_digest(index, prev_head,
-                               hashlib.sha256(op).digest(), new_head)
+                               hashlib.sha256(op).digest(), new_head,
+                               attempt)
 
 
 def next_head(prev_head: bytes, op: bytes) -> bytes:
@@ -119,10 +149,11 @@ def verify_certificate(cert: CommitCertificate, *, index: int,
 def count_valid_sigs(cert: CommitCertificate,
                      validator_keys: Dict[int, bytes]) -> int:
     """Signatures by distinct PROVISIONED validators that verify over the
-    certificate's own payload.  Shared by full verification and the
-    client-side structural check."""
+    certificate's own payload (including its claimed attempt).  Shared by
+    full verification and the client-side structural check."""
     payload = cert_payload_digest(cert.index, cert.prev_head,
-                                  cert.op_hash, cert.new_head)
+                                  cert.op_hash, cert.new_head,
+                                  cert.attempt)
     n = 0
     for vidx, sig in cert.sigs.items():
         pub = validator_keys.get(vidx)
@@ -209,6 +240,23 @@ def check_op_auth(op: bytes, auth: Optional[dict],
         return "client op without auth evidence"
     body = op[1:]
 
+    def _tofu_repair(sender: str) -> None:
+        """Self-authenticating directory repair: auth evidence for every
+        client op carries the sender's pubkey, so a validator whose
+        directory mirror has a hole (rejoined through a writer that
+        itself promoted mid-registration — the chain stores addresses,
+        not keys) heals on the next fresh op instead of refusing that
+        client forever.  Safe by construction: the address IS the key's
+        hash, and the op tag must still verify under it."""
+        if directory.knows(sender):
+            return
+        try:
+            pub = bytes.fromhex(auth.get("pubkey", ""))
+        except (TypeError, ValueError):
+            return
+        if pub and address_of(pub) == sender:
+            directory.enroll(pub)
+
     def _str_at(off):
         (n,) = struct.unpack_from("<q", body, off)
         if n < 0 or off + 8 + n > len(body):
@@ -241,9 +289,12 @@ def check_op_auth(op: bytes, auth: Optional[dict],
                     struct.pack("<f", cost_f32):
                 return "upload: cost not the f32 image of the signed value"
             payload = payload_hash + struct.pack("<qd", n, cost)
+            _tofu_repair(sender)
             if not directory.verify(sender, _op_bytes("upload", sender,
                                                       epoch, payload), tag):
-                return "upload: bad tag"
+                return (f"upload: bad tag (sender {sender[:12]}, "
+                        f"epoch {epoch}, "
+                        f"known={directory.knows(sender)})")
             return ""
         # _OP_SCORES
         sender, off = _str_at(0)
@@ -260,31 +311,131 @@ def check_op_auth(op: bytes, auth: Optional[dict],
                     struct.pack("<f", got):
                 return "scores: row not the f32 image of the signed values"
         payload = struct.pack(f"<{len(scores)}d", *scores)
+        _tofu_repair(sender)
         if not directory.verify(sender, _op_bytes("scores", sender, epoch,
                                                   payload), tag):
-            return "scores: bad tag"
+            return (f"scores: bad tag (sender {sender[:12]}, "
+                    f"epoch {epoch}, known={directory.knows(sender)})")
         return ""
     except (KeyError, TypeError, ValueError, struct.error,
             UnicodeDecodeError) as e:
         return f"undecodable op/auth: {type(e).__name__}: {e}"
 
 
+# ------------------------------------------------- repair (liveness) layer
+_ABANDON_MAGIC = b"BFLCABDN1"
+
+
+def abandon_stmt_payload(index: int, attempt: int, validator: int,
+                         has_vote: bool, voted_attempt: int,
+                         op_digest: bytes) -> bytes:
+    """The byte layout of one signed abandon statement: 'at repair attempt
+    `attempt` for chain position `index`, I hold `op_digest` (voted at
+    `voted_attempt`) — or nothing — and I promise to refuse votes below
+    `attempt` here.'  Binding the attempt makes old proofs unreplayable
+    at later repair rounds."""
+    return (_ABANDON_MAGIC
+            + struct.pack("<qqII", index, attempt, validator,
+                          1 if has_vote else 0)
+            + struct.pack("<q", voted_attempt)
+            + (op_digest or b"\0" * 32))
+
+
+def verify_repair_proof(proof, index: int, attempt: int, quorum: int,
+                        validator_keys: Dict[int, bytes],
+                        ) -> Tuple[bool, Optional[bytes], Optional[bytes]]:
+    """Check a repair proof for (index, attempt): >= quorum signed abandon
+    statements by distinct provisioned validators, exactly at this
+    position and attempt.
+
+    Returns (ok, mandated_op_hash, mandated_op_bytes).  The MANDATE rule
+    is evidence-exact: an op is mandated iff it COULD have gathered a
+    certificate given what the statements rule out — reports(op) +
+    (n - statements) >= quorum.  If a certificate exists (>= quorum
+    voters), any statement set keeps it above the bar (honest voters
+    report truthfully), so the mandate always protects a
+    possibly-certified op; with every validator reporting, the counts
+    are exact and a merely-STRANDED op (a dead proposer's partial votes,
+    below quorum) is correctly left unmandated — the proposer is free,
+    which is what keeps a crashed writer's leftovers from wedging its
+    successor.  Two ops can never both clear the bar (they would need
+    more reports than statements exist), so the mandate is unique; and
+    f lying validators alone cannot reach it (the bar is always
+    >= f+1).  No mandate (None) means no op can have certified: the
+    proposer may re-propose freely.  Never raises on malformed input."""
+    try:
+        stmts = list(proof["stmts"])
+    except (KeyError, TypeError):
+        return False, None, None
+    seen: Dict[int, Tuple[bytes, bytes]] = {}   # validator -> (hash, op)
+    distinct = set()
+    for s in stmts:
+        try:
+            v = int(s["validator"])
+            has_vote = bool(s.get("has_vote"))
+            voted_t = int(s.get("voted_t", 0))
+            oh = bytes.fromhex(s["op_hash"]) if has_vote else b""
+            ob = bytes.fromhex(s.get("op", "")) if has_vote else b""
+            sig = bytes.fromhex(s["sig"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        pub = validator_keys.get(v)
+        if pub is None or v in distinct:
+            continue
+        payload = abandon_stmt_payload(index, attempt, v, has_vote,
+                                       voted_t, oh)
+        if not verify_signature(pub, payload, sig):
+            continue
+        distinct.add(v)
+        # op bytes ride unsigned next to the signed digest: check them
+        if has_vote and oh and hashlib.sha256(ob).digest() == oh:
+            seen[v] = (oh, ob)
+    if len(distinct) < quorum:
+        return False, None, None
+    counts: Dict[bytes, int] = {}
+    for oh, _ in seen.values():
+        counts[oh] = counts.get(oh, 0) + 1
+    # evidence-exact bar: non-reporting validators might all have voted
+    # the op, so it could have certified iff reports + missing >= quorum
+    bar = quorum - (len(validator_keys) - len(distinct))
+    mandated = [oh for oh, c in counts.items() if c >= max(bar, 1)]
+    if len(mandated) != 1:
+        # zero ops clear the bar (nothing can have certified) — or, out
+        # of an abundance of caution, several do (unreachable by the
+        # counting argument: two ops clearing it need more reports than
+        # statements): the proposer chooses freely
+        return True, None, None
+    oh = mandated[0]
+    ob = next(b for h, b in seen.values() if h == oh)
+    return True, oh, ob
+
+
 # --------------------------------------------------------------- validator
 class ValidatorNode:
     """One member of the commit quorum: replica + wallet + vote server.
 
-    Serves two methods over comm.wire frames:
-    - ``bft_validate {i, op, auth?}``: validate op for chain position i.
-      Exactly-once voting per position; ops arrive strictly in order
-      (``OUT_OF_ORDER`` + our log size tells a lagging writer what to
-      resend); re-requests for an already-applied identical op re-sign
-      idempotently (a writer retrying after a lost reply must not wedge).
-    - ``info``: replica position (log_size/log_head/epoch), the resync
+    Serves three methods over comm.wire frames:
+    - ``bft_validate {i, op, auth?, t?, cert?, repair?}``: validate op for
+      chain position i at attempt t.  At most one vote per (position,
+      attempt); ops arrive strictly in order (``OUT_OF_ORDER`` + our log
+      size tells a lagging writer what to resend); re-requests for an
+      already-applied identical op re-sign idempotently (a writer
+      retrying after a lost reply must not wedge).  A DIFFERENT op at a
+      bound tip position is re-voted only on quorum evidence: an
+      existing commit certificate for it (resync-and-retry) or a valid
+      repair proof whose mandate admits it (re-proposal) — the replica
+      rolls back to the certified prefix, re-applies, and re-signs.
+    - ``bft_abandon {i, t}``: issue a signed abandon statement for the
+      position (what we hold there, if anything) and promise to refuse
+      votes below attempt t — the repair round's raw material.
+    - ``info``: replica position (log_size/log_head/epoch; pass ``at`` for
+      the head at an earlier index), the resync + invariant-monitor
       surface.
 
     The node APPLIES an op the moment it votes for it: its vote is a
     promise that this op IS position i of its chain, which is exactly
-    what makes a second, different op at i unsignable ("CONFLICT").
+    what makes a second, different op at i unsignable ("CONFLICT")
+    without quorum evidence.
     """
 
     def __init__(self, cfg: ProtocolConfig, wallet, index: int, *,
@@ -300,6 +451,7 @@ class ValidatorNode:
         self.wallet = wallet
         self.index = index
         self.require_auth = require_auth
+        self._ledger_backend = ledger_backend
         # peer validator public keys: with these provisioned, a backlog op
         # carrying an existing quorum CERTIFICATE is admitted without
         # client auth evidence — the quorum already re-verified the tag,
@@ -318,7 +470,10 @@ class ValidatorNode:
         self.directory = directory if directory is not None \
             else PublicDirectory()
         self._lock = threading.Lock()
-        self._voted: Dict[int, bytes] = {}      # index -> op digest signed
+        # index -> (attempt, op digest) of our current vote there
+        self._voted: Dict[int, Tuple[int, bytes]] = {}
+        # index -> lowest attempt we will still vote at (abandon promises)
+        self._promised: Dict[int, int] = {}
         self._heads: List[bytes] = []           # head after each op
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -364,8 +519,20 @@ class ValidatorNode:
                                  "log_size": self.ledger.log_size(),
                                  "log_head": self.ledger.log_head().hex(),
                                  "epoch": self.ledger.epoch}
+                        # head at an earlier index: the chaos invariant
+                        # monitor's certified-prefix-agreement probe
+                        try:
+                            at = int(msg.get("at", -1))
+                        except (TypeError, ValueError):
+                            at = -1
+                        if 0 <= at <= len(self._heads):
+                            reply["head_at"] = (
+                                self._heads[at - 1].hex() if at
+                                else _EMPTY_HEAD.hex())
                 elif method == "bft_validate":
                     reply = self._validate(msg)
+                elif method == "bft_abandon":
+                    reply = self._abandon(msg)
                 else:
                     reply = {"ok": False,
                              "error": f"unknown method {method!r}"}
@@ -379,91 +546,195 @@ class ValidatorNode:
                 pass
 
     # --------------------------------------------------------------- vote
-    def _refuse(self, status: str, detail: str = "") -> dict:
+    def _refuse(self, status: str, detail: str = "", **extra) -> dict:
         if self.verbose:
             print(f"[validator {self.index}] refuse: {status} {detail}",
                   flush=True)
         return {"ok": False, "status": status, "detail": detail,
-                "log_size": self.ledger.log_size()}
+                "log_size": self.ledger.log_size(), **extra}
 
-    def _sign_position(self, i: int, op: bytes) -> dict:
+    def _sign_position(self, i: int, op: bytes, attempt: int) -> dict:
         prev = self._heads[i - 1] if i > 0 else _EMPTY_HEAD
         head = self._heads[i]
-        sig = self.wallet.sign(cert_payload(i, prev, op, head))
-        return {"ok": True, "i": i, "validator": self.index,
+        sig = self.wallet.sign(cert_payload(i, prev, op, head, attempt))
+        return {"ok": True, "i": i, "validator": self.index, "t": attempt,
                 "head": head.hex(), "sig": sig.hex()}
 
-    def _certified_backlog(self, msg: dict, i: int, op: bytes) -> bool:
-        """True when `msg` carries a quorum certificate binding exactly
-        (i, OUR head, op) — an op the validator fleet already admitted
-        once, acceptable without per-client auth evidence (which lives
-        only in the original writer's process).  For register ops the
-        self-authenticating pubkey still enrolls, so later FRESH ops from
-        that client verify here."""
+    def _enroll_register_pubkey(self, op: bytes, auth) -> None:
+        """Recover a register op's self-authenticating pubkey into our
+        directory mirror (certificate-admitted ops carry no verified tag,
+        but later FRESH ops from that client must still verify here)."""
+        if not (op and op[0] == _OP_REGISTER and isinstance(auth, dict)):
+            return
+        try:
+            pub = bytes.fromhex(auth.get("pubkey", ""))
+            body = op[1:]
+            (n,) = struct.unpack_from("<q", body, 0)
+            addr = body[8:8 + n].decode()
+            if pub and address_of(pub) == addr \
+                    and not self.directory.knows(addr):
+                self.directory.enroll(pub)
+        except (ValueError, UnicodeDecodeError, struct.error):
+            pass
+
+    def _peer_certificate(self, msg: dict, i: int,
+                          op: bytes) -> Optional[CommitCertificate]:
+        """The request's certificate, iff it verifies as a quorum binding
+        of exactly (i, OUR prefix head, op); else None."""
         if not self.validator_keys:
-            return False
+            return None
         cert_wire = msg.get("cert")
         if not isinstance(cert_wire, dict):
-            return False
+            return None
         try:
             cert = CommitCertificate.from_wire(cert_wire)
         except ValueError:
-            return False
+            return None
         prev = self._heads[i - 1] if i > 0 else _EMPTY_HEAD
         if not verify_certificate(cert, index=i, prev_head=prev, op=op,
                                   quorum=self.quorum,
                                   validator_keys=self.validator_keys):
-            return False
-        auth = msg.get("auth")
-        if op and op[0] == _OP_REGISTER and isinstance(auth, dict):
-            try:
-                pub = bytes.fromhex(auth.get("pubkey", ""))
-                body = op[1:]
-                (n,) = struct.unpack_from("<q", body, 0)
-                addr = body[8:8 + n].decode()
-                if pub and address_of(pub) == addr \
-                        and not self.directory.knows(addr):
-                    self.directory.enroll(pub)
-            except (ValueError, UnicodeDecodeError, struct.error):
-                pass
-        return True
+            return None
+        return cert
+
+    def _rollback_to(self, i: int) -> None:
+        """Rebuild the replica from the certified prefix ops[0..i) —
+        quorum evidence just proved our tip vote lost, so the suffix is
+        provably uncertifiable history."""
+        from bflc_demo_tpu.ledger import clone_prefix
+        self.ledger = clone_prefix(self.ledger, i, self.cfg,
+                                   backend=self._ledger_backend)
+        del self._heads[i:]
+        for j in [k for k in self._voted if k >= i]:
+            del self._voted[j]
+
+    def _apply_and_sign(self, i: int, op: bytes, op_hash: bytes,
+                        attempt: int) -> dict:
+        st = self.ledger.validate_op(op)
+        if st != LedgerStatus.OK:
+            # the replica's own re-execution of the decision procedure
+            # (epoch/role/cap/duplicate guards) rejected the op
+            return self._refuse(st.name)
+        st = self.ledger.apply_op(op)
+        if st != LedgerStatus.OK:       # unreachable: validate just passed
+            return self._refuse(st.name, "apply after validate")
+        self._voted[i] = (attempt, op_hash)
+        self._heads.append(self.ledger.log_head())
+        return self._sign_position(i, op, attempt)
 
     def _validate(self, msg: dict) -> dict:
         try:
             i = int(msg["i"])
             op = bytes.fromhex(msg["op"])
+            attempt = int(msg.get("t", 0))
         except (KeyError, TypeError, ValueError):
             return self._refuse("BAD_REQUEST")
         op_hash = hashlib.sha256(op).digest()
         with self._lock:
             size = self.ledger.log_size()
+            promised = self._promised.get(i, 0)
             if i < size:
-                # already bound here: idempotent re-sign IF it is the same
-                # op; anything else is an attempted fork of our history
-                if self._voted.get(i) == op_hash:
-                    return self._sign_position(i, op)
-                return self._refuse("CONFLICT",
-                                    f"position {i} already holds a "
-                                    f"different op")
+                voted_t, voted_hash = self._voted.get(i, (0, None))
+                if voted_hash == op_hash:
+                    # idempotent re-sign of the op we hold; the attempt
+                    # upgrades freely (same op can never fork) but never
+                    # below an outstanding abandon promise
+                    t = max(attempt, voted_t)
+                    if t < promised:
+                        return self._refuse(
+                            "PROMISED", f"promised attempt {promised}",
+                            promised=promised, voted_t=voted_t)
+                    self._voted[i] = (t, op_hash)
+                    return self._sign_position(i, op, t)
+                # a DIFFERENT op at a bound position: only quorum evidence
+                # may move us.  (1) resync-and-retry — an existing commit
+                # certificate proves the canonical chain holds `op` here;
+                # since the certificate binds OUR OWN prefix head, our
+                # whole suffix from i provably lost (rollback depth is
+                # arbitrary: a validator that kept voting on a stale fork
+                # may have diverged several ops deep).
+                cert = self._peer_certificate(msg, i, op)
+                repair_ok = False
+                if cert is None and i == size - 1 \
+                        and attempt > voted_t and attempt >= promised:
+                    # (2) re-proposal — a repair proof at this attempt
+                    # whose mandate admits `op` (or mandates nothing)
+                    ok, mandated, _ = verify_repair_proof(
+                        msg.get("repair"), i, attempt, self.quorum,
+                        self.validator_keys)
+                    repair_ok = ok and (mandated is None
+                                        or mandated == op_hash)
+                if cert is None and not repair_ok:
+                    return self._refuse(
+                        "CONFLICT",
+                        f"position {i} already holds a different op",
+                        voted_t=voted_t, promised=promised)
+                # the repair proof authorizes the ROLLBACK, never an auth
+                # bypass: client-originated ops still need their tag (or
+                # an existing certificate, which embeds a quorum's
+                # re-verification of it)
+                if cert is None and self.require_auth:
+                    err = check_op_auth(op, msg.get("auth"),
+                                        self.directory)
+                    if err:
+                        return self._refuse("AUTH", err)
+                self._enroll_register_pubkey(op, msg.get("auth"))
+                self._rollback_to(i)
+                t = max(attempt, cert.attempt if cert else 0)
+                return self._apply_and_sign(i, op, op_hash, t)
             if i > size:
                 # strict ordering: we cannot judge op i without the prefix
                 return self._refuse("OUT_OF_ORDER",
                                     f"replica at {size}, asked for {i}")
+            if attempt < promised:
+                return self._refuse("PROMISED",
+                                    f"promised attempt {promised}",
+                                    promised=promised, voted_t=0)
             if self.require_auth:
                 err = check_op_auth(op, msg.get("auth"), self.directory)
-                if err and not self._certified_backlog(msg, i, op):
-                    return self._refuse("AUTH", err)
-            st = self.ledger.validate_op(op)
-            if st != LedgerStatus.OK:
-                # the replica's own re-execution of the decision procedure
-                # (epoch/role/cap/duplicate guards) rejected the op
-                return self._refuse(st.name)
-            st = self.ledger.apply_op(op)
-            if st != LedgerStatus.OK:   # unreachable: validate just passed
-                return self._refuse(st.name, "apply after validate")
-            self._voted[i] = op_hash
-            self._heads.append(self.ledger.log_head())
-            return self._sign_position(i, op)
+                if err:
+                    if self._peer_certificate(msg, i, op) is None:
+                        return self._refuse("AUTH", err)
+                    # certified backlog: the quorum already re-verified
+                    # the client tag once; admit on the certificate
+                    self._enroll_register_pubkey(op, msg.get("auth"))
+            return self._apply_and_sign(i, op, op_hash, attempt)
+
+    def _abandon(self, msg: dict) -> dict:
+        """Issue a signed abandon statement for (i, t): report what we
+        hold at position i and promise to refuse votes below attempt t.
+        The statement set (2f+1 of them) is the repair proof that makes a
+        re-proposal safe."""
+        try:
+            i = int(msg["i"])
+            t = int(msg["t"])
+        except (KeyError, TypeError, ValueError):
+            return self._refuse("BAD_REQUEST")
+        with self._lock:
+            size = self.ledger.log_size()
+            if i < size - 1:
+                # below the tip sits certified history — it is never
+                # abandonable (rollback depth is at most one op)
+                return self._refuse("CONFLICT",
+                                    f"position {i} is certified history")
+            voted_t, voted_hash = self._voted.get(i, (0, None))
+            promised = self._promised.get(i, 0)
+            if t < promised or (voted_hash is not None and t <= voted_t):
+                return self._refuse("STALE_ATTEMPT",
+                                    f"promised {promised}, voted at "
+                                    f"{voted_t}",
+                                    promised=promised, voted_t=voted_t)
+            self._promised[i] = t
+            has_vote = voted_hash is not None
+            op = self.ledger.log_op(i) if has_vote else b""
+            sig = self.wallet.sign(abandon_stmt_payload(
+                i, t, self.index, has_vote, voted_t,
+                voted_hash or b"\0" * 32))
+            return {"ok": True, "i": i, "t": t, "validator": self.index,
+                    "has_vote": has_vote,
+                    "op_hash": (voted_hash or b"").hex(),
+                    "op": op.hex(), "voted_t": voted_t,
+                    "sig": sig.hex()}
 
 
 class ValidatorClient:
@@ -514,16 +785,32 @@ class CertificateAssembler:
     each vote signature against the provisioned keys (a lying
     validator's garbage does not count), and returns the certificate
     once >= quorum distinct valid signatures agree — or None.
+
+    Liveness repair (round 7): when votes split below quorum because
+    validators hold a DIFFERENT op at the position (a dead writer's
+    stranded proposal, a promotion race, an equivocation), certify runs
+    abandon rounds at rising attempt numbers: 2f+1 signed statements
+    form a repair proof, the mandate rule picks the only safely
+    re-proposable op, and diverged validators roll back and re-vote —
+    so the stall degrades to delay.  A proposer whose own op LOSES the
+    mandate (a foreign op is canonically bound at its position) gets
+    None back with `superseded_op` set to the canonical op bytes — its
+    chain suffix is doomed and it must step aside (self-fence / re-follow).
     """
 
     def __init__(self, endpoints: List[Endpoint],
                  validator_keys: Dict[int, bytes], quorum: int, *,
-                 timeout_s: float = 10.0, tls=None, backlog_fn=None):
+                 timeout_s: float = 10.0, tls=None, backlog_fn=None,
+                 max_repair_rounds: int = 3):
         self.endpoints = list(endpoints)
         self.keys = dict(validator_keys)
         self.quorum = quorum
         self.timeout_s = timeout_s
         self.backlog_fn = backlog_fn
+        self.max_repair_rounds = max_repair_rounds
+        # set (instead of a certificate) when a repair round proved a
+        # FOREIGN op is the only safely bindable one at the position
+        self.superseded_op: Optional[bytes] = None
         self._clients = [ValidatorClient(ep, timeout_s=timeout_s, tls=tls)
                          for ep in endpoints]
 
@@ -532,14 +819,16 @@ class CertificateAssembler:
             c.close()
 
     def _vote_one(self, client: ValidatorClient, i: int, op: bytes,
-                  auth: Optional[dict]) -> Optional[dict]:
-        """One validator's vote for (i, op), resyncing its replica from
-        the backlog when it reports OUT_OF_ORDER.  None = no usable vote
-        (refusal, conflict, or transport failure)."""
-        for attempt in (0, 1):          # one reconnect per certify call
+                  auth: Optional[dict], attempt: int,
+                  repair: Optional[dict]) -> Optional[dict]:
+        """One validator's reply for (i, op, attempt), resyncing its
+        replica from the backlog when it reports OUT_OF_ORDER.  Returns
+        the final reply dict (ok or refusal); None = transport failure."""
+        for retry in (0, 1):            # one reconnect per certify call
             try:
                 r = client.request("bft_validate", i=i, op=op.hex(),
-                                   auth=auth)
+                                   auth=auth, t=attempt, repair=repair)
+                resyncs = 0
                 while (not r.get("ok")
                        and r.get("status") == "OUT_OF_ORDER"
                        and self.backlog_fn is not None):
@@ -554,39 +843,70 @@ class CertificateAssembler:
                                             op=bop.hex(), auth=bauth,
                                             cert=bcert)
                         if not rj.get("ok"):
-                            return None
+                            # the replica may hold a diverged SUFFIX
+                            # below j (it voted an op that later lost a
+                            # repair round while it was behind — the
+                            # canonical op then mis-applies onto its
+                            # fork): certificate resync walks back to
+                            # the true divergence point and heals it,
+                            # after which the backlog replay restarts
+                            resyncs += 1
+                            if resyncs > 2 or \
+                                    not self._resync_diverged(client, j):
+                                return None
+                            break
                     r = client.request("bft_validate", i=i, op=op.hex(),
-                                       auth=auth)
-                return r if r.get("ok") else None
+                                       auth=auth, t=attempt, repair=repair)
+                return r
             except (ConnectionError, WireError, OSError):
                 client.close()
-                if attempt:
+                if retry:
                     return None
         return None
 
-    def certify(self, i: int, op: bytes, auth: Optional[dict],
-                prev_head: bytes) -> Optional[CommitCertificate]:
+    def _gather_votes(self, i: int, op: bytes, auth: Optional[dict],
+                      prev_head: bytes, attempt: int,
+                      repair: Optional[dict]):
+        """-> (sigs_by_attempt, refusals, diverged): verified signatures
+        grouped by the attempt each validator actually signed at (an
+        idempotent re-sign may report a higher attempt than requested;
+        payloads differ per attempt, so a certificate needs a uniform
+        group).  `diverged` holds the clients whose ok-reply signature
+        did NOT verify over our payload — the fingerprint of a replica
+        voting on a stale fork (its head differs), which needs an active
+        certificate resync, not a repair round."""
         new_head = next_head(prev_head, op)
-        payload = cert_payload(i, prev_head, op, new_head)
-        votes: Dict[int, bytes] = {}
+        votes: Dict[int, Dict[int, bytes]] = {}
+        refusals: List[dict] = []
+        diverged: List[ValidatorClient] = []
         lock = threading.Lock()
 
         def ask(client):
-            r = self._vote_one(client, i, op, auth)
+            r = self._vote_one(client, i, op, auth, attempt, repair)
             if r is None:
+                return
+            if not r.get("ok"):
+                with lock:
+                    refusals.append(r)
                 return
             try:
                 vidx = int(r["validator"])
+                vt = int(r.get("t", attempt))
                 sig = bytes.fromhex(r["sig"])
             except (KeyError, TypeError, ValueError):
                 return
             pub = self.keys.get(vidx)
+            if pub is None:
+                return
             # verify BEFORE counting: a Byzantine validator's garbage
             # signature (or a vote minted on a diverged replica, whose
             # head therefore differs) must not contribute to the quorum
-            if pub is not None and verify_signature(pub, payload, sig):
-                with lock:
-                    votes[vidx] = sig
+            payload = cert_payload(i, prev_head, op, new_head, vt)
+            with lock:
+                if verify_signature(pub, payload, sig):
+                    votes.setdefault(vt, {})[vidx] = sig
+                else:
+                    diverged.append(client)
 
         threads = [threading.Thread(target=ask, args=(c,), daemon=True)
                    for c in self._clients]
@@ -594,11 +914,146 @@ class CertificateAssembler:
             t.start()
         for t in threads:
             t.join(timeout=self.timeout_s + 5.0)
-        if len(votes) < self.quorum:
-            return None
-        return CommitCertificate(index=i, prev_head=prev_head or _EMPTY_HEAD,
-                                 op_hash=hashlib.sha256(op).digest(),
-                                 new_head=new_head, sigs=dict(votes))
+        return votes, refusals, diverged
+
+    def _resync_diverged(self, client: ValidatorClient, i: int) -> bool:
+        """Heal a replica that kept extending a stale fork: locate the
+        first position where its head leaves our chain, then present the
+        commit certificate for OUR op there — the validator verifies the
+        quorum binding over its own shared prefix, rolls its suffix back
+        and rejoins (ValidatorNode resync path).  The regular backlog
+        replay then carries it forward."""
+        if self.backlog_fn is None:
+            return False
+        try:
+            inf = client.request("info")
+            size = min(int(inf.get("log_size", 0)), i)
+        except (ConnectionError, WireError, OSError, TypeError,
+                ValueError):
+            client.close()
+            return False
+        # our heads over the certified backlog (chain-rule fold)
+        ops = [self.backlog_fn(j) for j in range(size)]
+        heads = []
+        h = _EMPTY_HEAD
+        for entry in ops:
+            heads.append(next_head(h, entry[0]))
+            h = heads[-1]
+        d = size                        # first divergent index
+        for j in range(size, 0, -1):
+            try:
+                r = client.request("info", at=j)
+            except (ConnectionError, WireError, OSError):
+                client.close()
+                return False
+            if r.get("head_at") and \
+                    bytes.fromhex(r["head_at"]) == heads[j - 1]:
+                break
+            d = j - 1
+        if d >= size:
+            return False                # no divergence below i after all
+        op, auth = ops[d][0], ops[d][1]
+        cert = ops[d][2] if len(ops[d]) > 2 else None
+        if cert is None:
+            return False
+        try:
+            r = client.request("bft_validate", i=d, op=op.hex(),
+                               auth=auth, cert=cert)
+            return bool(r.get("ok"))
+        except (ConnectionError, WireError, OSError):
+            client.close()
+            return False
+
+    def _abandon_round(self, i: int, attempt: int):
+        """Ask every validator for a signed abandon statement at (i,
+        attempt); one internal re-ask at a higher attempt when stale
+        promises surface.  -> (statements, attempt_used)."""
+        for _ in range(2):
+            stmts: List[dict] = []
+            stale = attempt
+            lock = threading.Lock()
+
+            def ask(client):
+                nonlocal stale
+                try:
+                    r = client.request("bft_abandon", i=i, t=attempt)
+                except (ConnectionError, WireError, OSError):
+                    client.close()
+                    return
+                with lock:
+                    if r.get("ok"):
+                        stmts.append(r)
+                    elif r.get("status") == "STALE_ATTEMPT":
+                        try:
+                            stale = max(stale,
+                                        int(r.get("promised", 0)),
+                                        int(r.get("voted_t", 0)))
+                        except (TypeError, ValueError):
+                            pass
+
+            threads = [threading.Thread(target=ask, args=(c,),
+                                        daemon=True)
+                       for c in self._clients]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=self.timeout_s + 5.0)
+            if len(stmts) >= self.quorum or stale <= attempt:
+                return stmts, attempt
+            attempt = stale + 1
+        return stmts, attempt
+
+    def certify(self, i: int, op: bytes, auth: Optional[dict],
+                prev_head: bytes) -> Optional[CommitCertificate]:
+        self.superseded_op = None
+        op_hash = hashlib.sha256(op).digest()
+        new_head = next_head(prev_head, op)
+        attempt, repair = 0, None
+        for _ in range(self.max_repair_rounds + 1):
+            votes, refusals, diverged = self._gather_votes(
+                i, op, auth, prev_head, attempt, repair)
+            if diverged:
+                # heal stale-fork replicas BEFORE taking the quorum exit:
+                # a diverged validator silently erodes the f margin, and
+                # its certificate-led rollback is cheap — then re-gather
+                healed = [self._resync_diverged(c, i) for c in diverged]
+                if any(healed):
+                    continue
+            for vt, sigs in sorted(votes.items()):
+                if len(sigs) >= self.quorum:
+                    return CommitCertificate(
+                        index=i, prev_head=prev_head or _EMPTY_HEAD,
+                        op_hash=op_hash, new_head=new_head,
+                        attempt=vt, sigs=dict(sigs))
+            blockers = [r for r in refusals
+                        if r.get("status") in ("CONFLICT", "PROMISED",
+                                               "STALE_ATTEMPT")]
+            if not blockers or self.quorum <= 0:
+                # transport / availability failure, not divergence: a
+                # repair round cannot help — the caller retries later
+                return None
+            hint = 0
+            for r in blockers:
+                try:
+                    hint = max(hint, int(r.get("promised", 0) or 0),
+                               int(r.get("voted_t", 0) or 0))
+                except (TypeError, ValueError):
+                    pass
+            for vt in votes:
+                hint = max(hint, vt)
+            stmts, next_t = self._abandon_round(i, max(attempt, hint) + 1)
+            proof = {"stmts": stmts}
+            ok, mandated, mop = verify_repair_proof(
+                proof, i, next_t, self.quorum, self.keys)
+            if not ok:
+                return None             # no statement quorum reachable
+            if mandated is not None and mandated != op_hash:
+                # a foreign op is the only safely bindable one here: OUR
+                # chain suffix lost the race — step aside, don't stall
+                self.superseded_op = mop
+                return None
+            attempt, repair = next_t, proof
+        return None
 
 
 def provision_validators(n: int, master_seed: bytes):

@@ -51,16 +51,18 @@ This module closes it:
   DUPLICATE ("already in") for an op whose reply was lost, which callers
   treat as progress.
 
-Known window (documented, not hidden): in ASYNC mode (quorum=0), if the
-writer dies after streaming an upload op but before the standby mirrored
-that update's payload blob (fetched per-op, bypassing the QueryAllUpdates
-round gate), the promoted writer holds the update record without its
-payload.  An honest uploader that never saw its reply retries and
-re-supplies the blob (the upload handler re-accepts payloads for
-DUPLICATE uploads); an uploader that already got its reply will not, and
-that round can only complete via the stall-recovery path once the round
-closes over the remaining updates.  In QUORUM mode this window is CLOSED:
-the standby acks an upload only after mirroring its payload, so an
+Upload payloads are mirrored BEFORE the op applies (round 7): a streamed
+upload op binds on the standby only once its payload blob landed (fetched
+per-op, bypassing the QueryAllUpdates round gate), so in EVERY mode —
+async included — a promoted standby never holds an update record without
+its payload.  If the writer dies mid-fetch the op simply never applied
+here: the promoted chain lacks the record entirely and the uploader's
+signed retry re-supplies both record and blob.  The one deliberate
+exception: when the writer authoritatively answers "unknown blob" (the
+round already aggregated and the blob was consumed), the op applies as
+historical record with its ack clamped until the replayed chain's epoch
+moves past it — a blob that no longer exists writer-side cannot gate
+replication forever.  Quorum mode keeps its stronger property on top: an
 acknowledged upload provably survives writer death with its blob
 (regression-tested in tests/test_failover.py).
 """
@@ -85,6 +87,13 @@ Endpoint = Tuple[str, int]
 
 class WriterDead(Exception):
     """The followed writer is unreachable."""
+
+
+class PromotionSuperseded(Exception):
+    """This standby's fence op lost the promotion race: a validator
+    quorum mandated a FOREIGN op at its chain position (another proposer
+    won).  The standby has already rolled its fence op back; it must
+    re-follow the winner instead of serving."""
 
 
 class FailoverClient:
@@ -364,14 +373,20 @@ class Standby:
         self.bft_timeout_s = bft_timeout_s
         self._certs: Dict[int, dict] = {}
         self.verbose = verbose
+        self._ledger_backend = ledger_backend
         self.ledger = make_ledger(cfg, backend=ledger_backend)
         self._blobs: Dict[bytes, bytes] = {}
-        # quorum-ack correctness (ADVICE r5 medium): upload ops whose
-        # payload blob is not yet mirrored, by chain index.  Outgoing acks
-        # are CLAMPED below the lowest pending index — acks are cumulative
-        # watermarks on the writer, so acking op j would otherwise
-        # silently certify an unmirrored upload i<j as durable.
+        # upload ops applied WITHOUT their payload blob, by chain index.
+        # Since round 7 the follow loop mirrors a payload BEFORE applying
+        # its op, so this holds only the one sanctioned exception: the
+        # writer authoritatively answered "unknown blob" (the round
+        # already aggregated it away).  Outgoing acks stay CLAMPED below
+        # the lowest pending index (acks are cumulative watermarks
+        # upstream) until the replayed epoch moves past the record.
         self._pending_payload: Dict[int, bytes] = {}
+        # set by _mirror_upload_payload when the writer ANSWERED the blob
+        # fetch negatively (vs a transport failure) — reset per attempt
+        self._blob_unknown = False
         self._model_blob: Optional[bytes] = None
         self._directory = PublicDirectory() if require_auth else None
         # sync gating: only hit the writer's sideband endpoints when the
@@ -402,18 +417,39 @@ class Standby:
         """Follow -> (writer dies) -> promote or re-follow -> serve."""
         writer = 0                      # index of the endpoint we follow
         while not self._stop.is_set():
-            try:
-                self._follow(self.endpoints[writer])
-            except WriterDead:
-                if self.verbose:
-                    print(f"[standby {self.index}] writer "
-                          f"{self.endpoints[writer]} dead", flush=True)
+            if 0 <= writer < len(self.endpoints):
+                try:
+                    self._follow(self.endpoints[writer])
+                except WriterDead:
+                    if self.verbose:
+                        print(f"[standby {self.index}] writer "
+                              f"{self.endpoints[writer]} dead", flush=True)
             if self._stop.is_set():
                 return
             winner = self._elect()
             if winner == self.index:
+                if self._model_blob is None:
+                    # a freshly (re)started standby can win the priority
+                    # election before it ever mirrored state — it has
+                    # nothing to serve.  Follow ANY serving peer
+                    # (regardless of priority index) to rebuild state
+                    # first; only then is promotion meaningful.
+                    writer = self._any_serving_peer()
+                    time.sleep(self.heartbeat_s)
+                    continue
                 try:
                     self._promote_and_serve()
+                    return
+                except PromotionSuperseded:
+                    # another proposer's fence op is canonically bound at
+                    # our position: we lost the race (fence op already
+                    # rolled back) — re-follow the winner
+                    if self.verbose:
+                        print(f"[standby {self.index}] promotion "
+                              f"superseded; re-following", flush=True)
+                    writer = self._any_serving_peer()
+                    time.sleep(self.heartbeat_s)
+                    continue
                 except Exception:
                     # a failed promotion must not leave the bound socket
                     # accepting connects while nothing serves: peers would
@@ -425,13 +461,12 @@ class Standby:
                     except OSError:
                         pass
                     raise
-                return
-            if winner < 0:
+            elif winner < 0:
                 time.sleep(self.heartbeat_s)   # nobody promotable yet
-                continue
-            writer = winner
-            # give the winner time to finish promotion before subscribing
-            time.sleep(self.heartbeat_s)
+            else:
+                writer = winner
+                # give the winner time to finish promotion first
+                time.sleep(self.heartbeat_s)
 
     # ------------------------------------------------------------ following
     def _follow(self, writer: Endpoint) -> None:
@@ -487,10 +522,11 @@ class Standby:
                 except (TimeoutError, socket.timeout):
                     if not self._writer_alive(writer):
                         raise WriterDead("probe failed")
-                    # idle stream: keep retrying any unmirrored upload
-                    # payloads so a transient blob-fetch failure heals
-                    # WITHOUT waiting for the next op (the clamped ack
-                    # below then advances past it)
+                    # idle stream: keep retrying any record whose blob the
+                    # writer once reported consumed (the only way an
+                    # unmirrored upload can be applied here) and drop the
+                    # moot ones, so the ack clamp lifts without waiting
+                    # for the next op
                     if self._pending_payload:
                         self._retry_pending_payloads(ctl)
                         self._send_ack(sub, last_applied)
@@ -507,34 +543,31 @@ class Standby:
                     # a Byzantine writer streaming forged/forked/
                     # uncertified state is refused, not replicated
                     self._require_certificate(msg, op_index, op_bytes)
+                # mirror-BEFORE-apply: an upload op binds here only once
+                # its payload blob landed, so this replica can never hold
+                # an update record without its payload — in async mode
+                # just as in quorum mode.  If the writer dies mid-fetch
+                # the op never applied: the promoted chain lacks the
+                # record entirely and the uploader's signed retry
+                # re-supplies it.  Returns False only on an authoritative
+                # "unknown blob" (round already aggregated it away): the
+                # op then applies as historical record with its ack
+                # clamped until the replayed epoch moves past it.
+                if not self._await_upload_payload(op_bytes, ctl, writer):
+                    self._pending_payload[op_index] = op_bytes
                 st = self.ledger.apply_op(op_bytes)
                 if st != LedgerStatus.OK:
                     raise RuntimeError(
                         f"standby rejected op {msg['i']}: {st.name} — "
                         f"writer/replica divergence, refusing to continue")
                 last_applied = op_index
-                if op_bytes and op_bytes[0] == self._UPLOAD_OPCODE:
-                    # an applied upload is UNDURABLE until its payload
-                    # blob lands — register it as pending BEFORE anything
-                    # below can fail/continue, so every outgoing ack is
-                    # clamped under it (ADVICE r5: acks are cumulative
-                    # watermarks on the writer; acking any later op would
-                    # silently certify this one as durable without its
-                    # payload, and the acknowledged client never retries
-                    # — the round wedges after promotion).  The sync-
-                    # failure `continue` path skips the mirror entirely;
-                    # registering first keeps the clamp sound there too.
-                    self._pending_payload[op_index] = op_bytes
+                self._drop_moot_payloads()
                 try:
                     self._sync_state(ctl)
                 except (ConnectionError, WireError, OSError):
                     if not self._writer_alive(writer):
                         raise WriterDead("state sync failed")
                     continue            # sideband incomplete: no ack yet
-                self._retry_pending_payloads(ctl)
-                if op_index in self._pending_payload and \
-                        not self._writer_alive(writer):
-                    raise WriterDead("payload mirror failed")
                 # confirm apply + mirror upstream: the writer's quorum-ack
                 # mode counts these before acknowledging mutations
                 # (best-effort — a lost ack only delays, never corrupts)
@@ -543,9 +576,47 @@ class Standby:
             sub.close()
             ctl.close()
 
+    def _await_upload_payload(self, op_bytes: bytes,
+                              ctl: CoordinatorClient,
+                              writer: Endpoint) -> bool:
+        """Block until the op's payload blob is mirrored (True), the
+        writer authoritatively reports it unknown (False — apply with a
+        clamped ack), or the writer dies (raises WriterDead — the op must
+        NOT apply, or a promoted chain would hold a blob-less record)."""
+        if not op_bytes or op_bytes[0] != self._UPLOAD_OPCODE:
+            return True
+        while not self._stop.is_set():
+            self._blob_unknown = False
+            if self._mirror_upload_payload(op_bytes, ctl):
+                return True
+            if self._blob_unknown:
+                return False
+            if not self._writer_alive(writer):
+                raise WriterDead("writer died before the payload of a "
+                                 "streamed upload could be mirrored")
+            time.sleep(min(self.heartbeat_s, 0.25))
+        raise WriterDead("standby stopping")
+
+    def _drop_moot_payloads(self) -> None:
+        """Unblock acks for blob-less records the chain has moved past:
+        once the replayed epoch advances beyond an upload's epoch, its
+        round is settled (aggregated or recovered over) and the missing
+        blob can never be needed again."""
+        if not self._pending_payload:
+            return
+        from bflc_demo_tpu.ledger.tool import decode_op
+        for i in list(self._pending_payload):
+            try:
+                ep = int(decode_op(self._pending_payload[i])["epoch"])
+            except (KeyError, ValueError):
+                ep = None
+            if ep is None or ep < self.ledger.epoch:
+                del self._pending_payload[i]
+
     def _retry_pending_payloads(self, ctl: CoordinatorClient) -> None:
         """Re-attempt the blob fetch for every pending upload op, lowest
         index first (the ack clamp lifts exactly as the holes fill)."""
+        self._drop_moot_payloads()
         for i in sorted(self._pending_payload):
             if self._mirror_upload_payload(self._pending_payload[i], ctl):
                 del self._pending_payload[i]
@@ -613,10 +684,27 @@ class Standby:
         except (ConnectionError, WireError, OSError):
             return False
         if r.get("ok"):
-            blob = bytes.fromhex(r["blob"])
+            try:
+                blob = bytes.fromhex(r.get("blob", ""))
+            except ValueError:
+                blob = b""
             if hashlib.sha256(blob).digest() == ph:
                 self._blobs[ph] = blob
                 return True
+            # the writer ANSWERED with bytes that do not hash to the
+            # op's payload digest: a Byzantine or corrupt writer.  This
+            # gets the same explicit refusal as an uncertified append —
+            # never a silent retry wedge (review: the mirror-before-
+            # apply loop would otherwise spin on it forever)
+            raise RuntimeError(
+                f"standby {self.index}: writer served a corrupt payload "
+                f"blob for {ph.hex()[:12]} — Byzantine or corrupt "
+                f"writer, refusing to replicate")
+        # the writer ANSWERED and does not hold the blob: the round
+        # already aggregated it away (blobs are dropped at commit) —
+        # an authoritative negative, not a transport failure, so the
+        # caller must not block replication on it forever
+        self._blob_unknown = True
         return False
 
     def _sync_state(self, ctl: CoordinatorClient) -> None:
@@ -692,6 +780,19 @@ class Standby:
     def _writer_alive(self, ep: Endpoint) -> bool:
         return self._writer_info(ep) is not None
 
+    def _any_serving_peer(self) -> int:
+        """Index of ANY endpoint currently serving at a generation not
+        behind ours (ignores the priority order — used when this standby
+        cannot or must not promote), or -1."""
+        for j, ep in enumerate(self.endpoints):
+            if j == self.index:
+                continue
+            inf = self._writer_info(ep)
+            if inf is not None and \
+                    int(inf.get("gen", 0)) >= self.ledger.generation:
+                return j
+        return -1
+
     # ------------------------------------------------------------- election
     def _elect(self) -> int:
         """Deterministic, lease-free: the LIVE endpoint with the highest
@@ -721,18 +822,43 @@ class Standby:
         return -1
 
     # ------------------------------------------------------------ promotion
+    def _rollback_last_op(self) -> None:
+        """Drop the chain's final op (our failed fence) by replaying the
+        prefix into a fresh ledger — quorum evidence just proved a
+        foreign op is bound at that position."""
+        from bflc_demo_tpu.ledger import clone_prefix
+        upto = self.ledger.log_size() - 1
+        self.ledger = clone_prefix(self.ledger, upto, self.cfg,
+                                   backend=self._ledger_backend)
+        self._certs.pop(upto, None)
+
+    _PROMOTE_OPCODE = 8         # ledger op codec (ledger/tool.decode_op)
+
     def _certify_promotion(self) -> None:
         """Gather a validator quorum certificate for the just-appended
         promote op; a promotion that cannot certify must NOT serve (BFT
         clients would reject every ack, and rightly so).  This doubles as
-        leader arbitration: validators sign one op per chain position, so
-        two standbys racing to promote at the same index cannot both win.
+        leader arbitration: validators sign one op per chain position and
+        attempt, so two standbys racing to promote at the same index
+        cannot both win — the loser's repair round MANDATES the winner's
+        fence op and this raises PromotionSuperseded (fence op rolled
+        back; the caller re-follows the winner).
+
+        A mandated foreign op that is NOT a fence belongs to a DEAD
+        proposer (the old writer's stranded-but-possibly-certified last
+        op — its voters survive, its process did not): re-following
+        would spin on a ghost, so the standby ADOPTS it — certifies it
+        at this position (holders re-sign idempotently; no client auth
+        is needed for a re-sign), splices it under the fence, and
+        re-fences at the next position.  The record's payload blob, if
+        any, arrives through the uploader's signed retry (the certified
+        DUPLICATE-ack path).  An unreachable quorum (partition, crashed
+        validators) is retried until it heals or the standby is
+        stopped: certification unavailability must degrade to delay,
+        never to a dead failover ladder.
         """
         from bflc_demo_tpu.comm.bft import CertificateAssembler
         from bflc_demo_tpu.comm.ledger_service import chain_head_at
-        ix = self.ledger.log_size() - 1
-        op = self.ledger.log_op(ix)
-        prev = chain_head_at(self.ledger, ix) or b"\0" * 32
         assembler = CertificateAssembler(
             self.bft_validators, self.bft_keys, self.bft_quorum,
             timeout_s=self.bft_timeout_s, tls=None,
@@ -742,14 +868,51 @@ class Standby:
             backlog_fn=lambda j: (self.ledger.log_op(j), None,
                                   self._certs.get(j)))
         try:
-            cert = assembler.certify(ix, op, None, prev)
+            while not self._stop.is_set():
+                ix = self.ledger.log_size() - 1
+                op = self.ledger.log_op(ix)
+                prev = chain_head_at(self.ledger, ix) or b"\0" * 32
+                cert = assembler.certify(ix, op, None, prev)
+                if cert is not None:
+                    self._certs[ix] = cert.to_wire()
+                    return
+                mop = assembler.superseded_op
+                if mop is not None:
+                    if mop[:1] == bytes([self._PROMOTE_OPCODE]):
+                        # a LIVE rival's fence won the position
+                        self._rollback_last_op()
+                        raise PromotionSuperseded(
+                            f"standby {self.index}: a foreign fence op "
+                            f"is bound at position {ix}")
+                    mcert = assembler.certify(ix, mop, None, prev)
+                    if mcert is not None:
+                        self._rollback_last_op()    # drop our fence
+                        st = self.ledger.apply_op(mop)
+                        if st != LedgerStatus.OK:
+                            raise RuntimeError(
+                                f"standby {self.index}: mandated op at "
+                                f"{ix} does not apply: {st.name}")
+                        self._certs[ix] = mcert.to_wire()
+                        st = self.ledger.promote_writer(
+                            self.ledger.generation + 1, self.index)
+                        if st != LedgerStatus.OK:
+                            raise RuntimeError(
+                                f"re-fence rejected: {st.name}")
+                        if self.verbose:
+                            print(f"[standby {self.index}] adopted the "
+                                  f"dead writer's stranded op at {ix}; "
+                                  f"re-fencing at {ix + 1}", flush=True)
+                        continue
+                if self.verbose:
+                    print(f"[standby {self.index}] promotion fence op "
+                          f"gathered no validator quorum yet; retrying",
+                          flush=True)
+                time.sleep(max(self.heartbeat_s, 0.5))
         finally:
             assembler.close()
-        if cert is None:
-            raise RuntimeError(
-                f"standby {self.index}: promotion fence op gathered no "
-                f"validator quorum — refusing to serve uncertified")
-        self._certs[ix] = cert.to_wire()
+        raise RuntimeError(
+            f"standby {self.index}: stopped before the promotion fence "
+            f"op certified")
 
     def _promote_and_serve(self) -> None:
         if self._model_blob is None:

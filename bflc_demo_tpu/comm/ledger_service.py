@@ -544,6 +544,21 @@ class LedgerServer:
                     auth = self._op_auth.get(i)
                 cert = self._bft.certify(i, op, auth, prev)
                 if cert is None:
+                    if getattr(self._bft, "superseded_op", None) \
+                            is not None:
+                        # the validator quorum mandated a FOREIGN op at
+                        # our chain position: someone else (a promoted
+                        # standby) is writing the canonical chain and our
+                        # suffix is provably uncertifiable.  Self-demote
+                        # like the STALE_WRITER path — retrying would
+                        # stall every client against a doomed chain.
+                        if self.verbose:
+                            print("[coordinator] certification "
+                                  "superseded by a foreign proposer: "
+                                  "self-demoting", flush=True)
+                        self.fenced.set()
+                        self.close()
+                        return None
                     if time.monotonic() > deadline:
                         return None
                     # transient quorum failure: retry within budget, but
@@ -885,10 +900,15 @@ class LedgerServer:
                     self._blobs[digest] = blob
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
                     # f64 originals ride along: the op stores f32, the tag
-                    # signs f64 — validators re-check both (comm.bft)
+                    # signs f64 — validators re-check both (comm.bft).
+                    # The sender's (self-authenticating) pubkey rides too,
+                    # so a validator with a directory hole — rejoined
+                    # through a mid-registration promotion — heals on
+                    # this op instead of refusing the client forever
                     self._op_auth[self.ledger.log_size() - 1] = {
                         "tag": m.get("tag", ""), "n": int(m["n"]),
-                        "cost": float(m["cost"])}
+                        "cost": float(m["cost"]),
+                        "pubkey": self._sender_pubkey_hex(addr)}
                 elif st == LedgerStatus.DUPLICATE:
                     # an honest retry (e.g. across a writer failover) whose
                     # original reply was lost: the record is in the ledger —
@@ -925,7 +945,8 @@ class LedgerServer:
                 if st == LedgerStatus.OK:
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
                     self._op_auth[self.ledger.log_size() - 1] = {
-                        "tag": m.get("tag", ""), "scores": scores}
+                        "tag": m.get("tag", ""), "scores": scores,
+                        "pubkey": self._sender_pubkey_hex(addr)}
                 self._touch(addr)
                 self._note_progress(st)
                 if st == LedgerStatus.OK and self.ledger.aggregate_ready():
@@ -977,6 +998,13 @@ class LedgerServer:
                     self._cv.wait(timeout=remaining)
                 return {"ok": True, "log_size": self.ledger.log_size()}
             return {"ok": False, "error": f"unknown method {method!r}"}
+
+    def _sender_pubkey_hex(self, addr: str) -> str:
+        """The sender's enrolled public key (hex, '' when unknown) — the
+        self-authenticating directory-repair evidence validators use
+        (comm.bft.check_op_auth _tofu_repair)."""
+        pub = self.directory.export_raw().get(addr)
+        return pub.hex() if pub is not None else ""
 
     def _resupply_blob(self, digest: bytes, blob: bytes) -> None:
         """Store a hash-verified payload for an update the LEDGER already

@@ -16,6 +16,15 @@ transport.
 
 Frames are capped at 256 MiB: a hostile or corrupt length prefix must not
 drive an unbounded allocation (same rule as the ledger's op-byte bounds).
+
+Fault injection (bflc_demo_tpu.chaos): every frame send/receive consults a
+process-local injector when one is installed — partition windows surface
+as connection errors, delay windows as latency, drop windows as lost
+frames.  This IS the socket boundary, so chaos exercises exactly the
+failure modes real networks produce (a dropped reply, for instance, makes
+the client retry an op the server already applied — the
+duplicate-delivery path).  Without an installed injector the hot path
+pays one None check per frame.
 """
 
 from __future__ import annotations
@@ -27,6 +36,19 @@ from typing import Any, Dict, Optional
 
 MAX_FRAME = 256 << 20
 
+# process-local fault injector (chaos.hooks.FaultInjector) or None.
+# Installed once at child-process startup by the chaos campaign; never
+# mutated afterwards, so no locking is needed on the read side.
+_INJECTOR = None
+
+
+def set_fault_injector(injector) -> None:
+    """Install (or clear, with None) the process-local fault injector
+    consulted on every frame.  The injector's on_send/on_recv may sleep
+    (delay), raise WireError (partition / dropped frame), or pass."""
+    global _INJECTOR
+    _INJECTOR = injector
+
 
 class WireError(ConnectionError):
     """Framing violation or unexpected EOF mid-frame."""
@@ -36,6 +58,8 @@ def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
     data = json.dumps(msg, separators=(",", ":")).encode()
     if len(data) > MAX_FRAME:
         raise WireError(f"frame too large: {len(data)}")
+    if _INJECTOR is not None:
+        _INJECTOR.on_send(sock)
     sock.sendall(struct.pack(">I", len(data)) + data)
 
 
@@ -54,6 +78,8 @@ def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
     """Receive one frame; None on clean EOF (peer closed)."""
+    if _INJECTOR is not None:
+        _INJECTOR.on_recv(sock)
     header = recv_exact(sock, 4)
     if header is None:
         return None

@@ -40,7 +40,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      factory_kw: Optional[dict] = None,
                      standbys: int = 0, tls_dir: str = "",
                      quorum: int = 0, bft_validators: int = 0,
-                     attest_scores: bool = False,
+                     attest_scores: Optional[bool] = None,
+                     chaos_seed: Optional[int] = None,
+                     chaos_profile: str = "standard",
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -48,10 +50,12 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     host: per-client dispatches, reference-shaped event loop;
     threaded: true-concurrency thread-per-client with failure recovery;
     processes: real OS processes over the socket coordinator (the
-    reference's deployment shape; optional hot standbys + TLS + quorum);
+    reference's deployment shape; optional hot standbys + TLS + quorum +
+    BFT validators + a seeded chaos campaign via chaos_seed);
     executor: the composed deployment — OS-process clients stage shards
     over the socket while the coordinator runs every round as ONE SPMD
-    program on its device mesh (optional TLS + score attestation).
+    program on its device mesh (optional TLS; score attestation is
+    default-on, attest_scores=False opts out).
     mesh_kw (participation/client_chunk/remat/...) only apply to 'mesh'.
     """
     # never silently drop a requested trust/fault-tolerance feature: a
@@ -60,8 +64,11 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     inapplicable = []
     if runtime != "processes":
         inapplicable += [("standbys", standbys), ("quorum", quorum),
-                         ("bft_validators", bft_validators)]
-    if runtime != "executor":
+                         ("bft_validators", bft_validators),
+                         ("chaos_seed", chaos_seed is not None)]
+    if runtime not in ("executor", "mesh"):
+        # attestation exists on both mesh-family runtimes (default-on
+        # where wallets exist); elsewhere an explicit request must error
         inapplicable += [("attest_scores", attest_scores)]
     if runtime not in ("processes", "executor") and tls_dir:
         inapplicable += [("tls_dir", tls_dir)]
@@ -73,6 +80,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         return run_federated_mesh(model, shards, test_set, cfg,
                                   rounds=rounds, seed=seed,
                                   ledger_backend=ledger_backend,
+                                  attest_scores=attest_scores,
                                   verbose=verbose, **mesh_kw)
     if mesh_kw:
         raise ValueError(f"options {list(mesh_kw)} only apply to the mesh "
@@ -96,7 +104,8 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
             process_factory, shards, test_set, cfg, rounds=rounds,
             factory_kw=factory_kw or {}, standbys=standbys,
             tls_dir=tls_dir, quorum=quorum,
-            bft_validators=bft_validators, verbose=verbose)
+            bft_validators=bft_validators, chaos_seed=chaos_seed,
+            chaos_profile=chaos_profile, verbose=verbose)
     if runtime == "executor":
         if not process_factory:
             raise ValueError("this preset does not support the 'executor' "

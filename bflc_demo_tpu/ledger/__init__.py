@@ -28,3 +28,19 @@ def make_ledger(cfg: ProtocolConfig = DEFAULT_PROTOCOL, *,
             raise RuntimeError("native ledger requested but "
                                "libbflc_ledger.so could not be built/loaded")
     return PyLedger(*args)
+
+
+def clone_prefix(src, upto: int, cfg: ProtocolConfig, *,
+                 backend: str = "auto"):
+    """Fresh ledger replaying ops[0..upto) of `src` — THE
+    rollback-to-prefix primitive (BFT repair: a replica drops a suffix
+    that quorum evidence just proved uncertifiable).  Raises RuntimeError
+    if the prefix does not replay, which cannot happen on a chain the
+    source ledger itself accepted."""
+    fresh = make_ledger(cfg, backend=backend)
+    for j in range(upto):
+        st = fresh.apply_op(src.log_op(j))
+        if st != LedgerStatus.OK:
+            raise RuntimeError(
+                f"prefix replay rejected op {j}: {st.name}")
+    return fresh

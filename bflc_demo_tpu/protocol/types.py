@@ -94,10 +94,19 @@ class CommitCertificate:
     new_head: bytes                     # head digest after the op (32B)
     sigs: Dict[int, bytes] = dataclasses.field(default_factory=dict)
     # ^ validator index -> Ed25519 signature over cert_payload(...)
+    # certification attempt the signatures were minted at (comm.bft repair
+    # protocol): every signature in ONE certificate is over the SAME
+    # attempt, so a stalled position re-proposed at a higher attempt can
+    # never mix old-attempt and new-attempt votes into a thin quorum.
+    # Certificates at different attempts for the same (index, op) are
+    # equally valid — the repair rule guarantees all attempts converge on
+    # one op per position.
+    attempt: int = 0
 
     def to_wire(self) -> Dict[str, Any]:
         return {"i": self.index, "prev": self.prev_head.hex(),
                 "op_hash": self.op_hash.hex(), "head": self.new_head.hex(),
+                "t": self.attempt,
                 "sigs": {str(v): s.hex() for v, s in self.sigs.items()}}
 
     @classmethod
@@ -111,6 +120,7 @@ class CommitCertificate:
                        prev_head=bytes.fromhex(d["prev"]),
                        op_hash=bytes.fromhex(d["op_hash"]),
                        new_head=bytes.fromhex(d["head"]),
+                       attempt=int(d.get("t", 0)),
                        sigs=sigs)
         except (KeyError, TypeError, AttributeError) as e:
             raise ValueError(f"malformed commit certificate: {e}") from e

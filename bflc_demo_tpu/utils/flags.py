@@ -38,7 +38,13 @@ class RunOptions:
     tls_dir: str = ""                # processes runtime: TLS cert dir
     quorum: int = 0                  # processes runtime: quorum-ack
     bft_validators: int = 0          # processes runtime: BFT commit quorum
-    attest_scores: bool = False      # executor runtime: score attestation
+    # mesh/executor runtimes: score attestation.  Tri-state: None (the
+    # default) = on wherever wallets exist; --attest-scores forces on;
+    # --no-attest-scores is the explicit benchmarking opt-out
+    attest_scores: Optional[bool] = None
+    chaos_seed: int = -1             # processes runtime: >= 0 runs the
+    #                                  seeded fault campaign (chaos/)
+    chaos_profile: str = "standard"  # chaos schedule intensity profile
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 
@@ -62,7 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native committee-consensus federated learning")
     for f in dataclasses.fields(RunOptions):
         flag = "--" + f.name.replace("_", "-")
-        if f.type == "bool" or isinstance(f.default, bool):
+        if f.name == "chaos_profile":
+            # validate at parse time (a typo must be an argparse error,
+            # not a mid-run ValueError from the schedule generator)
+            from bflc_demo_tpu.chaos.schedule import PROFILES
+            p.add_argument(flag, choices=sorted(PROFILES),
+                           default=f.default)
+        elif f.type == "bool" or isinstance(f.default, bool) or \
+                "bool" in str(f.type):
+            # plain bools AND tri-state Optional[bool] flags (None
+            # default = "decide per runtime"; --flag/--no-flag override)
             p.add_argument(flag, action=argparse.BooleanOptionalAction,
                            default=f.default)
         else:

@@ -356,3 +356,67 @@ def test_plot_run_writes_png(tmp_path):
     out = plot_run(res, str(tmp_path / "ev.png"), title="t")
     with open(out, "rb") as f:
         assert f.read(8) == b"\x89PNG\r\n\x1a\n"
+
+
+class TestMeshScoreAttestation:
+    """Round 7: score attestation is default-on across the mesh family
+    when wallets exist, with an explicit attest_scores=False opt-out —
+    the trust feature is no longer a runtime choice (PARITY divergence
+    #1 closed by default)."""
+
+    def test_default_on_with_wallets_and_opt_out(self, small_data):
+        from bflc_demo_tpu.client import run_federated_mesh
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        shards, test_set = small_data
+        wallets, _ = provision_wallets(SMALL.client_num, b"attest-aux-1")
+        res = run_federated_mesh(make_softmax_regression(), shards,
+                                 test_set, SMALL, rounds=2, seed=0,
+                                 attest_wallets=wallets)
+        # wallets present, nothing else asked for: attestation is ON and
+        # every round's committee rows carry verifying signatures
+        assert res.attest_log and sorted(res.attest_log) == [0, 1]
+        led = res.ledger
+        for epoch, sigs in res.attest_log.items():
+            assert len(sigs) == SMALL.comm_count
+            for addr, sig_hex in sigs.items():
+                cid = int(addr, 16)
+                w = wallets[cid]
+                # signature binds (kind, sender, epoch) — re-verifiable
+                # by any holder of the round inputs; here we check the
+                # identity binding round-trips
+                assert addr == f"0x{cid:040x}"
+                assert len(bytes.fromhex(sig_hex)) == 64
+        assert led is not None
+        # explicit opt-out: no attestation work, no log
+        res2 = run_federated_mesh(make_softmax_regression(), shards,
+                                  test_set, SMALL, rounds=2, seed=0,
+                                  attest_wallets=wallets,
+                                  attest_scores=False)
+        assert res2.attest_log is None
+        # identical training outcome either way (attestation is evidence,
+        # not arithmetic)
+        assert res.accuracy_history == res2.accuracy_history
+        # no wallets at all: default stays off...
+        res3 = run_federated_mesh(make_softmax_regression(), shards,
+                                  test_set, SMALL, rounds=1, seed=0)
+        assert res3.attest_log is None
+        # ...but an explicit request without wallets must error, never
+        # silently drop the trust feature
+        with pytest.raises(ValueError, match="wallets"):
+            run_federated_mesh(make_softmax_regression(), shards,
+                               test_set, SMALL, rounds=1, seed=0,
+                               attest_scores=True)
+
+    def test_batched_dispatch_attests_every_replayed_round(self,
+                                                           small_data):
+        from bflc_demo_tpu.client import run_federated_mesh
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        shards, test_set = small_data
+        wallets, _ = provision_wallets(SMALL.client_num, b"attest-aux-2")
+        res = run_federated_mesh(make_softmax_regression(), shards,
+                                 test_set, SMALL, rounds=2, seed=0,
+                                 rounds_per_dispatch=2,
+                                 attest_wallets=wallets)
+        assert res.attest_log and sorted(res.attest_log) == [0, 1]
+        assert all(len(s) == SMALL.comm_count
+                   for s in res.attest_log.values())

@@ -61,7 +61,10 @@ def _sign(w, kind, epoch, payload):
 
 def _mk_validators(n=N_VALIDATORS, seed=b"bft-drill-01"):
     vwallets, vkeys = provision_validators(n, seed)
-    nodes = [ValidatorNode(CFG, w, i) for i, w in enumerate(vwallets)]
+    # peer keys provisioned, as in every production deployment
+    # (process_runtime) — certificate-led resync/backlog need them
+    nodes = [ValidatorNode(CFG, w, i, validator_keys=vkeys)
+             for i, w in enumerate(vwallets)]
     for v in nodes:
         v.start()
     eps = [(v.host, v.port) for v in nodes]
@@ -697,6 +700,263 @@ class TestBFTFailover:
             client.close()
             standby.stop()
             srv.close()
+            for v in nodes:
+                v.close()
+
+
+class TestLivenessRepair:
+    """Round 7: certification recovers from replica divergence instead of
+    stalling forever (resync-and-retry + abandon/re-proposal, comm.bft).
+    Safety stays intact: exactly one op ever certifies per position."""
+
+    def _two_valid_ops(self, wallets):
+        forks = []
+        for w in wallets[:2]:
+            led = make_ledger(CFG, backend="python")
+            led.register_node(w.address)
+            forks.append((led.log_op(0),
+                          {"tag": _sign(w, "register", 0, b""),
+                           "pubkey": w.public_bytes.hex()}))
+        return forks
+
+    def test_equivocating_writer_stalls_then_repair_certifies(self):
+        """The documented round-6 stall: an equivocating writer diverges
+        the validators 2-2 at one position — no branch can quorum.  A
+        subsequent honest proposal now drives the abandon round, the
+        mandate rule picks the one safely bindable op, diverged
+        validators roll back and re-vote, and certification RECOVERS —
+        including for the next fresh op."""
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-live-01")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-live-01")
+        try:
+            (opx, authx), (opy, authy) = self._two_valid_ops(wallets)
+            # the equivocation: X to validators {0,1}, Y to {2,3}
+            for op, auth, half in ((opx, authx, eps[:2]),
+                                   (opy, authy, eps[2:])):
+                asm = CertificateAssembler(half, vkeys, 1, timeout_s=5.0)
+                assert asm.certify(0, op, auth, b"\0" * 32) is not None
+                asm.close()
+            # pre-repair this stalled permanently (comm.bft round-6 doc);
+            # now the honest re-proposal repairs.  The mandate may pick
+            # either branch (2-2 ties are free-choice; a 3-statement
+            # proof can mandate the other side) — what matters is that
+            # EXACTLY ONE certifies and everyone converges.
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0)
+            cert = asm.certify(0, opx, authx, b"\0" * 32)
+            winner, wauth = opx, authx
+            if cert is None:
+                assert asm.superseded_op == opy, \
+                    "no certificate and no mandate: still stalled"
+                winner, wauth = opy, authy
+                cert = asm.certify(0, opy, authy, b"\0" * 32)
+            asm.close()
+            assert cert is not None, "repair failed to certify any op"
+            assert cert.attempt >= 1       # it took a repair round
+            assert verify_certificate(cert, index=0, prev_head=b"\0" * 32,
+                                      op=winner, quorum=QUORUM,
+                                      validator_keys=vkeys)
+            for v in nodes:                # full convergence, no fork
+                assert v.ledger.log_size() == 1
+                assert v.ledger.log_op(0) == winner
+                assert sorted(v._voted) == [0]
+            # the LOSER op can never certify now: every valid repair
+            # proof reports the winner with a unique f+1 mandate
+            loser, lauth = (opy, authy) if winner is opx else (opx, authx)
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0)
+            assert asm.certify(0, loser, lauth, b"\0" * 32) is None
+            assert asm.superseded_op == winner
+            asm.close()
+            # and the chain continues: the next FRESH op certifies clean
+            w2 = next(w for w in wallets
+                      if w.address not in (wallets[0].address,
+                                           wallets[1].address))
+            led = make_ledger(CFG, backend="python")
+            assert led.apply_op(winner) == LedgerStatus.OK
+            led.register_node(w2.address)
+            op2 = led.log_op(1)
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0)
+            cert2 = asm.certify(1, op2,
+                                {"tag": _sign(w2, "register", 0, b""),
+                                 "pubkey": w2.public_bytes.hex()},
+                                next_head(b"\0" * 32, winner))
+            asm.close()
+            assert cert2 is not None
+        finally:
+            for v in nodes:
+                v.close()
+
+    def test_partitioned_validator_heals_and_rejoins(self):
+        """A validator partitioned mid-certification misses ops; on heal
+        the certified backlog carries it forward — one vote per position,
+        no double-voting, full head agreement."""
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-heal-01")
+        vwallets, vkeys = provision_validators(N_VALIDATORS, b"bft-heal-01")
+        nodes = [ValidatorNode(CFG, w, i, validator_keys=vkeys)
+                 for i, w in enumerate(vwallets)]
+        for v in nodes:
+            v.start()
+        try:
+            chain = make_ledger(CFG, backend="python")
+            certs, auths = {}, {}
+
+            def backlog(j):
+                return chain.log_op(j), auths.get(j), certs.get(j)
+
+            # ops 0..2 certify while validator 3 is partitioned away
+            asm = CertificateAssembler([(v.host, v.port)
+                                        for v in nodes[:3]],
+                                       vkeys, QUORUM, timeout_s=5.0,
+                                       backlog_fn=backlog)
+            for j, w in enumerate(wallets[:3]):
+                prev = chain.log_head() if chain.log_size() else b"\0" * 32
+                chain.register_node(w.address)
+                auths[j] = {"tag": _sign(w, "register", 0, b""),
+                            "pubkey": w.public_bytes.hex()}
+                cert = asm.certify(j, chain.log_op(j), auths[j], prev)
+                assert cert is not None
+                certs[j] = cert.to_wire()
+            asm.close()
+            assert nodes[3].ledger.log_size() == 0
+            # heal: the next certification resyncs validator 3 from the
+            # certified backlog and its vote joins the certificate
+            w3 = wallets[3]
+            prev = chain.log_head()
+            chain.register_node(w3.address)
+            auths[3] = {"tag": _sign(w3, "register", 0, b""),
+                        "pubkey": w3.public_bytes.hex()}
+            asm = CertificateAssembler([(v.host, v.port) for v in nodes],
+                                       vkeys, QUORUM, timeout_s=5.0,
+                                       backlog_fn=backlog)
+            cert = asm.certify(3, chain.log_op(3), auths[3], prev)
+            asm.close()
+            assert cert is not None
+            assert len(cert.sigs) == N_VALIDATORS    # the healed one too
+            for v in nodes:
+                assert v.ledger.log_size() == 4
+                assert v.ledger.log_head() == chain.log_head()
+                assert sorted(v._voted) == [0, 1, 2, 3]   # exactly once
+        finally:
+            for v in nodes:
+                v.close()
+
+    def test_stale_fork_validator_resynced_by_certificate(self):
+        """A validator that bound a stranded op keeps voting on its own
+        fork (valid-looking replies, wrong head).  The assembler detects
+        the bad-head vote and heals it by presenting the commit
+        certificate for the canonical op — rollback, rejoin, re-vote."""
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-fork-heal-01")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-fork-heal-01")
+        try:
+            (opx, authx), (opy, authy) = self._two_valid_ops(wallets)
+            # validator 3 binds the STRANDED op Y at position 0 (a dead
+            # writer's last proposal that never certified)
+            vc = ValidatorClient(eps[3], timeout_s=5.0)
+            assert vc.request("bft_validate", i=0, op=opy.hex(),
+                              auth=authy)["ok"]
+            vc.close()
+            chain = make_ledger(CFG, backend="python")
+            certs, auths = {}, {0: authx}
+
+            def backlog(j):
+                return chain.log_op(j), auths.get(j), certs.get(j)
+
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0,
+                                       backlog_fn=backlog)
+            # X certifies through validators 0-2 (v3 answers CONFLICT or
+            # a stale-fork vote; the quorum does not need it)
+            assert chain.apply_op(opx) == LedgerStatus.OK
+            cert0 = asm.certify(0, opx, authx, b"\0" * 32)
+            assert cert0 is not None
+            certs[0] = cert0.to_wire()
+            # the chain moves on; v3 extends its private fork until the
+            # assembler heals it with cert0 — the next certificate must
+            # end up carrying ALL FOUR signatures
+            w2 = wallets[2]
+            chain.register_node(w2.address)
+            auths[1] = {"tag": _sign(w2, "register", 0, b""),
+                        "pubkey": w2.public_bytes.hex()}
+            cert1 = asm.certify(1, chain.log_op(1), auths[1],
+                                next_head(b"\0" * 32, opx))
+            asm.close()
+            assert cert1 is not None
+            assert len(cert1.sigs) == N_VALIDATORS, \
+                "stale-fork validator was not healed"
+            assert nodes[3].ledger.log_op(0) == opx
+            assert nodes[3].ledger.log_head() == chain.log_head()
+            assert sorted(nodes[3]._voted) == [0, 1]
+        finally:
+            for v in nodes:
+                v.close()
+
+
+class TestBacklogResyncThroughDivergence:
+    """The 100-round-soak wedge (round 7): a validator that voted a
+    LOSING op while lagging holds a diverged suffix; later backlog
+    replay of the canonical chain mis-applies onto its fork (here: the
+    same register op landing DUPLICATE) and, pre-fix, refused forever —
+    the replica could never rejoin.  The backlog path must escalate a
+    replay refusal to certificate resync at the true divergence point."""
+
+    def test_backlog_refusal_triggers_cert_resync(self):
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-wedge-01")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-wedge-01")
+        try:
+            regs = []
+            for w in wallets[:5]:
+                led = make_ledger(CFG, backend="python")
+                led.register_node(w.address)
+                regs.append((led.log_op(0),
+                             {"tag": _sign(w, "register", 0, b""),
+                              "pubkey": w.public_bytes.hex()}))
+            # canonical chain: A, B, E, F (E = the op validator 3 will
+            # have stranded at position 1 — the client's retry landed it
+            # at position 2 of the canonical chain)
+            (opa, aa), (opb, ab), (ope, ae), (opf, af), (opg, ag) = regs
+            chain = make_ledger(CFG, backend="python")
+            order = [(opa, aa), (opb, ab), (ope, ae), (opf, af)]
+            certs, auths = {}, {}
+
+            def backlog(j):
+                return chain.log_op(j), auths.get(j), certs.get(j)
+
+            # validator 3 sees op A, then strands op E at position 1
+            vc = ValidatorClient(eps[3], timeout_s=5.0)
+            assert vc.request("bft_validate", i=0, op=opa.hex(),
+                              auth=aa)["ok"]
+            assert vc.request("bft_validate", i=1, op=ope.hex(),
+                              auth=ae)["ok"]
+            vc.close()
+            # the canonical chain certifies through validators 0-2
+            asm3 = CertificateAssembler(eps[:3], vkeys, QUORUM,
+                                        timeout_s=5.0,
+                                        backlog_fn=backlog)
+            for j, (op, auth) in enumerate(order):
+                prev = chain.log_head() if chain.log_size() else b"\0" * 32
+                assert chain.apply_op(op) == LedgerStatus.OK
+                auths[j] = auth
+                cert = asm3.certify(j, op, auth, prev)
+                assert cert is not None, f"op {j} failed to certify"
+                certs[j] = cert.to_wire()
+            asm3.close()
+            assert nodes[3].ledger.log_size() == 2      # stranded fork
+            # full-fleet certification of the next op: validator 3 is
+            # BEHIND (OUT_OF_ORDER) and its fork makes canonical op 2
+            # (register E) refuse as ALREADY_REGISTERED mid-backlog —
+            # the resync escalation must heal it at position 1
+            prev = chain.log_head()
+            assert chain.apply_op(opg) == LedgerStatus.OK
+            auths[4] = ag
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0,
+                                       backlog_fn=backlog)
+            cert = asm.certify(4, opg, ag, prev)
+            asm.close()
+            assert cert is not None
+            assert len(cert.sigs) == N_VALIDATORS, \
+                "wedged validator did not rejoin through the backlog"
+            assert nodes[3].ledger.log_size() == 5
+            assert nodes[3].ledger.log_head() == chain.log_head()
+            assert nodes[3].ledger.log_op(1) == opb     # fork healed
+        finally:
             for v in nodes:
                 v.close()
 

@@ -1,0 +1,254 @@
+"""Chaos engine: seeded fault campaigns against the real process
+federation, with continuous invariant checking (bflc_demo_tpu.chaos).
+
+Three layers:
+- unit: FaultSchedule determinism/replayability from one integer seed,
+  wire-spec concretization, FaultInjector semantics at the frame
+  boundary, torn-WAL injection + recovery;
+- the tier-1 MINI-SOAK: a fixed, fully deterministic campaign (kill +
+  partition + validator kill/restart + writer kill) over a small fleet —
+  every invariant monitor must hold and the federation must finish;
+- the 100-round soak (slow): the headline campaign at config-1 parity
+  geometry (20 clients + 2 standbys + 4 validators + quorum), randomized
+  from a seed, reaching reference-level accuracy under fire
+  (tools/chaos_soak.py is the CLI twin that emits the JSON artifact).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.chaos.hooks import FaultInjector, tear_wal_tail
+from bflc_demo_tpu.chaos.schedule import (FaultEvent, FaultSchedule,
+                                          PROFILES, WireWindow)
+from bflc_demo_tpu.comm.wire import WireError
+from bflc_demo_tpu.data import load_occupancy, iid_shards
+from bflc_demo_tpu.data.occupancy import occupancy_source
+from bflc_demo_tpu.ledger.pyledger import PyLedger
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+
+class TestFaultSchedule:
+    def test_replayable_from_one_seed(self):
+        kw = dict(duration_s=300.0, n_clients=20, n_standbys=2,
+                  n_validators=4, profile="standard")
+        a, b = FaultSchedule(1234, **kw), FaultSchedule(1234, **kw)
+        assert a.summary() == b.summary()
+        assert [w.as_dict() for r in sorted(a.wire_windows)
+                for w in a.wire_windows[r]] == \
+               [w.as_dict() for r in sorted(b.wire_windows)
+                for w in b.wire_windows[r]]
+        c = FaultSchedule(1235, **kw)
+        assert c.summary() != a.summary()   # the seed IS the campaign
+
+    def test_profiles_and_structure(self):
+        assert set(PROFILES) == {"light", "standard", "heavy"}
+        with pytest.raises(ValueError):
+            FaultSchedule(1, duration_s=60, n_clients=4, n_standbys=1,
+                          n_validators=4, profile="nope")
+        s = FaultSchedule(7, duration_s=600.0, n_clients=20,
+                          n_standbys=2, n_validators=4)
+        ts = [e.t for e in s.events]
+        assert ts == sorted(ts)
+        assert all(e.t >= s.grace_s for e in s.events)
+        # every kill of a restartable role has a matching restart; writer
+        # kills never restart (fencing) and never exceed the standby count
+        kills = [e for e in s.events if e.kind == "kill"]
+        writer_kills = [e for e in kills if e.target == "writer"]
+        assert 0 < len(writer_kills) <= 2
+        for e in kills:
+            if e.target == "writer":
+                continue
+            assert any(r.kind == "restart" and r.target == e.target
+                       and r.t > e.t for r in s.events), e
+
+    def test_wire_spec_concretizes_ports(self):
+        s = FaultSchedule(7, duration_s=120.0, n_clients=4, n_standbys=1,
+                          n_validators=4)
+        s.wire_windows = {"client-0": [WireWindow(
+            5.0, 9.0, "partition", ("writer", "standby-1"))]}
+        spec = s.wire_spec("client-0", 1000.0,
+                           {"writer": 7001, "standby-1": 7002})
+        assert spec["t0"] == 1000.0 and spec["role"] == "client-0"
+        assert spec["windows"][0]["ports"] == [7001, 7002]
+        assert s.wire_spec("client-1", 1000.0, {}) is None
+
+
+class _FakeSock:
+    def __init__(self, port):
+        self._port = port
+
+    def getpeername(self):
+        return ("127.0.0.1", self._port)
+
+
+class TestFaultInjector:
+    def _spec(self, windows):
+        return {"t0": time.time(), "role": "client-0", "seed": 1,
+                "windows": windows}
+
+    def test_partition_blocks_only_listed_ports_in_window(self):
+        inj = FaultInjector(self._spec([
+            {"start": -1.0, "end": 60.0, "mode": "partition",
+             "ports": [7001], "p": 1.0, "delay_ms": 0.0}]))
+        with pytest.raises(WireError):
+            inj.on_send(_FakeSock(7001))
+        inj.on_send(_FakeSock(7002))            # other peers untouched
+        inj.on_recv(_FakeSock(7002))
+        assert inj.injected["partition"] == 1
+
+    def test_window_expiry_and_drop_and_delay(self):
+        inj = FaultInjector(self._spec([
+            {"start": -10.0, "end": -5.0, "mode": "partition",
+             "ports": [], "p": 1.0, "delay_ms": 0.0}]))
+        inj.on_send(_FakeSock(7001))            # expired window: clean
+        drop = FaultInjector(self._spec([
+            {"start": -1.0, "end": 60.0, "mode": "drop", "ports": [],
+             "p": 1.0, "delay_ms": 0.0}]))
+        with pytest.raises(WireError):
+            drop.on_recv(_FakeSock(7001))
+        slow = FaultInjector(self._spec([
+            {"start": -1.0, "end": 60.0, "mode": "delay", "ports": [],
+             "p": 1.0, "delay_ms": 30.0}]))
+        t0 = time.monotonic()
+        slow.on_send(_FakeSock(7001))
+        assert time.monotonic() - t0 >= 0.025
+        assert slow.injected["delay"] == 1
+
+
+class TestTornWAL:
+    def test_torn_tail_recovers_to_intact_prefix(self, tmp_path):
+        cfg = ProtocolConfig(client_num=4, comm_count=2,
+                             aggregate_count=2, needed_update_count=2)
+        path = str(tmp_path / "chain.wal")
+        led = PyLedger(4, 2, 2, 2)
+        assert led.attach_wal(path)
+        for i in range(4):
+            led.register_node(f"0x{i:040x}")
+        led.detach_wal()
+        assert tear_wal_tail(path, nbytes=5)
+        fresh = PyLedger(4, 2, 2, 2)
+        # the torn final record is skipped; the intact prefix replays
+        assert fresh.replay_wal(path) == 3
+        assert fresh.num_registered == 3
+        assert cfg  # geometry documented above
+
+    def test_tear_refuses_tiny_files(self, tmp_path):
+        p = tmp_path / "tiny.wal"
+        p.write_bytes(b"BFLCWAL1")
+        assert not tear_wal_tail(str(p))
+
+
+def _small_cfg():
+    return ProtocolConfig(client_num=4, comm_count=2, aggregate_count=2,
+                          needed_update_count=2, learning_rate=0.05,
+                          batch_size=32, local_epochs=2).validate()
+
+
+def _occupancy_fleet(n):
+    xtr, ytr, xte, yte = load_occupancy()
+    return (iid_shards(np.asarray(xtr), np.asarray(ytr), n),
+            (np.asarray(xte), np.asarray(yte)))
+
+
+class TestMiniSoak:
+    """The tier-1 chaos drill: a fixed deterministic campaign composing
+    client kill/restart, validator kill/restart (certified-backlog
+    resync on rejoin), a writer<->validator partition window, a lossy
+    client link, and a writer kill (BFT-certified promotion) — all
+    invariant monitors must hold and the federation must finish."""
+
+    def test_seeded_mini_soak_kill_partition_resync(self):
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        cfg = _small_cfg()
+        shards, test_set = _occupancy_fleet(cfg.client_num)
+        sched = FaultSchedule(123, duration_s=90.0, n_clients=4,
+                              n_standbys=1, n_validators=4,
+                              profile="light")
+        # a handcrafted, fully deterministic event list (same object
+        # shape the seed generator emits — the generator is drilled
+        # above; here the COMPOSITION is pinned so the drill always
+        # exercises kill + partition + resync + failover)
+        sched.events = [
+            FaultEvent(4.0, "kill", "validator-1"),
+            FaultEvent(7.0, "restart", "validator-1"),
+            FaultEvent(9.0, "kill", "client-2"),
+            FaultEvent(11.0, "restart", "client-2"),
+            FaultEvent(13.0, "kill", "writer"),
+        ]
+        sched.wire_windows = {
+            "writer": [WireWindow(5.0, 8.0, "partition",
+                                  ("validator-2",))],
+            "client-1": [WireWindow(6.0, 9.0, "drop",
+                                    ("writer", "standby-1"), p=0.3)],
+        }
+        res = run_federated_processes(
+            "make_softmax_regression", shards, test_set, cfg,
+            rounds=8, standbys=1, bft_validators=4,
+            timeout_s=300.0, chaos_schedule=sched, verbose=False)
+        rep = res.chaos_report
+        assert rep is not None
+        assert rep["violations"] == [], rep["violations"]
+        assert res.rounds_completed >= 8
+        v = rep["invariant_verdicts"]
+        assert v["monotone_progress"] == "PASS"
+        assert v["no_uncertified_bind"] == "PASS"
+        assert v["single_certified_history"] == "PASS"
+        assert v["acked_upload_durability"] == "PASS"
+        executed = {(e["kind"], e["target"])
+                    for e in rep["faults_executed"]}
+        assert ("kill", "validator-1") in executed
+        assert ("restart", "validator-1") in executed
+        # the restarted validator rejoined the certified history
+        assert int(v["validators_probed"]) >= 3
+        assert rep["invariant_checks"]["history_checks"] >= 1
+        assert rep["acked_uploads_checked"] >= 1
+
+
+@pytest.mark.slow
+class TestChaosSoak100:
+    """The headline artifact: 100 rounds at config-1 parity geometry
+    (20 clients + 2 standbys + 4 validators + quorum-ack + WAL) under a
+    seeded randomized kill/partition/delay/drop/tear campaign.  All
+    invariants hold and the run reaches reference-level accuracy
+    (source-aware bar, as in tests/test_e2e.py)."""
+
+    def test_100_round_randomized_campaign(self, tmp_path):
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        cfg = ProtocolConfig().validate()        # config-1 parity genome
+        shards, test_set = _occupancy_fleet(cfg.client_num)
+        res = run_federated_processes(
+            "make_softmax_regression", shards, test_set, cfg,
+            rounds=100, standbys=2, quorum=1, bft_validators=4,
+            wal_path=str(tmp_path / "writer.wal"),
+            timeout_s=2400.0,
+            chaos_seed=int(os.environ.get("BFLC_CHAOS_SEED", "7")),
+            chaos_profile="standard", chaos_duration_s=300.0,
+            verbose=True)
+        rep = res.chaos_report
+        assert rep is not None
+        assert rep["violations"] == [], rep["violations"]
+        assert res.rounds_completed >= 100
+        v = rep["invariant_verdicts"]
+        for key in ("monotone_progress", "no_uncertified_bind",
+                    "single_certified_history",
+                    "acked_upload_durability"):
+            assert v[key] == "PASS", (key, v)
+        # real faults actually fired (a quiet campaign proves nothing)
+        executed = rep["faults_executed"]
+        assert any(e["kind"] == "kill" and e["target"] == "writer"
+                   for e in executed), executed
+        assert sum(1 for e in executed if e["kind"] == "kill") >= 5
+        # reference-level accuracy UNDER FIRE (source-aware bar — the
+        # real UCI distribution supports the 0.92 reference plateau, the
+        # synthetic stand-in oscillates around a different peak; same
+        # convention as tests/test_e2e.py)
+        if occupancy_source() == "csv":
+            assert res.final_accuracy >= 0.92, res.accuracy_history[-5:]
+        else:
+            assert res.best_accuracy() >= 0.85, res.accuracy_history[-5:]
+            assert res.final_accuracy >= 0.80, res.accuracy_history[-5:]

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Chaos soak runner: one seeded fault campaign, one replayable artifact.
+
+Runs the process federation (config1 parity geometry by default: 20
+clients + 2 standbys + 4 BFT validators + quorum-ack) under a seeded
+randomized fault schedule (bflc_demo_tpu.chaos) and writes a JSON
+artifact carrying everything needed to replay or triage a failure:
+
+    {seed, profile, schedule, faults executed/skipped, invariant
+     verdicts + violations, rounds, final/best accuracy, wall time}
+
+Exit code 0 iff every invariant held AND the accuracy bar was met.
+
+The headline campaign (TPU_RESULTS.md / tests/test_chaos.py slow soak):
+
+    python tools/chaos_soak.py --rounds 100 --seed 7 --out soak.json
+
+A quick smoke (seeded mini-soak, ~a minute):
+
+    python tools/chaos_soak.py --rounds 8 --clients 4 --standbys 1 \\
+        --duration 45 --profile light --min-acc 0
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=7,
+                   help="campaign seed (replays the exact schedule)")
+    p.add_argument("--profile", default="standard",
+                   choices=["light", "standard", "heavy"])
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--clients", type=int, default=20)
+    p.add_argument("--standbys", type=int, default=2)
+    p.add_argument("--validators", type=int, default=4)
+    p.add_argument("--quorum", type=int, default=1)
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="fault-window length in seconds "
+                        "(0 = half the timeout)")
+    p.add_argument("--timeout", type=float, default=2400.0)
+    p.add_argument("--min-acc", type=float, default=0.92,
+                   help="final-accuracy bar (config1 parity: 0.92)")
+    p.add_argument("--out", default="",
+                   help="artifact path (default chaos_soak_<seed>.json)")
+    p.add_argument("--wal", default="", help="WAL path (enables the "
+                   "torn-write faults); default: a temp file")
+    p.add_argument("--verbose", action="store_true", default=True)
+    p.add_argument("--quiet", dest="verbose", action="store_false")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+    from bflc_demo_tpu.client.process_runtime import \
+        run_federated_processes
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+    # config1 parity geometry, scaled to --clients when smaller fleets
+    # are requested (the protocol genome scales like eval.configs does)
+    n = args.clients
+    cfg = (ProtocolConfig() if n == 20 else ProtocolConfig(
+        client_num=n, comm_count=max(2, n // 5),
+        aggregate_count=max(2, n // 4),
+        needed_update_count=max(2, n // 2))).validate()
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(np.asarray(xtr), np.asarray(ytr), cfg.client_num)
+
+    wal = args.wal
+    if not wal:
+        import tempfile
+        wal = os.path.join(tempfile.mkdtemp(prefix="bflc-soak-"),
+                           "writer.wal")
+
+    t0 = time.time()
+    failure = ""
+    res = None
+    try:
+        res = run_federated_processes(
+            "make_softmax_regression", shards, (np.asarray(xte),
+                                                np.asarray(yte)),
+            cfg, rounds=args.rounds,
+            standbys=args.standbys, quorum=args.quorum,
+            bft_validators=args.validators, wal_path=wal,
+            timeout_s=args.timeout,
+            chaos_seed=args.seed, chaos_profile=args.profile,
+            chaos_duration_s=(args.duration or None),
+            verbose=args.verbose)
+    except Exception as e:              # noqa: BLE001 — the artifact must
+        # record the failure mode; triage replays by seed
+        failure = f"{type(e).__name__}: {e}"
+
+    report = dict(res.chaos_report or {}) if res is not None else {}
+    violations = report.get("violations", [])
+    final_acc = res.final_accuracy if res is not None else 0.0
+    artifact = {
+        "seed": args.seed,
+        "profile": args.profile,
+        "geometry": {"clients": cfg.client_num,
+                     "standbys": args.standbys,
+                     "validators": args.validators,
+                     "quorum": args.quorum, "rounds": args.rounds},
+        "wall_time_s": round(time.time() - t0, 1),
+        "failure": failure,
+        "rounds_completed": (res.rounds_completed if res else 0),
+        "final_accuracy": round(final_acc, 4),
+        "best_accuracy": round(res.best_accuracy(), 4) if res else 0.0,
+        "min_acc_bar": args.min_acc,
+        "chaos": report,
+    }
+    ok = (not failure and not violations and final_acc >= args.min_acc)
+    artifact["verdict"] = "PASS" if ok else "FAIL"
+
+    out = args.out or f"chaos_soak_{args.seed}.json"
+    with open(out, "w") as fh:
+        json.dump(artifact, fh, indent=2)
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k not in ("chaos",)}, indent=2))
+    print(f"artifact -> {out}")
+    if violations:
+        print("INVARIANT VIOLATIONS:", *violations, sep="\n  ")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
