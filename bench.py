@@ -24,6 +24,16 @@ also run the DECLARED metric axis (BASELINE.json: test-acc @ round 50 —
 VERDICT r5 missing #2) as a 50-round campaign with a monotone-epoch audit
 (`eval.benchmarks.endurance_config1`; also tests/test_endurance.py).
 
+Control-plane axes (PR 3): `extra.crypto_backend` records which Ed25519
+implementation ran (numbers across hosts are incomparable without it);
+`extra.certification` is ops-certified/sec for the BFT commit path —
+batched vs sequential, plus a pre-PR legacy-mode baseline leg;
+`extra.federation` runs the config-1 process federation (20 clients +
+2 standbys + 4 validators + quorum + WAL) and reports round wall time,
+ops-certified/sec and the writer's crypto-time share (utils.tracing).
+BFLC_BENCH_NO_CONTROL_PLANE=1 skips both; BFLC_BENCH_FED_BASELINE=1
+re-runs the federation on the legacy control plane for the ratio.
+
 vs_baseline: the reference's round time is structurally bounded below by its
 polling design — every protocol phase waits a uniform(10,30) s sleep per
 client (python-sdk/main.py:62, 231-233), i.e. >= ~20 s/round in expectation
@@ -137,6 +147,26 @@ def _child() -> None:
         extra["flops_per_round"] = round(rp["flops_per_round"])
         if rp.get("mfu") is not None:
             extra["mfu"] = round(rp["mfu"], 6)
+    # control-plane axes (PR 3).  The active crypto backend is recorded
+    # unconditionally: cross-host perf numbers are uninterpretable without
+    # knowing whether Ed25519 ran on the `cryptography` wheel or the
+    # pure-Python fallback.
+    from bflc_demo_tpu.comm.identity import ED25519_BACKEND
+    extra["crypto_backend"] = ED25519_BACKEND
+    if not os.environ.get("BFLC_BENCH_NO_CONTROL_PLANE"):
+        from bflc_demo_tpu.eval.benchmarks import (certification_throughput,
+                                                   federation_config1)
+        # ops-certified/sec with its own pre-PR baseline leg (a light
+        # legacy-mode child), then the config-1 process federation —
+        # round wall time + crypto share through the real socket path.
+        # BFLC_BENCH_FED_BASELINE=1 additionally re-runs the federation
+        # on the legacy control plane for the before/after ratio (slow;
+        # the artifact of record lives in TPU_RESULTS.md).
+        extra["certification"] = certification_throughput(n_ops=24)
+        extra["federation"] = federation_config1(
+            rounds=3,
+            compare_sequential=bool(
+                os.environ.get("BFLC_BENCH_FED_BASELINE")))
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),
         # measurable on CPU with no tunnel: one 50-round config-1 campaign

@@ -48,7 +48,10 @@ class FaultInjector:
     def _peer_port(sock) -> Optional[int]:
         try:
             return sock.getpeername()[1]
-        except OSError:
+        except (OSError, IndexError, TypeError):
+            # disconnected, or a non-INET socket (AF_UNIX peers have
+            # string names): no port identity — port-scoped windows skip
+            # it, unscoped windows still apply
             return None
 
     def _apply(self, sock) -> None:

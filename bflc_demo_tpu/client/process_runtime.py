@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bflc_demo_tpu.comm.wire import blob_bytes
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 
@@ -237,7 +238,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             if mr["epoch"] != epoch:
                 continue        # round turned over mid-step; resync
             params = restore_pytree(
-                template, unpack_pytree(bytes.fromhex(mr["blob"])))
+                template, unpack_pytree(blob_bytes(mr["blob"])))
             delta, cost = local_train(
                 model.apply, params, xj, yj, lr=cfg.learning_rate,
                 batch_size=cfg.batch_size, local_epochs=cfg.local_epochs)
@@ -246,7 +247,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             n = int(x.shape[0])
             payload = digest + struct.pack("<qd", n, float(cost))
             r = client.request(
-                "upload", addr=wallet.address, blob=blob.hex(),
+                "upload", addr=wallet.address, blob=blob,
                 hash=digest.hex(), n=n, cost=float(cost), epoch=epoch,
                 tag=_sign(wallet, "upload", epoch, payload))
             if r.get("status") in ("OK", "CAP_REACHED", "DUPLICATE",
@@ -275,15 +276,22 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             ups = client.request("updates")["updates"]
             if ups:
                 import jax
+                from bflc_demo_tpu.comm.wire import split_blob_parts
+                # one batched fetch for the round's candidate deltas
+                # (hash-verified per part; falls back per-hash for
+                # anything the reply omits or garbles)
+                br = client.request("blobs",
+                                    hashes=[u["hash"] for u in ups])
+                fetched = split_blob_parts(br) if br.get("ok") else {}
                 deltas = []
                 for u in ups:
-                    b = bytes.fromhex(client.request(
-                        "blob", hash=u["hash"])["blob"])
+                    b = fetched.get(u["hash"]) or blob_bytes(
+                        client.request("blob", hash=u["hash"])["blob"])
                     deltas.append(restore_pytree(template,
                                                  unpack_pytree(b)))
                 mr = client.request("model")
                 params = restore_pytree(
-                    template, unpack_pytree(bytes.fromhex(mr["blob"])))
+                    template, unpack_pytree(blob_bytes(mr["blob"])))
                 stacked = jax.tree_util.tree_map(
                     lambda *t: jnp.stack(t), *deltas)
                 scores = score_candidates(model.apply, params, stacked,
@@ -360,7 +368,8 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
 class ProcessFederationResult:
     def __init__(self, accuracy_history, rounds_completed, log_head,
                  log_size, recovered_clients, replica_report,
-                 wall_time_s: float = 0.0, chaos_report=None):
+                 wall_time_s: float = 0.0, chaos_report=None,
+                 final_info=None):
         self.accuracy_history = accuracy_history
         self.rounds_completed = rounds_completed
         self.ledger_log_head = log_head
@@ -371,6 +380,14 @@ class ProcessFederationResult:
         # chaos campaign report (chaos.campaign.ChaosCampaign.finish) or
         # None when the run was fault-free
         self.chaos_report = chaos_report
+        # the writer's last full `info` reply: certified_size plus — when
+        # the run traced (BFLC_PROC_TRACE) — the writer-side `perf` phase
+        # accounting the federation benchmark attributes its wins with
+        self.final_info = final_info
+        # (epoch, seconds-since-start) at each sponsor-observed commit:
+        # lets the federation benchmark separate steady-state round time
+        # from fleet spawn (20 jax child imports dwarf a round)
+        self.epoch_times = []
 
     @property
     def final_accuracy(self) -> float:
@@ -644,6 +661,7 @@ def run_federated_processes(
                              standby_keys=standby_keys,
                              bft_keys=bft_keys or None)
     history: List[Tuple[int, float]] = []
+    epoch_times: List[Tuple[int, float]] = []
     seen_epoch = 0              # model at epoch 0 is the uncommitted init
     writer_killed = False
     deadline = time.monotonic() + timeout_s
@@ -664,9 +682,11 @@ def run_federated_processes(
                 if mr["epoch"] > seen_epoch:
                     params = restore_pytree(
                         template,
-                        unpack_pytree(bytes.fromhex(mr["blob"])))
+                        unpack_pytree(blob_bytes(mr["blob"])))
                     acc = float(evaluate(model.apply, params, xte_j, yte_j))
                     history.append((mr["epoch"] - 1, acc))
+                    epoch_times.append((mr["epoch"] - 1,
+                                        time.monotonic() - t_start))
                     seen_epoch = mr["epoch"]
                     if verbose:
                         print(f"Epoch: {mr['epoch'] - 1:03d}, "
@@ -746,7 +766,7 @@ def run_federated_processes(
 
     crashed = [i for i in crash_at
                if clients[i].exitcode not in (0, None)]
-    return ProcessFederationResult(
+    result = ProcessFederationResult(
         accuracy_history=history,
         rounds_completed=final["epoch"],
         log_head=final["log_head"],
@@ -754,7 +774,10 @@ def run_federated_processes(
         recovered_clients=crashed,
         replica_report=replica_report,
         wall_time_s=time.monotonic() - t_start,
-        chaos_report=chaos_report)
+        chaos_report=chaos_report,
+        final_info=final)
+    result.epoch_times = epoch_times
+    return result
 
 
 # ------------------------------------------------- mesh-executor federation
@@ -808,14 +831,14 @@ def attest_score_row(client, wallet, model, template, cfg,
     if mr["epoch"] != epoch:
         return False                    # round turned over; re-poll
     gparams = restore_pytree(
-        template, unpack_pytree(bytes.fromhex(mr["blob"])))
+        template, unpack_pytree(blob_bytes(mr["blob"])))
     deltas = []
     for h in pa["hashes"]:
         br = client.request("blob", hash=h)
         if not br.get("ok"):
             return False
         deltas.append(restore_pytree(
-            template, unpack_pytree(bytes.fromhex(br["blob"]))))
+            template, unpack_pytree(blob_bytes(br["blob"]))))
     stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *deltas)
     # reproduce the staging pad exactly via the SAME helpers the staging
     # plane uses (client/staging.cyc_pad / cast_features — a hand-rolled
@@ -890,8 +913,7 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
     yb = pack_entries({"y": np.asarray(y).astype(np.int32)})
     payload = _hl.sha256(xb).digest() + _hl.sha256(yb).digest()
     tag = wallet.sign(_op_bytes("stage", wallet.address, 0, payload)).hex()
-    r = client.request("stage", addr=wallet.address, x=xb.hex(), y=yb.hex(),
-                       tag=tag)
+    r = client.request("stage", addr=wallet.address, x=xb, y=yb, tag=tag)
     if not r["ok"]:
         raise RuntimeError(f"stage failed: {r}")
 
@@ -916,7 +938,7 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
             mr = client.request("model")
             if mr["epoch"] > seen:
                 params = restore_pytree(
-                    template, unpack_pytree(bytes.fromhex(mr["blob"])))
+                    template, unpack_pytree(blob_bytes(mr["blob"])))
                 acc = float(evaluate(model.apply, params, xj, yj))
                 if not np.isfinite(acc):
                     raise RuntimeError("non-finite local accuracy")
@@ -1029,7 +1051,7 @@ def run_federated_mesh_processes(
                 if mr["epoch"] > seen_epoch:
                     params = restore_pytree(
                         template,
-                        unpack_pytree(bytes.fromhex(mr["blob"])))
+                        unpack_pytree(blob_bytes(mr["blob"])))
                     acc = float(evaluate(model.apply, params, xte_j, yte_j))
                     history.append((mr["epoch"] - 1, acc))
                     seen_epoch = mr["epoch"]

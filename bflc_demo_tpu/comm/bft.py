@@ -74,12 +74,15 @@ import hashlib
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from bflc_demo_tpu.comm.identity import (PublicDirectory, _op_bytes,
-                                         address_of, verify_signature)
+                                         address_of, verify_signature,
+                                         verify_signatures_batch)
+from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.comm.wire import WireError, recv_msg, send_msg
 from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
 from bflc_demo_tpu.ledger.base import (encode_register_op,
@@ -150,16 +153,22 @@ def count_valid_sigs(cert: CommitCertificate,
                      validator_keys: Dict[int, bytes]) -> int:
     """Signatures by distinct PROVISIONED validators that verify over the
     certificate's own payload (including its claimed attempt).  Shared by
-    full verification and the client-side structural check."""
+    full verification and the client-side structural check.
+
+    Fast path (PR 3): all provisioned sigs are checked in ONE batch
+    verification (comm.identity.verify_signatures_batch) — the common
+    all-honest certificate pays one shared multiscalar mul instead of a
+    ladder per signature; any batch failure falls back to the per-sig
+    loop, so the count is always attributable."""
     payload = cert_payload_digest(cert.index, cert.prev_head,
                                   cert.op_hash, cert.new_head,
                                   cert.attempt)
-    n = 0
-    for vidx, sig in cert.sigs.items():
-        pub = validator_keys.get(vidx)
-        if pub is not None and verify_signature(pub, payload, sig):
-            n += 1
-    return n
+    items = [(pub, payload, sig) for vidx, sig in cert.sigs.items()
+             if (pub := validator_keys.get(vidx)) is not None]
+    if items and verify_signatures_batch(items):
+        return len(items)
+    return sum(1 for pub, msg, sig in items
+               if verify_signature(pub, msg, sig))
 
 
 def verify_certificate_sigs(cert_wire, quorum: int,
@@ -414,7 +423,7 @@ def verify_repair_proof(proof, index: int, attempt: int, quorum: int,
 class ValidatorNode:
     """One member of the commit quorum: replica + wallet + vote server.
 
-    Serves three methods over comm.wire frames:
+    Serves four methods over comm.wire frames:
     - ``bft_validate {i, op, auth?, t?, cert?, repair?}``: validate op for
       chain position i at attempt t.  At most one vote per (position,
       attempt); ops arrive strictly in order (``OUT_OF_ORDER`` + our log
@@ -425,6 +434,16 @@ class ValidatorNode:
       existing commit certificate for it (resync-and-retry) or a valid
       repair proof whose mandate admits it (re-proposal) — the replica
       rolls back to the certified prefix, re-applies, and re-signs.
+    - ``bft_vote_batch {i, ops, auths?, t?}`` (PR 3): validate + co-sign
+      a CONTIGUOUS op range [i, i+len(ops)) in one round-trip — the
+      per-op certificates are byte-identical to the single-op path
+      (same cert_payload layout, each op chain-linked via its own
+      prev-head), only the transport is amortized.  The fast path stops
+      at the first op it cannot sign outright (conflict, auth failure,
+      promise) and returns the refusal alongside the votes already
+      minted; the writer falls back to ``bft_validate`` for that
+      position, where the full certificate/repair evidence machinery
+      lives untouched.
     - ``bft_abandon {i, t}``: issue a signed abandon statement for the
       position (what we hold there, if anything) and promise to refuse
       votes below attempt t — the repair round's raw material.
@@ -531,6 +550,8 @@ class ValidatorNode:
                                 else _EMPTY_HEAD.hex())
                 elif method == "bft_validate":
                     reply = self._validate(msg)
+                elif method == "bft_vote_batch":
+                    reply = self._vote_batch(msg)
                 elif method == "bft_abandon":
                     reply = self._abandon(msg)
                 else:
@@ -622,6 +643,47 @@ class ValidatorNode:
         self._heads.append(self.ledger.log_head())
         return self._sign_position(i, op, attempt)
 
+    def _vote_locked(self, i: int, op: bytes, auth, attempt: int) -> dict:
+        """The evidence-free voting core (lock held): idempotent re-sign
+        of an op we already hold, strict ordering, abandon promises, auth
+        check, apply + sign.  Anything needing QUORUM EVIDENCE (a peer
+        certificate or a repair proof) refuses here — `_validate` layers
+        that handling on top; the batch fast path refuses outright and
+        lets the writer fall back to the single-op method."""
+        op_hash = hashlib.sha256(op).digest()
+        size = self.ledger.log_size()
+        promised = self._promised.get(i, 0)
+        if i < size:
+            voted_t, voted_hash = self._voted.get(i, (0, None))
+            if voted_hash == op_hash:
+                # idempotent re-sign of the op we hold; the attempt
+                # upgrades freely (same op can never fork) but never
+                # below an outstanding abandon promise
+                t = max(attempt, voted_t)
+                if t < promised:
+                    return self._refuse(
+                        "PROMISED", f"promised attempt {promised}",
+                        promised=promised, voted_t=voted_t)
+                self._voted[i] = (t, op_hash)
+                return self._sign_position(i, op, t)
+            return self._refuse(
+                "CONFLICT",
+                f"position {i} already holds a different op",
+                voted_t=voted_t, promised=promised)
+        if i > size:
+            # strict ordering: we cannot judge op i without the prefix
+            return self._refuse("OUT_OF_ORDER",
+                                f"replica at {size}, asked for {i}")
+        if attempt < promised:
+            return self._refuse("PROMISED",
+                                f"promised attempt {promised}",
+                                promised=promised, voted_t=0)
+        if self.require_auth:
+            err = check_op_auth(op, auth, self.directory)
+            if err:
+                return self._refuse("AUTH", err)
+        return self._apply_and_sign(i, op, op_hash, attempt)
+
     def _validate(self, msg: dict) -> dict:
         try:
             i = int(msg["i"])
@@ -630,22 +692,24 @@ class ValidatorNode:
         except (KeyError, TypeError, ValueError):
             return self._refuse("BAD_REQUEST")
         op_hash = hashlib.sha256(op).digest()
+        tr = tracing.PROC
+        if tr.enabled:
+            t0 = time.perf_counter()
+            try:
+                return self._validate_inner(i, op, op_hash, attempt, msg)
+            finally:
+                tr.charge("bft.validate_s", time.perf_counter() - t0)
+                tr.charge("bft.validate_n")
+        return self._validate_inner(i, op, op_hash, attempt, msg)
+
+    def _validate_inner(self, i: int, op: bytes, op_hash: bytes,
+                        attempt: int, msg: dict) -> dict:
         with self._lock:
-            size = self.ledger.log_size()
-            promised = self._promised.get(i, 0)
-            if i < size:
-                voted_t, voted_hash = self._voted.get(i, (0, None))
-                if voted_hash == op_hash:
-                    # idempotent re-sign of the op we hold; the attempt
-                    # upgrades freely (same op can never fork) but never
-                    # below an outstanding abandon promise
-                    t = max(attempt, voted_t)
-                    if t < promised:
-                        return self._refuse(
-                            "PROMISED", f"promised attempt {promised}",
-                            promised=promised, voted_t=voted_t)
-                    self._voted[i] = (t, op_hash)
-                    return self._sign_position(i, op, t)
+            r = self._vote_locked(i, op, msg.get("auth"), attempt)
+            status = r.get("status")
+            if r.get("ok") or status not in ("CONFLICT", "AUTH"):
+                return r
+            if status == "CONFLICT":
                 # a DIFFERENT op at a bound position: only quorum evidence
                 # may move us.  (1) resync-and-retry — an existing commit
                 # certificate proves the canonical chain holds `op` here;
@@ -653,6 +717,9 @@ class ValidatorNode:
                 # whole suffix from i provably lost (rollback depth is
                 # arbitrary: a validator that kept voting on a stale fork
                 # may have diverged several ops deep).
+                size = self.ledger.log_size()
+                voted_t, _vh = self._voted.get(i, (0, None))
+                promised = self._promised.get(i, 0)
                 cert = self._peer_certificate(msg, i, op)
                 repair_ok = False
                 if cert is None and i == size - 1 \
@@ -665,10 +732,7 @@ class ValidatorNode:
                     repair_ok = ok and (mandated is None
                                         or mandated == op_hash)
                 if cert is None and not repair_ok:
-                    return self._refuse(
-                        "CONFLICT",
-                        f"position {i} already holds a different op",
-                        voted_t=voted_t, promised=promised)
+                    return r
                 # the repair proof authorizes the ROLLBACK, never an auth
                 # bypass: client-originated ops still need their tag (or
                 # an existing certificate, which embeds a quorum's
@@ -682,23 +746,50 @@ class ValidatorNode:
                 self._rollback_to(i)
                 t = max(attempt, cert.attempt if cert else 0)
                 return self._apply_and_sign(i, op, op_hash, t)
-            if i > size:
-                # strict ordering: we cannot judge op i without the prefix
-                return self._refuse("OUT_OF_ORDER",
-                                    f"replica at {size}, asked for {i}")
-            if attempt < promised:
-                return self._refuse("PROMISED",
-                                    f"promised attempt {promised}",
-                                    promised=promised, voted_t=0)
-            if self.require_auth:
-                err = check_op_auth(op, msg.get("auth"), self.directory)
-                if err:
-                    if self._peer_certificate(msg, i, op) is None:
-                        return self._refuse("AUTH", err)
-                    # certified backlog: the quorum already re-verified
-                    # the client tag once; admit on the certificate
-                    self._enroll_register_pubkey(op, msg.get("auth"))
+            # AUTH refusal at the fresh tip: certified backlog — the
+            # quorum already re-verified the client tag once; admit on
+            # the certificate
+            if self._peer_certificate(msg, i, op) is None:
+                return r
+            self._enroll_register_pubkey(op, msg.get("auth"))
             return self._apply_and_sign(i, op, op_hash, attempt)
+
+    _VOTE_BATCH_MAX = 256
+
+    def _vote_batch(self, msg: dict) -> dict:
+        """One round-trip, many votes (see class docstring).  Reply:
+        {ok: True, votes: [per-op vote dicts], stopped: refusal|None,
+        log_size} — `votes` covers the longest signable prefix; `stopped`
+        is the first refusal (OUT_OF_ORDER lets the writer resync the
+        backlog and re-ask; CONFLICT/AUTH/PROMISED route that position to
+        the evidence-carrying single-op path)."""
+        try:
+            start = int(msg["i"])
+            ops = [bytes.fromhex(o) for o in msg["ops"]]
+            auths = msg.get("auths") or [None] * len(ops)
+            attempt = int(msg.get("t", 0))
+        except (KeyError, TypeError, ValueError):
+            return self._refuse("BAD_REQUEST")
+        if len(auths) != len(ops) or len(ops) > self._VOTE_BATCH_MAX:
+            return self._refuse("BAD_REQUEST",
+                                f"batch of {len(ops)} ops rejected")
+        votes: List[dict] = []
+        stopped = None
+        t0 = time.perf_counter() if tracing.PROC.enabled else 0.0
+        with self._lock:
+            for k, op in enumerate(ops):
+                r = self._vote_locked(start + k, op, auths[k], attempt)
+                if not r.get("ok"):
+                    stopped = r
+                    break
+                votes.append(r)
+            size = self.ledger.log_size()
+        if tracing.PROC.enabled:
+            tracing.PROC.charge("bft.validate_s",
+                                time.perf_counter() - t0)
+            tracing.PROC.charge("bft.validate_n", len(votes))
+        return {"ok": True, "votes": votes, "stopped": stopped,
+                "log_size": size}
 
     def _abandon(self, msg: dict) -> dict:
         """Issue a signed abandon statement for (i, t): report what we
@@ -863,6 +954,183 @@ class CertificateAssembler:
                 if retry:
                     return None
         return None
+
+    def _catch_up(self, client: ValidatorClient, behind: int,
+                  upto: int) -> bool:
+        """Replay certified backlog ops [behind, upto) into a lagging
+        replica (certificates ride along so client auth evidence is not
+        needed; `_resync_diverged` heals a stale-fork suffix mid-replay).
+        True when the replica provably reached `upto`.  Batch-path
+        counterpart of the inline resync in `_vote_one` — kept separate
+        so the single-op path's repair semantics stay untouched."""
+        if self.backlog_fn is None or not 0 <= behind < upto:
+            return False
+        resyncs = 0
+        j = behind
+        while j < upto:
+            entry = self.backlog_fn(j)
+            bop, bauth = entry[0], entry[1]
+            bcert = entry[2] if len(entry) > 2 else None
+            try:
+                rj = client.request("bft_validate", i=j, op=bop.hex(),
+                                    auth=bauth, cert=bcert)
+            except (ConnectionError, WireError, OSError):
+                client.close()
+                return False
+            if rj.get("ok"):
+                j += 1
+                continue
+            # the replica may hold a diverged suffix below j: certificate
+            # resync walks back to the divergence point and heals it,
+            # after which the replay restarts from wherever it stands
+            resyncs += 1
+            if resyncs > 2 or not self._resync_diverged(client, j):
+                return False
+            try:
+                inf = client.request("info")
+                j = max(0, min(int(inf.get("log_size", j)), j))
+            except (ConnectionError, WireError, OSError,
+                    TypeError, ValueError):
+                client.close()
+                return False
+        return True
+
+    def _vote_batch_one(self, client: ValidatorClient, start: int,
+                        entries) -> Optional[List[dict]]:
+        """One validator's vote list for the contiguous ops `entries` at
+        positions [start, start+len(entries)) — one `bft_vote_batch`
+        round-trip, with a certified-backlog replay + one re-ask when the
+        replica reports OUT_OF_ORDER below `start`.  None on transport
+        failure or a validator that does not speak the batch method (an
+        old-version peer): the caller falls back to single-op voting."""
+        ops_hex = [op.hex() for op, _ in entries]
+        auths = [a for _, a in entries]
+        for retry in (0, 1):            # one reconnect per call
+            try:
+                r = client.request("bft_vote_batch", i=start, ops=ops_hex,
+                                    auths=auths)
+                if not r.get("ok"):
+                    return None         # old peer / malformed: fall back
+                stopped = r.get("stopped")
+                if not r.get("votes") and isinstance(stopped, dict) \
+                        and stopped.get("status") == "OUT_OF_ORDER":
+                    try:
+                        behind = int(stopped.get("log_size", -1))
+                    except (TypeError, ValueError):
+                        behind = -1
+                    if self._catch_up(client, behind, start):
+                        r = client.request("bft_vote_batch", i=start,
+                                           ops=ops_hex, auths=auths)
+                        if not r.get("ok"):
+                            return None
+                return r.get("votes") or []
+            except (ConnectionError, WireError, OSError):
+                client.close()
+                if retry:
+                    return None
+        return None
+
+    def certify_range(self, start: int, entries,
+                      prev_head: bytes) -> List[Optional[CommitCertificate]]:
+        """Batched fast path (PR 3): certify the contiguous ops
+        `entries` = [(op, auth), ...] at positions [start, ...) in ONE
+        vote round-trip per validator instead of one per op.  Votes are
+        verified before counting — in bulk (batch verification) with a
+        per-sig fallback, so a lying validator still contributes nothing
+        — and certificates come out byte-identical to the single-op
+        path: per-position, chain-linked via each op's own prev-head,
+        accepted by the unchanged `verify_certificate`.
+
+        Returns a certificate list aligned with `entries`; the first
+        None (and everything after it — certificates install strictly in
+        chain order) marks where the fast path stopped.  The caller
+        routes that position through `certify`, whose conflict-resync,
+        abandon/repair and superseded-proposer machinery is deliberately
+        untouched."""
+        n = len(entries)
+        prevs: List[bytes] = []
+        heads: List[bytes] = []
+        h = prev_head or _EMPTY_HEAD
+        for op, _ in entries:
+            prevs.append(h)
+            h = next_head(h, op)
+            heads.append(h)
+        # position -> attempt -> {validator: sig}; raw first, verify bulk
+        raw: List[List[Tuple[int, int, bytes]]] = [[] for _ in range(n)]
+        lock = threading.Lock()
+
+        def ask(client):
+            vs = self._vote_batch_one(client, start, entries)
+            if not vs:
+                return
+            for v in vs:
+                try:
+                    k = int(v["i"]) - start
+                    vidx = int(v["validator"])
+                    vt = int(v.get("t", 0))
+                    sig = bytes.fromhex(v["sig"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if 0 <= k < n and vidx in self.keys:
+                    with lock:
+                        raw[k].append((vidx, vt, sig))
+
+        threads = [threading.Thread(target=ask, args=(c,), daemon=True)
+                   for c in self._clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.timeout_s + 5.0)
+
+        # bulk signature verification across every collected vote; on a
+        # batch miss (>= 1 bad or torsion-defective sig) re-verify each —
+        # `verify_signature` — so garbage is attributed and dropped
+        items = []
+        flat = []
+        for k, lst in enumerate(raw):
+            for vidx, vt, sig in lst:
+                payload = cert_payload(start + k, prevs[k],
+                                       entries[k][0], heads[k], vt)
+                items.append((self.keys[vidx], payload, sig))
+                flat.append((k, vidx, vt, sig))
+        all_ok = verify_signatures_batch(items) if items else True
+        votes: List[Dict[int, Dict[int, bytes]]] = [{} for _ in range(n)]
+        for (k, vidx, vt, sig), (pub, payload, _s) in zip(flat, items):
+            if all_ok or verify_signature(pub, payload, sig):
+                votes[k].setdefault(vt, {})[vidx] = sig
+
+        certs: List[Optional[CommitCertificate]] = []
+        for k in range(n):
+            got = None
+            for vt, sigs in sorted(votes[k].items()):
+                if len(sigs) >= self.quorum:
+                    got = CommitCertificate(
+                        index=start + k, prev_head=prevs[k],
+                        op_hash=hashlib.sha256(entries[k][0]).digest(),
+                        new_head=heads[k], attempt=vt, sigs=dict(sigs))
+                    break
+            if got is not None and all_ok \
+                    and len(got.sigs) == self.quorum:
+                # exactly-quorum certificate whose sigs were accepted on
+                # batch verification ALONE (cofactored): belt-and-braces
+                # re-check each under the stricter cofactorless rule, so
+                # a torsion-defective signature is never the one holding
+                # a quorum together — every downstream verifier counts
+                # deterministically either way (the batch equation is
+                # cofactored on purpose), this just refuses to MINT a
+                # zero-slack certificate leaning on a defective sig
+                payload = cert_payload(start + k, prevs[k],
+                                       entries[k][0], heads[k],
+                                       got.attempt)
+                if sum(1 for vidx, sig in got.sigs.items()
+                       if verify_signature(self.keys[vidx], payload,
+                                           sig)) < self.quorum:
+                    got = None
+            certs.append(got)
+            if got is None:
+                break
+        certs += [None] * (n - len(certs))
+        return certs
 
     def _gather_votes(self, i: int, op: bytes, auth: Optional[dict],
                       prev_head: bytes, attempt: int,

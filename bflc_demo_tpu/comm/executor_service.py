@@ -49,6 +49,7 @@ import numpy as np
 
 from bflc_demo_tpu.comm.identity import _op_bytes
 from bflc_demo_tpu.comm.ledger_service import LedgerServer
+from bflc_demo_tpu.comm.wire import blob_bytes
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import pack_pytree, unpack_pytree
 
@@ -108,8 +109,8 @@ class MeshExecutorServer(LedgerServer):
         if method == "stage":
             with self._lock:
                 addr = m["addr"]
-                xb = bytes.fromhex(m["x"])
-                yb = bytes.fromhex(m["y"])
+                xb = blob_bytes(m["x"])
+                yb = blob_bytes(m["y"])
                 payload = (hashlib.sha256(xb).digest()
                            + hashlib.sha256(yb).digest())
                 if self.require_auth and not self.directory.verify(

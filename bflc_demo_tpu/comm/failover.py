@@ -78,7 +78,8 @@ from typing import Dict, List, Optional, Tuple
 from bflc_demo_tpu.comm.identity import PublicDirectory, address_of
 from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                LedgerServer)
-from bflc_demo_tpu.comm.wire import send_msg, recv_msg, WireError
+from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
+                                     WireError)
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
@@ -543,6 +544,14 @@ class Standby:
                     # a Byzantine writer streaming forged/forked/
                     # uncertified state is refused, not replicated
                     self._require_certificate(msg, op_index, op_bytes)
+                # a pushed upload op may carry its payload blob inline
+                # (binary frame piggyback, PR 3): hash-verify against the
+                # op and mirror it without the fetch round-trip the
+                # mirror-before-apply gate would otherwise spend on the
+                # ack critical path.  A wrong-hash blob is ignored — the
+                # gate below then fetches/fails exactly as before, so a
+                # lying writer gains nothing.
+                self._harvest_pushed_blob(msg, op_bytes)
                 # mirror-BEFORE-apply: an upload op binds here only once
                 # its payload blob landed, so this replica can never hold
                 # an update record without its payload — in async mode
@@ -663,6 +672,22 @@ class Standby:
 
     _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
 
+    def _harvest_pushed_blob(self, msg: dict, op_bytes: bytes) -> None:
+        """Mirror an op-stream frame's piggybacked payload blob iff it
+        hashes to the op's recorded payload digest (see _follow)."""
+        blob_field = msg.get("blob")
+        if blob_field is None or not op_bytes \
+                or op_bytes[0] != self._UPLOAD_OPCODE:
+            return
+        from bflc_demo_tpu.ledger.tool import decode_op
+        try:
+            blob = blob_bytes(blob_field)
+            ph = bytes.fromhex(decode_op(op_bytes)["payload_hash"])
+        except (KeyError, ValueError):
+            return
+        if ph not in self._blobs and hashlib.sha256(blob).digest() == ph:
+            self._blobs[ph] = blob
+
     def _mirror_upload_payload(self, op_bytes: bytes,
                                ctl: CoordinatorClient) -> bool:
         """Fetch an upload op's payload blob by hash, bypassing the
@@ -685,7 +710,7 @@ class Standby:
             return False
         if r.get("ok"):
             try:
-                blob = bytes.fromhex(r.get("blob", ""))
+                blob = blob_bytes(r.get("blob", ""))
             except ValueError:
                 blob = b""
             if hashlib.sha256(blob).digest() == ph:
@@ -720,12 +745,25 @@ class Standby:
         never a full directory refetch or update rescan per op.
         """
         if self.ledger.update_count != self._synced_update_count:
+            missing = [u.payload_hash
+                       for u in self.ledger.query_all_updates()
+                       if u.payload_hash not in self._blobs]
+            if len(missing) > 1:
+                # batched mirror (one round-trip; hash-verified per
+                # part inside split_blob_parts); per-hash fallback below
+                # covers whatever the writer omitted or a pre-batch peer
+                from bflc_demo_tpu.comm.wire import split_blob_parts
+                r = ctl.request("blobs",
+                                hashes=[h.hex() for h in missing])
+                if r.get("ok"):
+                    for h, part in split_blob_parts(r).items():
+                        self._blobs[bytes.fromhex(h)] = part
             all_stored = True
             for u in self.ledger.query_all_updates():
                 if u.payload_hash not in self._blobs:
                     r = ctl.request("blob", hash=u.payload_hash.hex())
                     if r.get("ok"):
-                        blob = bytes.fromhex(r["blob"])
+                        blob = blob_bytes(r["blob"])
                         if hashlib.sha256(blob).digest() == u.payload_hash:
                             self._blobs[u.payload_hash] = blob
                     if u.payload_hash not in self._blobs:
@@ -741,7 +779,7 @@ class Standby:
         if want_hash != have and want_hash != b"\0" * 32:
             r = ctl.request("model")
             if r.get("ok"):
-                blob = bytes.fromhex(r["blob"])
+                blob = blob_bytes(r["blob"])
                 if hashlib.sha256(blob).digest() == want_hash:
                     self._model_blob = blob
         elif self._model_blob is None:
@@ -752,7 +790,7 @@ class Standby:
             # before round 0 commits would make promotion impossible
             r = ctl.request("model")
             if r.get("ok"):
-                self._model_blob = bytes.fromhex(r["blob"])
+                self._model_blob = blob_bytes(r["blob"])
         if self._directory is not None and \
                 self.ledger.num_registered != self._synced_registered:
             r = ctl.request("directory")

@@ -33,9 +33,11 @@ import hashlib
 import hmac
 import os
 import struct
+import time
 from typing import Dict, List, Sequence, Tuple
 
 from bflc_demo_tpu.ledger.base import LedgerStatus
+from bflc_demo_tpu.utils import tracing
 
 try:                                    # prefer the C-backed implementation
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -58,12 +60,35 @@ from bflc_demo_tpu.comm import pure25519 as _pure
 HAVE_ED25519 = True
 
 
-def verify_signature(public_bytes: bytes, message: bytes,
-                     signature: bytes) -> bool:
-    """THE Ed25519 verification chokepoint: every tag, promotion-evidence
-    and commit-certificate check in the repo funnels here, so the two
-    backends cannot drift between enforcement points.  Never raises on
-    malformed input — a hostile peer's garbage is a False, not a crash."""
+# --- verification memo (PR 3): repeated (pubkey, payload, sig) checks are
+# structural on the certificate paths — a standby re-verifies the same cert
+# sigs its promotion later re-checks, a client's retry re-verifies the ack
+# certificate it already accepted once, resync replays re-present certified
+# history.  Verification is a deterministic pure function, so a bounded
+# memo keyed on the full triple is semantically invisible.  Disabled (like
+# every control-plane fast path) by BFLC_CONTROL_PLANE_LEGACY=1 at import.
+_MEMO_ENABLED = not os.environ.get("BFLC_CONTROL_PLANE_LEGACY")
+_VERIFY_MEMO: Dict[bytes, bool] = {}
+_VERIFY_MEMO_MAX = 8192
+
+
+def _memo_key(public_bytes: bytes, message: bytes, signature: bytes,
+              domain: bytes = b"1") -> bytes:
+    # length-prefixed so (pub, msg, sig) concatenation is unambiguous;
+    # the domain byte separates cofactorless (per-item) verdicts from
+    # cofactored (batch) ones — the two semantics differ on
+    # torsion-defective signatures and must never answer for each other
+    h = hashlib.sha256()
+    h.update(domain)
+    h.update(struct.pack("<qq", len(public_bytes), len(signature)))
+    h.update(public_bytes)
+    h.update(signature)
+    h.update(message)
+    return h.digest()
+
+
+def _verify_signature_raw(public_bytes: bytes, message: bytes,
+                          signature: bytes) -> bool:
     if ED25519_BACKEND == "cryptography":
         try:
             Ed25519PublicKey.from_public_bytes(public_bytes).verify(
@@ -72,6 +97,85 @@ def verify_signature(public_bytes: bytes, message: bytes,
         except (InvalidSignature, ValueError):
             return False
     return _pure.ed25519_verify(public_bytes, message, signature)
+
+
+def _verify_signature_timed(public_bytes: bytes, message: bytes,
+                            signature: bytes) -> bool:
+    tr = tracing.PROC
+    if tr.enabled:
+        t0 = time.perf_counter()
+        ok = _verify_signature_raw(public_bytes, message, signature)
+        tr.charge("crypto.verify_s", time.perf_counter() - t0)
+        tr.charge("crypto.verify_n")
+        return ok
+    return _verify_signature_raw(public_bytes, message, signature)
+
+
+def verify_signature(public_bytes: bytes, message: bytes,
+                     signature: bytes) -> bool:
+    """THE Ed25519 verification chokepoint: every tag, promotion-evidence
+    and commit-certificate check in the repo funnels here, so the two
+    backends cannot drift between enforcement points.  Never raises on
+    malformed input — a hostile peer's garbage is a False, not a crash."""
+    if not _MEMO_ENABLED:
+        return _verify_signature_timed(public_bytes, message, signature)
+    key = _memo_key(public_bytes, message, signature)
+    hit = _VERIFY_MEMO.get(key)
+    if hit is not None:
+        return hit
+    ok = _verify_signature_timed(public_bytes, message, signature)
+    _memo_store(key, ok)
+    return ok
+
+
+def _memo_store(key: bytes, ok: bool) -> None:
+    if len(_VERIFY_MEMO) >= _VERIFY_MEMO_MAX:
+        try:
+            _VERIFY_MEMO.pop(next(iter(_VERIFY_MEMO)))
+        except KeyError:                # racing evictors: already gone
+            pass
+    _VERIFY_MEMO[key] = ok
+
+
+def verify_signatures_batch(items: Sequence[Tuple[bytes, bytes, bytes]],
+                            ) -> bool:
+    """Batch chokepoint: True iff EVERY (pubkey, message, signature)
+    triple verifies (cofactored semantics for items that reach the
+    batch — see pure25519.ed25519_verify_batch).  False only says "at
+    least one failed" — a caller that needs attribution falls back to
+    per-item `verify_signature`.  Under the pure-Python backend this is
+    real Ed25519 batch verification (one shared multiscalar mul) fed
+    through the verify memo, so a re-presented certificate (standby
+    re-verify, client retry, resync replay) costs a dict lookup per
+    signature instead of any curve arithmetic; under the `cryptography`
+    wheel (no batch API) it is a loop, already fast there.  Honest
+    batches never take the fallback."""
+    if ED25519_BACKEND == "cryptography" or not _MEMO_ENABLED:
+        return all(verify_signature(p, m, s) for p, m, s in items)
+    pending = []
+    for it in items:
+        key = _memo_key(it[0], it[1], it[2], domain=b"8")
+        hit = _VERIFY_MEMO.get(key)
+        if hit is False:
+            return False
+        if hit is None:
+            pending.append((key, it))
+    if not pending:
+        return True
+    tr = tracing.PROC
+    if tr.enabled:
+        t0 = time.perf_counter()
+        ok = _pure.ed25519_verify_batch([it for _, it in pending])
+        tr.charge("crypto.verify_s", time.perf_counter() - t0)
+        tr.charge("crypto.verify_n", len(pending))
+    else:
+        ok = _pure.ed25519_verify_batch([it for _, it in pending])
+    if ok:
+        # only positive results memoize here: a failed batch does not
+        # attribute, and the per-item fallback will memo each verdict
+        for key, _ in pending:
+            _memo_store(key, True)
+    return ok
 
 
 class KeyRing:
@@ -141,9 +245,16 @@ class Wallet:
         return cls(sk, dk)
 
     def sign(self, op_bytes: bytes) -> bytes:
+        tr = tracing.PROC
+        t0 = time.perf_counter() if tr.enabled else 0.0
         if ED25519_BACKEND == "cryptography":
-            return self._sign.sign(op_bytes)
-        return _pure.ed25519_sign(self._sign_sk, op_bytes)
+            sig = self._sign.sign(op_bytes)
+        else:
+            sig = _pure.ed25519_sign(self._sign_sk, op_bytes)
+        if tr.enabled:
+            tr.charge("crypto.sign_s", time.perf_counter() - t0)
+            tr.charge("crypto.sign_n")
+        return sig
 
     # signer surface shared with KeyRing so FLNode/sign_* helpers take either
     def mac(self, address: str, op_bytes: bytes) -> bytes:

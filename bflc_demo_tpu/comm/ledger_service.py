@@ -40,7 +40,9 @@ import numpy as np
 
 from bflc_demo_tpu.comm.identity import (PublicDirectory, ReplayGuard,
                                          address_of, _op_bytes)
-from bflc_demo_tpu.comm.wire import send_msg, recv_msg, WireError
+from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
+                                     WireError)
+from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import unpack_pytree, pack_entries
@@ -304,6 +306,14 @@ class LedgerServer:
         # turns extending the watermark — plain mutual exclusion, no
         # wakeup protocol
         self._cert_lock = threading.Lock()
+        # pre-PR control-plane baseline switch (the benchmark's
+        # before/after leg): sequential certification (one op per
+        # validator round-trip) and no op-stream blob piggyback
+        import os as _os
+        self._legacy = bool(_os.environ.get("BFLC_CONTROL_PLANE_LEGACY"))
+        # bounded in-flight certification window (PR 3): how many backlog
+        # ops one certify_range round-trip may carry
+        self._cert_batch = 1 if self._legacy else 128
         self._op_auth: Dict[int, dict] = {}
         if bft_validators:
             from bflc_demo_tpu.comm.bft import CertificateAssembler
@@ -528,6 +538,21 @@ class LedgerServer:
         mutation threads block here and take over the watermark in turn.
         Votes are gathered WITHOUT the ledger lock, so reads and other
         dispatches proceed meanwhile.
+
+        Batched + pipelined (PR 3): each pass drains the WHOLE
+        uncertified backlog — not just [.., upto) — in one
+        `certify_range` round-trip per validator, bounded by
+        `_cert_batch` ops in flight so fencing / self-demotion checks
+        run between windows.  Ops appended by other dispatch threads
+        while a batch's votes are in flight simply ride the next batch:
+        vote-gathering overlaps writer-side accept, and a mutator
+        queueing on _cert_lock usually finds its op already certified
+        when it gets the lock.  Any position the fast path cannot
+        certify falls through to the single-op `certify`, whose
+        conflict-resync / repair / superseded machinery is untouched.
+        BFLC_CONTROL_PLANE_LEGACY=1 pins `_cert_batch` to 1 — the
+        pre-PR one-op-per-round-trip behaviour, kept as the benchmark
+        baseline switch.
         """
         if self._bft is None:
             return None
@@ -540,9 +565,37 @@ class LedgerServer:
                 i = self._certified_size
                 prev = self._cert_head
                 with self._lock:
-                    op = self.ledger.log_op(i)
-                    auth = self._op_auth.get(i)
+                    hi = min(max(upto, self.ledger.log_size()),
+                             i + self._cert_batch)
+                    entries = [(self.ledger.log_op(j),
+                                self._op_auth.get(j))
+                               for j in range(i, hi)]
+                if len(entries) > 1:
+                    tr = tracing.PROC
+                    t0 = time.perf_counter() if tr.enabled else 0.0
+                    certs = self._bft.certify_range(i, entries, prev)
+                    if tr.enabled:
+                        tr.charge("bft.certify_s",
+                                  time.perf_counter() - t0)
+                    installed = 0
+                    for k, cert in enumerate(certs):
+                        if cert is None:
+                            break
+                        self._install_certificate(i + k, entries[k][0],
+                                                  cert.to_wire())
+                        installed += 1
+                    if tr.enabled and installed:
+                        tr.charge("bft.certify_batched_ops", installed)
+                    if installed:
+                        with self._cv:
+                            self._cv.notify_all()
+                        continue        # drained some: advance / re-batch
+                op, auth = entries[0]
+                tr = tracing.PROC
+                t0 = time.perf_counter() if tr.enabled else 0.0
                 cert = self._bft.certify(i, op, auth, prev)
+                if tr.enabled:
+                    tr.charge("bft.certify_s", time.perf_counter() - t0)
                 if cert is None:
                     if getattr(self._bft, "superseded_op", None) \
                             is not None:
@@ -567,15 +620,21 @@ class LedgerServer:
                     # validator endpoints for the whole timeout
                     time.sleep(0.2)
                     continue
-                from bflc_demo_tpu.comm.bft import next_head
-                wire = cert.to_wire()
-                self._certs[i] = wire
-                self._certs_by_ophash[wire["op_hash"]] = wire
-                self._cert_head = next_head(prev, op)
-                self._certified_size = i + 1
+                self._install_certificate(i, op, cert.to_wire())
+                if tr.enabled:
+                    tr.charge("bft.certify_single_ops")
                 with self._cv:
                     self._cv.notify_all()   # wake gated op-stream pushers
             return self._certs.get(upto - 1)
+
+    def _install_certificate(self, i: int, op: bytes, wire: dict) -> None:
+        """Record op i's certificate and advance the certification
+        watermark (caller holds _cert_lock and notifies _cv)."""
+        from bflc_demo_tpu.comm.bft import next_head
+        self._certs[i] = wire
+        self._certs_by_ophash[wire["op_hash"]] = wire
+        self._cert_head = next_head(self._cert_head, op)
+        self._certified_size = i + 1
 
     def _stream_ops(self, conn: socket.socket, start: int,
                     quorum_eligible: bool) -> None:
@@ -629,6 +688,16 @@ class LedgerServer:
                     frame = {"i": next_i + i, "op": op.hex()}
                     if self._bft is not None:
                         frame["cert"] = self._certs.get(next_i + i)
+                    blob = (None if self._legacy
+                            else self._op_payload_blob(op))
+                    if blob is not None:
+                        # piggyback an upload op's payload blob on the
+                        # push (binary frame tail): the follower's
+                        # mirror-before-apply gate is satisfied without
+                        # a fetch round-trip on the ack critical path —
+                        # it still hash-verifies against the op, so a
+                        # lying writer gains nothing (PR 3)
+                        frame["blob"] = blob
                     send_msg(conn, frame)
                 next_i += len(ops)
         finally:
@@ -637,6 +706,24 @@ class LedgerServer:
                 self._sub_sent.pop(sub_id, None)
                 self._sub_eligible.pop(sub_id, None)
                 self._cv.notify_all()
+
+    _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
+
+    def _op_payload_blob(self, op: bytes) -> Optional[bytes]:
+        """An upload op's payload blob when this writer still holds it
+        (None for non-upload ops or post-aggregation drops) — the
+        op-stream piggyback source.  Decoded via the ONE op codec
+        (ledger.tool.decode_op) so the piggyback cannot silently drift
+        from the chain's byte layout."""
+        if not op or op[0] != self._UPLOAD_OPCODE:
+            return None
+        from bflc_demo_tpu.ledger.tool import decode_op
+        try:
+            digest = bytes.fromhex(decode_op(op)["payload_hash"])
+        except (KeyError, ValueError):
+            return None
+        with self._lock:
+            return self._blobs.get(digest)
 
     def _ack_reader(self, conn: socket.socket, sub_id: object) -> None:
         try:
@@ -860,12 +947,15 @@ class LedgerServer:
                 return {"ok": True, "role": role, "epoch": epoch,
                         "round_closed": self.ledger.round_closed}
             if method == "model":
+                # bytes value -> binary wire frame: the model blob is the
+                # fattest reply on the control plane; hex-doubling it in
+                # JSON was pure overhead (comm.wire, PR 3)
                 return {"ok": True, "epoch": self.ledger.epoch,
                         "hash": self._model_hash.hex(),
-                        "blob": self._model_blob.hex()}
+                        "blob": self._model_blob}
             if method == "upload":
                 addr = m["addr"]
-                blob = bytes.fromhex(m["blob"])
+                blob = blob_bytes(m["blob"])
                 digest = hashlib.sha256(blob).digest()
                 if digest.hex() != m["hash"]:
                     return {"ok": False, "status": "BAD_ARG",
@@ -928,7 +1018,25 @@ class LedgerServer:
                 blob = self._blobs.get(digest)
                 if blob is None:
                     return {"ok": False, "error": "unknown blob"}
-                return {"ok": True, "blob": blob.hex()}
+                return {"ok": True, "blob": blob}
+            if method == "blobs":
+                # batched content-addressed fetch (PR 3): one round-trip
+                # for a round's K candidate deltas instead of K — the
+                # committee-scoring hot path.  Held blobs ride the binary
+                # tail back-to-back with a [hash, length] manifest;
+                # unknown hashes are simply absent (the caller falls back
+                # per-hash, same contract as "blob").
+                parts, tail = [], []
+                for h in list(m.get("hashes", []))[:256]:
+                    try:
+                        b = self._blobs.get(bytes.fromhex(h))
+                    except (TypeError, ValueError):
+                        b = None
+                    if b is not None:
+                        parts.append([h, len(b)])
+                        tail.append(b)
+                return {"ok": True, "parts": parts,
+                        "blob": b"".join(tail)}
             if method == "scores":
                 addr = m["addr"]
                 scores = [float(s) for s in m["scores"]]
@@ -961,20 +1069,27 @@ class LedgerServer:
                     a: p.hex()
                     for a, p in self.directory.export_raw().items()}}
             if method == "info":
-                return {"ok": True, "epoch": self.ledger.epoch,
-                        "num_registered": self.ledger.num_registered,
-                        "update_count": self.ledger.update_count,
-                        "score_count": self.ledger.score_count,
-                        "round_closed": self.ledger.round_closed,
-                        "last_global_loss": self.ledger.last_global_loss,
-                        "rounds_completed": self._rounds_completed,
-                        "log_size": self.ledger.log_size(),
-                        "log_head": self.ledger.log_head().hex(),
-                        "gen": self.ledger.generation,
-                        "writer_index": self.ledger.writer_index,
-                        "certified_size": (self._certified_size
-                                           if self._bft is not None
-                                           else None)}
+                reply = {"ok": True, "epoch": self.ledger.epoch,
+                         "num_registered": self.ledger.num_registered,
+                         "update_count": self.ledger.update_count,
+                         "score_count": self.ledger.score_count,
+                         "round_closed": self.ledger.round_closed,
+                         "last_global_loss": self.ledger.last_global_loss,
+                         "rounds_completed": self._rounds_completed,
+                         "log_size": self.ledger.log_size(),
+                         "log_head": self.ledger.log_head().hex(),
+                         "gen": self.ledger.generation,
+                         "writer_index": self.ledger.writer_index,
+                         "certified_size": (self._certified_size
+                                            if self._bft is not None
+                                            else None)}
+                if tracing.PROC.enabled:
+                    # the federation benchmark's attribution surface: the
+                    # sponsor reads the writer's own phase accounting
+                    # (wire / crypto / validate / aggregate) off the last
+                    # info poll instead of guessing from wall time
+                    reply["perf"] = tracing.PROC.summary()
+                return reply
             if method == "log_range":
                 start, end = int(m["start"]), int(m["end"])
                 size = self.ledger.log_size()
@@ -1052,6 +1167,7 @@ class LedgerServer:
         """On-coordinator aggregation — the reference's on-chain Aggregate
         (.cpp:349-456): weighted-FedAvg the ledger-selected deltas into the
         global model, commit the new model's content hash, publish blob."""
+        t0 = time.perf_counter() if tracing.PROC.enabled else 0.0
         pending = self.ledger.pending()
         updates = self.ledger.query_all_updates()
         epoch = self.ledger.epoch
@@ -1076,6 +1192,8 @@ class LedgerServer:
         self._rounds_completed += 1
         self._last_progress = time.monotonic()
         self._cv.notify_all()
+        if tracing.PROC.enabled:
+            tracing.PROC.charge("aggregate_s", time.perf_counter() - t0)
         if self.verbose:
             print(f"[coordinator] epoch {epoch} aggregated: "
                   f"loss={self.ledger.last_global_loss:.5f}", flush=True)

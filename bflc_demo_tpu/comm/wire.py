@@ -7,34 +7,64 @@ TPU-native coordinator: a trivially parseable frame format —
 
     [4-byte big-endian length][UTF-8 JSON object]
 
-— where binary fields (digests, signatures, op bytes, tensor blobs) travel
-hex-encoded inside the JSON.  Control messages are tiny (hashes + scores +
-meta; tensors cross separately as store blobs), so JSON's overhead is
-irrelevant and its debuggability is worth more than a binary codec here.
-Integrity/authenticity comes from Ed25519 op tags (comm.identity), not the
-transport.
+— where binary fields (digests, signatures, op bytes) travel hex-encoded
+inside the JSON.  Control messages are tiny (hashes + scores + meta), so
+JSON's overhead is irrelevant and its debuggability is worth more than a
+binary codec here.  Integrity/authenticity comes from Ed25519 op tags
+(comm.identity), not the transport.
+
+Blob-carrying messages (upload payloads, blob mirroring, model fetch) are
+the exception (PR 3): hex-doubling a model blob inside a JSON string both
+inflates the wire 2x and forces a JSON parse of megabyte strings.  Any
+top-level `bytes` value in a message therefore rides a BINARY frame
+variant —
+
+    [4-byte length][\\x00BIN1][4-byte header length][JSON header][raw tail]
+
+— where the JSON header is the message minus its bytes-valued fields plus
+a `_bin: [[field, length], ...]` manifest, and the raw tail is those
+fields' bytes concatenated in manifest order.  Old-format (pure-JSON)
+frames remain accepted on every receive path — the first body byte
+distinguishes them ('{' vs NUL) — so mixed-version peers interoperate,
+and hex-string senders keep working: `blob_bytes` decodes either
+representation at the consumption sites.  BFLC_CONTROL_PLANE_LEGACY=1 at
+import forces hex-in-JSON sends (the before/after benchmark switch).
 
 Frames are capped at 256 MiB: a hostile or corrupt length prefix must not
 drive an unbounded allocation (same rule as the ledger's op-byte bounds).
+The binary header length and every manifest entry are validated against
+the same cap — a lying manifest is a WireError, never an overread.
 
-Fault injection (bflc_demo_tpu.chaos): every frame send/receive consults a
-process-local injector when one is installed — partition windows surface
-as connection errors, delay windows as latency, drop windows as lost
-frames.  This IS the socket boundary, so chaos exercises exactly the
-failure modes real networks produce (a dropped reply, for instance, makes
-the client retry an op the server already applied — the
-duplicate-delivery path).  Without an installed injector the hot path
-pays one None check per frame.
+Fault injection (bflc_demo_tpu.chaos): every frame send/receive — JSON
+and binary alike — consults a process-local injector when one is
+installed; partition windows surface as connection errors, delay windows
+as latency, drop windows as lost frames.  This IS the socket boundary, so
+chaos exercises exactly the failure modes real networks produce (a
+dropped reply, for instance, makes the client retry an op the server
+already applied — the duplicate-delivery path).  Without an installed
+injector the hot path pays one None check per frame.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import socket
 import struct
+import time
 from typing import Any, Dict, Optional
 
+from bflc_demo_tpu.utils import tracing
+
 MAX_FRAME = 256 << 20
+
+# binary-frame sentinel: a JSON object frame's first byte is '{', so a
+# NUL-led magic is unambiguous on the same socket
+_BIN_MAGIC = b"\x00BIN1"
+
+# legacy switch (see module docstring): force hex-in-JSON frames
+_JSON_ONLY = bool(os.environ.get("BFLC_CONTROL_PLANE_LEGACY"))
 
 # process-local fault injector (chaos.hooks.FaultInjector) or None.
 # Installed once at child-process startup by the chaos campaign; never
@@ -54,13 +84,116 @@ class WireError(ConnectionError):
     """Framing violation or unexpected EOF mid-frame."""
 
 
+def blob_bytes(value) -> bytes:
+    """Decode a blob-carrying message field: raw bytes from a binary
+    frame, or a hex string from a legacy JSON frame (mixed-version
+    peers).  Raises ValueError on anything else, like bytes.fromhex."""
+    if isinstance(value, (bytes, bytearray)):
+        return bytes(value)
+    if isinstance(value, str):
+        return bytes.fromhex(value)
+    raise ValueError(f"blob field is {type(value).__name__}, "
+                     f"expected bytes or hex str")
+
+
+def split_blob_parts(reply: Dict[str, Any]) -> Dict[str, bytes]:
+    """Decode a batched content-addressed blob reply
+    (``{parts: [[hex_hash, length], ...], blob: <concatenated tail>}`` —
+    the coordinator's ``blobs`` method) into {hex_hash: bytes}.
+
+    Every part is verified against its own hash and malformed or lying
+    entries are simply omitted — callers treat absence as a miss and
+    fall back to per-hash fetches, so a hostile or buggy peer can cause
+    extra round-trips, never a crash or a wrong blob."""
+    out: Dict[str, bytes] = {}
+    try:
+        raw = blob_bytes(reply.get("blob", b""))
+        off = 0
+        for entry in reply.get("parts", []):
+            h, n = str(entry[0]), int(entry[1])
+            if n < 0 or off + n > len(raw):
+                break
+            part = raw[off:off + n]
+            off += n
+            if hashlib.sha256(part).hexdigest() == h:
+                out[h] = part
+    except (TypeError, ValueError, IndexError, KeyError,
+            AttributeError):
+        pass
+    return out
+
+
+def _encode(msg: Dict[str, Any]) -> bytes:
+    """Message dict -> frame body.  bytes-valued top-level fields select
+    the binary variant (unless the legacy switch forces hex-in-JSON)."""
+    bin_fields = [(k, v) for k, v in msg.items()
+                  if isinstance(v, (bytes, bytearray, memoryview))]
+    if not bin_fields:
+        return json.dumps(msg, separators=(",", ":")).encode()
+    if _JSON_ONLY:
+        patched = {k: (bytes(v).hex()
+                       if isinstance(v, (bytes, bytearray, memoryview))
+                       else v) for k, v in msg.items()}
+        return json.dumps(patched, separators=(",", ":")).encode()
+    head = {k: v for k, v in msg.items()
+            if not isinstance(v, (bytes, bytearray, memoryview))}
+    head["_bin"] = [[k, len(v)] for k, v in bin_fields]
+    hdata = json.dumps(head, separators=(",", ":")).encode()
+    return b"".join([_BIN_MAGIC, struct.pack(">I", len(hdata)), hdata]
+                    + [bytes(v) for _, v in bin_fields])
+
+
+def _decode_binary(body: bytes) -> Dict[str, Any]:
+    """Binary frame body -> message dict with bytes-valued blob fields.
+    Every length is validated against the actual body: a corrupt or
+    hostile manifest is a WireError, never an overread or a giant
+    allocation past the frame cap (the body itself is already capped)."""
+    off = len(_BIN_MAGIC)
+    if len(body) < off + 4:
+        raise WireError("truncated binary frame header")
+    (hlen,) = struct.unpack_from(">I", body, off)
+    off += 4
+    if hlen > len(body) - off:
+        raise WireError(f"binary frame header length {hlen} overruns "
+                        f"frame of {len(body)} bytes")
+    try:
+        msg = json.loads(body[off:off + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"undecodable binary frame header: {e}") from e
+    if not isinstance(msg, dict):
+        raise WireError("binary frame header is not a JSON object")
+    off += hlen
+    manifest = msg.pop("_bin", [])
+    if not isinstance(manifest, list):
+        raise WireError("binary frame manifest is not a list")
+    for entry in manifest:
+        try:
+            key, n = str(entry[0]), int(entry[1])
+        except (TypeError, ValueError, IndexError, KeyError) as e:
+            raise WireError(f"malformed binary manifest entry: {e}") from e
+        if n < 0 or n > len(body) - off:
+            raise WireError(f"binary field {key!r} length {n} overruns "
+                            f"frame tail of {len(body) - off} bytes")
+        msg[key] = body[off:off + n]
+        off += n
+    if off != len(body):
+        raise WireError(f"{len(body) - off} trailing bytes after the "
+                        f"binary frame manifest")
+    return msg
+
+
 def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
-    data = json.dumps(msg, separators=(",", ":")).encode()
+    tr = tracing.PROC
+    t0 = time.perf_counter() if tr.enabled else 0.0
+    data = _encode(msg)
     if len(data) > MAX_FRAME:
         raise WireError(f"frame too large: {len(data)}")
     if _INJECTOR is not None:
         _INJECTOR.on_send(sock)
     sock.sendall(struct.pack(">I", len(data)) + data)
+    if tr.enabled:
+        tr.charge("wire.send_s", time.perf_counter() - t0)
+        tr.charge("wire.bytes_out", 4 + len(data))
 
 
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -77,12 +210,19 @@ def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
-    """Receive one frame; None on clean EOF (peer closed)."""
+    """Receive one frame; None on clean EOF (peer closed).  Accepts both
+    the JSON and the binary variant on the same socket — the peer's
+    version never matters to the receiver."""
     if _INJECTOR is not None:
         _INJECTOR.on_recv(sock)
     header = recv_exact(sock, 4)
     if header is None:
         return None
+    # timing starts AFTER the length prefix arrived: the wait for a
+    # frame's first bytes is the PEER's think time (or idle), not wire
+    # cost — charging it would drown the attribution in blocking reads
+    tr = tracing.PROC
+    t0 = time.perf_counter() if tr.enabled else 0.0
     (length,) = struct.unpack(">I", header)
     if length > MAX_FRAME:
         raise WireError(f"frame length {length} exceeds cap")
@@ -90,9 +230,16 @@ def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
     if body is None:
         raise WireError("EOF between header and body")
     try:
-        msg = json.loads(body.decode())
-    except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise WireError(f"undecodable frame: {e}") from e
-    if not isinstance(msg, dict):
-        raise WireError("frame is not a JSON object")
-    return msg
+        if body.startswith(_BIN_MAGIC):
+            return _decode_binary(body)
+        try:
+            msg = json.loads(body.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise WireError(f"undecodable frame: {e}") from e
+        if not isinstance(msg, dict):
+            raise WireError("frame is not a JSON object")
+        return msg
+    finally:
+        if tr.enabled:
+            tr.charge("wire.recv_s", time.perf_counter() - t0)
+            tr.charge("wire.bytes_in", 4 + len(body))

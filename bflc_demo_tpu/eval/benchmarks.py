@@ -10,13 +10,20 @@ round time is the headline win.
 
 from __future__ import annotations
 
-from typing import Dict
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, Optional
 
-from bflc_demo_tpu.client.simulation import run_federated
-from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
-from bflc_demo_tpu.data import load_occupancy, iid_shards
-from bflc_demo_tpu.models import make_softmax_regression
 from bflc_demo_tpu.protocol.constants import DEFAULT_PROTOCOL
+
+# NOTE: the FL-runtime imports (jax-heavy) are deliberately lazy — the
+# control-plane benchmarks below are spawned into light subprocesses for
+# their legacy-mode baseline leg, and those children must not pay a full
+# jax initialisation to time some Ed25519 and socket code.
 
 
 def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
@@ -30,6 +37,11 @@ def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
     post-hoc ledger audit.
     estimate_flops (mesh, rounds_per_dispatch=1 only): record XLA
     cost-analysis FLOPs/round and MFU against the chip peak (eval.mfu)."""
+    from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+    from bflc_demo_tpu.client.simulation import run_federated
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+    from bflc_demo_tpu.models import make_softmax_regression
+
     if runtime not in ("host", "mesh"):
         raise ValueError(f"runtime must be 'host' or 'mesh', got {runtime!r}")
     if runtime == "host" and rounds_per_dispatch > 1:
@@ -118,6 +130,10 @@ def endurance_config1(rounds: int = 50, ledger_backend: str = "auto",
     Returns {rounds_completed, test_acc_at_round_50 (or at `rounds`),
     best_test_acc, epochs_monotone, wall_time_s}.
     """
+    from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+    from bflc_demo_tpu.models import make_softmax_regression
+
     cfg = DEFAULT_PROTOCOL
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(xtr, ytr, cfg.client_num)
@@ -143,3 +159,234 @@ def endurance_config1(rounds: int = 50, ledger_backend: str = "auto",
             and len(epochs) == rounds),
         "wall_time_s": round(res.wall_time_s, 3),
     }
+
+
+# --------------------------------------------------- control plane (PR 3)
+def _cert_throughput_inproc(n_ops: int = 24, validators: int = 4,
+                            modes=("sequential", "batched")) -> Dict:
+    """Certification-machinery throughput, measured in-process: a writer-
+    side CertificateAssembler against a live (thread-served) validator
+    fleet, certifying the same n_ops-deep backlog of signed register ops.
+
+    'sequential' = one `certify` round-trip per op (the pre-PR shape);
+    'batched' = one `certify_range` call (PR 3).  A fresh fleet per mode
+    (replicas are stateful).  Runs under whatever crypto mode the process
+    imported with — BFLC_CONTROL_PLANE_LEGACY=1 in the environment gives
+    the pre-PR naive-Ed25519 numbers, which is how `certification_
+    throughput` obtains its baseline leg.  Every certificate produced is
+    checked under the unchanged `verify_certificate`."""
+    from bflc_demo_tpu.comm.bft import (CertificateAssembler,
+                                        ValidatorNode, next_head,
+                                        provision_validators,
+                                        verify_certificate)
+    from bflc_demo_tpu.comm.identity import (ED25519_BACKEND, _op_bytes,
+                                             provision_wallets)
+    from bflc_demo_tpu.ledger.base import encode_register_op
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig, bft_quorum
+
+    cfg = ProtocolConfig(client_num=max(n_ops, 5), comm_count=4,
+                         aggregate_count=6, needed_update_count=10,
+                         learning_rate=0.05, batch_size=16)
+    wallets, _ = provision_wallets(n_ops, b"cert-bench-seed-01")
+    entries = []
+    for w in wallets:
+        op = encode_register_op(w.address)
+        tag = w.sign(_op_bytes("register", w.address, 0, b"")).hex()
+        entries.append((op, {"tag": tag, "pubkey": w.public_bytes.hex()}))
+    quorum = bft_quorum(validators)
+    out: Dict = {"n_ops": n_ops, "validators": validators,
+                 "crypto_backend": ED25519_BACKEND,
+                 "legacy_mode": bool(
+                     os.environ.get("BFLC_CONTROL_PLANE_LEGACY"))}
+    for mode in modes:
+        vwallets, vkeys = provision_validators(
+            validators, b"cert-bench-fleet|" + mode.encode())
+        nodes = [ValidatorNode(cfg, w, i, validator_keys=vkeys)
+                 for i, w in enumerate(vwallets)]
+        for v in nodes:
+            v.start()
+        asm = CertificateAssembler([(v.host, v.port) for v in nodes],
+                                   vkeys, quorum)
+        try:
+            t0 = time.perf_counter()
+            if mode == "sequential":
+                prev = b"\0" * 32
+                certs = []
+                for i, (op, auth) in enumerate(entries):
+                    certs.append(asm.certify(i, op, auth, prev))
+                    prev = next_head(prev, op)
+            else:
+                certs = asm.certify_range(0, entries, b"\0" * 32)
+            dt = time.perf_counter() - t0
+        finally:
+            asm.close()
+            for v in nodes:
+                v.close()
+        prev = b"\0" * 32
+        for i, ((op, _), cert) in enumerate(zip(entries, certs)):
+            if cert is None or not verify_certificate(
+                    cert, index=i, prev_head=prev, op=op, quorum=quorum,
+                    validator_keys=vkeys):
+                raise RuntimeError(
+                    f"{mode}: op {i} failed certification — a throughput "
+                    f"number over broken certificates would be fiction")
+            prev = next_head(prev, op)
+        out[f"{mode}_ops_per_sec"] = round(n_ops / dt, 2)
+        out[f"{mode}_ms_per_op"] = round(dt * 1e3 / n_ops, 3)
+    if {"sequential", "batched"} <= set(modes):
+        out["batched_vs_sequential"] = round(
+            out["batched_ops_per_sec"] / out["sequential_ops_per_sec"], 2)
+    return out
+
+
+def certification_throughput(n_ops: int = 24, validators: int = 4,
+                             include_legacy: bool = True) -> Dict:
+    """The ops-certified/sec axis with its own baseline: the in-process
+    measurement above under THIS process's (fast) crypto, plus — in a
+    child interpreter with BFLC_CONTROL_PLANE_LEGACY=1 — the pre-PR path
+    (sequential certification, naive Ed25519, no verify memo, hex-JSON
+    frames).  `speedup_vs_pre_pr` is batched-fast vs sequential-legacy:
+    the number the PR's acceptance bar is stated in."""
+    out = _cert_throughput_inproc(n_ops, validators)
+    if include_legacy:
+        code = ("import json; "
+                "from bflc_demo_tpu.eval.benchmarks import "
+                "_cert_throughput_inproc as f; "
+                f"print(json.dumps(f({n_ops}, {validators}, "
+                "modes=('sequential',))))")
+        env = dict(os.environ, BFLC_CONTROL_PLANE_LEGACY="1",
+                   JAX_PLATFORMS="cpu")
+        try:
+            r = subprocess.run([sys.executable, "-c", code], env=env,
+                               capture_output=True, text=True, timeout=600)
+            lines = [ln for ln in r.stdout.splitlines()
+                     if ln.startswith("{")]
+            if r.returncode == 0 and lines:
+                legacy = json.loads(lines[-1])
+                out["pre_pr_sequential_ops_per_sec"] = \
+                    legacy["sequential_ops_per_sec"]
+                out["speedup_vs_pre_pr"] = round(
+                    out["batched_ops_per_sec"]
+                    / legacy["sequential_ops_per_sec"], 2)
+            else:
+                out["pre_pr_error"] = r.stderr.strip()[-300:]
+        except subprocess.TimeoutExpired:
+            out["pre_pr_error"] = "legacy child timed out"
+    return out
+
+
+def federation_config1(rounds: int = 3, *, standbys: int = 2,
+                       validators: int = 4, quorum: int = 1,
+                       compare_sequential: bool = False,
+                       timeout_s: float = 420.0) -> Dict:
+    """Process-federation benchmark at the paper's config-1 BFT geometry —
+    the topology that actually reproduces the reference's deployment (20
+    client processes + 2 hot standbys + 4 commit validators + quorum-1
+    acks + WAL; the same fleet the chaos-soak headline runs) — measuring
+    what no other bench axis sees: round wall time THROUGH the certified
+    socket path, ops-certified/sec, and the crypto-time share of the
+    writer process (attributed by utils.tracing spans, not asserted).
+
+    compare_sequential=True re-runs the identical federation with
+    BFLC_CONTROL_PLANE_LEGACY=1 in the children's environment — the
+    pre-PR control plane (sequential certification, naive Ed25519,
+    hex-JSON blob frames) — and reports the round-time and
+    ops-certified/sec ratios."""
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+
+    cfg = DEFAULT_PROTOCOL
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+
+    def _run(legacy: bool) -> Dict:
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        saved = {k: os.environ.get(k)
+                 for k in ("BFLC_CONTROL_PLANE_LEGACY", "BFLC_PROC_TRACE")}
+        if legacy:
+            os.environ["BFLC_CONTROL_PLANE_LEGACY"] = "1"
+        else:
+            os.environ.pop("BFLC_CONTROL_PLANE_LEGACY", None)
+        os.environ["BFLC_PROC_TRACE"] = "1"
+        try:
+            with tempfile.TemporaryDirectory(prefix="bflc-fed-bench-") \
+                    as td:
+                res = run_federated_processes(
+                    "make_softmax_regression", shards, (xte, yte), cfg,
+                    rounds=rounds, standbys=standbys, quorum=quorum,
+                    bft_validators=validators,
+                    wal_path=os.path.join(td, "writer.wal"),
+                    timeout_s=timeout_s)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        info = res.final_info or {}
+        wall = max(res.wall_time_s, 1e-9)
+        certified = int(info.get("certified_size")
+                        or info.get("log_size") or 0)
+        perf = info.get("perf") or {}
+        costs = perf.get("costs", {})
+        crypto_s = (costs.get("crypto.sign_s", 0.0)
+                    + costs.get("crypto.verify_s", 0.0))
+        wire_s = (costs.get("wire.send_s", 0.0)
+                  + costs.get("wire.recv_s", 0.0))
+        rounds_done = max(res.rounds_completed, 1)
+        # steady-state round time: commit-to-commit intervals from the
+        # sponsor's own observations.  Fleet spawn (20 jax child imports)
+        # and the registration burst live before the FIRST commit;
+        # dividing total wall by rounds would let that startup noise
+        # drown exactly the per-round control-plane cost this benchmark
+        # exists to measure.
+        ts = [t for _, t in res.epoch_times]
+        if len(ts) >= 2:
+            round_wall = (ts[-1] - ts[0]) / (len(ts) - 1)
+        else:
+            round_wall = wall / rounds_done
+        return {
+            "rounds": res.rounds_completed,
+            "round_wall_time_s": round(round_wall, 4),
+            "time_to_first_round_s": round(ts[0], 3) if ts else None,
+            "wall_time_s": round(wall, 3),
+            "ops_certified": certified,
+            # fleet-level rate (includes spawn/idle — trend, not truth)
+            # and the writer's actual certification throughput (ops over
+            # the time the certify path really ran)
+            "ops_certified_per_sec": round(certified / wall, 2),
+            "cert_throughput_ops_per_sec": round(
+                certified / costs["bft.certify_s"], 2)
+            if costs.get("bft.certify_s") else None,
+            "best_acc": round(res.best_accuracy(), 4),
+            "writer_crypto_time_s": round(crypto_s, 3),
+            "writer_crypto_share": round(crypto_s / wall, 4),
+            "writer_wire_time_s": round(wire_s, 3),
+            "writer_certify_time_s": round(
+                costs.get("bft.certify_s", 0.0), 3),
+            "writer_aggregate_time_s": round(
+                costs.get("aggregate_s", 0.0), 3),
+            "ops_certified_batched": int(
+                costs.get("bft.certify_batched_ops", 0)),
+            "ops_certified_single": int(
+                costs.get("bft.certify_single_ops", 0)),
+        }
+
+    out: Dict = {
+        "geometry": {"clients": cfg.client_num, "standbys": standbys,
+                     "validators": validators, "quorum": quorum,
+                     "wal": True, "rounds": rounds},
+        "fast": _run(legacy=False),
+    }
+    if compare_sequential:
+        out["pre_pr_sequential"] = _run(legacy=True)
+        fast, seq = out["fast"], out["pre_pr_sequential"]
+        if fast["round_wall_time_s"] > 0:
+            out["round_time_speedup"] = round(
+                seq["round_wall_time_s"] / fast["round_wall_time_s"], 2)
+        if fast.get("cert_throughput_ops_per_sec") \
+                and seq.get("cert_throughput_ops_per_sec"):
+            out["cert_throughput_speedup"] = round(
+                fast["cert_throughput_ops_per_sec"]
+                / seq["cert_throughput_ops_per_sec"], 2)
+    return out

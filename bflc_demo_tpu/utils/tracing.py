@@ -19,19 +19,27 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
+import threading
 import time
 from collections import defaultdict
 from typing import Any, Dict, Iterator, List, Optional
 
 
 class Tracer:
-    """Hierarchical span/event tracer with cost counters."""
+    """Hierarchical span/event tracer with cost counters.
+
+    Thread-safety: `charge` takes a lock (only when enabled) so the
+    multi-threaded control-plane servers can account concurrently;
+    `span`/`event` share one name stack and are meant for single-threaded
+    drivers — servers charge counters instead of nesting spans."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self.events: List[Dict[str, Any]] = []
         self.costs: Dict[str, float] = defaultdict(float)
         self._stack: List[str] = []
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[None]:
@@ -59,9 +67,19 @@ class Tracer:
     def charge(self, category: str, amount: float = 1.0) -> None:
         """Cost accounting — the gasPricer equivalent.  Categories in use:
         'ledger.ops', 'device.dispatches', 'host_bytes.in', 'host_bytes.out',
-        'train.samples'."""
+        'train.samples'; and, on the control-plane fast path (PR 3),
+        'crypto.sign_s'/'crypto.verify_s'/'crypto.verify_n',
+        'wire.send_s'/'wire.recv_s'/'wire.bytes_out'/'wire.bytes_in',
+        'bft.validate_s'/'bft.certify_s'/'aggregate_s'."""
         if self.enabled:
-            self.costs[category] += amount
+            with self._lock:
+                self.costs[category] += amount
+
+    def reset(self) -> None:
+        with self._lock:
+            self.events.clear()
+            self.costs.clear()
+            self._stack.clear()
 
     # --- reporting ---
     def span_totals(self) -> Dict[str, float]:
@@ -83,3 +101,13 @@ class Tracer:
 
 
 NULL_TRACER = Tracer(enabled=False)
+
+# Process-wide control-plane tracer (PR 3): comm.wire, comm.identity and
+# comm.bft charge phase timings into it so a federation round's cost is
+# ATTRIBUTABLE (wire vs crypto vs validate vs aggregate), not asserted.
+# Disabled by default (one `enabled` check per charge site); enabled at
+# interpreter start via BFLC_PROC_TRACE=1 — the federation benchmark sets
+# it in the spawn environment so every child traces — or in-process by
+# flipping `PROC.enabled` (tools/profile_round.py).  Access as
+# `tracing.PROC` (module attribute), never `from ... import PROC`.
+PROC = Tracer(enabled=bool(os.environ.get("BFLC_PROC_TRACE")))

@@ -628,6 +628,191 @@ class TestValidatorRejoin:
                 v.close()
 
 
+class TestBatchedCertification:
+    """PR 3: `bft_vote_batch` / `certify_range` — one round-trip per
+    validator for a contiguous op range.  The certificates must be
+    byte-compatible with the single-op path (same payload layout,
+    position-bound, chain-linked, accepted by the unchanged
+    `verify_certificate`), idempotent re-asks must re-sign, a lagging
+    replica must catch up on certified backlog, and a conflicting
+    replica must stop the fast path cold so the evidence-carrying
+    single-op machinery takes over."""
+
+    def _signed_register_ops(self, wallets):
+        led = make_ledger(CFG, backend="python")
+        entries = []
+        for w in wallets:
+            led.register_node(w.address)
+            entries.append((led.log_op(led.log_size() - 1),
+                            {"tag": _sign(w, "register", 0, b""),
+                             "pubkey": w.public_bytes.hex()}))
+        return entries
+
+    def test_range_certifies_and_verifies_like_single_path(self):
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-batch-01")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-batch-01")
+        try:
+            entries = self._signed_register_ops(wallets[:4])
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0)
+            certs = asm.certify_range(0, entries, b"\0" * 32)
+            assert all(c is not None for c in certs)
+            prev = b"\0" * 32
+            for i, ((op, _), cert) in enumerate(zip(entries, certs)):
+                # the unchanged verifier accepts every batch certificate
+                assert verify_certificate(
+                    cert, index=i, prev_head=prev, op=op, quorum=QUORUM,
+                    validator_keys=vkeys), i
+                assert len(cert.sigs) == N_VALIDATORS
+                prev = next_head(prev, op)
+            # idempotent re-ask (a writer retrying after a lost reply):
+            # every validator re-signs the ops it already holds
+            certs2 = asm.certify_range(0, entries, b"\0" * 32)
+            assert all(c is not None for c in certs2)
+            # and the single-op path interoperates on the same replicas
+            c0 = asm.certify(0, entries[0][0], entries[0][1], b"\0" * 32)
+            assert c0 is not None and c0.op_hash == certs[0].op_hash
+            asm.close()
+        finally:
+            for v in nodes:
+                v.close()
+
+    def test_lagging_validator_catches_up_inside_batch(self):
+        """A validator that missed certified history (crash+rejoin) is
+        replayed the backlog — certificates riding along in place of the
+        writer-process-local auth evidence — within the batch call."""
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-batch-02")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-batch-02")
+        try:
+            entries = self._signed_register_ops(wallets[:4])
+            # certify ops 0-1 through validators 0-2 only: validator 3
+            # stays two ops behind
+            asm3 = CertificateAssembler(eps[:3], vkeys, QUORUM,
+                                        timeout_s=5.0)
+            backlog = {}
+            prev = b"\0" * 32
+            for i in range(2):
+                op, auth = entries[i]
+                cert = asm3.certify(i, op, auth, prev)
+                assert cert is not None
+                backlog[i] = (op, auth, cert.to_wire())
+                prev = next_head(prev, op)
+            asm3.close()
+            # now batch-certify ops 2-3 through ALL validators; the
+            # assembler must catch validator 3 up from the backlog
+            asm = CertificateAssembler(
+                eps, vkeys, QUORUM, timeout_s=5.0,
+                backlog_fn=lambda j: backlog[j])
+            certs = asm.certify_range(2, entries[2:], prev)
+            assert all(c is not None for c in certs)
+            # full 4-sig certificates prove validator 3 really voted
+            assert all(len(c.sigs) == N_VALIDATORS for c in certs)
+            assert nodes[3].ledger.log_size() == 4
+            asm.close()
+        finally:
+            for v in nodes:
+                v.close()
+
+    def test_conflicting_replica_stops_fast_path_not_safety(self):
+        """A validator already bound to a DIFFERENT op at the tip makes
+        the batch fast path stop at that position (no certificate from
+        the remaining thin quorum is assembled with fewer than quorum
+        sigs) — never a forced vote: moving a bound replica takes the
+        single-op path's quorum evidence."""
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-batch-03")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-batch-03")
+        try:
+            entries = self._signed_register_ops(wallets[:3])
+            # poison validator 0 with a different op at position 0 via a
+            # direct single vote (auth is valid — it is a real client op,
+            # just a DIFFERENT one)
+            other = self._signed_register_ops([wallets[3]])[0]
+            vc = ValidatorClient(eps[0], timeout_s=5.0)
+            r = vc.request("bft_validate", i=0, op=other[0].hex(),
+                           auth=other[1])
+            assert r.get("ok"), r
+            vc.close()
+            asm = CertificateAssembler(eps, vkeys, QUORUM, timeout_s=5.0)
+            certs = asm.certify_range(0, entries, b"\0" * 32)
+            # quorum still reachable (3 clean validators) for pos 0; the
+            # conflicted validator contributed nothing there
+            if certs[0] is not None:
+                assert 0 not in certs[0].sigs
+                assert len(certs[0].sigs) >= QUORUM
+            # and every certificate that did come out verifies
+            prev = b"\0" * 32
+            for i, ((op, _), cert) in enumerate(zip(entries, certs)):
+                if cert is None:
+                    break
+                assert verify_certificate(
+                    cert, index=i, prev_head=prev, op=op, quorum=QUORUM,
+                    validator_keys=vkeys)
+                prev = next_head(prev, op)
+            asm.close()
+        finally:
+            for v in nodes:
+                v.close()
+
+    def test_server_drains_backlog_batched(self):
+        """LedgerServer._ensure_certified drains the whole uncertified
+        backlog per call: a burst of mutations certifies in one
+        round-trip window, every op-stream certificate verifies, and
+        `certified_size` catches the log tip."""
+        wallets, directory = provision_wallets(CFG.client_num,
+                                               b"bft-batch-04")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-batch-04")
+        server = LedgerServer(CFG, _init_blob(),
+                              bft_validators=eps, bft_keys=vkeys)
+        server.start()
+        try:
+            from bflc_demo_tpu.comm.ledger_service import \
+                CoordinatorClient
+            c = CoordinatorClient(server.host, server.port)
+            _register_all(c, wallets)
+            _drive_round(c, wallets, 0)
+            info = c.request("info")
+            assert info["epoch"] == 1
+            assert info["certified_size"] == info["log_size"]
+            # every certificate in the mirror chain-verifies
+            prev = b"\0" * 32
+            for i in range(info["log_size"]):
+                op = server.ledger.log_op(i)
+                cert = CommitCertificate.from_wire(server._certs[i])
+                assert verify_certificate(
+                    cert, index=i, prev_head=prev, op=op, quorum=QUORUM,
+                    validator_keys=vkeys), i
+                prev = next_head(prev, op)
+            c.close()
+        finally:
+            server.close()
+            for v in nodes:
+                v.close()
+
+    def test_legacy_sequential_mode_still_green(self):
+        """BFLC_CONTROL_PLANE_LEGACY pins _cert_batch to 1 (the pre-PR
+        one-op-per-round-trip path) — the benchmark baseline must remain
+        a working configuration, not a strawman."""
+        wallets, _ = provision_wallets(CFG.client_num, b"bft-batch-05")
+        nodes, eps, vkeys = _mk_validators(seed=b"bft-batch-05")
+        server = LedgerServer(CFG, _init_blob(),
+                              bft_validators=eps, bft_keys=vkeys)
+        server._cert_batch = 1          # what the legacy env pins
+        server.start()
+        try:
+            from bflc_demo_tpu.comm.ledger_service import \
+                CoordinatorClient
+            c = CoordinatorClient(server.host, server.port)
+            _register_all(c, wallets)
+            _drive_round(c, wallets, 0)
+            info = c.request("info")
+            assert info["epoch"] == 1
+            assert info["certified_size"] == info["log_size"]
+            c.close()
+        finally:
+            server.close()
+            for v in nodes:
+                v.close()
+
+
 class TestBFTFailover:
     """Fail-stop and Byzantine layers compose: the writer dies, the
     standby promotes over the certified chain — certifying its own fence

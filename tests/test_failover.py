@@ -16,7 +16,8 @@ import numpy as np
 import pytest
 
 from bflc_demo_tpu.comm.failover import FailoverClient, Standby
-from bflc_demo_tpu.comm.identity import provision_wallets, _op_bytes
+from bflc_demo_tpu.comm.identity import (Wallet, provision_wallets,
+                                         _op_bytes)
 from bflc_demo_tpu.comm.ledger_service import LedgerServer
 from bflc_demo_tpu.protocol import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import pack_pytree
@@ -333,6 +334,27 @@ class TestFailoverClient:
                                 max_cycles=2)
         with pytest.raises(ConnectionError):
             client.request("info")
+
+    def test_keyless_multi_endpoint_warns_about_fence_poisoning(self):
+        """ADVICE r5 (low): without provisioned standby keys, promotion
+        evidence is accepted on STRUCTURAL match alone, so one hostile
+        endpoint replying {gen: 999, gen_ev: {...}} poisons the fence and
+        the client rejects the legitimate writer forever — a one-message
+        DoS.  Anywhere failover is real (> 1 endpoint), constructing the
+        forgeable configuration must warn loudly; provisioning keys or
+        running single-endpoint must stay silent."""
+        eps = [("127.0.0.1", 1), ("127.0.0.1", 2)]
+        with pytest.warns(RuntimeWarning, match="standby_keys"):
+            FailoverClient(eps, timeout_s=1.0)
+        import warnings as _warnings
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")     # any warning would raise
+            # single endpoint: failover (and the DoS) cannot happen
+            FailoverClient(eps[:1], timeout_s=1.0)
+            # keys provisioned: evidence is signature-verified
+            sb = Wallet.from_seed(b"keyless-warn-test")
+            FailoverClient(eps, timeout_s=1.0,
+                           standby_keys={1: sb.public_bytes})
 
 
 class _Partition:
@@ -778,21 +800,33 @@ class TestQuorumAck:
         from bflc_demo_tpu.ledger.tool import decode_op
 
         class _FlakyBlobStandby(Standby):
-            """Injects transient blob-fetch failure for chosen digests."""
+            """Injects transient blob-UNAVAILABILITY for chosen digests:
+            both mirror paths — the fetch round-trip AND the op-stream
+            piggyback (PR 3) — must fail, or the injected fault no
+            longer models 'this blob cannot be obtained right now'."""
 
             def __init__(self, *a, **kw):
                 self.fail_digests = set()       # payload-hash hex strings
                 super().__init__(*a, **kw)
 
-            def _mirror_upload_payload(self, op_bytes, ctl):
+            def _failing(self, op_bytes) -> bool:
                 if op_bytes and op_bytes[0] == self._UPLOAD_OPCODE:
                     try:
                         ph = decode_op(op_bytes).get("payload_hash")
                     except Exception:
                         ph = None
-                    if ph in self.fail_digests:
-                        return False
+                    return ph in self.fail_digests
+                return False
+
+            def _mirror_upload_payload(self, op_bytes, ctl):
+                if self._failing(op_bytes):
+                    return False
                 return super()._mirror_upload_payload(op_bytes, ctl)
+
+            def _harvest_pushed_blob(self, msg, op_bytes):
+                if self._failing(op_bytes):
+                    return
+                super()._harvest_pushed_blob(msg, op_bytes)
 
         srv = LedgerServer(CFG, _init_blob(), require_auth=False,
                            stall_timeout_s=60.0, ledger_backend="python",

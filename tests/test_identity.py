@@ -238,6 +238,147 @@ class TestPure25519Backend:
         assert not p.ed25519_verify(pk, b"", b"\x00" * 64)
         assert not p.ed25519_verify(pk, b"", sig[:-1])
 
+    def test_ed25519_rfc8032_vectors_3_and_sha_abc(self):
+        """The remaining short RFC 8032 §7.1 vectors (TEST 3, TEST
+        SHA(abc)) — together with TEST 1/2 above they pin key expansion,
+        nonce derivation and the sign equation against published
+        ground truth, so the PR-3 precompute tables can never silently
+        change outputs."""
+        import hashlib
+        from bflc_demo_tpu.comm import pure25519 as p
+        sk3 = bytes.fromhex("c5aa8df43f9f837bedb7442f31dcb7b1"
+                            "66d38535076f094b85ce3a2e0b4458f7")
+        pk3 = bytes.fromhex("fc51cd8e6218a1a38da47ed00230f058"
+                            "0816ed13ba3303ac5deb911548908025")
+        msg3 = bytes.fromhex("af82")
+        sig3 = bytes.fromhex(
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db"
+            "5ac3ac18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027"
+            "beceea1ec40a")
+        assert p.ed25519_public(sk3) == pk3
+        assert p.ed25519_sign(sk3, msg3) == sig3
+        assert p.ed25519_verify(pk3, msg3, sig3)
+        sk4 = bytes.fromhex("833fe62409237b9d62ec77587520911e"
+                            "9a759cec1d19755b7da901b96dca3d42")
+        pk4 = bytes.fromhex("ec172b93ad5e563bf4932c70e1245034"
+                            "c35467ef2efd4d64ebf819683467e2bf")
+        msg4 = hashlib.sha512(b"abc").digest()
+        sig4 = bytes.fromhex(
+            "dc2a4459e7369633a52b1bf277839a00201009a3efbf3ecb69bea2186c"
+            "26b58909351fc9ac90b3ecfdfbc7c66431e0303dca179c138ac17ad9be"
+            "f1177331a704")
+        assert p.ed25519_public(sk4) == pk4
+        assert p.ed25519_sign(sk4, msg4) == sig4
+        assert p.ed25519_verify(pk4, msg4, sig4)
+
+    def test_precompute_paths_match_naive_on_random_inputs(self):
+        """PR-3 correctness guard: the windowed fixed-base table, the
+        multiscalar (batch-verify) path and the caches must be
+        BYTE-IDENTICAL to the naive double-and-add ladder — checked on
+        randomized scalars, seeds and messages so a table-construction
+        bug cannot hide behind the fixed RFC vectors."""
+        import hashlib
+        import random
+        from bflc_demo_tpu.comm import pure25519 as p
+        rng = random.Random(0xED25519)
+        # scalar-mult table vs ladder on random scalars (incl. edges)
+        for s in [0, 1, 2, p._L - 1, p._L, (1 << 255) - 19] + [
+                rng.getrandbits(256) for _ in range(40)]:
+            assert p._compress(p._pt_mul_base(s)) == \
+                p._compress(p._pt_mul(s, p._G)), s
+        # dedicated doubling and wNAF variable-base mul vs the ladder,
+        # on arbitrary (non-base) points
+        for i in range(12):
+            k = rng.getrandbits(255)
+            pt = p._pt_mul(k | 1, p._G)
+            assert p._compress(p._pt_dbl(pt)) == \
+                p._compress(p._pt_add(pt, pt))
+            s = rng.getrandbits(253)
+            assert p._compress(p._pt_mul_wnaf(s, pt)) == \
+                p._compress(p._pt_mul(s, pt)), (k, s)
+        assert p._compress(p._pt_mul_wnaf(0, p._G)) == \
+            p._compress(p._pt_mul(0, p._G))
+        # sign/verify: cached fast path vs from-scratch recomputation
+        for i in range(10):
+            seed = hashlib.sha256(b"xcheck-%d" % i).digest()
+            msg = bytes(rng.getrandbits(8) for _ in range(rng.randint(
+                0, 200)))
+            a, prefix = p._expand_seed(seed)
+            pub_naive = p._compress(p._pt_mul(a, p._G))
+            assert p.ed25519_public(seed) == pub_naive
+            r = int.from_bytes(hashlib.sha512(prefix + msg).digest(),
+                               "little") % p._L
+            r_enc = p._compress(p._pt_mul(r, p._G))
+            h = int.from_bytes(hashlib.sha512(
+                r_enc + pub_naive + msg).digest(), "little") % p._L
+            sig_naive = r_enc + int.to_bytes((r + h * a) % p._L, 32,
+                                             "little")
+            assert p.ed25519_sign(seed, msg) == sig_naive
+            assert p.ed25519_verify(pub_naive, msg, sig_naive)
+
+    def test_batch_verification_agrees_with_individual(self):
+        """ed25519_verify_batch: all-honest batches always pass (the
+        accept direction involves no randomness); one bad signature
+        anywhere fails the batch, and callers' per-item fallback then
+        attributes it — so batch-then-fallback equals individual
+        verification on every input."""
+        import random
+        from bflc_demo_tpu.comm import pure25519 as p
+        rng = random.Random(7)
+        seeds = [bytes([i]) * 32 for i in range(4)]
+        pubs = [p.ed25519_public(s) for s in seeds]
+        items = []
+        for i in range(24):
+            k = i % 4
+            msg = bytes(rng.getrandbits(8) for _ in range(32))
+            items.append((pubs[k], msg, p.ed25519_sign(seeds[k], msg)))
+        assert p.ed25519_verify_batch(items)
+        assert p.ed25519_verify_batch([])
+        assert p.ed25519_verify_batch(items[:1])
+        # one forged message → batch False, individual pinpoints it
+        bad = list(items)
+        bad[7] = (bad[7][0], b"forged message", bad[7][2])
+        assert not p.ed25519_verify_batch(bad)
+        flags = [p.ed25519_verify(pub, m, s) for pub, m, s in bad]
+        assert flags.count(False) == 1 and not flags[7]
+        # malformed inputs are False, never exceptions
+        assert not p.ed25519_verify_batch([(b"\xff" * 32, b"m",
+                                            items[0][2])])
+        assert not p.ed25519_verify_batch([(pubs[0], b"m", b"\x00" * 63)])
+
+    def test_batch_verification_is_deterministic_on_torsion_defects(self):
+        """The batch equation is cofactored ON PURPOSE: a signature whose
+        only defect is a small-torsion component in R must get the SAME
+        verdict on every call (here: accepted, as RFC 8032 §8.9
+        cofactored verification allows), never a per-call coin flip — a
+        randomized verdict would let the same commit certificate count a
+        quorum on one node and miss it on another.  Per-item
+        (cofactorless) verification stays strictly stricter and rejects
+        it deterministically too."""
+        import hashlib
+        from bflc_demo_tpu.comm import pure25519 as p
+        seed = b"\x42" * 32
+        pub = p.ed25519_public(seed)
+        a, prefix = p._expand_seed(seed)
+        msg = b"torsion-defect determinism"
+        r = int.from_bytes(hashlib.sha512(prefix + msg).digest(),
+                           "little") % p._L
+        # R' = R + T2 where T2 = (0, -1) has order 2: 8*T2 = identity,
+        # so the cofactored equation holds while the exact one fails
+        t2 = (0, (-1) % p._P, 1, 0)
+        r_enc = p._compress(p._pt_add(p._pt_mul(r, p._G), t2))
+        h = int.from_bytes(hashlib.sha512(r_enc + pub + msg).digest(),
+                           "little") % p._L
+        sig = r_enc + int.to_bytes((r + h * a) % p._L, 32, "little")
+        for _ in range(12):             # no coin flips either way
+            assert not p.ed25519_verify(pub, msg, sig)
+            assert p.ed25519_verify_batch([(pub, msg, sig)])
+        # mixed with honest signatures: still deterministic
+        honest = [(pub, b"h%d" % i, p.ed25519_sign(seed, b"h%d" % i))
+                  for i in range(3)]
+        for _ in range(6):
+            assert p.ed25519_verify_batch(honest + [(pub, msg, sig)])
+
     def test_x25519_rfc7748_vector_and_dh_symmetry(self):
         from bflc_demo_tpu.comm import pure25519 as p
         k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"

@@ -20,7 +20,8 @@ import pytest
 from bflc_demo_tpu.comm.identity import Wallet, provision_wallets, _op_bytes
 from bflc_demo_tpu.comm.ledger_service import (LedgerServer,
                                                CoordinatorClient)
-from bflc_demo_tpu.comm.wire import send_msg, recv_msg, WireError
+from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
+                                     WireError)
 from bflc_demo_tpu.protocol import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import (pack_pytree, unpack_pytree,
                                                pack_entries)
@@ -116,7 +117,7 @@ class TestCoordinatorServer:
         ups = c.request("updates")["updates"]
         assert len(ups) == 3
         # blob fetch round-trips bit-exactly
-        got = bytes.fromhex(c.request("blob", hash=ups[0]["hash"])["blob"])
+        got = blob_bytes(c.request("blob", hash=ups[0]["hash"])["blob"])
         assert hashlib.sha256(got).digest().hex() == ups[0]["hash"]
 
         for j, comm in enumerate(committee):
@@ -127,13 +128,13 @@ class TestCoordinatorServer:
         info = c.request("info")
         assert info["epoch"] == 1               # aggregation fired
         mr = c.request("model")
-        flat = unpack_pytree(bytes.fromhex(mr["blob"]))
+        flat = unpack_pytree(blob_bytes(mr["blob"]))
         # top-2 by median are trainers 0 and 1 (equal weights): mean delta
         # W = 1.5 everywhere, so W = -lr * 1.5
         np.testing.assert_allclose(flat["['W']"],
                                    -CFG.learning_rate * 1.5, atol=1e-6)
         assert mr["hash"] == hashlib.sha256(
-            bytes.fromhex(mr["blob"])).digest().hex()
+            blob_bytes(mr["blob"])).digest().hex()
         c.close()
 
     def test_wrong_hash_rejected(self, server):

@@ -1,0 +1,200 @@
+"""Wire framing: binary blob frames, mixed-version interop, frame caps,
+and chaos injection on the binary path (PR 3).
+
+The binary variant ([len][\\x00BIN1][hlen][JSON header][raw tail]) must be
+bit-faithful, coexist with legacy hex-JSON frames ON THE SAME SOCKET (a
+mixed-version peer can switch formats frame by frame), die loudly on any
+corrupt or overclaiming length field under the existing 256 MiB cap, and
+remain fully visible to the chaos FaultInjector — a fault campaign that
+silently skipped the fattest frames would be theater.
+"""
+
+import json
+import socket
+import struct
+import time
+
+import pytest
+
+from bflc_demo_tpu.chaos.hooks import FaultInjector
+from bflc_demo_tpu.comm import wire
+from bflc_demo_tpu.comm.wire import (MAX_FRAME, WireError, blob_bytes,
+                                     recv_msg, send_msg)
+
+
+@pytest.fixture
+def pair():
+    a, b = socket.socketpair()
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestBinaryFrames:
+    def test_bytes_fields_round_trip_bit_exact(self, pair):
+        a, b = pair
+        blob = bytes(range(256)) * 17
+        send_msg(a, {"method": "upload", "blob": blob, "hash": "ab" * 32,
+                     "n": 7, "cost": 1.5})
+        m = recv_msg(b)
+        assert m == {"method": "upload", "blob": blob, "hash": "ab" * 32,
+                     "n": 7, "cost": 1.5}
+        assert isinstance(m["blob"], bytes)
+
+    def test_multiple_binary_fields_keep_order_and_length(self, pair):
+        a, b = pair
+        send_msg(a, {"method": "stage", "x": b"\x00" * 100, "y": b"\x01",
+                     "tag": "cafe"})
+        m = recv_msg(b)
+        assert m["x"] == b"\x00" * 100 and m["y"] == b"\x01"
+        assert m["tag"] == "cafe"
+
+    def test_empty_bytes_field(self, pair):
+        a, b = pair
+        send_msg(a, {"method": "m", "blob": b""})
+        assert recv_msg(b)["blob"] == b""
+
+    def test_wire_is_half_the_hex_size(self, pair):
+        """The point of the exercise: no 2x hex inflation on blobs."""
+        a, b = pair
+        blob = b"\xab" * 50_000
+        send_msg(a, {"blob": blob})
+        m = recv_msg(b)
+        assert m["blob"] == blob
+        # a hex-JSON frame for the same blob is ~2x the bytes
+        legacy = len(json.dumps({"blob": blob.hex()}).encode())
+        binary = len(wire._encode({"blob": blob}))
+        assert binary < legacy * 0.55
+
+    def test_blob_bytes_accepts_both_representations(self):
+        assert blob_bytes(b"\xde\xad") == b"\xde\xad"
+        assert blob_bytes(bytearray(b"\x01")) == b"\x01"
+        assert blob_bytes("dead") == b"\xde\xad"
+        with pytest.raises(ValueError):
+            blob_bytes("zz")            # not hex
+        with pytest.raises(ValueError):
+            blob_bytes(17)              # not a wire blob at all
+
+
+class TestMixedVersionPeers:
+    def test_old_and_new_frames_interleave_on_one_socket(self, pair):
+        """A legacy peer (hex-in-JSON) and a binary-frame peer can share
+        one connection: the receiver keys off each frame's first byte."""
+        a, b = pair
+        blob = b"\x10\x20\x30"
+        # new-format frame
+        send_msg(a, {"method": "upload", "blob": blob})
+        # legacy frame, hand-built exactly as the old send_msg did
+        legacy_body = json.dumps(
+            {"method": "upload", "blob": blob.hex()},
+            separators=(",", ":")).encode()
+        a.sendall(struct.pack(">I", len(legacy_body)) + legacy_body)
+        # another new-format frame
+        send_msg(a, {"method": "done", "blob": blob})
+
+        m1, m2, m3 = recv_msg(b), recv_msg(b), recv_msg(b)
+        assert blob_bytes(m1["blob"]) == blob
+        assert blob_bytes(m2["blob"]) == blob     # hex str, same bytes
+        assert isinstance(m2["blob"], str)
+        assert blob_bytes(m3["blob"]) == blob
+
+    def test_legacy_switch_forces_hex_json(self, pair, monkeypatch):
+        """BFLC_CONTROL_PLANE_LEGACY pins the old format — the benchmark
+        baseline leg — and the result is decodable by any peer."""
+        a, b = pair
+        monkeypatch.setattr(wire, "_JSON_ONLY", True)
+        send_msg(a, {"method": "m", "blob": b"\x05\x06"})
+        m = recv_msg(b)
+        assert m["blob"] == "0506"
+
+
+class TestFrameCaps:
+    def test_oversized_length_prefix_rejected(self, pair):
+        a, b = pair
+        a.sendall(struct.pack(">I", MAX_FRAME + 1))
+        with pytest.raises(WireError, match="exceeds cap"):
+            recv_msg(b)
+
+    def test_binary_header_length_overrun_rejected(self, pair):
+        a, b = pair
+        body = wire._BIN_MAGIC + struct.pack(">I", 10_000) + b"{}"
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="header length"):
+            recv_msg(b)
+
+    def test_binary_manifest_overrun_rejected(self, pair):
+        """A manifest claiming more tail bytes than the frame holds must
+        be a WireError, never an overread or a giant allocation."""
+        a, b = pair
+        hdr = json.dumps({"m": 1, "_bin": [["blob", 1 << 30]]}).encode()
+        body = (wire._BIN_MAGIC + struct.pack(">I", len(hdr)) + hdr
+                + b"xy")
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="overruns"):
+            recv_msg(b)
+
+    def test_binary_trailing_garbage_rejected(self, pair):
+        a, b = pair
+        hdr = json.dumps({"m": 1, "_bin": [["blob", 1]]}).encode()
+        body = (wire._BIN_MAGIC + struct.pack(">I", len(hdr)) + hdr
+                + b"abc")             # manifest consumes 1 of 3 bytes
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="trailing"):
+            recv_msg(b)
+
+    def test_truncated_binary_header_rejected(self, pair):
+        a, b = pair
+        body = wire._BIN_MAGIC + b"\x00"
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="truncated"):
+            recv_msg(b)
+
+    def test_negative_manifest_length_rejected(self, pair):
+        a, b = pair
+        hdr = json.dumps({"_bin": [["blob", -5]]}).encode()
+        body = wire._BIN_MAGIC + struct.pack(">I", len(hdr)) + hdr
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="overruns"):
+            recv_msg(b)
+
+
+class TestChaosOnBinaryFrames:
+    """The FaultInjector hook must keep firing on the new path: the
+    fattest frames (blob mirroring, model fetch) are exactly the ones a
+    fault campaign most needs to partition/drop."""
+
+    def _injector(self, mode, p=1.0, **kw):
+        now = time.time()
+        return FaultInjector({
+            "t0": now - 1.0, "role": "test", "seed": 1,
+            "windows": [{"start": 0.0, "end": 3600.0, "mode": mode,
+                         "ports": [], "p": p, **kw}]})
+
+    def test_drop_fires_on_binary_send(self, pair, monkeypatch):
+        a, b = pair
+        inj = self._injector("drop", p=1.0)
+        monkeypatch.setattr(wire, "_INJECTOR", inj)
+        with pytest.raises(WireError, match="dropped"):
+            send_msg(a, {"method": "upload", "blob": b"\x01" * 1000})
+        assert inj.injected["drop"] == 1
+
+    def test_partition_fires_on_binary_recv(self, pair, monkeypatch):
+        a, b = pair
+        monkeypatch.setattr(wire, "_INJECTOR", None)
+        send_msg(a, {"method": "m", "blob": b"\x02"})
+        inj = self._injector("partition")
+        monkeypatch.setattr(wire, "_INJECTOR", inj)
+        with pytest.raises(WireError, match="partitioned"):
+            recv_msg(b)
+        assert inj.injected["partition"] == 1
+
+    def test_delay_fires_on_binary_send(self, pair, monkeypatch):
+        a, b = pair
+        inj = self._injector("delay", p=1.0, delay_ms=30.0)
+        monkeypatch.setattr(wire, "_INJECTOR", inj)
+        t0 = time.perf_counter()
+        send_msg(a, {"method": "m", "blob": b"\x03" * 10})
+        assert time.perf_counter() - t0 >= 0.025
+        assert inj.injected["delay"] == 1
+        monkeypatch.setattr(wire, "_INJECTOR", None)
+        assert recv_msg(b)["blob"] == b"\x03" * 10

@@ -1,0 +1,160 @@
+"""Run ONE instrumented federation round and print the tracing breakdown.
+
+Future perf PRs should start from data, not vibes: this tool stands up the
+full BFT control plane IN ONE PROCESS (writer + 4 commit validators,
+thread-served, exactly the tests' topology), enables the process tracer
+(utils.tracing.PROC), drives a complete config-1-shaped protocol round
+through the real socket path — register, uploads, committee scores,
+aggregation, certification — and prints where the time went:
+
+    wire      frame send/recv on every socket hop
+    crypto    Ed25519 sign/verify (the one chokepoint, comm.identity)
+    validate  validator-side re-execution + co-signing (comm.bft)
+    certify   writer-side certificate assembly round-trips
+    aggregate on-coordinator FedAvg + commit
+
+Because every role shares the process, the tracer sees all sides at once;
+note that shared-process accounting also means the verify memo collapses
+the validators' repeated client-tag checks — the per-process federation
+numbers live in `eval.benchmarks.federation_config1`.
+
+Usage:  python tools/profile_round.py [--clients N] [--legacy]
+        --legacy pins the pre-PR control plane (sequential certification,
+        naive Ed25519, hex-JSON frames) by re-exec'ing with
+        BFLC_CONTROL_PLANE_LEGACY=1 so import-time switches apply.
+"""
+
+import argparse
+import hashlib
+import os
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _reexec_legacy() -> None:
+    env = dict(os.environ, BFLC_CONTROL_PLANE_LEGACY="1",
+               JAX_PLATFORMS="cpu")
+    args = [a for a in sys.argv if a != "--legacy"]
+    os.execve(sys.executable, [sys.executable] + args, env)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--validators", type=int, default=4)
+    ap.add_argument("--legacy", action="store_true",
+                    help="profile the pre-PR control plane")
+    args = ap.parse_args()
+    if args.legacy and not os.environ.get("BFLC_CONTROL_PLANE_LEGACY"):
+        _reexec_legacy()
+
+    import numpy as np
+
+    from bflc_demo_tpu.comm.bft import ValidatorNode, provision_validators
+    from bflc_demo_tpu.comm.identity import (ED25519_BACKEND, _op_bytes,
+                                             provision_wallets)
+    from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                   LedgerServer)
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+    from bflc_demo_tpu.utils import tracing
+    from bflc_demo_tpu.utils.serialization import pack_pytree
+
+    n = args.clients
+    cfg = ProtocolConfig(client_num=n, comm_count=max(2, n // 4),
+                         aggregate_count=2,
+                         needed_update_count=max(3, n // 2),
+                         learning_rate=0.05, batch_size=16)
+    wallets, _ = provision_wallets(n, b"profile-round-seed")
+    vwallets, vkeys = provision_validators(args.validators,
+                                           b"profile-round-validators")
+    blob0 = pack_pytree({"W": np.zeros((5, 2), np.float32),
+                         "b": np.zeros((2,), np.float32)})
+
+    tracing.PROC.enabled = True
+    tracing.PROC.reset()
+    nodes = [ValidatorNode(cfg, w, i, validator_keys=vkeys)
+             for i, w in enumerate(vwallets)]
+    for v in nodes:
+        v.start()
+    server = LedgerServer(cfg, blob0,
+                          bft_validators=[(v.host, v.port) for v in nodes],
+                          bft_keys=vkeys)
+    server.start()
+    client = CoordinatorClient(server.host, server.port)
+
+    def sign(w, kind, epoch, payload):
+        return w.sign(_op_bytes(kind, w.address, epoch, payload)).hex()
+
+    t_round = time.perf_counter()
+    for w in wallets:
+        r = client.request("register", addr=w.address,
+                           pubkey=w.public_bytes.hex(),
+                           tag=sign(w, "register", 0, b""))
+        assert r["ok"], r
+    committee = set(client.request("committee")["committee"])
+    trainers = [w for w in wallets if w.address not in committee]
+    for i, w in enumerate(trainers[: cfg.needed_update_count]):
+        blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
+                                         np.float32),
+                            "b": np.zeros((2,), np.float32)})
+        digest = hashlib.sha256(blob).digest()
+        payload = digest + struct.pack("<qd", 10 + i, 1.0)
+        r = client.request("upload", addr=w.address, blob=blob,
+                           hash=digest.hex(), n=10 + i, cost=1.0, epoch=0,
+                           tag=sign(w, "upload", 0, payload))
+        assert r["ok"], r
+    n_up = cfg.needed_update_count
+    for j, w in enumerate([w for w in wallets
+                           if w.address in committee]):
+        scores = [0.5 + 0.01 * (j + u) for u in range(n_up)]
+        payload = struct.pack(f"<{n_up}d", *scores)
+        r = client.request("scores", addr=w.address, epoch=0,
+                           scores=scores,
+                           tag=sign(w, "scores", 0, payload))
+        assert r["ok"] or r.get("status") == "WRONG_EPOCH", r
+    info = client.request("info")
+    assert info["epoch"] == 1, info
+    wall = time.perf_counter() - t_round
+
+    client.close()
+    server.close()
+    for v in nodes:
+        v.close()
+
+    costs = dict(tracing.PROC.costs)
+    phases = {
+        "wire": costs.get("wire.send_s", 0) + costs.get("wire.recv_s", 0),
+        "crypto": costs.get("crypto.sign_s", 0)
+        + costs.get("crypto.verify_s", 0),
+        "validate": costs.get("bft.validate_s", 0),
+        "certify": costs.get("bft.certify_s", 0),
+        "aggregate": costs.get("aggregate_s", 0),
+    }
+    mode = ("LEGACY (pre-PR)"
+            if os.environ.get("BFLC_CONTROL_PLANE_LEGACY") else "fast")
+    print(f"one federated round: {n} clients, {args.validators} "
+          f"validators, quorum certification — {mode} control plane, "
+          f"crypto backend: {ED25519_BACKEND}")
+    print(f"round wall time: {wall * 1e3:9.1f} ms   "
+          f"(log={info['log_size']} ops, "
+          f"certified={info['certified_size']})")
+    print(f"{'phase':<10} {'time_ms':>9}  {'share':>6}  notes")
+    for name, sec in sorted(phases.items(), key=lambda kv: -kv[1]):
+        note = ""
+        if name == "certify":
+            note = "(contains validate+crypto+wire of the vote path)"
+        elif name == "crypto":
+            note = (f"sign={costs.get('crypto.sign_n', 0):.0f} "
+                    f"verify={costs.get('crypto.verify_n', 0):.0f} calls")
+        print(f"{name:<10} {sec * 1e3:9.1f}  {sec / wall:6.1%}  {note}")
+    other = ("wire.bytes_out", "wire.bytes_in")
+    print("wire bytes: out={:.0f} in={:.0f}".format(
+        costs.get(other[0], 0), costs.get(other[1], 0)))
+
+
+if __name__ == "__main__":
+    main()
