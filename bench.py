@@ -31,8 +31,13 @@ batched vs sequential, plus a pre-PR legacy-mode baseline leg;
 `extra.federation` runs the config-1 process federation (20 clients +
 2 standbys + 4 validators + quorum + WAL) and reports round wall time,
 ops-certified/sec and the writer's crypto-time share (utils.tracing).
-BFLC_BENCH_NO_CONTROL_PLANE=1 skips both; BFLC_BENCH_FED_BASELINE=1
-re-runs the federation on the legacy control plane for the ratio.
+The federation leg runs with the fleet telemetry plane armed (PR 4,
+bflc_demo_tpu/obs): `extra.telemetry` records its scrape coverage
+(roles answering / roles expected); the measured scrape-on-vs-off
+overhead lives in TPU_RESULTS.md (eval.benchmarks.
+telemetry_overhead_config1).  BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
+of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
+control plane for the ratio.
 
 vs_baseline: the reference's round time is structurally bounded below by its
 polling design — every protocol phase waits a uniform(10,30) s sleep per
@@ -167,6 +172,10 @@ def _child() -> None:
             rounds=3,
             compare_sequential=bool(
                 os.environ.get("BFLC_BENCH_FED_BASELINE")))
+        # telemetry-plane health (PR 4): scrape coverage — roles
+        # answering / roles expected across the federation run's
+        # per-round scrapes (the federation leg runs telemetry-armed)
+        extra["telemetry"] = extra["federation"]["fast"].get("telemetry")
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),
         # measurable on CPU with no tunnel: one 50-round config-1 campaign

@@ -66,6 +66,19 @@ class ChaosCampaign:
         self.executed: List[dict] = []
         self.skipped: List[dict] = []
         self.client_respawns = 0
+        # telemetry hook (obs.collector.FleetCollector.observe_fault):
+        # every executed/skipped fault is mirrored onto the run's
+        # metrics.jsonl timeline, so a post-mortem reads fault -> metric
+        # causality off one ordered stream
+        self.on_fault = None
+
+    def _record(self, ev_dict: dict, executed: bool) -> None:
+        (self.executed if executed else self.skipped).append(ev_dict)
+        if self.on_fault is not None:
+            try:
+                self.on_fault({**ev_dict, "executed": executed})
+            except Exception:       # noqa: BLE001 — telemetry must never
+                pass                # break the campaign driver
 
     # ------------------------------------------------------------ wiring
     def register(self, role: str, spawn_fn: Callable, proc) -> None:
@@ -82,7 +95,7 @@ class ChaosCampaign:
                 else f"standby-{self._writer_index}")
 
     def _skip(self, ev, why: str) -> None:
-        self.skipped.append({**ev.as_dict(), "why": why})
+        self._record({**ev.as_dict(), "why": why}, executed=False)
         self._log(f"SKIP {ev.kind} {ev.target}: {why}")
 
     def _exec_kill(self, ev) -> None:
@@ -122,7 +135,8 @@ class ChaosCampaign:
                 return self._skip(ev, f"{len(dead)} validators already "
                                       f"dead (f={f})")
         h.kill()
-        self.executed.append(ev.as_dict())
+        self._record({**ev.as_dict(), "resolved_target": target},
+                     executed=True)
         self._log(f"KILL {target}")
 
     def _exec_restart(self, ev) -> None:
@@ -139,7 +153,7 @@ class ChaosCampaign:
         except Exception as e:          # noqa: BLE001 — a failed respawn
             # is a campaign observation, not a driver crash
             return self._skip(ev, f"respawn failed: {e}")
-        self.executed.append(ev.as_dict())
+        self._record(ev.as_dict(), executed=True)
         self._log(f"RESTART {ev.target}")
 
     def _exec_tear_wal(self, ev) -> None:
@@ -147,7 +161,7 @@ class ChaosCampaign:
         if not self.wal_path:
             return self._skip(ev, "no WAL attached")
         if tear_wal_tail(self.wal_path):
-            self.executed.append(ev.as_dict())
+            self._record(ev.as_dict(), executed=True)
             self._log("TEAR WAL tail")
         else:
             self._skip(ev, "WAL too small to tear")

@@ -57,6 +57,11 @@ class InvariantMonitor:
 
     def _flag(self, msg: str) -> None:
         self.violations.append(msg)
+        # invariant violations are flight-recorder flush triggers: the
+        # post-mortem must hold the evidence even if the driver dies next
+        from bflc_demo_tpu.obs import flight as obs_flight
+        obs_flight.FLIGHT.record("invariant_violation", msg)
+        obs_flight.FLIGHT.flush("invariant_violation")
         if self.verbose:
             print(f"[chaos][INVARIANT] {msg}", flush=True)
 

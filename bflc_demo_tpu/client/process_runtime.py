@@ -38,7 +38,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bflc_demo_tpu.comm.wire import blob_bytes
+from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+# client-side phase telemetry (obs.metrics; no-op unless the child
+# installed telemetry): where a federated client's round actually goes —
+# local training, the upload round-trip, committee scoring
+_M_PHASE = obs_metrics.REGISTRY.histogram(
+    "client_phase_seconds", "client round phase wall time", ("phase",))
+_M_ACTIONS = obs_metrics.REGISTRY.counter(
+    "client_actions_total", "completed client actions", ("action",))
 
 
 def _force_cpu_jax() -> None:
@@ -85,6 +94,15 @@ def _install_chaos(chaos_spec) -> None:
         install_injector(chaos_spec)
 
 
+def _install_telemetry(spec: Optional[dict]) -> None:
+    """Arm this child's telemetry plane (no-op without a spec): metrics
+    registry + tracer under the role name, flight recorder + snapshot
+    publisher into the run's telemetry dir (bflc_demo_tpu.obs)."""
+    if spec:
+        from bflc_demo_tpu import obs
+        obs.install_process_telemetry(spec["role"], spec["dir"])
+
+
 def _client_tls(tls_dir: str):
     """ssl context for dialing the coordinator, or None when TLS is off —
     the ONE construction point for client-side contexts in this module."""
@@ -105,9 +123,11 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  stall_timeout_s: float, wal_path: str, tls_dir: str,
                  standby_keys: dict, quorum: int,
                  bft_endpoints: list, bft_keys: dict,
-                 verbose: bool, chaos_spec: Optional[dict] = None) -> None:
+                 verbose: bool, chaos_spec: Optional[dict] = None,
+                 telemetry_spec: Optional[dict] = None) -> None:
     _force_cpu_jax()
     _install_chaos(chaos_spec)
+    _install_telemetry(telemetry_spec)
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
     tls = _server_tls(tls_dir)
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
@@ -125,7 +145,8 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
 def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
                     port_q, validator_keys: dict, verbose: bool,
                     port: int = 0,
-                    chaos_spec: Optional[dict] = None) -> None:
+                    chaos_spec: Optional[dict] = None,
+                    telemetry_spec: Optional[dict] = None) -> None:
     """One BFT commit-quorum member (comm.bft.ValidatorNode): an
     independent replica + wallet that re-executes every op and co-signs
     commit certificates — the reference analogue of one PBFT chain node.
@@ -135,6 +156,7 @@ def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
     path is pure ledger + crypto, and a lean child restarts fast."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # in case a dep imports jax
     _install_chaos(chaos_spec)
+    _install_telemetry(telemetry_spec)
     from bflc_demo_tpu.comm.bft import ValidatorNode
     from bflc_demo_tpu.comm.identity import Wallet
     node = ValidatorNode(ProtocolConfig(**cfg_kw),
@@ -161,7 +183,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                  bft_keys: Optional[dict] = None,
                  chaos_spec: Optional[dict] = None,
                  ack_log_path: str = "",
-                 request_timeout_s: float = 120.0) -> None:
+                 request_timeout_s: float = 120.0,
+                 telemetry_spec: Optional[dict] = None) -> None:
     """One federated client: register -> role loop -> train/score -> exit.
 
     Runs the same state machine as client/runtime.FLNode.step (itself the
@@ -179,6 +202,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     """
     _force_cpu_jax()
     _install_chaos(chaos_spec)
+    _install_telemetry(telemetry_spec)
     import json as _json
 
     import jax.numpy as jnp
@@ -239,22 +263,27 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                 continue        # round turned over mid-step; resync
             params = restore_pytree(
                 template, unpack_pytree(blob_bytes(mr["blob"])))
-            delta, cost = local_train(
-                model.apply, params, xj, yj, lr=cfg.learning_rate,
-                batch_size=cfg.batch_size, local_epochs=cfg.local_epochs)
+            with _M_PHASE.time(phase="train"):
+                delta, cost = local_train(
+                    model.apply, params, xj, yj, lr=cfg.learning_rate,
+                    batch_size=cfg.batch_size,
+                    local_epochs=cfg.local_epochs)
             blob = pack_pytree(delta)
             digest = hashlib.sha256(blob).digest()
             n = int(x.shape[0])
             payload = digest + struct.pack("<qd", n, float(cost))
-            r = client.request(
-                "upload", addr=wallet.address, blob=blob,
-                hash=digest.hex(), n=n, cost=float(cost), epoch=epoch,
-                tag=_sign(wallet, "upload", epoch, payload))
+            with _M_PHASE.time(phase="upload"):
+                r = client.request(
+                    "upload", addr=wallet.address, blob=blob,
+                    hash=digest.hex(), n=n, cost=float(cost), epoch=epoch,
+                    tag=_sign(wallet, "upload", epoch, payload))
             if r.get("status") in ("OK", "CAP_REACHED", "DUPLICATE",
                                    "NOT_READY"):
                 # NOT_READY = round closed under recovery; wait it out
                 trained_epoch = epoch
                 acted = r["ok"]
+                if r["ok"]:
+                    _M_ACTIONS.inc(action="upload")
             if r.get("ok") and ack_log_path:
                 # journal the acknowledged upload: the chaos invariant
                 # monitor later proves it survived in the one certified
@@ -274,6 +303,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                                tag=_sign(wallet, "register", 0, b""))
         elif st["role"] == "comm" and epoch > scored_epoch:
             ups = client.request("updates")["updates"]
+            t_score = (time.perf_counter()
+                       if obs_metrics.REGISTRY.enabled else 0.0)
             if ups:
                 import jax
                 from bflc_demo_tpu.comm.wire import split_blob_parts
@@ -307,6 +338,11 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                 if r.get("status") in ("OK", "WRONG_EPOCH", "DUPLICATE"):
                     scored_epoch = epoch
                     acted = r["ok"]
+                    if r["ok"]:
+                        _M_ACTIONS.inc(action="score")
+                if t_score:
+                    _M_PHASE.observe(time.perf_counter() - t_score,
+                                     phase="score")
                 if r.get("status") == "BAD_ARG":
                     # same directory-hole self-heal as the upload path
                     client.request("register", addr=wallet.address,
@@ -337,7 +373,8 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                   tls_dir: str, wallet_seed: bytes, standby_keys: dict,
                   quorum: int, bft_endpoints: list, bft_keys: dict,
                   verbose: bool, port: int = 0,
-                  chaos_spec: Optional[dict] = None) -> None:
+                  chaos_spec: Optional[dict] = None,
+                  telemetry_spec: Optional[dict] = None) -> None:
     """Hot standby: follow the writer's op stream, promote on its death
     (comm.failover.Standby).  Reports its serving port, then blocks.  A
     fixed `port` makes the role restartable under chaos (clients keep
@@ -345,6 +382,7 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
     currently serves and rebuilds its replica from op 0."""
     _force_cpu_jax()
     _install_chaos(chaos_spec)
+    _install_telemetry(telemetry_spec)
     from bflc_demo_tpu.comm.failover import Standby
     from bflc_demo_tpu.comm.identity import Wallet
     tls_c, tls_s = _client_tls(tls_dir), _server_tls(tls_dir)
@@ -369,7 +407,7 @@ class ProcessFederationResult:
     def __init__(self, accuracy_history, rounds_completed, log_head,
                  log_size, recovered_clients, replica_report,
                  wall_time_s: float = 0.0, chaos_report=None,
-                 final_info=None):
+                 final_info=None, telemetry_report=None):
         self.accuracy_history = accuracy_history
         self.rounds_completed = rounds_completed
         self.ledger_log_head = log_head
@@ -384,6 +422,10 @@ class ProcessFederationResult:
         # the run traced (BFLC_PROC_TRACE) — the writer-side `perf` phase
         # accounting the federation benchmark attributes its wins with
         self.final_info = final_info
+        # telemetry-plane run report (run with telemetry_dir=...): scrape
+        # coverage + artifact paths (metrics.jsonl / metrics.prom /
+        # per-role flight dumps) — obs.collector.FleetCollector
+        self.telemetry_report = telemetry_report
         # (epoch, seconds-since-start) at each sponsor-observed commit:
         # lets the federation benchmark separate steady-state round time
         # from fleet spawn (20 jax child imports dwarf a round)
@@ -421,6 +463,7 @@ def run_federated_processes(
         chaos_duration_s: Optional[float] = None,
         chaos_schedule=None,
         chaos_dir: str = "",
+        telemetry_dir: str = "",
         verbose: bool = False) -> ProcessFederationResult:
     """Run a full federation as (1 coordinator + N clients [+ standbys]
     [+ 1 replica]) OS processes.  Parent = sponsor.
@@ -461,6 +504,14 @@ def run_federated_processes(
     chaos_schedule overrides the generated schedule (tests);
     chaos_duration_s bounds the fault window (default: 0.5 * timeout_s);
     chaos_dir holds the per-client ack journals (tempdir by default).
+    telemetry_dir: arm the fleet telemetry plane (bflc_demo_tpu.obs):
+    every child installs the metrics registry + flight recorder, the
+    driver's FleetCollector scrapes all roles each committed round
+    (telemetry RPC for the writer/validators, file snapshots for
+    clients/standbys) into <telemetry_dir>/metrics.jsonl — chaos fault
+    events interleaved on the same timeline — plus a Prometheus text
+    dump at the end; the report rides result.telemetry_report and each
+    role's flight-recorder dump survives its process's death.
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -549,6 +600,13 @@ def run_federated_processes(
         return (chaos_schedule.wire_spec(role, chaos_t0, port_of)
                 if campaign is not None else None)
 
+    def _tspec(role: str):
+        return ({"role": role, "dir": telemetry_dir}
+                if telemetry_dir else None)
+
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+
     client_timeout_s = 15.0 if campaign is not None else 120.0
 
     def _spawn_validator(v: int, vport: int = 0):
@@ -557,7 +615,8 @@ def run_federated_processes(
             target=_validator_proc,
             args=(cfg_kw, master_seed + b"|bft-validator|"
                   + struct.pack("<q", v), v, q, bft_keys, verbose,
-                  vport, _wire(f"validator-{v}")),
+                  vport, _wire(f"validator-{v}"),
+                  _tspec(f"validator-{v}")),
             daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -570,7 +629,7 @@ def run_federated_processes(
                               stall_timeout_s, wal_path, tls_dir,
                               standby_keys, quorum,
                               bft_endpoints, bft_keys, verbose,
-                              _wire("writer")),
+                              _wire("writer"), _tspec("writer")),
                         daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -583,7 +642,8 @@ def run_federated_processes(
                               stall_timeout_s, tls_dir,
                               standby_seeds[s], standby_keys,
                               quorum, bft_endpoints, bft_keys,
-                              verbose, sbport, _wire(f"standby-{s}")),
+                              verbose, sbport, _wire(f"standby-{s}"),
+                              _tspec(f"standby-{s}")),
                         daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -598,7 +658,8 @@ def run_federated_processes(
                   model_factory, factory_kw,
                   np.asarray(sx), one_hot(np.asarray(sy), nc), cfg_kw,
                   rounds, crash_at.get(i), tls_dir, standby_keys,
-                  bft_keys, _wire(f"client-{i}"), ack, client_timeout_s),
+                  bft_keys, _wire(f"client-{i}"), ack, client_timeout_s,
+                  _tspec(f"client-{i}")),
             daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -649,6 +710,34 @@ def run_federated_processes(
                 (lambda i=i, sx=sx, sy=sy, eps=list(endpoints):
                  _spawn_client(i, sx, sy, eps)[0]), p)
 
+    # --- telemetry plane (bflc_demo_tpu.obs): the driver scrapes the
+    # whole fleet each committed round — telemetry RPC for socket-serving
+    # roles, published file snapshots for clients/standbys — onto one
+    # metrics.jsonl timeline; chaos fault events land on the same file.
+    collector = None
+    if telemetry_dir:
+        from bflc_demo_tpu.obs.collector import FleetCollector
+        rpc_roles = {"writer": (host, port)}
+        for v in range(bft_validators):
+            rpc_roles[f"validator-{v}"] = (host,
+                                           port_of[f"validator-{v}"])
+        file_roles = {
+            role: os.path.join(telemetry_dir, f"{role}.metrics.json")
+            for role in ([f"client-{i}" for i in range(len(shards))]
+                         + [f"standby-{s + 1}" for s in range(standbys)])}
+        collector = FleetCollector(
+            rpc_roles, file_roles,
+            jsonl_path=os.path.join(telemetry_dir, "metrics.jsonl"),
+            # only the coordinator serves TLS; validators are plaintext
+            # on the coordinator-side segment (comm.bft deployment note)
+            tls=_client_tls(tls_dir), tls_roles=("writer",))
+        if campaign is not None:
+            campaign.on_fault = collector.observe_fault
+        collector.note("fleet_up", clients=len(shards),
+                       standbys=standbys, validators=bft_validators,
+                       quorum=quorum)
+        collector.scrape(tag="fleet_up")
+
     from bflc_demo_tpu.comm.failover import FailoverClient
     xte, yte = test_set
     xte_j = jnp.asarray(xte)
@@ -691,6 +780,10 @@ def run_federated_processes(
                     if verbose:
                         print(f"Epoch: {mr['epoch'] - 1:03d}, "
                               f"test_acc: {acc:.4f}", flush=True)
+                    if collector is not None:
+                        collector.note("round_commit",
+                                       epoch=mr["epoch"] - 1, acc=acc)
+                        collector.scrape(tag=f"round-{mr['epoch'] - 1}")
             if kill_writer_at_epoch is not None and not writer_killed \
                     and info["epoch"] >= kill_writer_at_epoch:
                 # the no-single-point-of-failure drill: SIGKILL the primary
@@ -718,6 +811,15 @@ def run_federated_processes(
             # catch the tip; one certified history; acked uploads durable)
             chaos_report = campaign.finish(sponsor, ack_paths)
             final = sponsor.request("info")
+        telemetry_report = None
+        if collector is not None:
+            collector.scrape(tag="final")
+            prom_path = os.path.join(telemetry_dir, "metrics.prom")
+            collector.write_prometheus(prom_path)
+            telemetry_report = {"dir": telemetry_dir,
+                                "jsonl": collector.jsonl_path,
+                                "prometheus": prom_path,
+                                **collector.coverage_report()}
         final_ep = sponsor.current_endpoint
         replica_report = None
         if replicas > 0:
@@ -775,7 +877,8 @@ def run_federated_processes(
         replica_report=replica_report,
         wall_time_s=time.monotonic() - t_start,
         chaos_report=chaos_report,
-        final_info=final)
+        final_info=final,
+        telemetry_report=telemetry_report)
     result.epoch_times = epoch_times
     return result
 

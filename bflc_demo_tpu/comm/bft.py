@@ -82,6 +82,7 @@ import numpy as np
 from bflc_demo_tpu.comm.identity import (PublicDirectory, _op_bytes,
                                          address_of, verify_signature,
                                          verify_signatures_batch)
+from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.comm.wire import WireError, recv_msg, send_msg
 from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
@@ -97,6 +98,24 @@ _EMPTY_HEAD = b"\0" * 32        # head digest of the empty chain (log_head())
 
 # ledger op codec (must match pyledger/ledger.cpp opcode table)
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES = 1, 2, 3
+
+# --- validator-side telemetry (obs.metrics; no-ops unless the process
+# registry is enabled): vote latency by transport shape, refusals by
+# status, and the liveness-repair event counters a chaos post-mortem
+# correlates with fault windows.
+_M_VOTE = obs_metrics.REGISTRY.histogram(
+    "vote_latency_seconds",
+    "validator-side validate+sign time per request", ("kind",))
+_M_REFUSE = obs_metrics.REGISTRY.counter(
+    "vote_refusals_total", "refused vote requests by status", ("status",))
+_M_REPAIR = obs_metrics.REGISTRY.counter(
+    "repair_events_total",
+    "quorum-evidence rollbacks applied (certificate resync or "
+    "repair-proof re-proposal)", ("kind",))
+_M_ABANDON = obs_metrics.REGISTRY.counter(
+    "abandon_events_total", "signed abandon statements issued")
+_G_VLOG = obs_metrics.REGISTRY.gauge(
+    "validator_log_size", "replica chain length at last scrape")
 
 
 def cert_payload_digest(index: int, prev_head: bytes, op_digest: bytes,
@@ -548,6 +567,15 @@ class ValidatorNode:
                             reply["head_at"] = (
                                 self._heads[at - 1].hex() if at
                                 else _EMPTY_HEAD.hex())
+                elif method == "telemetry":
+                    # FleetCollector scrape surface (obs.collector) —
+                    # same shape as the ledger server's reply
+                    _G_VLOG.set(self.ledger.log_size())
+                    reply = {"ok": True,
+                             "role": (obs_metrics.REGISTRY.role
+                                      or f"validator-{self.index}"),
+                             "snapshot":
+                                 obs_metrics.REGISTRY.snapshot()}
                 elif method == "bft_validate":
                     reply = self._validate(msg)
                 elif method == "bft_vote_batch":
@@ -568,6 +596,7 @@ class ValidatorNode:
 
     # --------------------------------------------------------------- vote
     def _refuse(self, status: str, detail: str = "", **extra) -> dict:
+        _M_REFUSE.inc(status=status)
         if self.verbose:
             print(f"[validator {self.index}] refuse: {status} {detail}",
                   flush=True)
@@ -693,13 +722,16 @@ class ValidatorNode:
             return self._refuse("BAD_REQUEST")
         op_hash = hashlib.sha256(op).digest()
         tr = tracing.PROC
-        if tr.enabled:
+        if tr.enabled or obs_metrics.REGISTRY.enabled:
             t0 = time.perf_counter()
             try:
                 return self._validate_inner(i, op, op_hash, attempt, msg)
             finally:
-                tr.charge("bft.validate_s", time.perf_counter() - t0)
-                tr.charge("bft.validate_n")
+                dt = time.perf_counter() - t0
+                if tr.enabled:
+                    tr.charge("bft.validate_s", dt)
+                    tr.charge("bft.validate_n")
+                _M_VOTE.observe(dt, kind="single")
         return self._validate_inner(i, op, op_hash, attempt, msg)
 
     def _validate_inner(self, i: int, op: bytes, op_hash: bytes,
@@ -743,6 +775,8 @@ class ValidatorNode:
                     if err:
                         return self._refuse("AUTH", err)
                 self._enroll_register_pubkey(op, msg.get("auth"))
+                _M_REPAIR.inc(kind=("cert_resync" if cert is not None
+                                    else "re_proposal"))
                 self._rollback_to(i)
                 t = max(attempt, cert.attempt if cert else 0)
                 return self._apply_and_sign(i, op, op_hash, t)
@@ -775,7 +809,8 @@ class ValidatorNode:
                                 f"batch of {len(ops)} ops rejected")
         votes: List[dict] = []
         stopped = None
-        t0 = time.perf_counter() if tracing.PROC.enabled else 0.0
+        t0 = time.perf_counter() if (
+            tracing.PROC.enabled or obs_metrics.REGISTRY.enabled) else 0.0
         with self._lock:
             for k, op in enumerate(ops):
                 r = self._vote_locked(start + k, op, auths[k], attempt)
@@ -788,6 +823,8 @@ class ValidatorNode:
             tracing.PROC.charge("bft.validate_s",
                                 time.perf_counter() - t0)
             tracing.PROC.charge("bft.validate_n", len(votes))
+        if obs_metrics.REGISTRY.enabled and t0:
+            _M_VOTE.observe(time.perf_counter() - t0, kind="batch")
         return {"ok": True, "votes": votes, "stopped": stopped,
                 "log_size": size}
 
@@ -816,6 +853,7 @@ class ValidatorNode:
                                     f"{voted_t}",
                                     promised=promised, voted_t=voted_t)
             self._promised[i] = t
+            _M_ABANDON.inc()
             has_vote = voted_hash is not None
             op = self.ledger.log_op(i) if has_vote else b""
             sig = self.wallet.sign(abandon_stmt_payload(

@@ -50,8 +50,17 @@ import numpy as np
 from bflc_demo_tpu.comm.identity import _op_bytes
 from bflc_demo_tpu.comm.ledger_service import LedgerServer
 from bflc_demo_tpu.comm.wire import blob_bytes
+from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import pack_pytree, unpack_pytree
+
+# executor telemetry (the `telemetry` scrape RPC itself is inherited
+# from LedgerServer): mesh-round progress and per-round dispatch time
+_G_MESH_ROUNDS = obs_metrics.REGISTRY.gauge(
+    "executor_rounds_done", "mesh protocol rounds executed")
+_M_MESH_ROUND = obs_metrics.REGISTRY.histogram(
+    "executor_round_seconds",
+    "one SPMD protocol round on the mesh (dispatch + audit + publish)")
 
 
 class MeshExecutorServer(LedgerServer):
@@ -295,6 +304,8 @@ class MeshExecutorServer(LedgerServer):
         rng = np.random.default_rng(self.seed)
         k = cfg.needed_update_count
         for _ in range(self.rounds):
+            t_round = (time.perf_counter()
+                       if obs_metrics.REGISTRY.enabled else 0.0)
             with self._lock:
                 epoch = self.ledger.epoch
                 committee_ids = sorted(
@@ -336,6 +347,9 @@ class MeshExecutorServer(LedgerServer):
                 self._rounds_completed += 1
                 self._last_progress = time.monotonic()
                 self._cv.notify_all()
+                if t_round:
+                    _G_MESH_ROUNDS.set(self.rounds_done)
+                    _M_MESH_ROUND.observe(time.perf_counter() - t_round)
                 if self.verbose:
                     print(f"[executor] epoch {epoch} mesh round done "
                           f"(loss={self.ledger.last_global_loss:.5f})",

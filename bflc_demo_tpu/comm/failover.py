@@ -81,9 +81,25 @@ from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
 from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
                                      WireError)
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 Endpoint = Tuple[str, int]
+
+# --- standby-side telemetry (obs.metrics; no-ops unless enabled).  A
+# pre-promotion standby serves no socket, so these reach the collector
+# through the file snapshots obs.install_process_telemetry publishes.
+_M_MIRROR = obs_metrics.REGISTRY.histogram(
+    "standby_mirror_latency_seconds",
+    "per-blob payload mirror fetch (the mirror-before-apply gate)")
+_G_APPLIED = obs_metrics.REGISTRY.gauge(
+    "standby_applied_ops", "ops applied from the writer's stream")
+_G_ACK_LAG = obs_metrics.REGISTRY.gauge(
+    "standby_ack_lag_ops",
+    "applied ops not yet ack-eligible (pending-payload clamp depth)")
+_M_PROMOTIONS = obs_metrics.REGISTRY.counter(
+    "standby_promotions_total", "promotions by outcome", ("outcome",))
 
 
 class WriterDead(Exception):
@@ -445,6 +461,9 @@ class Standby:
                     # another proposer's fence op is canonically bound at
                     # our position: we lost the race (fence op already
                     # rolled back) — re-follow the winner
+                    _M_PROMOTIONS.inc(outcome="superseded")
+                    obs_flight.FLIGHT.record(
+                        "event", "promotion_superseded", index=self.index)
                     if self.verbose:
                         print(f"[standby {self.index}] promotion "
                               f"superseded; re-following", flush=True)
@@ -639,6 +658,9 @@ class Standby:
         ack = last_applied
         if self._pending_payload:
             ack = min(ack, min(self._pending_payload) - 1)
+        if obs_metrics.REGISTRY.enabled:
+            _G_APPLIED.set(last_applied + 1)
+            _G_ACK_LAG.set(last_applied - ack)
         if ack < 0:
             return
         try:
@@ -705,7 +727,8 @@ class Standby:
         if ph in self._blobs:
             return True
         try:
-            r = ctl.request("blob", hash=ph.hex())
+            with _M_MIRROR.time():
+                r = ctl.request("blob", hash=ph.hex())
         except (ConnectionError, WireError, OSError):
             return False
         if r.get("ok"):
@@ -1008,6 +1031,12 @@ class Standby:
         # open enrollment on the promoted writer: a client the directory
         # missed re-presents its (self-authenticating) pubkey on register
         self.server._open_enrollment = True
+        _M_PROMOTIONS.inc(outcome="promoted")
+        obs_flight.FLIGHT.record(
+            "event", "standby_promoted", index=self.index,
+            gen=self.ledger.generation, epoch=self.ledger.epoch,
+            log_size=self.ledger.log_size())
+        obs_flight.FLIGHT.flush("promoted")
         self.promoted.set()
         if self.verbose:
             print(f"[standby {self.index}] promoted: serving on "

@@ -42,6 +42,8 @@ from bflc_demo_tpu.comm.identity import (PublicDirectory, ReplayGuard,
                                          address_of, _op_bytes)
 from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
                                      WireError)
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
@@ -58,6 +60,28 @@ from bflc_demo_tpu.utils.serialization import unpack_pytree, pack_entries
 GAS_REGISTER = 1_000
 GAS_UPLOAD_BASE = 1_000
 GAS_SCORES = 500
+
+# --- writer-side telemetry (obs.metrics; no-ops unless the registry is
+# enabled).  Instantaneous state (round, uncertified backlog) is set at
+# scrape time inside the `telemetry` dispatch — a gauge sampled when it
+# is read is always current; the latency/size distributions accumulate
+# where the work happens.
+_M_RPC = obs_metrics.REGISTRY.histogram(
+    "rpc_latency_seconds",
+    "server-side request handling time (dispatch + certification + "
+    "quorum wait) per wire method", ("method",))
+_M_CERTIFY = obs_metrics.REGISTRY.histogram(
+    "certify_latency_seconds",
+    "one certification round-trip to the validator quorum", ("mode",))
+_M_CERT_BATCH = obs_metrics.REGISTRY.histogram(
+    "cert_batch_size", "ops certified per certify_range round-trip",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")))
+_G_ROUND = obs_metrics.REGISTRY.gauge(
+    "round", "current ledger epoch (completed FL rounds)")
+_G_BACKLOG = obs_metrics.REGISTRY.gauge(
+    "uncertified_backlog", "chain ops not yet quorum-certified")
+_G_SUBS = obs_metrics.REGISTRY.gauge(
+    "op_stream_subscribers", "live op-stream subscribers")
 
 _PROMO_MAGIC = b"BFLCPROM1"
 
@@ -440,9 +464,16 @@ class LedgerServer:
                         try:
                             send_msg(conn, reply)
                         finally:
+                            obs_flight.FLIGHT.record(
+                                "event", "writer_fenced",
+                                gen=self.ledger.generation,
+                                observed_fence=fence)
+                            obs_flight.FLIGHT.flush("fenced")
                             self.fenced.set()
                             self.close()
                         return
+                t_req = (time.perf_counter()
+                         if obs_metrics.REGISTRY.enabled else 0.0)
                 try:
                     reply = self._dispatch(method, msg)
                     post_size = reply.pop("_post_size", None)
@@ -494,6 +525,9 @@ class LedgerServer:
                 reply.setdefault("gen", self.ledger.generation)
                 if self._promotion_evidence is not None:
                     reply.setdefault("gen_ev", self._promotion_evidence)
+                if t_req:
+                    _M_RPC.observe(time.perf_counter() - t_req,
+                                   method=method)
                 send_msg(conn, reply)
         except (WireError, OSError):
             pass
@@ -572,11 +606,15 @@ class LedgerServer:
                                for j in range(i, hi)]
                 if len(entries) > 1:
                     tr = tracing.PROC
-                    t0 = time.perf_counter() if tr.enabled else 0.0
+                    t0 = time.perf_counter() if (
+                        tr.enabled or obs_metrics.REGISTRY.enabled) \
+                        else 0.0
                     certs = self._bft.certify_range(i, entries, prev)
+                    dt = time.perf_counter() - t0 if t0 else 0.0
                     if tr.enabled:
-                        tr.charge("bft.certify_s",
-                                  time.perf_counter() - t0)
+                        tr.charge("bft.certify_s", dt)
+                    if obs_metrics.REGISTRY.enabled:
+                        _M_CERTIFY.observe(dt, mode="batch")
                     installed = 0
                     for k, cert in enumerate(certs):
                         if cert is None:
@@ -586,16 +624,22 @@ class LedgerServer:
                         installed += 1
                     if tr.enabled and installed:
                         tr.charge("bft.certify_batched_ops", installed)
+                    if obs_metrics.REGISTRY.enabled and installed:
+                        _M_CERT_BATCH.observe(installed)
                     if installed:
                         with self._cv:
                             self._cv.notify_all()
                         continue        # drained some: advance / re-batch
                 op, auth = entries[0]
                 tr = tracing.PROC
-                t0 = time.perf_counter() if tr.enabled else 0.0
+                t0 = time.perf_counter() if (
+                    tr.enabled or obs_metrics.REGISTRY.enabled) else 0.0
                 cert = self._bft.certify(i, op, auth, prev)
+                dt = time.perf_counter() - t0 if t0 else 0.0
                 if tr.enabled:
-                    tr.charge("bft.certify_s", time.perf_counter() - t0)
+                    tr.charge("bft.certify_s", dt)
+                if obs_metrics.REGISTRY.enabled:
+                    _M_CERTIFY.observe(dt, mode="single")
                 if cert is None:
                     if getattr(self._bft, "superseded_op", None) \
                             is not None:
@@ -609,6 +653,9 @@ class LedgerServer:
                             print("[coordinator] certification "
                                   "superseded by a foreign proposer: "
                                   "self-demoting", flush=True)
+                        obs_flight.FLIGHT.record(
+                            "event", "writer_superseded", position=i)
+                        obs_flight.FLIGHT.flush("superseded")
                         self.fenced.set()
                         self.close()
                         return None
@@ -1098,6 +1145,24 @@ class LedgerServer:
                     return {"ok": False, "error": "bad range"}
                 return {"ok": True, "ops": [self.ledger.log_op(i).hex()
                                             for i in range(start, end)]}
+            if method == "telemetry":
+                # the FleetCollector scrape surface (obs.collector):
+                # instantaneous state gauges are sampled HERE so a scrape
+                # is always current, then the whole registry snapshot
+                # (which also carries the tracer's cost categories) rides
+                # back in one reply.  Served even when the registry is
+                # disabled — the reply then says so instead of timing out
+                # (the collector reports it as answered-but-dark).
+                if obs_metrics.REGISTRY.enabled:
+                    _G_ROUND.set(self.ledger.epoch)
+                    _G_BACKLOG.set(self.ledger.log_size()
+                                   - (self._certified_size
+                                      if self._bft is not None
+                                      else self.ledger.log_size()))
+                    _G_SUBS.set(len(self._sub_acked))
+                return {"ok": True,
+                        "role": obs_metrics.REGISTRY.role or "writer",
+                        "snapshot": obs_metrics.REGISTRY.snapshot()}
             if method == "wait":
                 # event-driven poll: block until the log grows past the
                 # caller's view (or timeout) — replaces the reference's
@@ -1194,6 +1259,9 @@ class LedgerServer:
         self._cv.notify_all()
         if tracing.PROC.enabled:
             tracing.PROC.charge("aggregate_s", time.perf_counter() - t0)
+        obs_flight.FLIGHT.record(
+            "event", "round_committed", epoch=epoch,
+            loss=float(self.ledger.last_global_loss))
         if self.verbose:
             print(f"[coordinator] epoch {epoch} aggregated: "
                   f"loss={self.ledger.last_global_loss:.5f}", flush=True)

@@ -55,9 +55,22 @@ import struct
 import time
 from typing import Any, Dict, Optional
 
+from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.utils import tracing
 
 MAX_FRAME = 256 << 20
+
+# frame-mix telemetry (obs.metrics; no-ops unless the process registry
+# is enabled): how much of the wire rides the PR-3 binary variant vs
+# legacy hex-JSON, per direction, plus raw byte volume.  Latency stays
+# on the tracer charges below (wire.send_s / wire.recv_s) — absorbed
+# into every telemetry snapshot via trace_costs.
+_M_FRAMES = obs_metrics.REGISTRY.counter(
+    "wire_frames_total", "frames by direction and encoding",
+    ("dir", "kind"))
+_M_BYTES = obs_metrics.REGISTRY.counter(
+    "wire_bytes_total", "frame bytes (incl. length prefix) by direction",
+    ("dir",))
 
 # binary-frame sentinel: a JSON object frame's first byte is '{', so a
 # NUL-led magic is unambiguous on the same socket
@@ -194,6 +207,10 @@ def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
     if tr.enabled:
         tr.charge("wire.send_s", time.perf_counter() - t0)
         tr.charge("wire.bytes_out", 4 + len(data))
+    if obs_metrics.REGISTRY.enabled:
+        _M_FRAMES.inc(dir="out", kind=("bin" if data[:1] == b"\x00"
+                                       else "json"))
+        _M_BYTES.inc(4 + len(data), dir="out")
 
 
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -243,3 +260,7 @@ def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
         if tr.enabled:
             tr.charge("wire.recv_s", time.perf_counter() - t0)
             tr.charge("wire.bytes_in", 4 + len(body))
+        if obs_metrics.REGISTRY.enabled:
+            _M_FRAMES.inc(dir="in", kind=("bin" if body[:1] == b"\x00"
+                                          else "json"))
+            _M_BYTES.inc(4 + len(body), dir="in")

@@ -278,6 +278,7 @@ def certification_throughput(n_ops: int = 24, validators: int = 4,
 def federation_config1(rounds: int = 3, *, standbys: int = 2,
                        validators: int = 4, quorum: int = 1,
                        compare_sequential: bool = False,
+                       telemetry: bool = True,
                        timeout_s: float = 420.0) -> Dict:
     """Process-federation benchmark at the paper's config-1 BFT geometry —
     the topology that actually reproduces the reference's deployment (20
@@ -291,7 +292,13 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
     BFLC_CONTROL_PLANE_LEGACY=1 in the children's environment — the
     pre-PR control plane (sequential certification, naive Ed25519,
     hex-JSON blob frames) — and reports the round-time and
-    ops-certified/sec ratios."""
+    ops-certified/sec ratios.
+
+    telemetry=True (default) arms the fleet telemetry plane (obs/): the
+    driver scrapes every role each committed round and the result
+    carries `telemetry` scrape coverage (roles answering / expected) —
+    bench.py surfaces it as extra.telemetry.  telemetry=False is the
+    overhead baseline leg (TPU_RESULTS.md telemetry-overhead axis)."""
     from bflc_demo_tpu.data import load_occupancy, iid_shards
 
     cfg = DEFAULT_PROTOCOL
@@ -316,6 +323,8 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                     rounds=rounds, standbys=standbys, quorum=quorum,
                     bft_validators=validators,
                     wal_path=os.path.join(td, "writer.wal"),
+                    telemetry_dir=(os.path.join(td, "telemetry")
+                                   if telemetry else ""),
                     timeout_s=timeout_s)
         finally:
             for k, v in saved.items():
@@ -370,12 +379,20 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                 costs.get("bft.certify_batched_ops", 0)),
             "ops_certified_single": int(
                 costs.get("bft.certify_single_ops", 0)),
+            # scrape coverage: roles answering / roles expected — the
+            # telemetry plane's own health axis (None when disabled)
+            "telemetry": ({k: res.telemetry_report[k]
+                           for k in ("scrapes", "roles_expected",
+                                     "answered_total", "expected_total",
+                                     "coverage")}
+                          if res.telemetry_report else None),
         }
 
     out: Dict = {
         "geometry": {"clients": cfg.client_num, "standbys": standbys,
                      "validators": validators, "quorum": quorum,
-                     "wal": True, "rounds": rounds},
+                     "wal": True, "rounds": rounds,
+                     "telemetry": telemetry},
         "fast": _run(legacy=False),
     }
     if compare_sequential:
@@ -390,3 +407,33 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                 fast["cert_throughput_ops_per_sec"]
                 / seq["cert_throughput_ops_per_sec"], 2)
     return out
+
+
+def telemetry_overhead_config1(rounds: int = 3, trials: int = 1,
+                               **kw) -> Dict:
+    """Telemetry overhead measured, not asserted (the observability
+    PR's acceptance bar): the identical config-1 federation with the
+    scrape plane armed vs dark, steady round wall time compared.  With
+    trials > 1 each leg's round time is the per-trial minimum — the
+    least-contended observation on a noisy shared host."""
+    on_times, off_times, on_last, off_last = [], [], None, None
+    for _ in range(trials):
+        on_last = federation_config1(rounds=rounds, telemetry=True, **kw)
+        off_last = federation_config1(rounds=rounds, telemetry=False,
+                                      **kw)
+        on_times.append(on_last["fast"]["round_wall_time_s"])
+        off_times.append(off_last["fast"]["round_wall_time_s"])
+    on_t, off_t = min(on_times), min(off_times)
+    return {
+        "rounds": rounds, "trials": trials,
+        # headline = per-leg minimum over trials; the full per-trial
+        # lists ride along so the artifact is self-consistent (the
+        # last-trial detail legs below may show different times)
+        "round_wall_time_s_telemetry_on": on_t,
+        "round_wall_time_s_telemetry_off": off_t,
+        "round_times_on": on_times, "round_times_off": off_times,
+        "overhead_frac": round(on_t / off_t - 1.0, 4) if off_t else None,
+        "scrape_coverage": on_last["fast"].get("telemetry"),
+        "last_trial_on": on_last["fast"],
+        "last_trial_off": off_last["fast"],
+    }

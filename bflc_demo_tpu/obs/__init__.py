@@ -1,0 +1,65 @@
+"""Fleet telemetry plane (PR 4): metrics registry + flight recorder +
+collector.
+
+Three pieces, importable independently (none imports jax or comm at
+module load, so every role — including the lean validator children —
+can afford them):
+
+- `obs.metrics`  — process-wide Counter/Gauge/Histogram registry with
+  bounded label cardinality; near-zero cost disabled; absorbs the
+  utils.tracing.PROC cost categories into every snapshot.
+- `obs.flight`   — bounded event ring flushed to a per-role file on a
+  short cadence and on SIGTERM / unhandled exception / invariant
+  violation, so chaos post-mortems have data from the DEAD process.
+- `obs.collector`— FleetCollector: per-round whole-fleet scrapes
+  (telemetry RPC for socket-serving roles, file snapshots for the
+  rest) onto one metrics.jsonl timeline interleaved with chaos fault
+  events; Prometheus text dumps.
+
+`install_process_telemetry` is the one-call arming point every child
+process entry uses (client/process_runtime), mirroring how chaos
+injectors install.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from bflc_demo_tpu.obs import flight, metrics
+from bflc_demo_tpu.obs.collector import FleetCollector  # noqa: F401
+
+_PUBLISHER: "threading.Thread | None" = None
+
+
+def install_process_telemetry(role: str, out_dir: str, *,
+                              interval_s: float = 1.0,
+                              enable_tracing: bool = True,
+                              signals: bool = True) -> None:
+    """Arm this process's telemetry: enable the metrics registry under
+    `role`, flip the cost tracer on (the charge sites are shared), arm
+    the flight recorder at <out_dir>/<role>.flight.jsonl, and start the
+    snapshot publisher writing <out_dir>/<role>.metrics.json — the
+    scrape surface for roles that serve no socket.  Idempotent."""
+    global _PUBLISHER
+    metrics.REGISTRY.enabled = True
+    metrics.REGISTRY.role = role
+    if enable_tracing:
+        from bflc_demo_tpu.utils import tracing
+        tracing.PROC.enabled = True
+    flight.FLIGHT.install(role, out_dir, interval_s=interval_s,
+                          signals=signals)
+    if _PUBLISHER is None:
+        import os
+
+        from bflc_demo_tpu.obs.collector import publish_snapshot
+        path = os.path.join(out_dir, f"{role}.metrics.json")
+
+        def _loop() -> None:
+            while True:
+                publish_snapshot(path)
+                time.sleep(interval_s)
+
+        publish_snapshot(path)          # exists from role bring-up
+        _PUBLISHER = threading.Thread(target=_loop, daemon=True)
+        _PUBLISHER.start()

@@ -1,0 +1,214 @@
+"""FleetCollector: scrape every live role into one run artifact.
+
+The process-federation driver (client/process_runtime) owns the fleet's
+endpoint map, so it is the natural scrape point — each round it pulls a
+`telemetry` RPC snapshot from every wire-serving role (writer,
+validators, mesh executor) and reads the file snapshots that socket-less
+roles (clients, un-promoted standbys) publish via their telemetry
+thread.  Everything lands on ONE timeline file, `metrics.jsonl`:
+
+    {"type": "scrape", "t": ..., "tag": ..., "roles": {role: snapshot},
+     "coverage": {"answered": n, "expected": m, "missing": [...]}}
+    {"type": "fault", "t": ..., ...}      # chaos events, interleaved
+    {"type": "note",  "t": ..., ...}      # run milestones (round commits)
+
+so a chaos post-mortem reads fault -> metric causality off a single
+ordered stream (tools/fleet_top.py renders it).  A scrape NEVER raises:
+an unreachable role is a coverage miss, not a driver crash — under
+faults the collector's job is precisely to keep observing the part of
+the fleet that still answers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from bflc_demo_tpu.obs import metrics as obs_metrics
+
+
+def publish_snapshot(path: str) -> bool:
+    """Write the process registry's snapshot to `path` atomically — the
+    file-publication half for roles that serve no socket.  True when a
+    file was written."""
+    snap = obs_metrics.REGISTRY.snapshot()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def read_snapshot_file(path: str) -> Optional[dict]:
+    """A file-published snapshot, or None when absent/garbled (a role
+    killed mid-publish leaves the previous complete file — rename-into-
+    place — so garble means 'never published', not 'torn')."""
+    try:
+        with open(path) as fh:
+            snap = json.load(fh)
+        return snap if isinstance(snap, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def load_timeline(jsonl_path: str) -> List[dict]:
+    """Parse a metrics.jsonl run artifact, skipping any garbled line
+    (a crashed driver may tear the final append)."""
+    out: List[dict] = []
+    try:
+        with open(jsonl_path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+class FleetCollector:
+    """Periodic whole-fleet scraper writing the metrics.jsonl timeline.
+
+    rpc_roles: {role: (host, port)} — roles serving the `telemetry` wire
+    RPC (writer, validators, executor).
+    file_roles: {role: path} — roles publishing snapshot files instead
+    (clients, standbys); a missing file counts as a coverage miss.
+    tls/tls_roles: the ssl context is applied ONLY to roles named in
+    `tls_roles` — in a TLS deployment the coordinator serves TLS but the
+    BFT validators speak plaintext on the coordinator-side segment, so
+    one blanket context would fail every validator scrape.
+    """
+
+    def __init__(self, rpc_roles: Dict[str, Tuple[str, int]],
+                 file_roles: Optional[Dict[str, str]] = None, *,
+                 jsonl_path: str = "", timeout_s: float = 1.0,
+                 tls=None, tls_roles=()):
+        self.rpc_roles = dict(rpc_roles)
+        self.file_roles = dict(file_roles or {})
+        self.jsonl_path = jsonl_path
+        self.timeout_s = timeout_s
+        self.tls = tls
+        self.tls_roles = set(tls_roles)
+        self.scrapes = 0
+        self.answered_total = 0
+        self.expected_total = 0
+        self.last_scrape: Optional[dict] = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)),
+                        exist_ok=True)
+
+    # ------------------------------------------------------------- write
+    def _append(self, rec: dict) -> None:
+        if not self.jsonl_path:
+            return
+        try:
+            with open(self.jsonl_path, "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
+
+    def note(self, name: str, **attrs) -> None:
+        """Milestone line on the shared timeline (round commits etc.)."""
+        self._append({"type": "note", "t": time.time(), "name": name,
+                      **attrs})
+
+    def observe_fault(self, event: dict, source: str = "chaos") -> None:
+        """Inject a chaos FaultEvent (or any fault dict) into the
+        timeline — the fault->metric causality anchor.  A chaos event's
+        own 't' is schedule-relative (seconds from campaign t0); it must
+        not clobber the record's wall-clock 't' or the merged timeline
+        sorts every fault to the dawn of time."""
+        ev = dict(event)
+        if "t" in ev:
+            ev["t_sched"] = ev.pop("t")
+        self._append({"type": "fault", "t": time.time(),
+                      "source": source, **ev})
+
+    # ------------------------------------------------------------ scrape
+    def _scrape_rpc(self, role: str,
+                    ep: Tuple[str, int]) -> Optional[dict]:
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        try:
+            c = CoordinatorClient(ep[0], ep[1], timeout_s=self.timeout_s,
+                                  tls=(self.tls if role in self.tls_roles
+                                       else None))
+        except (ConnectionError, OSError):
+            return None
+        try:
+            r = c.request("telemetry")
+            snap = r.get("snapshot")
+            return snap if r.get("ok") and isinstance(snap, dict) \
+                else None
+        except (ConnectionError, OSError, ValueError):
+            return None
+        finally:
+            c.close()
+
+    def scrape(self, tag: Any = None) -> dict:
+        """One fleet-wide scrape; appends the record to metrics.jsonl
+        and returns it.  Partial coverage is normal under faults."""
+        roles: Dict[str, Optional[dict]] = {}
+        for role, ep in self.rpc_roles.items():
+            roles[role] = self._scrape_rpc(role, ep)
+        for role, path in self.file_roles.items():
+            roles[role] = read_snapshot_file(path)
+        answered = sorted(r for r, s in roles.items() if s is not None)
+        missing = sorted(r for r, s in roles.items() if s is None)
+        rec = {"type": "scrape", "t": time.time(), "tag": tag,
+               "roles": {r: s for r, s in roles.items()
+                         if s is not None},
+               "coverage": {"answered": len(answered),
+                            "expected": len(roles),
+                            "missing": missing}}
+        self.scrapes += 1
+        self.answered_total += len(answered)
+        self.expected_total += len(roles)
+        self.last_scrape = rec
+        self._append(rec)
+        return rec
+
+    # ---------------------------------------------------------- reports
+    def coverage_report(self) -> dict:
+        return {"scrapes": self.scrapes,
+                "roles_expected": len(self.rpc_roles)
+                + len(self.file_roles),
+                "answered_total": self.answered_total,
+                "expected_total": self.expected_total,
+                "coverage": (self.answered_total / self.expected_total
+                             if self.expected_total else 0.0),
+                "last_missing": (self.last_scrape or {}).get(
+                    "coverage", {}).get("missing", [])}
+
+    def write_prometheus(self, path: str) -> bool:
+        """Dump the latest scrape in Prometheus text format (role label
+        distinguishes the fleet's processes)."""
+        if self.last_scrape is None:
+            return False
+        snaps = []
+        for role, snap in sorted(self.last_scrape["roles"].items()):
+            # the collector's role key wins: it is what the operator
+            # addresses the process by (a shared-process fleet self-
+            # declares one registry role — or none at all)
+            snaps.append({**snap, "role": role})
+        text = obs_metrics.to_prometheus(snaps)
+        try:
+            with open(path, "w") as fh:
+                fh.write(text)
+            return True
+        except OSError:
+            return False

@@ -1,0 +1,176 @@
+"""Flight recorder: a bounded ring of recent events, durable past death.
+
+The chaos engine's central observability problem: a SIGKILLed writer or
+validator takes its evidence with it — `info.perf` only reports from
+processes that survive, which is exactly the wrong sample under faults.
+The flight recorder is the Dapper-style out-of-band answer (Sigelman et
+al., 2010): every role keeps a small in-memory ring of recent
+spans/events and a background flusher persists it to a per-role file on
+a short cadence, so even a SIGKILL (uncatchable by design) loses at most
+one flush interval of tail.  Catchable exits flush synchronously:
+
+- SIGTERM (the fleet teardown path and `Process.terminate`);
+- an unhandled exception (sys.excepthook);
+- an invariant violation (chaos.invariants flags call `note` + `flush`);
+- interpreter exit (atexit).
+
+Files are written tmp-then-rename so a kill mid-flush can never leave a
+torn file — the post-mortem artifact either parses or is the previous
+complete flush.  Format: one JSON object per line; line 0 is a header
+{type: "flight_header", role, pid, reason, flushed_at}, the rest are the
+ring's events oldest-first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class FlightRecorder:
+    """Bounded event ring + periodic/terminal flusher (module doc)."""
+
+    def __init__(self, capacity: int = 1024):
+        self.enabled = False
+        self.role = ""
+        self.path = ""
+        self._ring: deque = deque(maxlen=capacity)
+        # RLock: the SIGTERM handler runs on the main thread and calls
+        # flush(); if the signal lands while that same thread is inside
+        # record()'s critical section, a plain Lock would deadlock the
+        # teardown path (Process.terminate would never complete)
+        self._lock = threading.RLock()
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._installed_sigterm = False
+
+    # ------------------------------------------------------------ record
+    def record(self, kind: str, name: str, **attrs) -> None:
+        """Append one event (no-op unless installed).  `kind` is a small
+        closed vocabulary (span/event/fault/invariant_violation/...)."""
+        if not self.enabled:
+            return
+        ev: Dict[str, Any] = {"t": time.time(), "kind": kind,
+                              "name": name}
+        if attrs:
+            ev.update(attrs)
+        with self._lock:
+            self._ring.append(ev)
+
+    # ------------------------------------------------------------- flush
+    def flush(self, reason: str = "periodic") -> bool:
+        """Persist the ring to `self.path` atomically (tmp + rename).
+        True when a file was written."""
+        if not self.path:
+            return False
+        with self._lock:
+            events = list(self._ring)
+        header = {"type": "flight_header", "role": self.role,
+                  "pid": os.getpid(), "reason": reason,
+                  "flushed_at": time.time(), "n_events": len(events)}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(json.dumps(header) + "\n")
+                for ev in events:
+                    fh.write(json.dumps(ev) + "\n")
+            os.replace(tmp, self.path)
+            return True
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+
+    def _flush_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.flush("periodic")
+
+    # ----------------------------------------------------------- install
+    def install(self, role: str, out_dir: str, *,
+                interval_s: float = 1.0,
+                signals: bool = True) -> None:
+        """Arm the recorder for this process: per-role dump path, the
+        periodic flusher thread, and — when `signals` (and running in the
+        main thread) — SIGTERM + excepthook + atexit terminal flushes.
+
+        SIGTERM chains to the default disposition after flushing so
+        `Process.terminate` still kills the process with the usual
+        -SIGTERM exitcode (a swallowed TERM would wedge fleet teardown).
+        """
+        os.makedirs(out_dir, exist_ok=True)
+        self.role = role
+        self.path = os.path.join(out_dir, f"{role}.flight.jsonl")
+        self.enabled = True
+        self.record("event", "flight_recorder_installed", role=role)
+        if self._flusher is None:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, args=(interval_s,), daemon=True)
+            self._flusher.start()
+        if signals:
+            import atexit
+            atexit.register(lambda: self.flush("atexit"))
+            prev_hook = sys.excepthook
+
+            def _hook(tp, val, tb):
+                self.record("event", "unhandled_exception",
+                            error=f"{tp.__name__}: {val}")
+                self.flush("exception")
+                prev_hook(tp, val, tb)
+
+            sys.excepthook = _hook
+            if not self._installed_sigterm and \
+                    threading.current_thread() is threading.main_thread():
+                def _on_term(signum, frame):
+                    self.record("event", "sigterm")
+                    self.flush("sigterm")
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+                try:
+                    signal.signal(signal.SIGTERM, _on_term)
+                    self._installed_sigterm = True
+                except (ValueError, OSError):
+                    pass
+        # first flush immediately: the file must exist from the moment
+        # the role is up, so even an instant SIGKILL leaves an artifact
+        self.flush("install")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self.enabled:
+            self.flush("close")
+        self.enabled = False
+
+
+def load_flight(path: str) -> Dict[str, Any]:
+    """Parse a flight-recorder dump: {"header": dict, "events": [dict]}.
+    Raises ValueError on a malformed file (the artifact contract is that
+    dumps ALWAYS parse — rename-into-place guarantees it)."""
+    events: List[dict] = []
+    header: Optional[dict] = None
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if i == 0 and rec.get("type") == "flight_header":
+                header = rec
+            else:
+                events.append(rec)
+    if header is None:
+        raise ValueError(f"{path}: missing flight_header line")
+    return {"header": header, "events": events}
+
+
+#: process-wide recorder, armed by obs.install_process_telemetry.
+#: Access as `flight.FLIGHT` (module attribute).
+FLIGHT = FlightRecorder()

@@ -1,0 +1,340 @@
+"""Fleet metrics registry: Counter / Gauge / Histogram with label sets.
+
+The reference observes itself through three print streams behind an
+OUTPUT macro and meters cost with blockchain gas (SURVEY.md §5); PR 3
+upgraded that to a per-process `Tracer` (utils.tracing.PROC) — but every
+role still kept its telemetry private.  This registry is the fleet-wide
+half: Monarch-style labeled metrics with BOUNDED cardinality (Adya et
+al., VLDB 2020) that every role can expose over the `telemetry` wire RPC
+(comm.ledger_service / comm.bft) or publish as a file snapshot
+(obs.flight), scraped each round by obs.collector.FleetCollector.
+
+Design rules:
+
+- **near-zero cost when disabled** (the default): every mutate is one
+  attribute check and a return — instrument hot paths freely;
+- **bounded cardinality**: each metric holds at most
+  `max_series_per_metric` label sets; overflow folds into a single
+  ``{"overflow": "true"}`` series and bumps the registry's
+  `series_dropped` counter instead of growing without bound (a hostile
+  or buggy label value must not OOM the process);
+- **tracer absorption**: `snapshot()` carries `utils.tracing.PROC.costs`
+  verbatim under `trace_costs` — the gas-pricer categories
+  (wire/crypto/validate/certify/aggregate) ride every scrape without
+  re-plumbing the charge sites;
+- snapshots are plain JSON-able dicts; `to_prometheus` renders the
+  standard text exposition format.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, float("inf"))
+
+_OVERFLOW_KEY = (("overflow", "true"),)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _label_key(labelnames: Tuple[str, ...],
+               labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple((k, str(labels.get(k, ""))) for k in labelnames)
+
+
+class _Metric:
+    """Shared series storage: {label-tuple: value-or-hist-state}."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 labelnames: Tuple[str, ...]):
+        self._reg = registry
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+
+    def _key_for(self, labels: Dict[str, str]):
+        """The series key for `labels`, folding NEW series past the
+        cardinality cap into the overflow series (caller holds the
+        registry lock)."""
+        key = _label_key(self.labelnames, labels)
+        if key in self._series:
+            return key
+        if len(self._series) >= self._reg.max_series_per_metric:
+            self._reg.series_dropped += 1
+            return _OVERFLOW_KEY
+        return key
+
+    def samples(self) -> List[dict]:
+        return [{"labels": dict(k), "value": v}
+                for k, v in self._series.items()]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        reg = self._reg
+        if not reg.enabled:
+            return
+        with reg._lock:
+            key = self._key_for(labels)
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        reg = self._reg
+        if not reg.enabled:
+            return
+        with reg._lock:
+            self._series[self._key_for(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        reg = self._reg
+        if not reg.enabled:
+            return
+        with reg._lock:
+            key = self._key_for(labels)
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+class _HistState:
+    __slots__ = ("count", "sum", "buckets")
+
+    def __init__(self, n_buckets: int):
+        self.count = 0
+        self.sum = 0.0
+        self.buckets = [0] * n_buckets
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labelnames,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, help, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs or bs[-1] != float("inf"):
+            bs.append(float("inf"))
+        self.buckets = tuple(bs)
+
+    def observe(self, value: float, **labels) -> None:
+        reg = self._reg
+        if not reg.enabled:
+            return
+        with reg._lock:
+            key = self._key_for(labels)
+            st = self._series.get(key)
+            if st is None:
+                st = self._series[key] = _HistState(len(self.buckets))
+            st.count += 1
+            st.sum += float(value)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    st.buckets[i] += 1
+                    break
+
+    def time(self, **labels) -> "_HistTimer":
+        """Context manager observing the block's wall duration (a
+        disabled registry pays two attribute checks, no clock read)."""
+        return _HistTimer(self, labels)
+
+    def samples(self) -> List[dict]:
+        out = []
+        for k, st in self._series.items():
+            cum, buckets = 0, {}
+            for b, n in zip(self.buckets, st.buckets):
+                cum += n            # Prometheus buckets are cumulative
+                buckets["+Inf" if b == float("inf") else repr(b)] = cum
+            out.append({"labels": dict(k), "count": st.count,
+                        "sum": st.sum, "buckets": buckets})
+        return out
+
+
+class _HistTimer:
+    __slots__ = ("_h", "_labels", "_t0")
+
+    def __init__(self, h: Histogram, labels: Dict[str, str]):
+        self._h = h
+        self._labels = labels
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if self._h._reg.enabled:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._h._reg.enabled and self._t0:
+            self._h.observe(time.perf_counter() - self._t0,
+                            **self._labels)
+        return False
+
+
+class MetricsRegistry:
+    """Process-wide metric registry (one per role process).
+
+    Metric constructors are idempotent by name — modules declare their
+    metrics at import and re-imports get the same object; a name reused
+    with a different kind or label set raises (silent divergence would
+    corrupt every downstream consumer).
+    """
+
+    def __init__(self, enabled: bool = False, role: str = ""):
+        self.enabled = enabled
+        self.role = role
+        self.max_series_per_metric = 64
+        self.series_dropped = 0
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    # ------------------------------------------------------- constructors
+    def _get_or_make(self, cls, name: str, help: str,
+                     labelnames: Tuple[str, ...], **kw) -> _Metric:
+        name = _NAME_RE.sub("_", name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) \
+                        or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} re-declared as {cls.kind} "
+                        f"labels={tuple(labelnames)} but exists as "
+                        f"{m.kind} labels={m.labelnames}")
+                return m
+            m = cls(self, name, help, tuple(labelnames), **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Tuple[str, ...] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Tuple[str, ...] = (),
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(Histogram, name, help, labelnames,
+                                 buckets=buckets)
+
+    # ---------------------------------------------------------- reporting
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able point-in-time view: every series of every metric,
+        plus the process tracer's cost counters (the Tracer.charge
+        categories absorbed — one scrape carries both planes)."""
+        from bflc_demo_tpu.utils import tracing
+        with self._lock:
+            metrics = {name: {"type": m.kind, "help": m.help,
+                              "samples": m.samples()}
+                       for name, m in self._metrics.items()}
+        return {"t": time.time(), "role": self.role, "pid": os.getpid(),
+                "enabled": self.enabled,
+                "series_dropped": self.series_dropped,
+                "metrics": metrics,
+                "trace_costs": dict(tracing.PROC.costs)}
+
+    def reset(self) -> None:
+        """Zero every metric's series WITHOUT unregistering the metric
+        objects: modules hold them from import time, so dropping them
+        from the registry would orphan live instrumentation sites into
+        series no snapshot ever reports."""
+        with self._lock:
+            for m in self._metrics.values():
+                m._series.clear()
+            self.series_dropped = 0
+
+
+def _escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _fmt_labels(labels: Dict[str, str],
+                extra: Optional[dict] = None) -> str:
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def to_prometheus(snapshots: List[Dict[str, Any]],
+                  prefix: str = "bflc_") -> str:
+    """Render one or many role snapshots as Prometheus text exposition.
+
+    Each snapshot's role rides as a `role` label so a whole-fleet dump
+    is one coherent page; tracer cost counters surface as
+    `<prefix>trace_cost_total{category=...}`."""
+    helps: Dict[str, Tuple[str, str]] = {}
+    lines_by_name: Dict[str, List[str]] = {}
+
+    def emit(name: str, kind: str, help: str, line: str) -> None:
+        helps.setdefault(name, (kind, help))
+        lines_by_name.setdefault(name, []).append(line)
+
+    for snap in snapshots:
+        role = {"role": snap.get("role", "")}
+        for raw, m in sorted((snap.get("metrics") or {}).items()):
+            name = prefix + raw
+            for s in m.get("samples", []):
+                if m["type"] == "histogram":
+                    lab = s.get("labels", {})
+                    for le, n in s.get("buckets", {}).items():
+                        emit(name, "histogram", m.get("help", ""),
+                             f"{name}_bucket"
+                             f"{_fmt_labels(lab, {**role, 'le': le})}"
+                             f" {n}")
+                    emit(name, "histogram", m.get("help", ""),
+                         f"{name}_sum{_fmt_labels(lab, role)}"
+                         f" {s.get('sum', 0.0)}")
+                    emit(name, "histogram", m.get("help", ""),
+                         f"{name}_count{_fmt_labels(lab, role)}"
+                         f" {s.get('count', 0)}")
+                else:
+                    emit(name, m["type"], m.get("help", ""),
+                         f"{name}{_fmt_labels(s.get('labels', {}), role)}"
+                         f" {s.get('value', 0.0)}")
+        tname = prefix + "trace_cost_total"
+        for cat, v in sorted((snap.get("trace_costs") or {}).items()):
+            emit(tname, "counter",
+                 "utils.tracing.PROC cost counters (gas-pricer "
+                 "categories)",
+                 f"{tname}{_fmt_labels({'category': cat}, role)} {v}")
+    out: List[str] = []
+    for name, lines in lines_by_name.items():
+        kind, help = helps[name]
+        if help:
+            out.append(f"# HELP {name} {help}")
+        out.append(f"# TYPE {name} {kind}")
+        out.extend(lines)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+#: the process registry every instrumentation site charges into.
+#: Disabled by default (one attribute check per site); enabled at
+#: interpreter start via BFLC_TELEMETRY=1 + BFLC_TELEMETRY_ROLE (the
+#: process-federation spawner sets both), or in process by
+#: obs.install_process_telemetry.  Access as `metrics.REGISTRY`
+#: (module attribute), never `from ... import REGISTRY` — the same
+#: aliasing rule as tracing.PROC.
+REGISTRY = MetricsRegistry(
+    enabled=bool(os.environ.get("BFLC_TELEMETRY")),
+    role=os.environ.get("BFLC_TELEMETRY_ROLE", ""))

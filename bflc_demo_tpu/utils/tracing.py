@@ -30,39 +30,52 @@ class Tracer:
     """Hierarchical span/event tracer with cost counters.
 
     Thread-safety: `charge` takes a lock (only when enabled) so the
-    multi-threaded control-plane servers can account concurrently;
-    `span`/`event` share one name stack and are meant for single-threaded
-    drivers — servers charge counters instead of nesting spans."""
+    multi-threaded control-plane servers can account concurrently, and
+    the span name stack is THREAD-LOCAL — two server threads nesting
+    spans concurrently each see only their own ancestry, so span paths
+    never interleave across threads (the pre-PR shared stack crossed
+    paths the moment a second thread opened a span).  The events list
+    itself is append-only under the lock."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self.events: List[Dict[str, Any]] = []
         self.costs: Dict[str, float] = defaultdict(float)
-        self._stack: List[str] = []
+        self._local = threading.local()
         self._lock = threading.Lock()
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[None]:
         if not self.enabled:
             yield
             return
-        path = "/".join(self._stack + [name])
-        self._stack.append(name)
+        stack = self._stack()
+        path = "/".join(stack + [name])
+        stack.append(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self._stack.pop()
-            self.events.append({
-                "type": "span", "name": path,
-                "dur_s": time.perf_counter() - t0, **attrs})
+            stack.pop()
+            ev = {"type": "span", "name": path,
+                  "dur_s": time.perf_counter() - t0, **attrs}
+            with self._lock:
+                self.events.append(ev)
 
     def event(self, name: str, **attrs) -> None:
         if not self.enabled:
             return
-        path = "/".join(self._stack + [name])
-        self.events.append({"type": "event", "name": path,
-                            "t": time.perf_counter(), **attrs})
+        path = "/".join(self._stack() + [name])
+        ev = {"type": "event", "name": path,
+              "t": time.perf_counter(), **attrs}
+        with self._lock:
+            self.events.append(ev)
 
     def charge(self, category: str, amount: float = 1.0) -> None:
         """Cost accounting — the gasPricer equivalent.  Categories in use:
@@ -79,7 +92,10 @@ class Tracer:
         with self._lock:
             self.events.clear()
             self.costs.clear()
-            self._stack.clear()
+            # other threads' stacks die with their thread-local storage;
+            # rebinding drops THIS thread's (reset is a driver-side call
+            # between runs, not a mid-flight operation)
+            self._local = threading.local()
 
     # --- reporting ---
     def span_totals(self) -> Dict[str, float]:

@@ -158,9 +158,12 @@ class TestMiniSoak:
     client kill/restart, validator kill/restart (certified-backlog
     resync on rejoin), a writer<->validator partition window, a lossy
     client link, and a writer kill (BFT-certified promotion) — all
-    invariant monitors must hold and the federation must finish."""
+    invariant monitors must hold and the federation must finish.  Runs
+    with the telemetry plane armed (PR 4): the same drill must leave a
+    chaos-correlated metrics.jsonl timeline and flight-recorder dumps
+    from the KILLED processes."""
 
-    def test_seeded_mini_soak_kill_partition_resync(self):
+    def test_seeded_mini_soak_kill_partition_resync(self, tmp_path):
         from bflc_demo_tpu.client.process_runtime import \
             run_federated_processes
         cfg = _small_cfg()
@@ -185,10 +188,12 @@ class TestMiniSoak:
             "client-1": [WireWindow(6.0, 9.0, "drop",
                                     ("writer", "standby-1"), p=0.3)],
         }
+        tdir = str(tmp_path / "telemetry")
         res = run_federated_processes(
             "make_softmax_regression", shards, test_set, cfg,
             rounds=8, standbys=1, bft_validators=4,
-            timeout_s=300.0, chaos_schedule=sched, verbose=False)
+            timeout_s=300.0, chaos_schedule=sched,
+            telemetry_dir=tdir, verbose=False)
         rep = res.chaos_report
         assert rep is not None
         assert rep["violations"] == [], rep["violations"]
@@ -206,6 +211,42 @@ class TestMiniSoak:
         assert int(v["validators_probed"]) >= 3
         assert rep["invariant_checks"]["history_checks"] >= 1
         assert rep["acked_uploads_checked"] >= 1
+
+        # --- telemetry plane under the same faults (PR 4) ---
+        import os as _os
+
+        from bflc_demo_tpu.obs.collector import load_timeline
+        from bflc_demo_tpu.obs.flight import load_flight
+        tel = res.telemetry_report
+        assert tel is not None and tel["scrapes"] >= 3
+        tl = load_timeline(tel["jsonl"])
+        scrapes = [r for r in tl if r["type"] == "scrape"]
+        faults = [r for r in tl if r["type"] == "fault"]
+        # chaos events landed on the same timeline as the scrapes —
+        # the fault -> metric causality stream
+        assert any(f.get("kind") == "kill" for f in faults), faults
+        # every role CLASS appears in the scraped snapshots
+        seen = set()
+        for s in scrapes:
+            seen |= set(s["roles"])
+        assert any(r.startswith("client-") for r in seen)
+        assert any(r.startswith("validator-") for r in seen)
+        assert any(r.startswith("standby-") for r in seen)
+        assert "writer" in seen
+        # the KILLED writer's flight-recorder dump exists and parses
+        # (SIGKILL — only the periodic out-of-band flush can have
+        # written it), and so does the killed validator's
+        for role in ("writer", "validator-1"):
+            dump = load_flight(_os.path.join(tdir,
+                                             f"{role}.flight.jsonl"))
+            assert dump["header"]["role"] == role
+        # post-writer-kill scrapes degraded, never crashed: the dead
+        # writer shows up as a coverage miss in at least one scrape
+        assert any("writer" in s["coverage"]["missing"]
+                   for s in scrapes), \
+            [s["coverage"] for s in scrapes]
+        # the prometheus dump rendered
+        assert _os.path.exists(tel["prometheus"])
 
 
 @pytest.mark.slow

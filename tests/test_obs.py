@@ -1,0 +1,433 @@
+"""Fleet telemetry plane (bflc_demo_tpu.obs): metrics registry semantics,
+thread-local tracer spans, flight-recorder durability past SIGKILL, the
+telemetry scrape RPC + FleetCollector, and collector degradation under
+wire faults (the observability PR's contract: the plane keeps observing
+exactly when the fleet is failing).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs.collector import (FleetCollector, load_timeline,
+                                         publish_snapshot,
+                                         read_snapshot_file)
+from bflc_demo_tpu.obs.flight import FlightRecorder, load_flight
+from bflc_demo_tpu.obs.metrics import MetricsRegistry, to_prometheus
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils import tracing
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry(enabled=True, role="t")
+        c = reg.counter("reqs_total", "requests", ("method",))
+        c.inc(method="upload")
+        c.inc(2.5, method="upload")
+        c.inc(method="info")
+        g = reg.gauge("round")
+        g.set(7)
+        g.inc(); g.dec(2)
+        h = reg.histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+        h.observe(0.05)
+        h.observe(50.0)
+        snap = reg.snapshot()
+        json.dumps(snap)                    # JSON-able end to end
+        m = snap["metrics"]
+        by_label = {s["labels"]["method"]: s["value"]
+                    for s in m["reqs_total"]["samples"]}
+        assert by_label == {"upload": 3.5, "info": 1.0}
+        assert m["round"]["samples"][0]["value"] == 6.0
+        hs = m["lat"]["samples"][0]
+        assert hs["count"] == 2 and hs["sum"] == pytest.approx(50.05)
+        # buckets are CUMULATIVE (Prometheus convention): +Inf == count
+        assert hs["buckets"]["+Inf"] == 2
+        assert hs["buckets"]["0.1"] == 1
+
+    def test_timer_context_manager(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("dur", "", ("k",))
+        with h.time(k="a"):
+            time.sleep(0.01)
+        s = reg.snapshot()["metrics"]["dur"]["samples"][0]
+        assert s["count"] == 1 and s["sum"] >= 0.008
+
+    def test_bounded_cardinality_folds_to_overflow(self):
+        reg = MetricsRegistry(enabled=True)
+        c = reg.counter("x", "", ("k",))
+        for i in range(300):
+            c.inc(k=str(i))
+        snap = reg.snapshot()
+        samples = snap["metrics"]["x"]["samples"]
+        assert len(samples) <= reg.max_series_per_metric + 1
+        assert snap["series_dropped"] > 0
+        overflow = [s for s in samples
+                    if s["labels"].get("overflow") == "true"]
+        assert overflow and overflow[0]["value"] > 0
+
+    def test_disabled_registry_is_noop(self):
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("y")
+        h = reg.histogram("z")
+        c.inc()
+        h.observe(1.0)
+        with h.time():
+            pass
+        snap = reg.snapshot()
+        assert snap["metrics"]["y"]["samples"] == []
+        assert snap["metrics"]["z"]["samples"] == []
+
+    def test_redeclaration_idempotent_but_conflicts_raise(self):
+        reg = MetricsRegistry(enabled=True)
+        a = reg.counter("n", "h", ("k",))
+        assert reg.counter("n", "h", ("k",)) is a
+        with pytest.raises(ValueError):
+            reg.gauge("n")
+        with pytest.raises(ValueError):
+            reg.counter("n", "h", ("other",))
+
+    def test_snapshot_absorbs_tracer_costs(self):
+        reg = MetricsRegistry(enabled=True)
+        saved = tracing.PROC.enabled
+        tracing.PROC.enabled = True
+        try:
+            tracing.PROC.charge("test.category_s", 1.25)
+            snap = reg.snapshot()
+            assert snap["trace_costs"]["test.category_s"] == 1.25
+        finally:
+            tracing.PROC.enabled = saved
+            with tracing.PROC._lock:
+                tracing.PROC.costs.pop("test.category_s", None)
+
+    def test_prometheus_text_format(self):
+        reg = MetricsRegistry(enabled=True, role="writer")
+        reg.counter("ops_total", "ops", ("kind",)).inc(3, kind="up")
+        reg.histogram("lat", "", buckets=(0.1,)).observe(0.05)
+        text = to_prometheus([reg.snapshot()])
+        assert '# TYPE bflc_ops_total counter' in text
+        assert 'bflc_ops_total{kind="up",role="writer"} 3.0' in text
+        assert 'bflc_lat_bucket{le="0.1",role="writer"} 1' in text
+        assert 'bflc_lat_count{role="writer"} 1' in text
+
+
+class TestTracerThreadLocalSpans:
+    """Satellite regression: `Tracer.span` used to share ONE name stack
+    across threads (utils/tracing.py documented the hazard) — two
+    threads nesting spans interleaved their path prefixes.  The stack is
+    now thread-local: every span path must be built from its own
+    thread's ancestry only."""
+
+    def test_two_threads_produce_uncrossed_span_paths(self):
+        tr = tracing.Tracer(enabled=True)
+        start = threading.Barrier(2)
+
+        def worker(name):
+            start.wait()
+            for _ in range(50):
+                with tr.span(f"outer-{name}"):
+                    with tr.span(f"inner-{name}"):
+                        time.sleep(0)       # force interleaving
+
+        ts = [threading.Thread(target=worker, args=(n,))
+              for n in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        paths = {e["name"] for e in tr.events if e["type"] == "span"}
+        assert paths == {"outer-a", "outer-a/inner-a",
+                         "outer-b", "outer-b/inner-b"}, paths
+
+    def test_nested_path_still_builds_within_one_thread(self):
+        tr = tracing.Tracer(enabled=True)
+        with tr.span("a"):
+            with tr.span("b"):
+                tr.event("e")
+        names = [e["name"] for e in tr.events]
+        assert "a/b/e" in names and "a/b" in names and "a" in names
+
+
+class TestFlightRecorder:
+    def test_sigkill_leaves_parseable_dump(self, tmp_path):
+        """The chaos contract: a SIGKILLed role's flight file exists and
+        parses (periodic flush + atomic rename — no torn files)."""
+        code = textwrap.dedent(f"""
+            import time
+            from bflc_demo_tpu import obs
+            from bflc_demo_tpu.obs import flight
+            obs.install_process_telemetry(
+                "victim", {str(tmp_path)!r}, interval_s=0.1)
+            for i in range(10_000):
+                flight.FLIGHT.record("event", "tick", i=i)
+                time.sleep(0.01)
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen([sys.executable, "-c", code], env=env,
+                             cwd=os.path.dirname(os.path.dirname(
+                                 os.path.abspath(__file__))))
+        path = tmp_path / "victim.flight.jsonl"
+        deadline = time.monotonic() + 30.0
+        # wait until the victim demonstrably recorded some ticks
+        while time.monotonic() < deadline:
+            try:
+                if len(load_flight(str(path))["events"]) >= 3:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+        dump = load_flight(str(path))
+        assert dump["header"]["role"] == "victim"
+        ticks = [e for e in dump["events"] if e["name"] == "tick"]
+        assert len(ticks) >= 3
+        # the metrics snapshot file was published too
+        snap = read_snapshot_file(str(tmp_path / "victim.metrics.json"))
+        assert snap is not None and snap["role"] == "victim"
+
+    def test_ring_is_bounded_and_flush_atomic(self, tmp_path):
+        rec = FlightRecorder(capacity=16)
+        rec.enabled = True
+        rec.path = str(tmp_path / "r.flight.jsonl")
+        for i in range(100):
+            rec.record("event", "e", i=i)
+        assert rec.flush("test")
+        dump = load_flight(rec.path)
+        assert dump["header"]["reason"] == "test"
+        assert len(dump["events"]) == 16
+        assert dump["events"][-1]["i"] == 99      # newest survives
+
+    def test_load_flight_rejects_headerless_garbage(self, tmp_path):
+        p = tmp_path / "bad.flight.jsonl"
+        p.write_text('{"no": "header"}\n')
+        with pytest.raises(ValueError):
+            load_flight(str(p))
+
+
+def _mini_control_plane(n_clients=4, validators=4):
+    """Writer + validator fleet, thread-served in this process, one
+    complete protocol round driven through the socket (the
+    profile_round topology, shrunk)."""
+    import hashlib
+    import struct
+
+    from bflc_demo_tpu.comm.bft import ValidatorNode, provision_validators
+    from bflc_demo_tpu.comm.identity import _op_bytes, provision_wallets
+    from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                   LedgerServer)
+    from bflc_demo_tpu.utils.serialization import pack_pytree
+
+    cfg = ProtocolConfig(client_num=n_clients, comm_count=2,
+                         aggregate_count=2, needed_update_count=2,
+                         learning_rate=0.05, batch_size=16)
+    wallets, _ = provision_wallets(n_clients, b"obs-test-seed-000001")
+    vwallets, vkeys = provision_validators(validators,
+                                           b"obs-test-validators-01")
+    blob0 = pack_pytree({"W": np.zeros((5, 2), np.float32)})
+    nodes = [ValidatorNode(cfg, w, i, validator_keys=vkeys)
+             for i, w in enumerate(vwallets)]
+    for v in nodes:
+        v.start()
+    server = LedgerServer(cfg, blob0,
+                          bft_validators=[(v.host, v.port)
+                                          for v in nodes],
+                          bft_keys=vkeys)
+    server.start()
+    client = CoordinatorClient(server.host, server.port)
+
+    def sign(w, kind, epoch, payload):
+        return w.sign(_op_bytes(kind, w.address, epoch, payload)).hex()
+
+    for w in wallets:
+        r = client.request("register", addr=w.address,
+                           pubkey=w.public_bytes.hex(),
+                           tag=sign(w, "register", 0, b""))
+        assert r["ok"], r
+    committee = set(client.request("committee")["committee"])
+    trainers = [w for w in wallets if w.address not in committee]
+    for i, w in enumerate(trainers[:2]):
+        blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
+                                         np.float32)})
+        digest = hashlib.sha256(blob).digest()
+        payload = digest + struct.pack("<qd", 10 + i, 1.0)
+        r = client.request("upload", addr=w.address, blob=blob,
+                           hash=digest.hex(), n=10 + i, cost=1.0,
+                           epoch=0, tag=sign(w, "upload", 0, payload))
+        assert r["ok"], r
+    return cfg, server, nodes, client
+
+
+@pytest.fixture
+def enabled_registry():
+    """Flip the process registry on for the test, restore after (it is
+    process-global state)."""
+    saved_enabled = obs_metrics.REGISTRY.enabled
+    saved_role = obs_metrics.REGISTRY.role
+    obs_metrics.REGISTRY.enabled = True
+    try:
+        yield obs_metrics.REGISTRY
+    finally:
+        obs_metrics.REGISTRY.enabled = saved_enabled
+        obs_metrics.REGISTRY.role = saved_role
+
+
+class TestTelemetryRPCAndCollector:
+    def test_scrape_all_roles_jsonl_prom_and_fleet_top(
+            self, tmp_path, enabled_registry):
+        cfg, server, nodes, client = _mini_control_plane()
+        try:
+            jsonl = str(tmp_path / "metrics.jsonl")
+            # a file-published role rides the same scrape (what clients
+            # and standbys do in the process federation)
+            fpath = str(tmp_path / "client-x.metrics.json")
+            assert publish_snapshot(fpath)
+            coll = FleetCollector(
+                {"writer": (server.host, server.port),
+                 **{f"validator-{i}": (v.host, v.port)
+                    for i, v in enumerate(nodes)}},
+                {"client-x": fpath}, jsonl_path=jsonl)
+            coll.note("round_commit", epoch=0)
+            rec = coll.scrape(tag="round-0")
+            assert rec["coverage"]["answered"] == 6
+            assert rec["coverage"]["missing"] == []
+            wsnap = rec["roles"]["writer"]
+            # writer gauges sampled at scrape time
+            names = set(wsnap["metrics"])
+            assert {"round", "uncertified_backlog",
+                    "rpc_latency_seconds"} <= names
+            # validators answered with their own metrics + role
+            vsnap = rec["roles"]["validator-0"]
+            assert "vote_latency_seconds" in vsnap["metrics"]
+            # tracer costs absorbed into the snapshot
+            assert isinstance(wsnap["trace_costs"], dict)
+
+            # artifacts: jsonl timeline + Prometheus dump
+            prom = str(tmp_path / "metrics.prom")
+            assert coll.write_prometheus(prom)
+            text = open(prom).read()
+            assert "bflc_rpc_latency_seconds" in text
+            assert 'role="writer"' in text
+            tl = load_timeline(jsonl)
+            assert [r["type"] for r in tl] == ["note", "scrape"]
+
+            # fleet_top renders both views without raising
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))), "tools"))
+            try:
+                import fleet_top
+            finally:
+                sys.path.pop(0)
+            once = fleet_top.render_once(tl)
+            assert "writer" in once and "validator-0" in once
+            timeline = fleet_top.render_timeline(tl)
+            assert "round_commit" in timeline
+        finally:
+            client.close()
+            server.close()
+            for v in nodes:
+                v.close()
+
+    def test_wire_frame_mix_counted(self, tmp_path, enabled_registry):
+        cfg, server, nodes, client = _mini_control_plane()
+        try:
+            coll = FleetCollector({"writer": (server.host, server.port)})
+            rec = coll.scrape()
+            frames = rec["roles"]["writer"]["metrics"][
+                "wire_frames_total"]["samples"]
+            kinds = {(s["labels"]["dir"], s["labels"]["kind"]):
+                     s["value"] for s in frames}
+            # uploads carried binary blob frames; control replies are json
+            assert kinds.get(("in", "bin"), 0) >= 1
+            assert kinds.get(("out", "json"), 0) >= 1
+        finally:
+            client.close()
+            server.close()
+            for v in nodes:
+                v.close()
+
+
+class TestObserveFaultTimestamps:
+    def test_schedule_relative_t_cannot_clobber_wall_clock(self,
+                                                           tmp_path):
+        """A chaos FaultEvent's 't' is seconds-from-campaign-t0; the
+        timeline record's 't' must stay wall-clock or every fault sorts
+        to the dawn of the merged timeline (review finding)."""
+        jsonl = str(tmp_path / "m.jsonl")
+        coll = FleetCollector({}, jsonl_path=jsonl)
+        coll.observe_fault({"t": 6.0, "kind": "kill",
+                            "target": "writer", "executed": True})
+        coll.note("round_commit", epoch=0)
+        recs = load_timeline(jsonl)
+        fault, note = recs[0], recs[1]
+        assert fault["t_sched"] == 6.0
+        assert fault["t"] > 1e9                 # wall clock, not 6.0
+        assert abs(fault["t"] - note["t"]) < 60.0
+
+
+class TestCollectorUnderFaults:
+    def test_partial_scrape_with_drops_delays_and_a_kill(
+            self, tmp_path, enabled_registry):
+        """Satellite drill: scrape while the chaos injector drops/delays
+        frames to one validator, then kill another validator mid-scrape
+        — every scrape must return (partial), never raise."""
+        from bflc_demo_tpu.chaos.hooks import install_injector
+        from bflc_demo_tpu.comm import wire
+
+        cfg, server, nodes, client = _mini_control_plane()
+        jsonl = str(tmp_path / "metrics.jsonl")
+        try:
+            coll = FleetCollector(
+                {"writer": (server.host, server.port),
+                 **{f"validator-{i}": (v.host, v.port)
+                    for i, v in enumerate(nodes)}},
+                # an expected-but-absent file role degrades too
+                {"client-ghost": str(tmp_path / "nope.metrics.json")},
+                jsonl_path=jsonl, timeout_s=2.0)
+            # injector in THIS process, scoped to validator-0's port:
+            # the collector's own frames to it are dropped; delay
+            # windows cover validator-1 (slow but answering)
+            install_injector({
+                "t0": time.time(), "role": "collector", "seed": 1,
+                "windows": [
+                    {"start": -1.0, "end": 600.0, "mode": "drop",
+                     "ports": [nodes[0].port], "p": 1.0, "delay_ms": 0},
+                    {"start": -1.0, "end": 600.0, "mode": "delay",
+                     "ports": [nodes[1].port], "p": 1.0,
+                     "delay_ms": 20.0},
+                ]})
+            try:
+                rec = coll.scrape(tag="under-fire")
+                assert "validator-0" in rec["coverage"]["missing"]
+                assert "client-ghost" in rec["coverage"]["missing"]
+                assert "validator-1" in rec["roles"]    # delayed, alive
+                assert "writer" in rec["roles"]
+                # kill validator-2 between scrapes ("mid-scrape" from
+                # the fleet's perspective) — next scrape stays partial
+                nodes[2].close()
+                rec2 = coll.scrape(tag="after-kill")
+                assert "validator-2" in rec2["coverage"]["missing"]
+                assert "writer" in rec2["roles"]
+            finally:
+                install_injector(None)
+                wire.set_fault_injector(None)
+            # the artifact recorded both partial scrapes
+            tl = load_timeline(jsonl)
+            assert [r["tag"] for r in tl if r["type"] == "scrape"] == \
+                ["under-fire", "after-kill"]
+            report = coll.coverage_report()
+            assert 0.0 < report["coverage"] < 1.0
+        finally:
+            client.close()
+            server.close()
+            for v in nodes:
+                v.close()

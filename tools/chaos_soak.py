@@ -52,6 +52,13 @@ def main(argv=None) -> int:
                    help="artifact path (default chaos_soak_<seed>.json)")
     p.add_argument("--wal", default="", help="WAL path (enables the "
                    "torn-write faults); default: a temp file")
+    p.add_argument("--telemetry-dir", default="",
+                   help="fleet telemetry dir (metrics.jsonl timeline + "
+                        "per-role flight dumps; default "
+                        "<out>.telemetry/); render the post-mortem with "
+                        "tools/fleet_top.py --timeline")
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="disable the telemetry plane")
     p.add_argument("--verbose", action="store_true", default=True)
     p.add_argument("--quiet", dest="verbose", action="store_false")
     args = p.parse_args(argv)
@@ -80,6 +87,10 @@ def main(argv=None) -> int:
         wal = os.path.join(tempfile.mkdtemp(prefix="bflc-soak-"),
                            "writer.wal")
 
+    out = args.out or f"chaos_soak_{args.seed}.json"
+    telemetry_dir = "" if args.no_telemetry else (
+        args.telemetry_dir or out + ".telemetry")
+
     t0 = time.time()
     failure = ""
     res = None
@@ -93,6 +104,7 @@ def main(argv=None) -> int:
             timeout_s=args.timeout,
             chaos_seed=args.seed, chaos_profile=args.profile,
             chaos_duration_s=(args.duration or None),
+            telemetry_dir=telemetry_dir,
             verbose=args.verbose)
     except Exception as e:              # noqa: BLE001 — the artifact must
         # record the failure mode; triage replays by seed
@@ -115,16 +127,20 @@ def main(argv=None) -> int:
         "best_accuracy": round(res.best_accuracy(), 4) if res else 0.0,
         "min_acc_bar": args.min_acc,
         "chaos": report,
+        "telemetry": (res.telemetry_report
+                      if res is not None else None),
     }
     ok = (not failure and not violations and final_acc >= args.min_acc)
     artifact["verdict"] = "PASS" if ok else "FAIL"
 
-    out = args.out or f"chaos_soak_{args.seed}.json"
     with open(out, "w") as fh:
         json.dump(artifact, fh, indent=2)
     print(json.dumps({k: v for k, v in artifact.items()
                       if k not in ("chaos",)}, indent=2))
     print(f"artifact -> {out}")
+    if telemetry_dir:
+        print(f"telemetry -> {telemetry_dir} (post-mortem: python "
+              f"tools/fleet_top.py {telemetry_dir} --timeline)")
     if violations:
         print("INVARIANT VIOLATIONS:", *violations, sep="\n  ")
     return 0 if ok else 1

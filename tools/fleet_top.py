@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""fleet_top: render a telemetry run artifact (obs.collector timeline).
+
+Three modes over a `metrics.jsonl` written by the FleetCollector (a
+federation run with `telemetry_dir=...`, `tools/chaos_soak.py`, or the
+federation benchmark):
+
+    --once      one per-role table from the newest scrape, then exit;
+    --timeline  the post-mortem: fault events interleaved with each
+                scrape's key samples on one time-ordered stream (the
+                fault -> metric causality view);
+    (default)   live top: follow the file and re-render every --refresh
+                seconds until interrupted.
+
+Usage:
+    python tools/fleet_top.py <metrics.jsonl> [--once | --timeline]
+    python tools/fleet_top.py <telemetry_dir>        # finds metrics.jsonl
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bflc_demo_tpu.obs.collector import load_timeline  # noqa: E402
+
+
+def _hist_stats(sample):
+    """(count, mean, p50-ish) from one cumulative-bucket hist sample."""
+    count = sample.get("count", 0)
+    if not count:
+        return 0, 0.0, 0.0
+    mean = sample.get("sum", 0.0) / count
+    p50 = 0.0
+    half = count / 2.0
+    for le, cum in sample.get("buckets", {}).items():
+        if cum >= half:
+            p50 = float("inf") if le == "+Inf" else float(le)
+            break
+    return count, mean, p50
+
+
+def _metric(snapshot, name):
+    return ((snapshot.get("metrics") or {}).get(name) or {}).get(
+        "samples", [])
+
+
+def _gauge_value(snapshot, name, default=None):
+    s = _metric(snapshot, name)
+    return s[0]["value"] if s else default
+
+
+def _sum_counter(snapshot, name, **want):
+    total = 0.0
+    for s in _metric(snapshot, name):
+        lab = s.get("labels", {})
+        if all(lab.get(k) == v for k, v in want.items()):
+            total += s.get("value", 0.0)
+    return total
+
+
+def _merged_hist(snapshot, name, **want):
+    count, tot = 0, 0.0
+    for s in _metric(snapshot, name):
+        lab = s.get("labels", {})
+        if all(lab.get(k) == v for k, v in want.items()):
+            count += s.get("count", 0)
+            tot += s.get("sum", 0.0)
+    return count, (tot / count if count else 0.0)
+
+
+def _role_row(role, snap):
+    """One table row: the per-role-class key numbers."""
+    costs = snap.get("trace_costs") or {}
+    cells = [f"{role:<14}"]
+    if role.startswith("client"):
+        n_tr, m_tr = _merged_hist(snap, "client_phase_seconds",
+                                  phase="train")
+        n_up, m_up = _merged_hist(snap, "client_phase_seconds",
+                                  phase="upload")
+        n_sc, m_sc = _merged_hist(snap, "client_phase_seconds",
+                                  phase="score")
+        cells.append(f"train {n_tr}x{m_tr * 1e3:6.0f}ms  "
+                     f"upload {n_up}x{m_up * 1e3:6.0f}ms  "
+                     f"score {n_sc}x{m_sc * 1e3:6.0f}ms")
+    elif role.startswith("validator"):
+        n_b, m_b = _merged_hist(snap, "vote_latency_seconds",
+                                kind="batch")
+        n_s, m_s = _merged_hist(snap, "vote_latency_seconds",
+                                kind="single")
+        rep = _sum_counter(snap, "repair_events_total")
+        ab = _sum_counter(snap, "abandon_events_total")
+        log = _gauge_value(snap, "validator_log_size", 0)
+        cells.append(f"log {int(log):>5}  votes {n_b}b/{n_s}s "
+                     f"({m_b * 1e3:.1f}/{m_s * 1e3:.1f}ms)  "
+                     f"repairs {rep:.0f}  abandons {ab:.0f}")
+    elif role.startswith("standby"):
+        applied = _gauge_value(snap, "standby_applied_ops", 0)
+        lag = _gauge_value(snap, "standby_ack_lag_ops", 0)
+        n_m, m_m = _merged_hist(snap, "standby_mirror_latency_seconds")
+        promos = _sum_counter(snap, "standby_promotions_total")
+        cells.append(f"applied {int(applied):>5}  ack-lag {int(lag)}  "
+                     f"mirror {n_m}x{m_m * 1e3:.1f}ms  "
+                     f"promotions {promos:.0f}")
+    else:                               # writer / executor
+        rnd = _gauge_value(snap, "round", 0)
+        backlog = _gauge_value(snap, "uncertified_backlog", 0)
+        n_c, m_c = _merged_hist(snap, "certify_latency_seconds")
+        n_bt, m_bt = _merged_hist(snap, "cert_batch_size")
+        cells.append(f"round {int(rnd):>3}  backlog {int(backlog):>3}  "
+                     f"certify {n_c}x{m_c * 1e3:6.1f}ms  "
+                     f"batch-mean {m_bt:4.1f}")
+    wire_in = costs.get("wire.bytes_in", 0)
+    wire_out = costs.get("wire.bytes_out", 0)
+    if wire_in or wire_out:
+        cells.append(f"wire {wire_in / 1e6:6.2f}/{wire_out / 1e6:6.2f} MB")
+    bin_n = _sum_counter(snap, "wire_frames_total", kind="bin")
+    json_n = _sum_counter(snap, "wire_frames_total", kind="json")
+    if bin_n or json_n:
+        cells.append(f"frames {bin_n:.0f}bin/{json_n:.0f}json")
+    return "  ".join(cells)
+
+
+def render_once(timeline) -> str:
+    scrapes = [r for r in timeline if r.get("type") == "scrape"]
+    if not scrapes:
+        return "no scrapes in timeline (telemetry disabled or empty run)"
+    last = scrapes[-1]
+    cov = last.get("coverage", {})
+    lines = [f"scrape tag={last.get('tag')}  "
+             f"coverage {cov.get('answered')}/{cov.get('expected')}"
+             + (f"  missing: {', '.join(cov['missing'])}"
+                if cov.get("missing") else "")]
+    for role in sorted(last.get("roles", {})):
+        lines.append(_role_row(role, last["roles"][role]))
+    return "\n".join(lines)
+
+
+def _scrape_digest(rec) -> str:
+    """One compressed line per scrape for the timeline view."""
+    bits = []
+    roles = rec.get("roles", {})
+    w = roles.get("writer")
+    if w:
+        bits.append(f"round={int(_gauge_value(w, 'round', 0))} "
+                    f"backlog={int(_gauge_value(w, 'uncertified_backlog', 0))}")
+        n_c, m_c = _merged_hist(w, "certify_latency_seconds")
+        if n_c:
+            bits.append(f"certify~{m_c * 1e3:.0f}ms x{n_c}")
+    for role in sorted(roles):
+        if role.startswith("standby"):
+            lag = _gauge_value(roles[role], "standby_ack_lag_ops", 0)
+            promos = _sum_counter(roles[role],
+                                  "standby_promotions_total")
+            if lag or promos:
+                bits.append(f"{role}: lag={int(lag)} "
+                            f"promos={promos:.0f}")
+        if role.startswith("validator"):
+            rep = _sum_counter(roles[role], "repair_events_total")
+            if rep:
+                bits.append(f"{role}: repairs={rep:.0f}")
+    cov = rec.get("coverage", {})
+    if cov.get("missing"):
+        bits.append(f"dark: {','.join(cov['missing'])}")
+    return "  ".join(bits) or "(quiet)"
+
+
+def render_timeline(timeline) -> str:
+    recs = [r for r in timeline
+            if r.get("type") in ("scrape", "fault", "note")]
+    if not recs:
+        return "empty timeline"
+    t0 = min(r.get("t", 0.0) for r in recs)
+    lines = []
+    for r in sorted(recs, key=lambda r: r.get("t", 0.0)):
+        dt = r.get("t", 0.0) - t0
+        if r["type"] == "fault":
+            what = (f"{r.get('kind', '?')} {r.get('target', '')}"
+                    f"{'' if r.get('executed', True) else ' (skipped)'}")
+            lines.append(f"+{dt:7.1f}s  FAULT   {what.strip()}")
+        elif r["type"] == "note":
+            extras = {k: v for k, v in r.items()
+                      if k not in ("type", "t", "name")}
+            lines.append(f"+{dt:7.1f}s  note    {r.get('name')} "
+                         + " ".join(f"{k}={v}" for k, v in
+                                    sorted(extras.items())))
+        else:
+            lines.append(f"+{dt:7.1f}s  scrape  "
+                         f"[{r.get('tag')}] {_scrape_digest(r)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="metrics.jsonl (or its directory)")
+    ap.add_argument("--once", action="store_true",
+                    help="render the latest scrape and exit")
+    ap.add_argument("--timeline", action="store_true",
+                    help="render the fault/metric post-mortem timeline")
+    ap.add_argument("--refresh", type=float, default=2.0,
+                    help="live-mode refresh period (seconds)")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    if not os.path.exists(path):
+        print(f"no such artifact: {path}", file=sys.stderr)
+        return 2
+
+    if args.timeline:
+        print(render_timeline(load_timeline(path)))
+        return 0
+    if args.once:
+        print(render_once(load_timeline(path)))
+        return 0
+    try:
+        while True:
+            out = render_once(load_timeline(path))
+            sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
+                             else "")
+            print(time.strftime("%H:%M:%S"), "—", path)
+            print(out, flush=True)
+            time.sleep(args.refresh)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,10 +2,13 @@
 
 Future perf PRs should start from data, not vibes: this tool stands up the
 full BFT control plane IN ONE PROCESS (writer + 4 commit validators,
-thread-served, exactly the tests' topology), enables the process tracer
-(utils.tracing.PROC), drives a complete config-1-shaped protocol round
-through the real socket path — register, uploads, committee scores,
-aggregation, certification — and prints where the time went:
+thread-served, exactly the tests' topology), arms the telemetry plane
+(obs.metrics + utils.tracing.PROC), drives a complete config-1-shaped
+protocol round through the real socket path — register, uploads,
+committee scores, aggregation, certification — and prints where the time
+went.  Since PR 4 the numbers arrive the way every fleet consumer gets
+them: a FleetCollector scrape of the `telemetry` wire RPC (the snapshot
+carries the tracer's cost categories), not bespoke in-process reads:
 
     wire      frame send/recv on every socket hop
     crypto    Ed25519 sign/verify (the one chokepoint, comm.identity)
@@ -59,6 +62,8 @@ def main() -> None:
                                              provision_wallets)
     from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                    LedgerServer)
+    from bflc_demo_tpu.obs import metrics as obs_metrics
+    from bflc_demo_tpu.obs.collector import FleetCollector
     from bflc_demo_tpu.protocol.constants import ProtocolConfig
     from bflc_demo_tpu.utils import tracing
     from bflc_demo_tpu.utils.serialization import pack_pytree
@@ -76,6 +81,8 @@ def main() -> None:
 
     tracing.PROC.enabled = True
     tracing.PROC.reset()
+    obs_metrics.REGISTRY.enabled = True
+    obs_metrics.REGISTRY.role = "profile"
     nodes = [ValidatorNode(cfg, w, i, validator_keys=vkeys)
              for i, w in enumerate(vwallets)]
     for v in nodes:
@@ -120,12 +127,26 @@ def main() -> None:
     assert info["epoch"] == 1, info
     wall = time.perf_counter() - t_round
 
+    # the numbers ride the fleet path: one FleetCollector scrape of the
+    # telemetry RPC (writer + every validator answer the same surface
+    # the process-federation driver scrapes each round).  All roles
+    # share this process, so the writer snapshot's trace_costs holds the
+    # whole round's attribution — same data the old in-process read gave.
+    coll = FleetCollector(
+        {"writer": (server.host, server.port),
+         **{f"validator-{i}": (v.host, v.port)
+            for i, v in enumerate(nodes)}})
+    scrape = coll.scrape(tag="profile_round")
+    answered = scrape["coverage"]["answered"]
+    expected = scrape["coverage"]["expected"]
+    writer_snap = scrape["roles"].get("writer") or {}
+
     client.close()
     server.close()
     for v in nodes:
         v.close()
 
-    costs = dict(tracing.PROC.costs)
+    costs = dict(writer_snap.get("trace_costs") or tracing.PROC.costs)
     phases = {
         "wire": costs.get("wire.send_s", 0) + costs.get("wire.recv_s", 0),
         "crypto": costs.get("crypto.sign_s", 0)
@@ -142,6 +163,7 @@ def main() -> None:
     print(f"round wall time: {wall * 1e3:9.1f} ms   "
           f"(log={info['log_size']} ops, "
           f"certified={info['certified_size']})")
+    print(f"telemetry scrape: {answered}/{expected} roles answered")
     print(f"{'phase':<10} {'time_ms':>9}  {'share':>6}  notes")
     for name, sec in sorted(phases.items(), key=lambda kv: -kv[1]):
         note = ""
