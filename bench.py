@@ -176,6 +176,28 @@ def _child() -> None:
         # answering / roles expected across the federation run's
         # per-round scrapes (the federation leg runs telemetry-armed)
         extra["telemetry"] = extra["federation"]["fast"].get("telemetry")
+        # data-plane axes (PR 5): coordinator egress bytes/round,
+        # read-source shares, cache hit ratio, compression ratio and
+        # the quantized-delta accuracy gap, vs a
+        # BFLC_DATA_PLANE_LEGACY=1 child fleet
+        from bflc_demo_tpu.eval.benchmarks import data_plane_config1
+        dp = data_plane_config1(rounds=2)
+        extra["data_plane"] = {
+            "egress_reduction_x": dp.get("egress_reduction_x"),
+            "round_time_speedup": dp.get("round_time_speedup"),
+            "wire_transparent": dp.get("wire_transparent"),
+            "egress_bytes_per_round": dp["fast"][
+                "writer_egress_bytes_per_round"],
+            "legacy_egress_bytes_per_round": (
+                dp.get("pre_pr_legacy", {}).get(
+                    "writer_egress_bytes_per_round")),
+            "read_source_share": dp["fast"]["read_source_share"],
+            "cache_hit_ratio": dp["fast"]["cache_hit_ratio"],
+            "compression_ratio": dp["fast"]["compression_ratio"],
+            "quantized_acc_gap": dp.get("quantized_acc_gap"),
+            "quantized_delta_dtype": dp.get("quantized_leg", {}).get(
+                "delta_dtype"),
+        }
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),
         # measurable on CPU with no tunnel: one 50-round config-1 campaign

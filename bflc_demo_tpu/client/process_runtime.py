@@ -208,11 +208,14 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     import jax.numpy as jnp
 
     import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.comm.dataplane import ReadRouter
     from bflc_demo_tpu.comm.failover import FailoverClient
     from bflc_demo_tpu.comm.identity import Wallet
     from bflc_demo_tpu.core.local_train import local_train
     from bflc_demo_tpu.core.scoring import score_candidates
-    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+    from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+                                                   pack_pytree,
+                                                   pack_quantized,
                                                    unpack_pytree,
                                                    restore_pytree)
 
@@ -226,6 +229,11 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                             tls=_client_tls(tls_dir),
                             standby_keys=standby_keys,
                             bft_keys=bft_keys)
+    # data-plane fast path (comm.dataplane): content-addressed LRU cache
+    # + replica read fan-out for model/blob bytes; every read is
+    # hash-verified and the coordinator stays the correctness fallback
+    router = ReadRouter(client, timeout_s=request_timeout_s,
+                        tls=_client_tls(tls_dir))
     reg_deadline = time.monotonic() + 120.0
     while True:
         reply = client.request("register", addr=wallet.address,
@@ -258,18 +266,23 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             continue
         acted = False
         if st["role"] == "trainer" and epoch > trained_epoch:
-            mr = client.request("model")
-            if mr["epoch"] != epoch:
+            with _M_PHASE.time(phase="fetch"):
+                mr = router.fetch_model()
+            if not mr.get("ok") or mr["epoch"] != epoch:
                 continue        # round turned over mid-step; resync
-            params = restore_pytree(
-                template, unpack_pytree(blob_bytes(mr["blob"])))
+            params = restore_pytree(template, unpack_pytree(mr["blob"]))
             with _M_PHASE.time(phase="train"):
                 delta, cost = local_train(
                     model.apply, params, xj, yj, lr=cfg.learning_rate,
                     batch_size=cfg.batch_size,
                     local_epochs=cfg.local_epochs)
-            blob = pack_pytree(delta)
+            # opt-in quantized upload (utils.serialization): the blob —
+            # and therefore the hash this client SIGNS and the quorum
+            # certifies — is the quantized canonical bytes
+            blob = (pack_pytree(delta) if cfg.delta_dtype == "f32"
+                    else pack_quantized(delta, cfg.delta_dtype))
             digest = hashlib.sha256(blob).digest()
+            router.cache.put(digest.hex(), blob)
             n = int(x.shape[0])
             payload = digest + struct.pack("<qd", n, float(cost))
             with _M_PHASE.time(phase="upload"):
@@ -307,22 +320,22 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                        if obs_metrics.REGISTRY.enabled else 0.0)
             if ups:
                 import jax
-                from bflc_demo_tpu.comm.wire import split_blob_parts
-                # one batched fetch for the round's candidate deltas
-                # (hash-verified per part; falls back per-hash for
-                # anything the reply omits or garbles)
-                br = client.request("blobs",
-                                    hashes=[u["hash"] for u in ups])
-                fetched = split_blob_parts(br) if br.get("ok") else {}
-                deltas = []
-                for u in ups:
-                    b = fetched.get(u["hash"]) or blob_bytes(
-                        client.request("blob", hash=u["hash"])["blob"])
-                    deltas.append(restore_pytree(template,
-                                                 unpack_pytree(b)))
-                mr = client.request("model")
-                params = restore_pytree(
-                    template, unpack_pytree(blob_bytes(mr["blob"])))
+                # cache -> replica read set -> coordinator, every part
+                # hash-verified; a batched reply that omits/garbles a
+                # hash falls back per-hash and COUNTS the fallback
+                # (dataplane_blob_fallback_total — the silent-partial-
+                # batch fix)
+                fetched = router.fetch_blobs([u["hash"] for u in ups])
+                deltas = [restore_pytree(
+                              template,
+                              dequantize_entries(
+                                  unpack_pytree(fetched[u["hash"]])))
+                          for u in ups]
+                mr = router.fetch_model()
+                if not mr.get("ok"):
+                    continue
+                params = restore_pytree(template,
+                                        unpack_pytree(mr["blob"]))
                 stacked = jax.tree_util.tree_map(
                     lambda *t: jnp.stack(t), *deltas)
                 scores = score_candidates(model.apply, params, stacked,
@@ -351,6 +364,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
         if not acted:
             known_log = client.request("wait", log_size=known_log,
                                        timeout_s=2.0)["log_size"]
+    router.close()
     client.close()
 
 
@@ -749,6 +763,12 @@ def run_federated_processes(
                              tls=_client_tls(tls_dir),
                              standby_keys=standby_keys,
                              bft_keys=bft_keys or None)
+    from bflc_demo_tpu.comm.dataplane import ReadRouter
+    # the sponsor's per-commit model evaluation rides the same read
+    # fan-out as the clients (replica read sockets speak the same TLS
+    # as the coordinator when tls_dir is set)
+    sponsor_router = ReadRouter(sponsor, timeout_s=client_timeout_s,
+                                tls=_client_tls(tls_dir))
     history: List[Tuple[int, float]] = []
     epoch_times: List[Tuple[int, float]] = []
     seen_epoch = 0              # model at epoch 0 is the uncommitted init
@@ -767,11 +787,10 @@ def run_federated_processes(
             if campaign is not None:
                 campaign.tick(sponsor, info)
             if info["epoch"] > seen_epoch:
-                mr = sponsor.request("model")
-                if mr["epoch"] > seen_epoch:
+                mr = sponsor_router.fetch_model()
+                if mr.get("ok") and mr["epoch"] > seen_epoch:
                     params = restore_pytree(
-                        template,
-                        unpack_pytree(blob_bytes(mr["blob"])))
+                        template, unpack_pytree(mr["blob"]))
                     acc = float(evaluate(model.apply, params, xte_j, yte_j))
                     history.append((mr["epoch"] - 1, acc))
                     epoch_times.append((mr["epoch"] - 1,
@@ -845,6 +864,7 @@ def run_federated_processes(
                     raise RuntimeError("replica/writer head divergence")
             replica_report = reports[0]
     finally:
+        sponsor_router.close()
         sponsor.close()
         for i, p in enumerate(clients):
             p.join(timeout=15)
@@ -907,7 +927,8 @@ def _executor_proc(cfg_kw: dict, model_factory: str, factory_kw: dict,
 
 
 def attest_score_row(client, wallet, model, template, cfg,
-                     x_np: np.ndarray, y_np: np.ndarray, pa: dict) -> bool:
+                     x_np: np.ndarray, y_np: np.ndarray, pa: dict,
+                     router=None) -> bool:
     """Re-score a pending round's candidates on OUR shard; sign on match.
 
     Trust locality (reference main.py:196-228: committee members score on
@@ -926,22 +947,45 @@ def attest_score_row(client, wallet, model, template, cfg,
     from bflc_demo_tpu.comm.identity import _op_bytes
     from bflc_demo_tpu.core.scoring import score_candidates
     from bflc_demo_tpu.data.partition import one_hot
-    from bflc_demo_tpu.utils.serialization import (restore_pytree,
+    from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+                                                   restore_pytree,
                                                    unpack_pytree)
 
     epoch, s_pad = pa["epoch"], int(pa["s_pad"])
-    mr = client.request("model")
-    if mr["epoch"] != epoch:
+    # the global model rides the router too when one was provided (cache
+    # hit across the round's repeated attest polls); blob_bytes is an
+    # identity on the router's already-raw bytes
+    mr = (router.fetch_model() if router is not None
+          else client.request("model"))
+    if not mr.get("ok", True) or mr["epoch"] != epoch:
         return False                    # round turned over; re-poll
     gparams = restore_pytree(
         template, unpack_pytree(blob_bytes(mr["blob"])))
-    deltas = []
-    for h in pa["hashes"]:
-        br = client.request("blob", hash=h)
-        if not br.get("ok"):
-            return False
-        deltas.append(restore_pytree(
-            template, unpack_pytree(blob_bytes(br["blob"]))))
+    if router is not None:
+        # one batched, cached, hash-verified fetch for the round's K
+        # candidate-evidence blobs (comm.dataplane) instead of K
+        # round-trips against the executor's accept loop
+        try:
+            blobs = router.fetch_blobs(list(pa["hashes"]))
+        except (LookupError, ConnectionError):
+            return False                # round turned over; re-poll
+        # dequantize_entries: identity on f32 blobs, the ONE shared
+        # decode for opt-in quantized deltas — this attestation consumer
+        # must agree bit-for-bit with scorer/aggregator/admission
+        deltas = [restore_pytree(
+                      template,
+                      dequantize_entries(unpack_pytree(blobs[h])))
+                  for h in pa["hashes"]]
+    else:
+        deltas = []
+        for h in pa["hashes"]:
+            br = client.request("blob", hash=h)
+            if not br.get("ok"):
+                return False
+            deltas.append(restore_pytree(
+                template,
+                dequantize_entries(
+                    unpack_pytree(blob_bytes(br["blob"])))))
     stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *deltas)
     # reproduce the staging pad exactly via the SAME helpers the staging
     # plane uses (client/staging.cyc_pad / cast_features — a hand-rolled
@@ -1005,6 +1049,8 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
     wallet = Wallet.from_seed(wallet_seed)
     client = CoordinatorClient(host, port, timeout_s=120.0,
                                tls=_client_tls(tls_dir))
+    from bflc_demo_tpu.comm.dataplane import ReadRouter
+    thin_router = ReadRouter(client, tls=_client_tls(tls_dir))
     r = client.request("register", addr=wallet.address,
                        pubkey=wallet.public_bytes.hex(),
                        tag=_sign(wallet, "register", 0, b""))
@@ -1034,14 +1080,15 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
             pa = client.request("round_pending", addr=wallet.address)
             if pa.get("epoch") is not None:
                 attest_score_row(client, wallet, model, template, cfg,
-                                 x_np, y_np, pa)
+                                 x_np, y_np, pa, router=thin_router)
         # cheap "info" first: only fetch the (potentially multi-MB) model
-        # blob when a new epoch actually committed
+        # blob when a new epoch actually committed — and then through
+        # the router (cache + meta probe), not a raw full fetch
         if client.request("info")["epoch"] > seen:
-            mr = client.request("model")
-            if mr["epoch"] > seen:
+            mr = thin_router.fetch_model()
+            if mr.get("ok") and mr["epoch"] > seen:
                 params = restore_pytree(
-                    template, unpack_pytree(blob_bytes(mr["blob"])))
+                    template, unpack_pytree(mr["blob"]))
                 acc = float(evaluate(model.apply, params, xj, yj))
                 if not np.isfinite(acc):
                     raise RuntimeError("non-finite local accuracy")
@@ -1050,6 +1097,7 @@ def _thin_client_proc(host: str, port: int, wallet_seed: bytes,
             break
         known_log = client.request("wait", log_size=known_log,
                                    timeout_s=2.0)["log_size"]
+    thin_router.close()
     client.close()
 
 

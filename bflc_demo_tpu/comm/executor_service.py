@@ -71,6 +71,14 @@ class MeshExecutorServer(LedgerServer):
         y: int label blob, both packed pytrees {"x": ...}/{"y": ...});
         signed with kind="stage" over sha256(x_blob)+sha256(y_blob).
 
+    Data-plane reads (``blob``/``blobs``/``model`` — the attestation
+    evidence fetches and every thin client's per-epoch model poll) are
+    inherited from LedgerServer and therefore ride the ONE shared
+    hash-addressed dispatch (comm.dataplane.handle_read): batched blobs,
+    the ``model`` meta probe and client-side caching all work against
+    this executor exactly as against the coordinator or a standby read
+    replica.
+
     Once every registered client has staged, the runner thread executes
     `rounds` protocol rounds on the mesh, replaying each into the ledger
     (upload fingerprints, score rows, commit) — the mesh_runtime contract

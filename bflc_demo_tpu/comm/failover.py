@@ -75,6 +75,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from bflc_demo_tpu.comm.dataplane import ReadFanoutServer, data_plane_legacy
 from bflc_demo_tpu.comm.identity import PublicDirectory, address_of
 from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                LedgerServer)
@@ -419,12 +420,37 @@ class Standby:
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
+        # --- read fan-out (comm.dataplane): this standby already mirrors
+        # every payload blob before acking and the current model blob —
+        # serve them read-only on a side port, advertised to the writer
+        # at subscribe time, so clients take the O(N) model broadcast and
+        # the committee's delta fetches off the writer's accept loop.
+        # Everything served is hash-verified client-side, so a stale or
+        # confused replica costs a fallback round-trip, never wrong
+        # bytes.  Closed at promotion (the promoted LedgerServer serves
+        # everything on the real port).
+        self.read_server: Optional[ReadFanoutServer] = None
+        if not data_plane_legacy():
+            self.read_server = ReadFanoutServer(
+                self._blobs.get, self._read_model_state, host=host,
+                tls=tls_server)
+            self.read_server.start()
+
+    def _read_model_state(self):
+        """(epoch, hash, blob) of the mirrored model, or None before the
+        first mirror — the read fan-out server's model provider."""
+        blob = self._model_blob
+        if blob is None:
+            return None
+        return (self.ledger.epoch, hashlib.sha256(blob).digest(), blob)
 
     # ------------------------------------------------------------------ api
     def stop(self) -> None:
         self._stop.set()
         if self.server is not None:
             self.server.close()
+        if self.read_server is not None:
+            self.read_server.close()
         try:
             self._sock.close()
         except OSError:
@@ -502,6 +528,11 @@ class Standby:
                        "from": self.ledger.log_size()}
             if self.wallet is not None:
                 sub_msg["sb"] = self.index
+                if self.read_server is not None:
+                    # advertise the read fan-out endpoint; the writer
+                    # republishes it only if the handshake below proves
+                    # our provisioned identity (comm.ledger_service)
+                    sub_msg["read_ep"] = list(self.read_server.endpoint)
             send_msg(sub.sock, sub_msg)
             if self.wallet is not None:
                 # challenge-response: prove the standby identity so this
@@ -693,15 +724,30 @@ class Standby:
         self._certs[op_index] = cert_wire
 
     _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
+    _COMMIT_OPCODE = 4
 
     def _harvest_pushed_blob(self, msg: dict, op_bytes: bytes) -> None:
-        """Mirror an op-stream frame's piggybacked payload blob iff it
-        hashes to the op's recorded payload digest (see _follow)."""
+        """Mirror an op-stream frame's piggybacked blob iff it hashes to
+        the digest the op itself records (see _follow): an upload op's
+        payload, or a commit op's new MODEL blob (data-plane fast path —
+        the standby is then model-fresh the moment the commit applies,
+        with no fetch round-trip, and its read fan-out can serve the
+        round immediately)."""
         blob_field = msg.get("blob")
-        if blob_field is None or not op_bytes \
-                or op_bytes[0] != self._UPLOAD_OPCODE:
+        if blob_field is None or not op_bytes:
             return
         from bflc_demo_tpu.ledger.tool import decode_op
+        if op_bytes[0] == self._COMMIT_OPCODE:
+            try:
+                blob = blob_bytes(blob_field)
+                mh = bytes.fromhex(decode_op(op_bytes)["model_hash"])
+            except (KeyError, ValueError):
+                return
+            if hashlib.sha256(blob).digest() == mh:
+                self._model_blob = blob
+            return
+        if op_bytes[0] != self._UPLOAD_OPCODE:
+            return
         try:
             blob = blob_bytes(blob_field)
             ph = bytes.fromhex(decode_op(op_bytes)["payload_hash"])
@@ -978,6 +1024,13 @@ class Standby:
     def _promote_and_serve(self) -> None:
         if self._model_blob is None:
             raise RuntimeError("cannot promote: no model blob mirrored yet")
+        if self.read_server is not None:
+            # the promoted LedgerServer copies the blob store, so this
+            # side port would serve a frozen snapshot — close it; the
+            # writer's read set drops the endpoint when the subscription
+            # dies and clients fall back to the (new) coordinator
+            self.read_server.close()
+            self.read_server = None
         # the promotion FENCE: an op in the replicated chain itself.  Every
         # replica that replays this log knows generation N+1's writer; a
         # pre-partition writer still serving generation N self-demotes the

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bflc_demo_tpu.comm.dataplane import data_plane_legacy, handle_read
 from bflc_demo_tpu.comm.identity import (PublicDirectory, ReplayGuard,
                                          address_of, _op_bytes)
 from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
@@ -47,7 +48,8 @@ from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
-from bflc_demo_tpu.utils.serialization import unpack_pytree, pack_entries
+from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+                                               pack_entries, unpack_pytree)
 
 
 # --- admission-control gas (reference: CommitteePrecompiled.cpp:143,151,
@@ -286,6 +288,10 @@ class LedgerServer:
         self._sub_acked: Dict[object, int] = {}
         self._sub_sent: Dict[object, int] = {}
         self._sub_eligible: Dict[object, bool] = {}
+        # authenticated subscribers' advertised read-fan-out endpoints:
+        # republished in model replies so clients route blob/model reads
+        # to replicas (comm.dataplane) instead of this accept loop
+        self._sub_read_ep: Dict[object, Tuple[str, int]] = {}
         self._last_seen: Dict[str, float] = {}
         # replay rejection at the auth layer, not merely ledger idempotency
         # — the SAME ReplayGuard class AuthenticatedLedger uses, so the two
@@ -440,7 +446,23 @@ class LedgerServer:
                     eligible = ("sb" in msg and
                                 self._subscriber_handshake(conn, msg,
                                                            start))
-                    self._stream_ops(conn, start, eligible)
+                    read_ep = None
+                    if eligible and isinstance(msg.get("read_ep"),
+                                               (list, tuple)):
+                        # an AUTHENTICATED standby may advertise its
+                        # read-fan-out endpoint; the writer republishes
+                        # the live set in model replies so clients can
+                        # take their blob reads off this accept loop.
+                        # Anonymous subscribers never enter the set (a
+                        # hostile read replica cannot serve wrong bytes
+                        # — everything is hash-verified — but it could
+                        # sinkhole reads for a round-trip each).
+                        try:
+                            ep = msg["read_ep"]
+                            read_ep = (str(ep[0]), int(ep[1]))
+                        except (TypeError, ValueError, IndexError):
+                            read_ep = None
+                    self._stream_ops(conn, start, eligible, read_ep)
                     return
                 try:
                     fence = int(msg.get("fence", -1))
@@ -684,7 +706,8 @@ class LedgerServer:
         self._certified_size = i + 1
 
     def _stream_ops(self, conn: socket.socket, start: int,
-                    quorum_eligible: bool) -> None:
+                    quorum_eligible: bool,
+                    read_ep: Optional[Tuple[str, int]] = None) -> None:
         """Push canonical op bytes from `start` onward until the peer goes
         away — the replica feed (WAL-identical bytes, ledger.cpp op codec).
 
@@ -707,6 +730,8 @@ class LedgerServer:
             self._sub_acked[sub_id] = -1
             self._sub_sent[sub_id] = start - 1
             self._sub_eligible[sub_id] = quorum_eligible
+            if read_ep is not None:
+                self._sub_read_ep[sub_id] = read_ep
         reader = threading.Thread(target=self._ack_reader,
                                   args=(conn, sub_id), daemon=True)
         reader.start()
@@ -752,17 +777,36 @@ class LedgerServer:
                 self._sub_acked.pop(sub_id, None)
                 self._sub_sent.pop(sub_id, None)
                 self._sub_eligible.pop(sub_id, None)
+                self._sub_read_ep.pop(sub_id, None)
                 self._cv.notify_all()
 
     _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
+    _COMMIT_OPCODE = 4
 
     def _op_payload_blob(self, op: bytes) -> Optional[bytes]:
-        """An upload op's payload blob when this writer still holds it
-        (None for non-upload ops or post-aggregation drops) — the
-        op-stream piggyback source.  Decoded via the ONE op codec
-        (ledger.tool.decode_op) so the piggyback cannot silently drift
-        from the chain's byte layout."""
-        if not op or op[0] != self._UPLOAD_OPCODE:
+        """The blob a streamed op references, when this writer still
+        holds it: an upload op's payload (PR 3), or — data-plane fast
+        path — a commit op's NEW MODEL blob, so followers are
+        model-fresh the moment the commit applies and can serve the
+        round's read fan-out without a fetch round-trip (None otherwise;
+        a commit superseded by a later one no longer matches and ships
+        nothing).  Decoded via the ONE op codec (ledger.tool.decode_op)
+        so the piggyback cannot silently drift from the chain's byte
+        layout."""
+        if not op:
+            return None
+        if op[0] == self._COMMIT_OPCODE:
+            if data_plane_legacy():
+                return None
+            from bflc_demo_tpu.ledger.tool import decode_op
+            try:
+                mh = bytes.fromhex(decode_op(op)["model_hash"])
+            except (KeyError, ValueError):
+                return None
+            with self._lock:
+                return self._model_blob if self._model_hash == mh \
+                    else None
+        if op[0] != self._UPLOAD_OPCODE:
             return None
         from bflc_demo_tpu.ledger.tool import decode_op
         try:
@@ -948,8 +992,26 @@ class LedgerServer:
                 reply["_post_size"] = self.ledger.log_size()
         return reply
 
+    def _read_set(self) -> List[Tuple[str, int]]:
+        """Read-fan-out endpoints currently advertised by authenticated
+        subscribers (comm.dataplane) — empty under the legacy switch."""
+        if data_plane_legacy():
+            return []
+        with self._cv:
+            return sorted(set(self._sub_read_ep.values()))
+
     def _dispatch_inner(self, method: str, m: dict) -> dict:
         with self._lock:
+            # blob / blobs / model ride the ONE shared read dispatch
+            # (comm.dataplane.handle_read) — the same hash-addressed
+            # protocol standby read replicas and the mesh executor serve
+            read = handle_read(
+                method, m, blob_lookup=self._blobs.get,
+                model_state=lambda: (self.ledger.epoch, self._model_hash,
+                                     self._model_blob),
+                read_set=self._read_set)
+            if read is not None:
+                return read
             if method == "register":
                 addr = m["addr"]
                 if self.require_auth:
@@ -993,13 +1055,6 @@ class LedgerServer:
                 role, epoch = self.ledger.query_state(addr)
                 return {"ok": True, "role": role, "epoch": epoch,
                         "round_closed": self.ledger.round_closed}
-            if method == "model":
-                # bytes value -> binary wire frame: the model blob is the
-                # fattest reply on the control plane; hex-doubling it in
-                # JSON was pure overhead (comm.wire, PR 3)
-                return {"ok": True, "epoch": self.ledger.epoch,
-                        "hash": self._model_hash.hex(),
-                        "blob": self._model_blob}
             if method == "upload":
                 addr = m["addr"]
                 blob = blob_bytes(m["blob"])
@@ -1060,30 +1115,6 @@ class LedgerServer:
                 return {"ok": True, "updates": [
                     {"sender": u.sender, "hash": u.payload_hash.hex(),
                      "n": u.n_samples, "cost": u.avg_cost} for u in ups]}
-            if method == "blob":
-                digest = bytes.fromhex(m["hash"])
-                blob = self._blobs.get(digest)
-                if blob is None:
-                    return {"ok": False, "error": "unknown blob"}
-                return {"ok": True, "blob": blob}
-            if method == "blobs":
-                # batched content-addressed fetch (PR 3): one round-trip
-                # for a round's K candidate deltas instead of K — the
-                # committee-scoring hot path.  Held blobs ride the binary
-                # tail back-to-back with a [hash, length] manifest;
-                # unknown hashes are simply absent (the caller falls back
-                # per-hash, same contract as "blob").
-                parts, tail = [], []
-                for h in list(m.get("hashes", []))[:256]:
-                    try:
-                        b = self._blobs.get(bytes.fromhex(h))
-                    except (TypeError, ValueError):
-                        b = None
-                    if b is not None:
-                        parts.append([h, len(b)])
-                        tail.append(b)
-                return {"ok": True, "parts": parts,
-                        "blob": b"".join(tail)}
             if method == "scores":
                 addr = m["addr"]
                 scores = [float(s) for s in m["scores"]]
@@ -1201,9 +1232,18 @@ class LedgerServer:
         model's keys, shapes, AND dtypes; a reason string otherwise.
         Dtype equality matters as much as shape: a string-typed leaf with
         the right geometry would otherwise defer the failure to the
-        float32 cast inside aggregation."""
+        float32 cast inside aggregation.
+
+        With quantized deltas enabled (cfg.delta_dtype != "f32", opt-in)
+        the check runs over the DEQUANTIZED image — the same
+        deterministic decode scorers and the aggregator apply — so the
+        admitted structure is exactly what aggregation will walk.  With
+        quantization off the pre-PR strict check is unchanged: reduced-
+        precision blobs are rejected at the door."""
         try:
             delta = unpack_pytree(blob)
+            if self.cfg.delta_dtype != "f32":
+                delta = dequantize_entries(delta)
         except (ValueError, TypeError, struct.error) as e:
             return f"undecodable delta blob: {e}"
         schema = self._model_schema
@@ -1237,7 +1277,12 @@ class LedgerServer:
         updates = self.ledger.query_all_updates()
         epoch = self.ledger.epoch
         global_flat = unpack_pytree(self._model_blob)
-        delta_flats = [unpack_pytree(self._blobs[u.payload_hash])
+        # dequantize is the ONE shared decode (utils.serialization): an
+        # identity on plain f32 blobs, the deterministic inverse for
+        # opt-in f16/i8 uploads — scorer, aggregator and re-validators
+        # therefore agree on every delta's numeric meaning
+        delta_flats = [dequantize_entries(
+                           unpack_pytree(self._blobs[u.payload_hash]))
                        for u in updates]
         new_flat = _aggregate_flat(global_flat, delta_flats,
                                    [u.n_samples for u in updates],

@@ -30,10 +30,32 @@ and hex-string senders keep working: `blob_bytes` decodes either
 representation at the consumption sites.  BFLC_CONTROL_PLANE_LEGACY=1 at
 import forces hex-in-JSON sends (the before/after benchmark switch).
 
+Compressed frames (data-plane PR): a frame body — binary OR plain JSON —
+whose encoded size crosses a threshold (default 4 KiB,
+BFLC_WIRE_COMPRESS_MIN) is sent as
+
+    [4-byte length][\\x00ZIP1][4-byte raw length][deflate(body)]
+
+when compression actually shrinks it (incompressible blob tails ride
+uncompressed — negotiation is PER-FRAME, keyed off each frame's leading
+magic, so compressed, BIN1 and legacy hex-JSON frames interleave freely
+on one socket and mixed-version peers interoperate).  zlib is the
+default codec (stdlib everywhere, so any receiver can inflate it); zstd
+(magic \\x00ZST1) is accepted whenever the `zstandard` wheel exists but
+SENT only with BFLC_WIRE_ZSTD=1 — a fleet opts in once it knows every
+receiver holds the wheel.  BFLC_DATA_PLANE_LEGACY=1 (or
+the older BFLC_CONTROL_PLANE_LEGACY=1) pins compression off — the
+before/after benchmark switch.  The chaos injector fires on send/recv
+BEFORE any decoding, so compressed frames are partitioned/dropped/
+delayed exactly like every other frame.
+
 Frames are capped at 256 MiB: a hostile or corrupt length prefix must not
 drive an unbounded allocation (same rule as the ledger's op-byte bounds).
 The binary header length and every manifest entry are validated against
-the same cap — a lying manifest is a WireError, never an overread.
+the same cap — a lying manifest is a WireError, never an overread; a
+compressed frame's CLAIMED raw length is checked against the cap before
+inflation and the inflater is hard-bounded by it, so a deflate bomb costs
+at most one capped allocation.
 
 Fault injection (bflc_demo_tpu.chaos): every frame send/receive — JSON
 and binary alike — consults a process-local injector when one is
@@ -53,7 +75,20 @@ import os
 import socket
 import struct
 import time
+import zlib
 from typing import Any, Dict, Optional
+
+try:                                    # optional zstd (not in this image)
+    import zstandard as _zstd
+except ImportError:                     # pragma: no cover - env dependent
+    _zstd = None
+
+# zstd SENDING is opt-in (BFLC_WIRE_ZSTD=1): a receiver without the
+# wheel cannot inflate \x00ZST1, so a sender must not pick it just
+# because its own host has the module — that would wedge every large
+# frame to a zlib-only peer.  Receiving zstd works whenever the wheel
+# exists; zlib is the mixed-fleet-safe default (stdlib everywhere).
+_SEND_ZSTD = _zstd is not None and bool(os.environ.get("BFLC_WIRE_ZSTD"))
 
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.utils import tracing
@@ -71,13 +106,27 @@ _M_FRAMES = obs_metrics.REGISTRY.counter(
 _M_BYTES = obs_metrics.REGISTRY.counter(
     "wire_bytes_total", "frame bytes (incl. length prefix) by direction",
     ("dir",))
+_M_ZBYTES = obs_metrics.REGISTRY.counter(
+    "wire_zip_bytes_total",
+    "outbound compressed-frame volume: raw (pre-deflate) vs wire "
+    "(post-deflate) bytes", ("which",))
 
 # binary-frame sentinel: a JSON object frame's first byte is '{', so a
 # NUL-led magic is unambiguous on the same socket
 _BIN_MAGIC = b"\x00BIN1"
+# compressed-frame sentinels: [magic][4-byte raw len][compressed body]
+_ZLIB_MAGIC = b"\x00ZIP1"
+_ZSTD_MAGIC = b"\x00ZST1"
 
 # legacy switch (see module docstring): force hex-in-JSON frames
 _JSON_ONLY = bool(os.environ.get("BFLC_CONTROL_PLANE_LEGACY"))
+# data-plane legacy switch: pin compression off (the egress benchmark's
+# before leg); the control-plane switch implies it (that pins the whole
+# pre-PR-3 wire, which predates compression too)
+_NO_COMPRESS = _JSON_ONLY or bool(os.environ.get("BFLC_DATA_PLANE_LEGACY"))
+# only bodies past this size are worth a deflate pass (tiny control
+# frames would pay latency for nothing)
+_COMPRESS_MIN = int(os.environ.get("BFLC_WIRE_COMPRESS_MIN", 4096))
 
 # process-local fault injector (chaos.hooks.FaultInjector) or None.
 # Installed once at child-process startup by the chaos campaign; never
@@ -195,12 +244,82 @@ def _decode_binary(body: bytes) -> Dict[str, Any]:
     return msg
 
 
+def _maybe_compress(data: bytes) -> bytes:
+    """Deflate an encoded frame body when it is big enough AND the
+    deflate actually wins; otherwise return it unchanged.  Level 1: the
+    data plane's fat tails are float tensors — the cheap pass captures
+    most of what any level would, without stalling the accept loop."""
+    if _NO_COMPRESS or len(data) < _COMPRESS_MIN:
+        return data
+    if _SEND_ZSTD:
+        comp = _zstd.ZstdCompressor(level=3).compress(data)
+        magic = _ZSTD_MAGIC
+    else:
+        comp = zlib.compress(data, 1)
+        magic = _ZLIB_MAGIC
+    framed = magic + struct.pack(">I", len(data)) + comp
+    if len(framed) >= len(data):
+        return data                     # incompressible: send raw
+    if obs_metrics.REGISTRY.enabled:
+        _M_ZBYTES.inc(len(data), which="raw")
+        _M_ZBYTES.inc(len(framed), which="wire")
+    return framed
+
+
+def _decompress(body: bytes) -> bytes:
+    """Inflate a compressed frame body back to its inner (JSON or BIN1)
+    body.  The claimed raw length is validated against the frame cap
+    BEFORE inflation and the inflater is bounded by it — a lying or
+    hostile frame is a WireError, never an unbounded allocation."""
+    magic, zdata = body[:5], body[9:]
+    if len(body) < 9:
+        raise WireError("truncated compressed frame header")
+    (raw_len,) = struct.unpack_from(">I", body, 5)
+    if not 0 < raw_len <= MAX_FRAME:
+        # raw_len == 0 must die here too: zlib's max_length=0 and zstd's
+        # max_output_size=0 both mean UNBOUNDED, which would reopen the
+        # deflate-bomb hole this cap exists to close (no honest sender
+        # compresses an empty body — the threshold gate is above 0)
+        raise WireError(f"compressed frame claims {raw_len} raw bytes, "
+                        f"outside (0, cap]")
+    try:
+        if magic == _ZSTD_MAGIC:
+            if _zstd is None:
+                raise WireError("zstd frame received but the zstandard "
+                                "module is unavailable")
+            raw = _zstd.ZstdDecompressor().decompress(
+                zdata, max_output_size=raw_len)
+        else:
+            d = zlib.decompressobj()
+            raw = d.decompress(zdata, raw_len)
+            if d.unconsumed_tail or not d.eof:
+                raise WireError("compressed frame body overruns its "
+                                "claimed raw length")
+    except (zlib.error, MemoryError) as e:
+        raise WireError(f"undecodable compressed frame: {e}") from e
+    except Exception as e:              # zstd raises its own error type
+        if isinstance(e, WireError):
+            raise
+        raise WireError(f"undecodable compressed frame: {e}") from e
+    if len(raw) != raw_len:
+        raise WireError(f"compressed frame inflated to {len(raw)} bytes, "
+                        f"claimed {raw_len}")
+    return raw
+
+
 def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
     tr = tracing.PROC
     t0 = time.perf_counter() if tr.enabled else 0.0
     data = _encode(msg)
     if len(data) > MAX_FRAME:
+        # cap the RAW encoded size, pre-compression: an oversized body
+        # that happens to deflate under the cap would otherwise send
+        # fine and then die remotely at the receiver's raw-length check
+        # — an opaque disconnect instead of this local, attributable
+        # error (compression is win-gated, so a passing raw size can
+        # never compress to a failing wire size)
         raise WireError(f"frame too large: {len(data)}")
+    data = _maybe_compress(data)
     if _INJECTOR is not None:
         _INJECTOR.on_send(sock)
     sock.sendall(struct.pack(">I", len(data)) + data)
@@ -208,9 +327,16 @@ def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
         tr.charge("wire.send_s", time.perf_counter() - t0)
         tr.charge("wire.bytes_out", 4 + len(data))
     if obs_metrics.REGISTRY.enabled:
-        _M_FRAMES.inc(dir="out", kind=("bin" if data[:1] == b"\x00"
-                                       else "json"))
+        _M_FRAMES.inc(dir="out", kind=_frame_kind(data))
         _M_BYTES.inc(4 + len(data), dir="out")
+
+
+def _frame_kind(body: bytes) -> str:
+    if body[:1] != b"\x00":
+        return "json"
+    if body[:5] in (_ZLIB_MAGIC, _ZSTD_MAGIC):
+        return "zip"
+    return "bin"
 
 
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -247,10 +373,12 @@ def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
     if body is None:
         raise WireError("EOF between header and body")
     try:
-        if body.startswith(_BIN_MAGIC):
-            return _decode_binary(body)
+        inner = (_decompress(body)
+                 if body[:5] in (_ZLIB_MAGIC, _ZSTD_MAGIC) else body)
+        if inner.startswith(_BIN_MAGIC):
+            return _decode_binary(inner)
         try:
-            msg = json.loads(body.decode())
+            msg = json.loads(inner.decode())
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
             raise WireError(f"undecodable frame: {e}") from e
         if not isinstance(msg, dict):
@@ -261,6 +389,5 @@ def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
             tr.charge("wire.recv_s", time.perf_counter() - t0)
             tr.charge("wire.bytes_in", 4 + len(body))
         if obs_metrics.REGISTRY.enabled:
-            _M_FRAMES.inc(dir="in", kind=("bin" if body[:1] == b"\x00"
-                                          else "json"))
+            _M_FRAMES.inc(dir="in", kind=_frame_kind(body))
             _M_BYTES.inc(4 + len(body), dir="in")

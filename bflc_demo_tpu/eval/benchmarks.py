@@ -409,6 +409,244 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
     return out
 
 
+# ------------------------------------------------------ data plane (PR 5)
+def _wire_transparency_check() -> bool:
+    """Prove the compressed wire is content-transparent: the same
+    message decodes bit-identically whether it rides a compressed, BIN1
+    or legacy hex-JSON frame — so certified history (hashes over payload
+    BYTES) cannot depend on the frame encoding."""
+    import json as _json
+    import socket
+    import struct as _struct
+
+    from bflc_demo_tpu.comm import wire
+
+    blob = bytes(range(256)) * 64 + b"\x00" * 30000      # compressible
+    msg = {"method": "upload", "blob": blob, "hash": "ab" * 32, "n": 3}
+    a, b = socket.socketpair()
+    try:
+        wire.send_msg(a, msg)                            # compressed
+        legacy_body = _json.dumps(
+            {**{k: v for k, v in msg.items() if k != "blob"},
+             "blob": blob.hex()}, separators=(",", ":")).encode()
+        a.sendall(_struct.pack(">I", len(legacy_body)) + legacy_body)
+        m1, m2 = wire.recv_msg(b), wire.recv_msg(b)
+        return (wire.blob_bytes(m1["blob"]) == blob
+                and wire.blob_bytes(m2["blob"]) == blob
+                and m1["hash"] == m2["hash"] == msg["hash"])
+    finally:
+        a.close()
+        b.close()
+
+
+def _scrape_series(timeline, role_prefix: str, metric: str,
+                   **want) -> float:
+    """Max cumulative value of counter `metric` across all scraped
+    snapshots of roles starting with `role_prefix`, summed over roles
+    (counters are cumulative: each role's final snapshot carries its
+    total; a killed role keeps its last observed value)."""
+    best: Dict[str, float] = {}
+    for rec in timeline:
+        if rec.get("type") != "scrape":
+            continue
+        for role, snap in rec.get("roles", {}).items():
+            if not role.startswith(role_prefix):
+                continue
+            total = 0.0
+            samples = ((snap.get("metrics") or {}).get(metric)
+                       or {}).get("samples", [])
+            for s in samples:
+                lab = s.get("labels", {})
+                if all(lab.get(k) == v for k, v in want.items()):
+                    total += s.get("value", 0.0)
+            best[role] = max(best.get(role, 0.0), total)
+    return sum(best.values())
+
+
+def _scrape_hist(timeline, role_prefix: str, metric: str, **want):
+    """(count, mean) of histogram `metric` merged across roles, from
+    each role's last snapshot."""
+    last: Dict[str, tuple] = {}
+    for rec in timeline:
+        if rec.get("type") != "scrape":
+            continue
+        for role, snap in rec.get("roles", {}).items():
+            if not role.startswith(role_prefix):
+                continue
+            count, tot = 0, 0.0
+            samples = ((snap.get("metrics") or {}).get(metric)
+                       or {}).get("samples", [])
+            for s in samples:
+                lab = s.get("labels", {})
+                if all(lab.get(k) == v for k, v in want.items()):
+                    count += s.get("count", 0)
+                    tot += s.get("sum", 0.0)
+            if count:
+                last[role] = (count, tot)
+    n = sum(c for c, _ in last.values())
+    t = sum(s for _, s in last.values())
+    return n, (t / n if n else 0.0)
+
+
+def _writer_egress_per_round(timeline, fallback_total: float,
+                             rounds: int) -> float:
+    """Steady-state coordinator egress bytes/round: the slope of the
+    writer's cumulative wire.bytes_out across the per-round scrapes
+    (spawn/registration burst excluded); falls back to total/rounds."""
+    pts = []
+    for rec in timeline:
+        if rec.get("type") != "scrape" or \
+                not str(rec.get("tag", "")).startswith("round-"):
+            continue
+        w = rec.get("roles", {}).get("writer")
+        if not w:
+            continue
+        out = (w.get("trace_costs") or {}).get("wire.bytes_out")
+        if out is not None:
+            pts.append(float(out))
+    if len(pts) >= 2 and pts[-1] > pts[0]:
+        return (pts[-1] - pts[0]) / (len(pts) - 1)
+    return fallback_total / max(rounds, 1)
+
+
+def data_plane_config1(rounds: int = 3, *, standbys: int = 2,
+                       validators: int = 4, quorum: int = 1,
+                       model_hidden: int = 4096,
+                       include_legacy: bool = True,
+                       quantized: str = "i8",
+                       timeout_s: float = 420.0) -> Dict:
+    """Data-plane benchmark at the config-1 fleet geometry (20 clients +
+    2 standbys + 4 validators + quorum-1 + WAL) with a model fat enough
+    that the DATA plane, not the control plane, dominates the wire (a
+    5->hidden->2 MLP on occupancy; the reference's softmax model is 48
+    bytes, which would measure JSON overhead, not blob movement).
+
+    Axes: coordinator egress bytes/round (steady-state slope of the
+    writer's traced wire.bytes_out across the per-round telemetry
+    scrapes), model-distribution fan-out time (the clients' fetch-phase
+    histogram), steady round wall time, read-source shares, cache hit
+    ratio and compression ratio — each vs a child fleet running with
+    BFLC_DATA_PLANE_LEGACY=1 (no fan-out, no cache, no meta probe, no
+    compression).  Certified-history integrity per leg: the replica
+    replay inside run_federated_processes raises on head divergence, and
+    `wire_transparent` pins that frame encodings cannot alter content.
+
+    quantized: additionally run a leg with --delta-dtype set (opt-in
+    reduced-precision uploads) and report its accuracy next to the f32
+    leg's — the quantization-accuracy axis ('' skips the leg)."""
+    import dataclasses
+
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+    from bflc_demo_tpu.obs.collector import load_timeline
+
+    cfg = DEFAULT_PROTOCOL
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    factory_kw = {"input_shape": (5,), "hidden": int(model_hidden),
+                  "num_classes": 2}
+
+    def _run(legacy: bool, delta_dtype: str = "f32") -> Dict:
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        run_cfg = dataclasses.replace(cfg, delta_dtype=delta_dtype)
+        saved = {k: os.environ.get(k)
+                 for k in ("BFLC_DATA_PLANE_LEGACY", "BFLC_PROC_TRACE")}
+        if legacy:
+            os.environ["BFLC_DATA_PLANE_LEGACY"] = "1"
+        else:
+            os.environ.pop("BFLC_DATA_PLANE_LEGACY", None)
+        os.environ["BFLC_PROC_TRACE"] = "1"
+        try:
+            with tempfile.TemporaryDirectory(prefix="bflc-dp-bench-") \
+                    as td:
+                res = run_federated_processes(
+                    "make_mlp", shards, (xte, yte), run_cfg,
+                    rounds=rounds, factory_kw=factory_kw,
+                    standbys=standbys, quorum=quorum,
+                    bft_validators=validators,
+                    wal_path=os.path.join(td, "writer.wal"),
+                    telemetry_dir=os.path.join(td, "telemetry"),
+                    timeout_s=timeout_s)
+                timeline = load_timeline(res.telemetry_report["jsonl"]) \
+                    if res.telemetry_report else []
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        info = res.final_info or {}
+        costs = (info.get("perf") or {}).get("costs", {})
+        bytes_out = float(costs.get("wire.bytes_out", 0.0))
+        rounds_done = max(res.rounds_completed, 1)
+        ts = [t for _, t in res.epoch_times]
+        round_wall = ((ts[-1] - ts[0]) / (len(ts) - 1)
+                      if len(ts) >= 2 else res.wall_time_s / rounds_done)
+        reads = {src: _scrape_series(timeline, "client-",
+                                     "dataplane_reads_total", source=src)
+                 for src in ("cache", "replica", "writer")}
+        reads_total = sum(reads.values())
+        hits = _scrape_series(timeline, "client-",
+                              "dataplane_cache_events_total", event="hit")
+        misses = _scrape_series(timeline, "client-",
+                                "dataplane_cache_events_total",
+                                event="miss")
+        n_fetch, mean_fetch = _scrape_hist(timeline, "client-",
+                                           "client_phase_seconds",
+                                           phase="fetch")
+        zraw = _scrape_series(timeline, "", "wire_zip_bytes_total",
+                              which="raw")
+        zwire = _scrape_series(timeline, "", "wire_zip_bytes_total",
+                               which="wire")
+        fallbacks = _scrape_series(timeline, "client-",
+                                   "dataplane_blob_fallback_total")
+        return {
+            "rounds": res.rounds_completed,
+            "best_acc": round(res.best_accuracy(), 4),
+            "round_wall_time_s": round(round_wall, 4),
+            "writer_egress_bytes_total": int(bytes_out),
+            "writer_egress_bytes_per_round": int(_writer_egress_per_round(
+                timeline, bytes_out, rounds_done)),
+            "model_fetch_mean_s": round(mean_fetch, 4),
+            "model_fetches": n_fetch,
+            "read_source_share": (
+                {k: round(v / reads_total, 3) for k, v in reads.items()}
+                if reads_total else None),
+            "cache_hit_ratio": (round(hits / (hits + misses), 3)
+                                if hits + misses else None),
+            "blob_batch_fallbacks": int(fallbacks),
+            "compression_ratio": (round(zraw / zwire, 2) if zwire
+                                  else None),
+            "delta_dtype": delta_dtype,
+            "log_head": info.get("log_head"),
+            "replica_verified": res.replica_report is not None,
+        }
+
+    out: Dict = {
+        "geometry": {"clients": cfg.client_num, "standbys": standbys,
+                     "validators": validators, "quorum": quorum,
+                     "rounds": rounds, "model": "mlp",
+                     "model_hidden": int(model_hidden)},
+        "wire_transparent": _wire_transparency_check(),
+        "fast": _run(legacy=False),
+    }
+    if include_legacy:
+        out["pre_pr_legacy"] = _run(legacy=True)
+        fast, leg = out["fast"], out["pre_pr_legacy"]
+        if fast["writer_egress_bytes_per_round"]:
+            out["egress_reduction_x"] = round(
+                leg["writer_egress_bytes_per_round"]
+                / fast["writer_egress_bytes_per_round"], 2)
+        if fast["round_wall_time_s"]:
+            out["round_time_speedup"] = round(
+                leg["round_wall_time_s"] / fast["round_wall_time_s"], 2)
+    if quantized:
+        out["quantized_leg"] = _run(legacy=False, delta_dtype=quantized)
+        out["quantized_acc_gap"] = round(
+            out["fast"]["best_acc"] - out["quantized_leg"]["best_acc"], 4)
+    return out
+
+
 def telemetry_overhead_config1(rounds: int = 3, trials: int = 1,
                                **kw) -> Dict:
     """Telemetry overhead measured, not asserted (the observability

@@ -52,6 +52,13 @@ class ProtocolConfig:
     genesis_epoch: int = -999     # epoch value before CLIENT_NUM registrations
     initial_trained_epoch: int = -1
 
+    # data plane: opt-in reduced-precision upload deltas ("f32" = off).
+    # Client and coordinator must agree (it is part of the protocol
+    # genome): clients pack deltas in this encoding, the coordinator
+    # admits/dequantizes it, and the certified payload hash is over the
+    # quantized canonical bytes (utils.serialization).
+    delta_dtype: str = "f32"
+
     def validate(self) -> "ProtocolConfig":
         if not (0 < self.comm_count < self.client_num):
             raise ValueError(
@@ -68,6 +75,10 @@ class ProtocolConfig:
                 f"{self.client_num - self.comm_count})")
         if self.learning_rate <= 0 or self.batch_size <= 0:
             raise ValueError("learning_rate and batch_size must be positive")
+        if self.delta_dtype not in ("f32", "f16", "i8"):
+            raise ValueError(
+                f"delta_dtype must be one of ('f32', 'f16', 'i8'), got "
+                f"{self.delta_dtype!r}")
         return self
 
     @property

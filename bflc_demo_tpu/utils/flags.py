@@ -57,8 +57,11 @@ def protocol_from_env(base: Optional[ProtocolConfig] = None) -> ProtocolConfig:
         if raw is None:
             continue
         current = values[name]
-        values[name] = type(current)(float(raw) if isinstance(current, float)
-                                     else int(raw))
+        if isinstance(current, str):        # e.g. delta_dtype
+            values[name] = raw
+        else:
+            values[name] = type(current)(
+                float(raw) if isinstance(current, float) else int(raw))
     return ProtocolConfig(**values).validate()
 
 
@@ -83,6 +86,16 @@ def build_parser() -> argparse.ArgumentParser:
         else:
             p.add_argument(flag, type=type(f.default), default=f.default)
     for name, default in dataclasses.asdict(ProtocolConfig()).items():
+        if name == "delta_dtype":
+            # opt-in quantized upload deltas (utils.serialization): a
+            # typo must die at parse time, not mid-federation
+            p.add_argument("--delta-dtype", choices=["f32", "f16", "i8"],
+                           default=None,
+                           help="protocol: upload delta encoding "
+                                "(default f32 = dense float32; f16/i8 "
+                                "quantize client uploads, certified "
+                                "hash over the quantized bytes)")
+            continue
         p.add_argument("--" + name.replace("_", "-"),
                        type=type(default), default=None,
                        help=f"protocol: {name} (default {default})")

@@ -13,6 +13,23 @@ iff they have the same structure, dtypes, shapes and bytes.  The same encoding
 doubles as the wire/checkpoint format (`pack_pytree`/`unpack_pytree`) — a
 flat, self-describing binary layout (the flatbuffer/DLPack role in the
 BASELINE.json north star) with zero JSON anywhere.
+
+Quantized update deltas (data-plane PR; Konečný et al. 2016, Alistarh et
+al. 2017 QSGD): an UPLOAD delta may opt into a reduced-precision encoding
+(`--delta-dtype {f32,f16,i8}`) before it is packed.  Quantization happens
+ONCE, client-side, and the canonical bytes — hence the content hash the
+client signs and the validators certify — are the bytes of the QUANTIZED
+entries, so the trust machinery is untouched: what was signed is exactly
+what every consumer hashes.  Dequantization (`dequantize_entries`) is the
+one shared, deterministic inverse — committee scorers, the coordinator's
+aggregator, and any re-validator all call it, so a quantized delta has a
+single numeric meaning everywhere:
+
+- f16: float leaves stored as IEEE float16 (decoded back to float32);
+- i8: float leaves stored as int8 with one per-leaf float32 symmetric
+  scale (max|x|/127) riding in a reserved `<key>#qscale` 0-d entry;
+  decode is exactly `int8.astype(f32) * scale` — pure IEEE float32 ops,
+  bit-identical on every host.
 """
 
 from __future__ import annotations
@@ -27,6 +44,14 @@ import numpy as np
 Pytree = Any
 
 _MAGIC = b"BFLCT\x01"
+
+# opt-in reduced-precision delta encodings (utils.flags --delta-dtype)
+DELTA_DTYPES = ("f32", "f16", "i8")
+
+# reserved key suffix carrying an i8 leaf's dequantization scale.  '#'
+# cannot appear in a jax.tree_util.keystr path component the models
+# produce, so an honest tree can never collide with a scale entry.
+QSCALE_SUFFIX = "#qscale"
 
 
 def _leaf_entries(tree: Pytree) -> List[Tuple[str, np.ndarray]]:
@@ -144,3 +169,78 @@ def unpack_pytree(data: bytes) -> Dict[str, np.ndarray]:
         off += rawlen
         out[key] = arr
     return out
+
+
+# ----------------------------------------------------- quantized encodings
+def quantize_entries(flat: Dict[str, np.ndarray],
+                     dtype: str) -> Dict[str, np.ndarray]:
+    """Reduced-precision image of flat {path: array} entries.
+
+    f32 is the identity; f16 casts float leaves to IEEE float16; i8
+    stores each float leaf as symmetric int8 with one per-leaf float32
+    scale (max|x|/127, or 1.0 for an all-zero leaf) under the reserved
+    `<key>#qscale` entry.  Non-float leaves always pass through
+    untouched.  The mapping is deterministic: np.rint (ties to even) and
+    float32 divides are IEEE-pinned, so the same input bytes produce the
+    same quantized bytes — and therefore the same content hash — on
+    every host.
+    """
+    if dtype not in DELTA_DTYPES:
+        raise ValueError(f"delta dtype must be one of {DELTA_DTYPES}, "
+                         f"got {dtype!r}")
+    if dtype == "f32":
+        return dict(flat)
+    out: Dict[str, np.ndarray] = {}
+    for key, arr in flat.items():
+        a = np.asarray(arr)
+        if not np.issubdtype(a.dtype, np.floating):
+            out[key] = a
+            continue
+        if dtype == "f16":
+            out[key] = a.astype(np.float16)
+            continue
+        a32 = a.astype(np.float32)
+        amax = np.float32(np.max(np.abs(a32))) if a32.size else np.float32(0)
+        scale = np.float32(amax / np.float32(127.0)) if amax else \
+            np.float32(1.0)
+        q = np.clip(np.rint(a32 / scale), -127, 127).astype(np.int8)
+        out[key] = q
+        out[key + QSCALE_SUFFIX] = np.float32(scale)
+    return out
+
+
+def dequantize_entries(flat: Dict[str, np.ndarray]
+                       ) -> Dict[str, np.ndarray]:
+    """The ONE deterministic inverse of `quantize_entries`, shared by
+    committee scorers, the coordinator's aggregator and re-validators.
+
+    Plain f32 entries pass through unchanged (the function is an
+    identity on unquantized blobs); float16 leaves decode to float32;
+    int8 leaves paired with a `#qscale` entry decode as
+    `int8.astype(f32) * scale`.  An int8 leaf WITHOUT a scale entry is
+    left untouched (it is an honest integer tensor, not a quantized
+    float)."""
+    scales = {k: v for k, v in flat.items() if k.endswith(QSCALE_SUFFIX)}
+    out: Dict[str, np.ndarray] = {}
+    for key, arr in flat.items():
+        if key.endswith(QSCALE_SUFFIX):
+            continue
+        a = np.asarray(arr)
+        skey = key + QSCALE_SUFFIX
+        if a.dtype == np.int8 and skey in scales:
+            scale = np.float32(np.asarray(scales[skey]).reshape(()))
+            out[key] = a.astype(np.float32) * scale
+        elif a.dtype == np.float16:
+            out[key] = a.astype(np.float32)
+        else:
+            out[key] = a
+    return out
+
+
+def pack_quantized(tree: Pytree, dtype: str) -> bytes:
+    """Canonical bytes of `tree`'s quantized entries — what an opt-in
+    client uploads, hashes and SIGNS (the certified payload hash is over
+    these quantized canonical bytes, so quantization changes no trust
+    semantics; module docstring)."""
+    entries = dict(_leaf_entries(tree))
+    return pack_entries(quantize_entries(entries, dtype))

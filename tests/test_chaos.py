@@ -249,6 +249,77 @@ class TestMiniSoak:
         assert _os.path.exists(tel["prometheus"])
 
 
+class TestReadFanoutDegradation:
+    """Data-plane chaos (PR 5): clients route model/blob reads through
+    standby read replicas (comm.dataplane).  Killing EVERY serving
+    replica mid-federation must degrade reads to the coordinator
+    fallback — rounds keep completing, every invariant holds, and no
+    client ever accepts unverified bytes (hash checks make a dead or
+    stale replica cost a round-trip, not correctness)."""
+
+    def test_killing_serving_replicas_degrades_to_coordinator(
+            self, tmp_path):
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        from bflc_demo_tpu.obs.collector import load_timeline
+        cfg = _small_cfg()
+        shards, test_set = _occupancy_fleet(cfg.client_num)
+        sched = FaultSchedule(321, duration_s=60.0, n_clients=4,
+                              n_standbys=2, n_validators=0,
+                              profile="light")
+        # both read-serving standbys die mid-run; the writer survives,
+        # so every later read must fall back to it
+        sched.events = [
+            FaultEvent(6.0, "kill", "standby-1"),
+            FaultEvent(8.0, "kill", "standby-2"),
+        ]
+        sched.wire_windows = {}
+        tdir = str(tmp_path / "telemetry")
+        res = run_federated_processes(
+            "make_softmax_regression", shards, test_set, cfg,
+            rounds=6, standbys=2, timeout_s=300.0,
+            chaos_schedule=sched, telemetry_dir=tdir, verbose=False)
+        rep = res.chaos_report
+        assert rep is not None
+        assert rep["violations"] == [], rep["violations"]
+        assert res.rounds_completed >= 6
+        executed = {(e["kind"], e["target"])
+                    for e in rep["faults_executed"]}
+        assert ("kill", "standby-1") in executed
+        assert ("kill", "standby-2") in executed
+        # telemetry: clients actually exercised the fallback ladder.
+        # Cold-start reads hit the writer too, so "writer reads exist"
+        # would pass vacuously — the degradation signal is that writer-
+        # sourced reads KEEP GROWING after the last replica kill (the
+        # timeline is ordered: scrapes and fault records interleave).
+        tl = load_timeline(res.telemetry_report["jsonl"])
+
+        def _writer_reads(rec) -> float:
+            total = 0.0
+            for role, snap in rec.get("roles", {}).items():
+                if not role.startswith("client-"):
+                    continue
+                for s in ((snap.get("metrics") or {}).get(
+                        "dataplane_reads_total") or {}).get(
+                            "samples", []):
+                    if s["labels"].get("source") == "writer":
+                        total += s["value"]     # cumulative per client
+            return total
+
+        running, at_last_kill, kills_seen = 0.0, 0.0, 0
+        for rec in tl:
+            if rec.get("type") == "scrape":
+                running = max(running, _writer_reads(rec))
+            elif rec.get("type") == "fault" and \
+                    rec.get("kind") == "kill":
+                kills_seen += 1
+                at_last_kill = running
+        assert kills_seen >= 2, "kill faults missing from the timeline"
+        assert running > at_last_kill, \
+            ("no coordinator-fallback reads AFTER the replica kills "
+             f"(cumulative writer reads {at_last_kill} -> {running})")
+
+
 @pytest.mark.slow
 class TestChaosSoak100:
     """The headline artifact: 100 rounds at config-1 parity geometry

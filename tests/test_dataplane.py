@@ -1,0 +1,420 @@
+"""Data-plane fast path (comm.dataplane): content-addressed blob cache,
+replica read fan-out, batched-fetch fallback accounting, and the opt-in
+quantized-delta admission/aggregation path.
+
+Trust invariant under test throughout: fan-out, caching and quantization
+move BYTES, never trust — every accepted read is verified against a hash
+the client already holds (the writer-asserted model hash, the certified
+op's payload hash), so a stale, dead or lying replica can only ever cost
+a fallback round-trip.
+"""
+
+import dataclasses
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.comm.dataplane import (BlobCache, ReadFanoutServer,
+                                          ReadRouter, handle_read)
+from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                               LedgerServer)
+from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+                                               pack_pytree,
+                                               pack_quantized,
+                                               unpack_pytree)
+
+CFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                     needed_update_count=3, learning_rate=0.05,
+                     batch_size=16)
+
+
+def _init_blob():
+    return pack_pytree({"W": np.zeros((5, 2), np.float32),
+                        "b": np.zeros((2,), np.float32)})
+
+
+def _delta(v: float):
+    return {"W": np.full((5, 2), v, np.float32),
+            "b": np.zeros((2,), np.float32)}
+
+
+class TestBlobCache:
+    def test_put_get_and_lru_eviction_under_byte_budget(self):
+        c = BlobCache(max_bytes=100)
+        c.put("a", b"x" * 40)
+        c.put("b", b"y" * 40)
+        assert c.get("a") == b"x" * 40      # refresh 'a' (now MRU)
+        c.put("c", b"z" * 40)               # over budget: evict LRU = 'b'
+        assert c.get("b") is None
+        assert c.get("a") is not None and c.get("c") is not None
+
+    def test_oversized_blob_never_flushes_working_set(self):
+        c = BlobCache(max_bytes=100)
+        c.put("a", b"x" * 50)
+        c.put("big", b"z" * 1000)           # bigger than the whole budget
+        assert c.get("big") is None
+        assert c.get("a") == b"x" * 50
+
+    def test_replacement_updates_byte_accounting(self):
+        c = BlobCache(max_bytes=100)
+        c.put("a", b"x" * 90)
+        c.put("a", b"y" * 10)               # replace, don't double-count
+        c.put("b", b"z" * 80)
+        assert c.get("a") == b"y" * 10 and c.get("b") == b"z" * 80
+
+
+class TestHandleRead:
+    """The ONE shared read dispatch every serving role answers through."""
+
+    def test_blob_and_blobs_and_model(self):
+        store = {hashlib.sha256(b"one").digest(): b"one",
+                 hashlib.sha256(b"two").digest(): b"two"}
+        model = b"model-bytes"
+        mh = hashlib.sha256(model).digest()
+        kw = dict(blob_lookup=store.get,
+                  model_state=lambda: (3, mh, model),
+                  read_set=[("127.0.0.1", 9)])
+        h1 = hashlib.sha256(b"one").hexdigest()
+        assert handle_read("blob", {"hash": h1}, **kw)["blob"] == b"one"
+        r = handle_read("blobs", {"hashes": [h1, "ff" * 32]}, **kw)
+        assert r["parts"] == [[h1, 3]] and r["blob"] == b"one"
+        meta = handle_read("model", {"meta": 1}, **kw)
+        assert meta == {"ok": True, "epoch": 3, "hash": mh.hex(),
+                        "read_set": [["127.0.0.1", 9]]}
+        full = handle_read("model", {}, **kw)
+        assert full["blob"] == model
+        assert handle_read("upload", {}, **kw) is None
+
+    def test_unknown_blob_and_missing_model(self):
+        kw = dict(blob_lookup=lambda d: None, model_state=lambda: None)
+        assert not handle_read("blob", {"hash": "aa" * 32}, **kw)["ok"]
+        assert not handle_read("model", {}, **kw)["ok"]
+
+
+class TestReadFanout:
+    def test_replica_serves_hash_verified_reads(self):
+        store = {hashlib.sha256(b"abc").digest(): b"abc"}
+        model = _init_blob()
+        rep = ReadFanoutServer(
+            store.get,
+            lambda: (0, hashlib.sha256(model).digest(), model))
+        rep.start()
+        try:
+            c = CoordinatorClient(rep.host, rep.port)
+            h = hashlib.sha256(b"abc").hexdigest()
+            from bflc_demo_tpu.comm.wire import blob_bytes
+            assert blob_bytes(
+                c.request("blob", hash=h)["blob"]) == b"abc"
+            mr = c.request("model")
+            assert blob_bytes(mr["blob"]) == model
+            # mutations are refused with an error frame, never served
+            r = c.request("upload", addr="0x0", blob=b"", hash="",
+                          n=1, cost=0.0, epoch=0)
+            assert not r["ok"] and "unknown method" in r["error"]
+            c.close()
+        finally:
+            rep.close()
+
+    def test_lying_replica_fails_hash_check_and_router_falls_back(self):
+        """A replica serving WRONG bytes for the model is skipped (the
+        writer-asserted hash does not match) and the read degrades to
+        the coordinator — wrong bytes can never reach the caller."""
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        liar = ReadFanoutServer(
+            lambda d: b"not-the-blob",
+            lambda: (0, hashlib.sha256(b"forged").digest(), b"forged"))
+        liar.start()
+        try:
+            ctl = CoordinatorClient(srv.host, srv.port)
+            router = ReadRouter(ctl)
+            router._read_set = [liar.endpoint]
+            mr = router.fetch_model()
+            assert mr["ok"] and mr["source"] == "writer"
+            assert mr["blob"] == _init_blob()
+            ctl.close()
+        finally:
+            liar.close()
+            srv.close()
+
+    def test_stale_replica_first_in_rotation_does_not_mask_fresh_one(self):
+        """Round-robin failover must sweep ON from a declining replica:
+        advancing the rotation pointer mid-sweep used to re-probe the
+        stale replica and never reach the fresh one (regression)."""
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        model = _init_blob()
+        stale = ReadFanoutServer(
+            lambda d: None,
+            lambda: (0, hashlib.sha256(b"old-model").digest(),
+                     b"old-model"))
+        fresh = ReadFanoutServer(
+            lambda d: None,
+            lambda: (0, hashlib.sha256(model).digest(), model))
+        stale.start()
+        fresh.start()
+        try:
+            ctl = CoordinatorClient(srv.host, srv.port)
+            router = ReadRouter(ctl)
+            router._read_set = [stale.endpoint, fresh.endpoint]
+            router._rr = 0              # stale replica probed first
+            mr = router.fetch_model()
+            assert mr["ok"] and mr["blob"] == model
+            assert mr["source"] == "replica", mr["source"]
+            ctl.close()
+        finally:
+            stale.close()
+            fresh.close()
+            srv.close()
+
+    def test_dead_replica_mid_run_degrades_to_coordinator(self):
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        payload = b"p" * 4096
+        digest = hashlib.sha256(payload).digest()
+        srv._blobs[digest] = payload
+        rep = ReadFanoutServer({digest: payload}.get, lambda: None)
+        rep.start()
+        try:
+            ctl = CoordinatorClient(srv.host, srv.port)
+            router = ReadRouter(ctl)
+            router._read_set = [rep.endpoint]
+            h = digest.hex()
+            assert router.fetch_blobs([h])[h] == payload
+            # the serving replica dies; the next (uncached) fetch must
+            # fall back to the coordinator, not fail
+            rep.close()
+            payload2 = b"q" * 4096
+            d2 = hashlib.sha256(payload2).digest()
+            srv._blobs[d2] = payload2
+            assert router.fetch_blobs([d2.hex()])[d2.hex()] == payload2
+            ctl.close()
+        finally:
+            rep.close()
+            srv.close()
+
+
+class _StubControl:
+    """Duck-typed control client whose batched `blobs` reply OMITS some
+    hashes (a lagging or buggy peer) — the per-hash fallback fixture."""
+
+    def __init__(self, store):
+        self.store = store              # hex -> bytes
+        self.calls = []
+
+    def request(self, method, **fields):
+        self.calls.append(method)
+        if method == "blobs":
+            served = {h: self.store[h]
+                      for h in fields["hashes"][:1] if h in self.store}
+            return {"ok": True,
+                    "parts": [[h, len(b)] for h, b in served.items()],
+                    "blob": b"".join(served.values())}
+        if method == "blob":
+            b = self.store.get(fields["hash"])
+            if b is None:
+                return {"ok": False, "error": "unknown blob"}
+            return {"ok": True, "blob": b}
+        raise AssertionError(method)
+
+
+class TestBatchedFetchFallback:
+    """The silent-partial-batch fix: a batched reply that omits a hash
+    costs counted per-hash round-trips, never silence or a crash."""
+
+    def test_omitted_hash_falls_back_per_hash_and_counts(self):
+        blobs = {hashlib.sha256(bytes([i]) * 64).hexdigest():
+                 bytes([i]) * 64 for i in range(3)}
+        stub = _StubControl(blobs)
+        was_enabled = obs_metrics.REGISTRY.enabled
+        obs_metrics.REGISTRY.enabled = True
+        try:
+            from bflc_demo_tpu.comm import dataplane as dp
+            before = sum(
+                s["value"] for s in dp._M_FALLBACK.samples())
+            router = ReadRouter(stub)
+            out = router.fetch_blobs(sorted(blobs))
+            assert out == {h: blobs[h] for h in blobs}
+            after = sum(s["value"] for s in dp._M_FALLBACK.samples())
+            # the batch served 1 of 3: two per-hash fallbacks, counted
+            assert after - before == 2
+            assert stub.calls.count("blob") == 2
+        finally:
+            obs_metrics.REGISTRY.enabled = was_enabled
+
+    def test_totally_missing_hash_raises_lookup_error(self):
+        stub = _StubControl({})
+        router = ReadRouter(stub)
+        with pytest.raises(LookupError):
+            router.fetch_blobs(["ab" * 32])
+
+
+class TestReadSetAdvertisement:
+    """End-to-end: an authenticated standby advertises its read endpoint
+    at subscribe time, the writer republishes it in model replies, and a
+    router's reads actually land on the replica."""
+
+    def test_standby_read_ep_advertised_and_served(self):
+        from bflc_demo_tpu.comm.failover import Standby
+        from bflc_demo_tpu.comm.identity import Wallet
+        wallet = Wallet.from_seed(b"dp-readset-standby-1")
+        standby_keys = {1: wallet.public_bytes}
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0,
+                           ledger_backend="python",
+                           standby_keys=standby_keys)
+        srv.start()
+        sb = Standby(CFG, [(srv.host, srv.port), ("127.0.0.1", 0)], 1,
+                     ledger_backend="python", wallet=wallet,
+                     standby_keys=standby_keys, heartbeat_s=0.2)
+        t = threading.Thread(target=sb.run, daemon=True)
+        t.start()
+        try:
+            ctl = CoordinatorClient(srv.host, srv.port)
+            deadline = time.monotonic() + 20.0
+            meta = {}
+            while time.monotonic() < deadline:
+                meta = ctl.request("model", meta=1)
+                if meta.get("read_set"):
+                    break
+                time.sleep(0.2)
+            assert meta.get("read_set") == \
+                [list(sb.read_server.endpoint)], meta
+            assert "blob" not in meta           # meta probe carries none
+            # wait for the standby to mirror the genesis model, then a
+            # fresh router's model bytes must come FROM the replica
+            while time.monotonic() < deadline and sb._model_blob is None:
+                time.sleep(0.1)
+            router = ReadRouter(ctl)
+            router.note_read_set(meta)      # as a live client would
+            mr = router.fetch_model()
+            assert mr["ok"] and mr["blob"] == _init_blob()
+            assert mr["source"] == "replica", mr["source"]
+            # second fetch of the unchanged model: pure cache hit
+            assert router.fetch_model()["source"] == "cache"
+            ctl.close()
+        finally:
+            sb.stop()
+            srv.close()
+
+    def test_anonymous_subscriber_read_ep_ignored(self):
+        """An unauthenticated subscriber must not enter the read set (it
+        could sinkhole reads for a round-trip each)."""
+        from bflc_demo_tpu.comm.wire import recv_msg, send_msg
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python",
+                           standby_keys={1: b"\x01" * 32})
+        srv.start()
+        try:
+            sub = CoordinatorClient(srv.host, srv.port)
+            send_msg(sub.sock, {"method": "subscribe", "from": 0,
+                                "read_ep": ["127.0.0.1", 1]})
+            time.sleep(0.5)
+            ctl = CoordinatorClient(srv.host, srv.port)
+            meta = ctl.request("model", meta=1)
+            assert not meta.get("read_set"), meta
+            ctl.close()
+            sub.close()
+        finally:
+            srv.close()
+
+
+def _drive_round(server, delta_dtype: str):
+    """One full protocol round over the socket with `delta_dtype`
+    uploads; returns the committed flat model."""
+    c = CoordinatorClient(server.host, server.port)
+    addrs = [f"0x{i:040x}" for i in range(CFG.client_num)]
+    for a in addrs:
+        assert c.request("register", addr=a)["ok"]
+    committee = c.request("committee")["committee"]
+    trainers = [a for a in addrs if a not in committee]
+    for i, a in enumerate(trainers[:3]):
+        blob = (pack_pytree(_delta(float(i + 1)))
+                if delta_dtype == "f32"
+                else pack_quantized(_delta(float(i + 1)), delta_dtype))
+        digest = hashlib.sha256(blob).digest()
+        r = c.request("upload", addr=a, blob=blob, hash=digest.hex(),
+                      n=100, cost=1.0, epoch=0)
+        assert r["ok"], r
+    for j, comm in enumerate(committee):
+        scores = [0.9, 0.5, 0.1] if j == 0 else [0.8, 0.6, 0.2]
+        assert c.request("scores", addr=comm, epoch=0,
+                         scores=scores)["ok"]
+    assert c.request("info")["epoch"] == 1      # aggregation fired
+    mr = c.request("model")
+    from bflc_demo_tpu.comm.wire import blob_bytes
+    flat = unpack_pytree(blob_bytes(mr["blob"]))
+    c.close()
+    return flat
+
+
+class TestQuantizedDeltas:
+    """Opt-in reduced-precision uploads: the hash the ledger certifies
+    is over the QUANTIZED canonical bytes; admission, scoring and
+    aggregation all decode through the one shared dequantizer."""
+
+    @pytest.mark.parametrize("dtype", ["f16", "i8"])
+    def test_quantized_round_aggregates_close_to_f32(self, dtype):
+        cfg_q = dataclasses.replace(CFG, delta_dtype=dtype).validate()
+        srv_f = LedgerServer(CFG, _init_blob(), require_auth=False,
+                             stall_timeout_s=60.0,
+                             ledger_backend="python")
+        srv_q = LedgerServer(cfg_q, _init_blob(), require_auth=False,
+                             stall_timeout_s=60.0,
+                             ledger_backend="python")
+        srv_f.start()
+        srv_q.start()
+        try:
+            ref = _drive_round(srv_f, "f32")
+            got = _drive_round(srv_q, dtype)
+            # deltas are constants (exactly representable at f16; i8
+            # rounds to the max-scale grid): aggregation must land
+            # within one i8 quantization step of the f32 result
+            for key in ref:
+                np.testing.assert_allclose(
+                    got[key], ref[key], atol=CFG.learning_rate * 3 / 127)
+        finally:
+            srv_f.close()
+            srv_q.close()
+
+    def test_quantized_upload_rejected_when_opted_out(self):
+        """delta_dtype=f32 (the default) keeps the strict pre-PR
+        admission: a reduced-precision blob is BAD_ARG at the door."""
+        srv = LedgerServer(CFG, _init_blob(), require_auth=False,
+                           stall_timeout_s=60.0, ledger_backend="python")
+        srv.start()
+        try:
+            c = CoordinatorClient(srv.host, srv.port)
+            for i in range(CFG.client_num):
+                assert c.request("register",
+                                 addr=f"0x{i:040x}")["ok"]
+            committee = c.request("committee")["committee"]
+            trainer = next(f"0x{i:040x}" for i in range(CFG.client_num)
+                           if f"0x{i:040x}" not in committee)
+            blob = pack_quantized(_delta(1.0), "i8")
+            digest = hashlib.sha256(blob).digest()
+            r = c.request("upload", addr=trainer, blob=blob,
+                          hash=digest.hex(), n=100, cost=1.0, epoch=0)
+            assert not r["ok"] and r["status"] == "BAD_ARG", r
+            c.close()
+        finally:
+            srv.close()
+
+    def test_dequantization_is_deterministic(self):
+        rng = np.random.default_rng(7)
+        flat = {"['W']": rng.standard_normal((64, 8)).astype(np.float32)}
+        b1 = pack_quantized({"W": flat["['W']"]}, "i8")
+        b2 = pack_quantized({"W": flat["['W']"]}, "i8")
+        assert b1 == b2                 # signed bytes are reproducible
+        d1 = dequantize_entries(unpack_pytree(b1))
+        d2 = dequantize_entries(unpack_pytree(b2))
+        np.testing.assert_array_equal(d1["['W']"], d2["['W']"])
+        assert d1["['W']"].dtype == np.float32

@@ -1,4 +1,6 @@
-"""Canonical serialization / hashing / store tests."""
+"""Canonical serialization / hashing / store tests, plus round-trip
+property coverage and the opt-in quantized delta encodings (data-plane
+PR: utils.serialization.quantize_entries / dequantize_entries)."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -7,6 +9,11 @@ import pytest
 from bflc_demo_tpu.comm import UpdateStore
 from bflc_demo_tpu.utils import (canonical_bytes, hash_pytree, pack_pytree,
                                  unpack_pytree)
+from bflc_demo_tpu.utils.serialization import (QSCALE_SUFFIX,
+                                               dequantize_entries,
+                                               pack_entries,
+                                               pack_quantized,
+                                               quantize_entries)
 
 
 def tree():
@@ -52,6 +59,121 @@ def test_bfloat16_roundtrip():
     arr = flat["['W']"]
     assert arr.dtype == np.asarray(t["W"]).dtype
     np.testing.assert_array_equal(arr, np.asarray(t["W"]))
+
+
+class TestRoundTripProperties:
+    """pack -> unpack -> pack is the identity on bytes; unpack preserves
+    keys, shapes and dtypes — over the structural edge cases the wire
+    actually carries."""
+
+    def test_empty_tree(self):
+        blob = pack_pytree({})
+        assert unpack_pytree(blob) == {}
+        assert hash_pytree({}) == hash_pytree({})
+
+    def test_zero_d_arrays(self):
+        t = {"s": np.float32(3.5), "n": np.int64(-7)}
+        flat = unpack_pytree(pack_pytree(t))
+        assert flat["['s']"].shape == () and flat["['n']"].shape == ()
+        assert float(flat["['s']"]) == 3.5 and int(flat["['n']"]) == -7
+
+    def test_zero_length_axis(self):
+        t = {"e": np.zeros((0, 4), np.float32)}
+        flat = unpack_pytree(pack_pytree(t))
+        assert flat["['e']"].shape == (0, 4)
+        assert flat["['e']"].dtype == np.float32
+
+    @pytest.mark.parametrize("dtype", [
+        np.float32, np.float64, np.float16, np.int8, np.int32,
+        np.uint8, np.bool_])
+    def test_dtype_preservation(self, dtype):
+        arr = np.arange(6).reshape(2, 3).astype(dtype)
+        flat = unpack_pytree(pack_pytree({"a": arr}))
+        assert flat["['a']"].dtype == arr.dtype
+        np.testing.assert_array_equal(flat["['a']"], arr)
+
+    def test_pack_entries_unpack_identity(self):
+        """The documented contract: pack_entries(unpack_pytree(b)) == b
+        — content addresses agree across the network boundary."""
+        t = {"W": np.linspace(-1, 1, 12, dtype=np.float32).reshape(3, 4),
+             "b": np.zeros((4,), np.float32),
+             "n": np.int32(9)}
+        blob = pack_pytree(t)
+        assert pack_entries(unpack_pytree(blob)) == blob
+
+    def test_nested_structure_flattens_stably(self):
+        t = {"layer": {"W": np.ones((2, 2), np.float32)},
+             "head": [np.zeros(3, np.float32),
+                      np.ones(3, np.float32)]}
+        blob1, blob2 = pack_pytree(t), pack_pytree(t)
+        assert blob1 == blob2
+        flat = unpack_pytree(blob1)
+        assert len(flat) == 3
+        assert hash_pytree(t) == hash_pytree(t)
+
+
+class TestQuantizedEncodings:
+    def _flat(self):
+        rng = np.random.default_rng(42)
+        return {"['W']": rng.standard_normal((32, 8)).astype(np.float32),
+                "['b']": np.zeros((8,), np.float32)}
+
+    def test_f32_is_identity(self):
+        flat = self._flat()
+        assert quantize_entries(flat, "f32") == flat
+        out = dequantize_entries(flat)
+        for k in flat:
+            np.testing.assert_array_equal(out[k], flat[k])
+
+    def test_f16_roundtrip_error_bounded(self):
+        flat = self._flat()
+        out = dequantize_entries(quantize_entries(flat, "f16"))
+        for k in flat:
+            assert out[k].dtype == np.float32
+            np.testing.assert_allclose(out[k], flat[k],
+                                       atol=2e-3, rtol=1e-3)
+
+    def test_i8_roundtrip_error_within_half_scale(self):
+        flat = self._flat()
+        q = quantize_entries(flat, "i8")
+        assert q["['W']"].dtype == np.int8
+        scale = float(np.asarray(q["['W']" + QSCALE_SUFFIX]))
+        out = dequantize_entries(q)
+        assert np.max(np.abs(out["['W']"] - flat["['W']"])) \
+            <= scale / 2 + 1e-7
+
+    def test_i8_zero_leaf_uses_unit_scale(self):
+        q = quantize_entries({"['z']": np.zeros((4,), np.float32)}, "i8")
+        assert float(np.asarray(q["['z']" + QSCALE_SUFFIX])) == 1.0
+        out = dequantize_entries(q)
+        np.testing.assert_array_equal(out["['z']"], np.zeros(4))
+
+    def test_quantized_bytes_are_deterministic_and_hash_stable(self):
+        t = {"W": self._flat()["['W']"]}
+        for dtype in ("f16", "i8"):
+            b1, b2 = pack_quantized(t, dtype), pack_quantized(t, dtype)
+            assert b1 == b2
+            # the quantized blob IS the canonical payload: unpack/repack
+            # reproduces the exact signed bytes
+            assert pack_entries(unpack_pytree(b1)) == b1
+
+    def test_non_float_leaves_pass_through(self):
+        flat = {"['n']": np.arange(4, dtype=np.int32)}
+        for dtype in ("f16", "i8"):
+            q = quantize_entries(flat, dtype)
+            assert q["['n']"].dtype == np.int32
+            assert "['n']" + QSCALE_SUFFIX not in q
+            out = dequantize_entries(q)
+            np.testing.assert_array_equal(out["['n']"], flat["['n']"])
+
+    def test_honest_int8_tensor_without_scale_untouched(self):
+        flat = {"['q']": np.arange(-3, 3, dtype=np.int8)}
+        out = dequantize_entries(flat)
+        assert out["['q']"].dtype == np.int8
+
+    def test_unknown_dtype_rejected(self):
+        with pytest.raises(ValueError, match="delta dtype"):
+            quantize_entries({}, "f8")
 
 
 def test_store_integrity():

@@ -76,6 +76,110 @@ class TestBinaryFrames:
             blob_bytes(17)              # not a wire blob at all
 
 
+class TestCompressedFrames:
+    """The \\x00ZIP1 variant (data-plane PR): negotiated per-frame, size-
+    thresholded, win-gated, bounded against deflate bombs, and fully
+    interoperable with BIN1 and legacy hex-JSON on one socket."""
+
+    def test_compressible_blob_rides_zip_and_roundtrips(self, pair):
+        a, b = pair
+        blob = bytes(range(256)) * 400          # 100 KB, compressible
+        body = wire._maybe_compress(wire._encode({"blob": blob}))
+        assert body[:5] in (wire._ZLIB_MAGIC, wire._ZSTD_MAGIC)
+        send_msg(a, {"method": "m", "blob": blob})
+        m = recv_msg(b)
+        assert m["blob"] == blob and isinstance(m["blob"], bytes)
+
+    def test_small_and_incompressible_frames_stay_raw(self):
+        import os as _os
+        assert wire._maybe_compress(wire._encode({"x": 1}))[:1] == b"{"
+        rnd = _os.urandom(64 * 1024)            # deflate cannot win
+        body = wire._maybe_compress(wire._encode({"blob": rnd}))
+        assert body[:5] == wire._BIN_MAGIC
+
+    def test_three_frame_generations_interleave_on_one_socket(
+            self, pair, monkeypatch):
+        """Acceptance pin: compressed, BIN1 and legacy hex-JSON frames
+        interleaved on ONE socket all decode to the same content."""
+        a, b = pair
+        blob = b"\x42" * 20_000
+        send_msg(a, {"method": "m", "blob": blob})      # compressed
+        monkeypatch.setattr(wire, "_NO_COMPRESS", True)
+        send_msg(a, {"method": "m", "blob": blob})      # BIN1
+        monkeypatch.setattr(wire, "_NO_COMPRESS", False)
+        legacy = json.dumps({"method": "m", "blob": blob.hex()},
+                            separators=(",", ":")).encode()
+        a.sendall(struct.pack(">I", len(legacy)) + legacy)  # hex-JSON
+        send_msg(a, {"method": "m", "blob": blob})      # compressed
+        frames = [recv_msg(b) for _ in range(4)]
+        assert all(blob_bytes(m["blob"]) == blob for m in frames)
+        assert isinstance(frames[2]["blob"], str)       # really legacy
+
+    def test_claimed_raw_length_over_cap_rejected(self, pair):
+        import zlib
+        a, b = pair
+        body = (wire._ZLIB_MAGIC + struct.pack(">I", MAX_FRAME + 1)
+                + zlib.compress(b"x"))
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="outside"):
+            recv_msg(b)
+
+    def test_claimed_raw_length_zero_rejected(self, pair):
+        """raw_len == 0 would make zlib's max_length / zstd's
+        max_output_size mean UNBOUNDED — the deflate-bomb hole; it must
+        die at the header check, before any inflation."""
+        import zlib
+        a, b = pair
+        body = (wire._ZLIB_MAGIC + struct.pack(">I", 0)
+                + zlib.compress(b"A" * 100_000))
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="outside"):
+            recv_msg(b)
+
+    def test_corrupt_zip_payload_rejected(self, pair):
+        a, b = pair
+        body = wire._ZLIB_MAGIC + struct.pack(">I", 10) + b"garbage!"
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="undecodable compressed"):
+            recv_msg(b)
+
+    def test_inflated_length_mismatch_rejected(self, pair):
+        import zlib
+        a, b = pair
+        body = (wire._ZLIB_MAGIC + struct.pack(">I", 10)
+                + zlib.compress(b"abc"))        # claims 10, inflates 3
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="inflated|overruns"):
+            recv_msg(b)
+
+    def test_truncated_zip_header_rejected(self, pair):
+        a, b = pair
+        body = wire._ZLIB_MAGIC + b"\x00"
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(WireError, match="truncated"):
+            recv_msg(b)
+
+    def test_data_plane_legacy_switch_pins_compression_off(
+            self, monkeypatch):
+        monkeypatch.setattr(wire, "_NO_COMPRESS", True)
+        blob = b"\x00" * 50_000
+        body = wire._maybe_compress(wire._encode({"blob": blob}))
+        assert body[:5] == wire._BIN_MAGIC      # raw BIN1, not zip
+
+    def test_chaos_drop_fires_on_compressed_send(self, pair,
+                                                 monkeypatch):
+        from bflc_demo_tpu.chaos.hooks import FaultInjector
+        a, b = pair
+        inj = FaultInjector({
+            "t0": time.time() - 1.0, "role": "test", "seed": 1,
+            "windows": [{"start": 0.0, "end": 3600.0, "mode": "drop",
+                         "ports": [], "p": 1.0}]})
+        monkeypatch.setattr(wire, "_INJECTOR", inj)
+        with pytest.raises(WireError, match="dropped"):
+            send_msg(a, {"method": "m", "blob": b"\x01" * 20_000})
+        assert inj.injected["drop"] == 1
+
+
 class TestMixedVersionPeers:
     def test_old_and_new_frames_interleave_on_one_socket(self, pair):
         """A legacy peer (hex-in-JSON) and a binary-frame peer can share

@@ -86,6 +86,23 @@ def _role_row(role, snap):
         cells.append(f"train {n_tr}x{m_tr * 1e3:6.0f}ms  "
                      f"upload {n_up}x{m_up * 1e3:6.0f}ms  "
                      f"score {n_sc}x{m_sc * 1e3:6.0f}ms")
+        # data-plane read routing (PR 5): where this client's model/blob
+        # bytes came from, and the content-addressed cache's hit ratio
+        reads = {src: _sum_counter(snap, "dataplane_reads_total",
+                                   source=src)
+                 for src in ("cache", "replica", "writer")}
+        hits = _sum_counter(snap, "dataplane_cache_events_total",
+                            event="hit")
+        misses = _sum_counter(snap, "dataplane_cache_events_total",
+                              event="miss")
+        fb = _sum_counter(snap, "dataplane_blob_fallback_total")
+        if any(reads.values()):
+            cells.append(
+                f"reads {reads['cache']:.0f}c/{reads['replica']:.0f}r/"
+                f"{reads['writer']:.0f}w"
+                + (f"  hit {hits / (hits + misses):.0%}"
+                   if hits + misses else "")
+                + (f"  fb {fb:.0f}" if fb else ""))
     elif role.startswith("validator"):
         n_b, m_b = _merged_hist(snap, "vote_latency_seconds",
                                 kind="batch")
@@ -119,8 +136,17 @@ def _role_row(role, snap):
         cells.append(f"wire {wire_in / 1e6:6.2f}/{wire_out / 1e6:6.2f} MB")
     bin_n = _sum_counter(snap, "wire_frames_total", kind="bin")
     json_n = _sum_counter(snap, "wire_frames_total", kind="json")
-    if bin_n or json_n:
-        cells.append(f"frames {bin_n:.0f}bin/{json_n:.0f}json")
+    zip_n = _sum_counter(snap, "wire_frames_total", kind="zip")
+    if bin_n or json_n or zip_n:
+        cells.append(f"frames {bin_n:.0f}bin/{json_n:.0f}json/"
+                     f"{zip_n:.0f}zip")
+    zraw = _sum_counter(snap, "wire_zip_bytes_total", which="raw")
+    zwire = _sum_counter(snap, "wire_zip_bytes_total", which="wire")
+    if zwire:
+        cells.append(f"zip {zraw / zwire:.2f}x")
+    served = _sum_counter(snap, "readfan_requests_total")
+    if served:
+        cells.append(f"served {served:.0f} reads")
     return "  ".join(cells)
 
 

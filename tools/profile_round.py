@@ -36,6 +36,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+# sibling tools (fleet_top's snapshot helpers) importable regardless of
+# how this script was launched
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _reexec_legacy() -> None:
@@ -176,6 +179,28 @@ def main() -> None:
     other = ("wire.bytes_out", "wire.bytes_in")
     print("wire bytes: out={:.0f} in={:.0f}".format(
         costs.get(other[0], 0), costs.get(other[1], 0)))
+
+    # data-plane breakdown (PR 5 obs counters): frame-encoding mix,
+    # compression win, and blob-cache traffic when a router ran —
+    # summed by the ONE snapshot-schema helper fleet_top renders with
+    from fleet_top import _sum_counter as _csum
+
+    frames = {k: _csum(writer_snap, "wire_frames_total", kind=k)
+              for k in ("bin", "json", "zip")}
+    zraw = _csum(writer_snap, "wire_zip_bytes_total", which="raw")
+    zwire = _csum(writer_snap, "wire_zip_bytes_total", which="wire")
+    hits = _csum(writer_snap, "dataplane_cache_events_total",
+                 event="hit")
+    misses = _csum(writer_snap, "dataplane_cache_events_total",
+                   event="miss")
+    line = (f"data plane: frames {frames['bin']:.0f}bin/"
+            f"{frames['json']:.0f}json/{frames['zip']:.0f}zip")
+    if zwire:
+        line += (f"   compression {zraw / 1e6:.2f}->{zwire / 1e6:.2f} MB "
+                 f"({zraw / zwire:.2f}x)")
+    if hits or misses:
+        line += f"   cache {hits:.0f}h/{misses:.0f}m"
+    print(line)
 
 
 if __name__ == "__main__":
