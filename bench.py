@@ -35,7 +35,12 @@ The federation leg runs with the fleet telemetry plane armed (PR 4,
 bflc_demo_tpu/obs): `extra.telemetry` records its scrape coverage
 (roles answering / roles expected); the measured scrape-on-vs-off
 overhead lives in TPU_RESULTS.md (eval.benchmarks.
-telemetry_overhead_config1).  BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
+telemetry_overhead_config1).  `extra.hier` (PR 6) is the
+hierarchical-federation flatness axis: root egress and certified
+ops/round ratios across a 10x thin-client growth at fixed cell count,
+plus the single-tier leg's multiple (eval.benchmarks.hier_scaling; the
+full 1k->10k artifact is TPU_RESULTS.md round 11).
+BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
 
@@ -197,6 +202,31 @@ def _child() -> None:
             "quantized_acc_gap": dp.get("quantized_acc_gap"),
             "quantized_delta_dtype": dp.get("quantized_leg", {}).get(
                 "delta_dtype"),
+        }
+        # hierarchical-federation axes (PR 6): root-coordinator cost vs
+        # simulated thin-client count at fixed cell count — the headline
+        # claim is the flatness ratios (~1.0 across a 10x client-count
+        # increase; the full 1k->10k run is TPU_RESULTS.md round 11,
+        # this is its scaled-down bench-budget twin), plus the
+        # single-tier leg's multiple at the SAME client count
+        from bflc_demo_tpu.eval.benchmarks import hier_scaling
+        hs = hier_scaling(clients=(250, 2500), cells=8, rounds=2,
+                          validators=4, single_tier=(250,))
+        extra["hier"] = {
+            "clients_growth_x": hs.get("clients_growth_x"),
+            "egress_ratio_across_growth": hs.get("hier_egress_ratio"),
+            "ops_ratio_across_growth": hs.get("hier_ops_ratio"),
+            "certified_ops_ratio_across_growth": hs.get(
+                "hier_certified_ops_ratio"),
+            "single_vs_hier_egress_x": hs.get("single_vs_hier_egress_x"),
+            "single_vs_hier_ops_x": hs.get("single_vs_hier_ops_x"),
+            "root_egress_bytes_per_round": {
+                n: leg["root_egress_bytes_per_round"]
+                for n, leg in hs["hier"].items()},
+            "root_certified_ops_per_round": {
+                n: leg["root_certified_ops_per_round"]
+                for n, leg in hs["hier"].items()},
+            "geometry": hs["geometry"],
         }
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),

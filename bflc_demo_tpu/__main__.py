@@ -73,6 +73,19 @@ def main(argv=None) -> int:
             # failure with the same --chaos-seed
             kw["chaos_seed"] = opts.chaos_seed
             kw["chaos_profile"] = opts.chaos_profile
+        if opts.cells or opts.cell_size:
+            # hierarchical cell federation (bflc_demo_tpu.hier): cohort
+            # clients into cells; one certified cell-aggregate op per
+            # cell per round reaches the root — O(cells) root cost
+            if opts.standbys or opts.quorum or opts.tls_dir \
+                    or opts.chaos_seed >= 0:
+                print("--cells/--cell-size do not compose with "
+                      "--standbys/--quorum/--tls-dir/--chaos-seed yet "
+                      "(the hier driver takes an explicit chaos "
+                      "schedule)", file=sys.stderr)
+                return 2
+            kw["cells"] = opts.cells
+            kw["cell_size"] = opts.cell_size
         if opts.attest_scores is not None:
             # never silently drop a requested trust feature
             print("--attest-scores applies to the mesh/executor runtimes",
@@ -102,10 +115,11 @@ def main(argv=None) -> int:
         kw["attest_scores"] = opts.attest_scores
     elif opts.standbys or opts.tls_dir or opts.quorum \
             or opts.attest_scores is not None or opts.bft_validators \
-            or opts.chaos_seed >= 0:
+            or opts.chaos_seed >= 0 or opts.cells or opts.cell_size:
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
-              "--chaos-seed apply to the processes runtime; "
-              "--attest-scores to mesh/executor", file=sys.stderr)
+              "--chaos-seed/--cells/--cell-size apply to the processes "
+              "runtime; --attest-scores to mesh/executor",
+              file=sys.stderr)
         return 2
     if opts.secure:
         if opts.config != "config4":

@@ -146,7 +146,8 @@ def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
                     port_q, validator_keys: dict, verbose: bool,
                     port: int = 0,
                     chaos_spec: Optional[dict] = None,
-                    telemetry_spec: Optional[dict] = None) -> None:
+                    telemetry_spec: Optional[dict] = None,
+                    cell_registry: Optional[dict] = None) -> None:
     """One BFT commit-quorum member (comm.bft.ValidatorNode): an
     independent replica + wallet that re-executes every op and co-signs
     commit certificates — the reference analogue of one PBFT chain node.
@@ -163,6 +164,7 @@ def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
                          Wallet.from_seed(wallet_seed), index,
                          port=port,
                          validator_keys=validator_keys,
+                         cell_registry=cell_registry,
                          verbose=verbose)
     port_q.put(node.port)
     node.serve_forever()

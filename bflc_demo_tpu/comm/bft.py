@@ -483,6 +483,7 @@ class ValidatorNode:
                  directory: Optional[PublicDirectory] = None,
                  validator_keys: Optional[Dict[int, bytes]] = None,
                  quorum: Optional[int] = None,
+                 cell_registry: Optional[Dict[str, Tuple[int, int]]] = None,
                  verbose: bool = False):
         cfg.validate()
         self.cfg = cfg
@@ -507,6 +508,16 @@ class ValidatorNode:
         self.ledger = make_ledger(cfg, backend=ledger_backend)
         self.directory = directory if directory is not None \
             else PublicDirectory()
+        # hierarchical cell federation (bflc_demo_tpu.hier): on a ROOT
+        # quorum, every upload op is a cell-aggregate whose `n` field is
+        # the cell's claimed client count — a validator holding the
+        # registry refuses to co-sign an op from an unregistered sender
+        # or one whose count exceeds that cell's registered membership,
+        # so even a colluding root writer cannot certify an inflated
+        # weight (hier.partial.check_cell_upload_op; the registry is
+        # derived from configuration, like the validator keys)
+        self._cell_registry: Optional[Dict[str, Tuple[int, int]]] = (
+            dict(cell_registry) if cell_registry is not None else None)
         self._lock = threading.Lock()
         # index -> (attempt, op digest) of our current vote there
         self._voted: Dict[int, Tuple[int, bytes]] = {}
@@ -707,6 +718,11 @@ class ValidatorNode:
             return self._refuse("PROMISED",
                                 f"promised attempt {promised}",
                                 promised=promised, voted_t=0)
+        if self._cell_registry is not None:
+            from bflc_demo_tpu.hier.partial import check_cell_upload_op
+            err = check_cell_upload_op(op, self._cell_registry)
+            if err:
+                return self._refuse("CELL", err)
         if self.require_auth:
             err = check_op_auth(op, auth, self.directory)
             if err:

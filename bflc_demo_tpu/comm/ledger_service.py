@@ -225,6 +225,7 @@ class LedgerServer:
                  bft_quorum: Optional[int] = None,
                  bft_timeout_s: float = 10.0,
                  resume_certs: Optional[Dict[int, dict]] = None,
+                 cell_registry: Optional[Dict[str, Tuple[int, int]]] = None,
                  verbose: bool = False):
         """resume_ledger/resume_blobs/sock: the promotion surface
         (comm.failover.Standby) — a server constructed over a replica's
@@ -345,6 +346,16 @@ class LedgerServer:
         # ops one certify_range round-trip may carry
         self._cert_batch = 1 if self._legacy else 128
         self._op_auth: Dict[int, dict] = {}
+        # hierarchical cell federation (bflc_demo_tpu.hier): when a cell
+        # registry {aggregator address -> registered membership} is
+        # provisioned, this server is a ROOT — uploads are cell-aggregate
+        # ops (a partial-sum blob + reserved #cellmeta evidence entry,
+        # `n` = admitted client count) and only registered aggregators
+        # may submit them, with `n` bounded by their registered
+        # membership (the anti-inflation check; hier.partial).  None =
+        # the unchanged single-tier server.
+        self._cell_registry: Optional[Dict[str, Tuple[int, int]]] = (
+            dict(cell_registry) if cell_registry is not None else None)
         if bft_validators:
             from bflc_demo_tpu.comm.bft import CertificateAssembler
             from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
@@ -1081,8 +1092,13 @@ class LedgerServer:
                 # can't buy blob decodes): a delta whose leaves don't match
                 # the current model must die HERE, not later inside an
                 # innocent committee member's scores dispatch when
-                # aggregation walks the mismatched keys
-                err = self._delta_shape_error(blob)
+                # aggregation walks the mismatched keys.  A hier root
+                # additionally enforces the cell contract (registered
+                # aggregator, #cellmeta present, claimed client count
+                # within registered membership — hier.partial).
+                err = (self._cell_admission_error(addr, blob, int(m["n"]))
+                       if self._cell_registry is not None
+                       else self._delta_shape_error(blob))
                 if err:
                     return {"ok": False, "status": "BAD_ARG", "error": err}
                 st = self.ledger.upload_local_update(
@@ -1246,6 +1262,47 @@ class LedgerServer:
                 delta = dequantize_entries(delta)
         except (ValueError, TypeError, struct.error) as e:
             return f"undecodable delta blob: {e}"
+        return self._schema_error(delta)
+
+    def _cell_admission_error(self, addr: str, blob: bytes,
+                              claimed_n: int) -> str:
+        """'' when a cell-aggregate upload honors the cell contract
+        (hier root mode): the sender is a REGISTERED cell aggregator,
+        the blob carries a well-formed #cellmeta evidence entry whose
+        cell index matches the sender's registered cell (a lying
+        aggregator cannot attribute its partial to another cell), whose
+        claimed client count matches the op's `n` weight field, that
+        count does not exceed the sender's registered membership (it
+        cannot inflate its FedAvg weight either), and the partial's
+        tensor entries mirror the model schema."""
+        from bflc_demo_tpu.hier.partial import split_cellmeta
+        ent = self._cell_registry.get(addr)
+        if ent is None:
+            return (f"sender {addr[:12]} is not a registered cell "
+                    f"aggregator")
+        reg_index, cap = ent
+        try:
+            flat = unpack_pytree(blob)
+            partial, meta = split_cellmeta(flat)
+        except (ValueError, TypeError, struct.error) as e:
+            return f"undecodable cell partial: {e}"
+        if meta is None:
+            return "cell partial without a #cellmeta evidence entry"
+        cell_index, n_clients, _evidence = meta
+        if cell_index != reg_index:
+            return (f"#cellmeta cell index {cell_index} != registered "
+                    f"cell {reg_index} for sender {addr[:12]}")
+        if n_clients != claimed_n:
+            return (f"#cellmeta client count {n_clients} != op weight "
+                    f"{claimed_n}")
+        if not 0 < n_clients <= cap:
+            return (f"claimed client count {n_clients} exceeds "
+                    f"registered membership {cap}")
+        return self._schema_error(partial)
+
+    def _schema_error(self, delta: Dict[str, np.ndarray]) -> str:
+        """'' iff flat entries mirror the current model's keys, shapes
+        AND dtypes (shared by single-tier and cell admission)."""
         schema = self._model_schema
         if delta.keys() != schema.keys():
             missing = sorted(schema.keys() - delta.keys())[:3]
@@ -1284,6 +1341,14 @@ class LedgerServer:
         delta_flats = [dequantize_entries(
                            unpack_pytree(self._blobs[u.payload_hash]))
                        for u in updates]
+        if self._cell_registry is not None:
+            # hier root: each "delta" is a cell partial whose reserved
+            # #cellmeta evidence entry rode the certified hash but is not
+            # a model tensor; strip it before the weighted merge (the
+            # weights — u.n_samples — are the admitted CLIENT counts the
+            # admission check bounded against the registry)
+            from bflc_demo_tpu.hier.partial import split_cellmeta
+            delta_flats = [split_cellmeta(f)[0] for f in delta_flats]
         new_flat = _aggregate_flat(global_flat, delta_flats,
                                    [u.n_samples for u in updates],
                                    list(pending.selected),

@@ -647,6 +647,454 @@ def data_plane_config1(rounds: int = 3, *, standbys: int = 2,
     return out
 
 
+# ------------------------------------------- hierarchical federation (PR 6)
+def _flat_entries(template):
+    """[(keystr, leaf_index)] of a pytree template — the canonical entry
+    keys a packed blob of it carries (utils.serialization)."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(template)[0]
+    return [jax.tree_util.keystr(path) for path, _ in flat]
+
+
+def _spawn_bench_root(cfg, initial_blob, *, cell_registry=None,
+                      validators: int = 0,
+                      master_seed: bytes = b"hier-bench-fleet-01",
+                      stall_timeout_s: float = 120.0):
+    """Root coordinator (+ optional validator quorum) as SUBPROCESSES with
+    the cost tracer armed — the hier benchmark's measured tier.  Returns
+    (terminate_fn, host, port)."""
+    import dataclasses as _dc
+    import multiprocessing as mp
+
+    from bflc_demo_tpu.client.process_runtime import (_cpu_spawn_env,
+                                                      _validator_proc)
+    from bflc_demo_tpu.hier.runtime import _root_proc
+
+    cfg_kw = {f.name: getattr(cfg, f.name) for f in _dc.fields(cfg)}
+    ctx = mp.get_context("spawn")
+    host = "127.0.0.1"
+    saved = os.environ.get("BFLC_PROC_TRACE")
+    os.environ["BFLC_PROC_TRACE"] = "1"
+    procs = []
+    try:
+        bft_keys, bft_eps = {}, []
+        if validators:
+            from bflc_demo_tpu.comm.bft import provision_validators
+            _, bft_keys = provision_validators(validators, master_seed)
+            for v in range(validators):
+                q = ctx.Queue()
+                p = ctx.Process(
+                    target=_validator_proc,
+                    args=(cfg_kw, master_seed + b"|bft-validator|"
+                          + __import__("struct").pack("<q", v), v, q,
+                          bft_keys, False, 0, None, None, cell_registry),
+                    daemon=True)
+                with _cpu_spawn_env():
+                    p.start()
+                procs.append(p)
+                bft_eps.append((host, q.get(timeout=60)))
+        q = ctx.Queue()
+        root = ctx.Process(
+            target=_root_proc,
+            args=(cfg_kw, initial_blob, q, stall_timeout_s, "",
+                  cell_registry or {}, bft_eps, bft_keys, False),
+            daemon=True)
+        with _cpu_spawn_env():
+            root.start()
+        procs.append(root)
+        port = q.get(timeout=60)
+    finally:
+        if saved is None:
+            os.environ.pop("BFLC_PROC_TRACE", None)
+        else:
+            os.environ["BFLC_PROC_TRACE"] = saved
+
+    def _terminate():
+        for p in procs:
+            p.terminate()
+            p.join(timeout=10)
+
+    return _terminate, host, port
+
+
+def _root_wire_stats(client) -> Dict:
+    info = client.request("info")
+    costs = (info.get("perf") or {}).get("costs", {})
+    return {"epoch": info["epoch"],
+            "log_size": info["log_size"],
+            "certified_size": info.get("certified_size"),
+            "bytes_out": float(costs.get("wire.bytes_out", 0.0)),
+            "bytes_in": float(costs.get("wire.bytes_in", 0.0))}
+
+
+def _chunked_blob_fetch(client, hashes):
+    """Committee-side candidate fetch, chunked under handle_read's
+    256-hash batch cap — every byte counts toward root egress."""
+    from bflc_demo_tpu.comm.wire import split_blob_parts
+    out = {}
+    for i in range(0, len(hashes), 256):
+        r = client.request("blobs", hashes=hashes[i:i + 256])
+        if r.get("ok"):
+            out.update(split_blob_parts(r))
+    return out
+
+
+def hier_scaling(clients=(1000, 10000), cells: int = 8, rounds: int = 2,
+                 validators: int = 4, single_tier=(1000,),
+                 shard_size: int = 16, seed: int = 0) -> Dict:
+    """THE hierarchical-federation benchmark: root-coordinator cost vs
+    simulated thin-client count (ROADMAP "the 10k-client round").
+
+    Each leg stands up the REAL measured tier as OS processes — the root
+    `LedgerServer` (with the cell registry in hier legs) plus a BFT
+    validator quorum — and simulates the cheap tier in the driver: thin
+    clients train real softmax models on synthetic shards
+    (data/synthetic.py, one vmapped program over all clients), and the
+    cell aggregators run the real `hier.partial` pipeline (dequantize ->
+    sorted weighted partial -> evidence digest -> signed cell-aggregate
+    upload) over real sockets.  What crosses the root's wire is exactly
+    the two deployments' root traffic:
+
+    - hier: O(cells) model fetches + O(cells) certified cell-aggregate
+      ops per round — FLAT as the client count grows 10x (the acceptance
+      bar: within 1.2x);
+    - single-tier (the comparison leg — equivalently `BFLC_HIER_LEGACY=1`
+      / --cells 0 on the CLI path): every client fetches the model from
+      the root and uploads its own signed delta, committee members pull
+      every candidate — O(clients) root egress and certified ops.
+
+    Returns per-leg {root_egress_bytes_per_round, root_ops_per_round,
+    root_certified_ops_per_round, round_wall_time_s} plus the headline
+    ratios.  Measured egress is the root process's own traced
+    wire.bytes_out slope across rounds (registration burst excluded).
+    """
+    import dataclasses as _dc
+    import hashlib as _hl
+    import struct as _struct
+
+    import numpy as np
+
+    from bflc_demo_tpu.comm.identity import Wallet, _op_bytes
+    from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+    from bflc_demo_tpu.comm.wire import blob_bytes
+    from bflc_demo_tpu.core.local_train import local_train_impl
+    from bflc_demo_tpu.core.scoring import score_candidates
+    from bflc_demo_tpu.data.partition import one_hot
+    from bflc_demo_tpu.data.synthetic import synthetic_image_classification
+    from bflc_demo_tpu.hier.cells import (cell_protocol, plan_cells,
+                                          root_protocol)
+    from bflc_demo_tpu.hier.partial import (cell_evidence_digest,
+                                            cell_partial, partial_blob,
+                                            split_cellmeta)
+    from bflc_demo_tpu.models import make_softmax_regression
+    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+                                                   restore_pytree,
+                                                   unpack_pytree)
+
+    import jax
+    import jax.numpy as jnp
+
+    model = make_softmax_regression()
+    template = model.init_params(0)
+    keys = _flat_entries(template)
+    blob0 = pack_pytree(model.init_params(seed))
+    lr, bs = 0.05, min(16, shard_size)
+
+    def _sign(w, kind, epoch, payload):
+        return w.sign(_op_bytes(kind, w.address, epoch, payload)).hex()
+
+    def _shards(n):
+        x, y = synthetic_image_classification(n * shard_size, (5,), 2,
+                                              seed)
+        yh = one_hot(y, 2)
+        return (x.reshape(n, shard_size, 5),
+                yh.reshape(n, shard_size, 2))
+
+    # ONE vmapped train program per leg: every thin client trains its own
+    # shard for real; identical shapes keep it a single compile
+    _train_jit = jax.jit(jax.vmap(
+        lambda params, x, y: local_train_impl(model.apply, params, x, y,
+                                              lr, bs, 1),
+        in_axes=(None, 0, 0)))
+
+    def _train_all(params, xs, ys):
+        deltas, costs = _train_jit(params, jnp.asarray(xs),
+                                   jnp.asarray(ys))
+        return (jax.device_get(deltas), np.asarray(costs))
+
+    def _delta_entries(deltas_tree, i):
+        leaves = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda a: np.asarray(a[i]),
+                                   deltas_tree))
+        return dict(zip(keys, leaves))
+
+    def _register(conn, w):
+        r = conn.request("register", addr=w.address,
+                         pubkey=w.public_bytes.hex(),
+                         tag=_sign(w, "register", 0, b""))
+        assert r["ok"] or r.get("status") == "ALREADY_REGISTERED", r
+
+    def _leg_stats(base_stats, round_stats, t_leg):
+        # ONE definition of the headline per-round slopes (counter delta
+        # over committed rounds) shared by both legs, so the hier-vs-flat
+        # ratios can never drift from asymmetric edits
+        first, last = base_stats, round_stats[-1]
+        nr = len(round_stats)
+        return {
+            "rounds": nr,
+            "root_egress_bytes_per_round": int(
+                (last["bytes_out"] - first["bytes_out"]) / nr),
+            "root_ingress_bytes_per_round": int(
+                (last["bytes_in"] - first["bytes_in"]) / nr),
+            "root_ops_per_round": round(
+                (last["log_size"] - first["log_size"]) / nr, 1),
+            "root_certified_ops_per_round": round(
+                ((last["certified_size"] or 0)
+                 - (first["certified_size"] or 0)) / nr, 1)
+            if last["certified_size"] is not None else None,
+            "round_wall_time_s": round(
+                (time.monotonic() - t_leg) / nr, 3),
+        }
+
+    def _hier_leg(n: int) -> Dict:
+        # "one writer admits every upload" scaled to N: the global genome
+        # admits every trainer (the story the cell tier shards)
+        base = _dc.replace(
+            DEFAULT_PROTOCOL, client_num=n, comm_count=4,
+            aggregate_count=max(n - 4, 1),
+            needed_update_count=max(n - 4, 1), learning_rate=lr,
+            batch_size=bs, local_epochs=1)
+        plan = plan_cells(n, cells=cells)
+        aggs = {c: Wallet.from_seed(b"hier-bench-agg|%d|%d" % (n, c))
+                for c in range(plan.n_cells)}
+        registry = {aggs[c].address: (c, len(plan.members[c]))
+                    for c in range(plan.n_cells)}
+        root_cfg = root_protocol(base, plan.n_cells)
+        cell_cfgs = {c: cell_protocol(base, len(plan.members[c]))
+                     for c in range(plan.n_cells)}
+        stop, host, port = _spawn_bench_root(
+            root_cfg, blob0, cell_registry=registry,
+            validators=validators)
+        xs, ys = _shards(n)
+        t_leg = time.monotonic()
+        out: Dict = {"clients": n, "cells": plan.n_cells}
+        try:
+            conns = {c: CoordinatorClient(host, port, timeout_s=120.0)
+                     for c in range(plan.n_cells)}
+            for c, w in aggs.items():
+                _register(conns[c], w)
+            base_stats = _root_wire_stats(conns[0])
+            round_stats = []
+            for rd in range(rounds):
+                epoch = base_stats["epoch"] if not round_stats else \
+                    round_stats[-1]["epoch"]
+                # model DOWN the tree: one fetch per cell aggregator
+                mblobs = {}
+                for c in range(plan.n_cells):
+                    mr = conns[c].request("model")
+                    mblobs[c] = blob_bytes(mr["blob"])
+                params = restore_pytree(template,
+                                        unpack_pytree(mblobs[0]))
+                deltas, costs = _train_all(params, xs, ys)
+                # cell tier (driver-simulated, real hier.partial path);
+                # root-committee cells score instead of uploading, so
+                # skip their partial pipeline before paying for it
+                for c in range(plan.n_cells):
+                    w = aggs[c]
+                    st = conns[c].request("state", addr=w.address)
+                    if st["role"] != "trainer":
+                        continue
+                    cc = cell_cfgs[c]
+                    members = plan.members[c]
+                    trainers = members[cc.comm_count:]
+                    adm_idx = list(trainers[:cc.needed_update_count])
+                    admitted = [(f"0xm{i:08x}", _delta_entries(deltas, i),
+                                 shard_size, float(costs[i]))
+                                for i in adm_idx]
+                    part, n_adm, mcost = cell_partial(admitted)
+                    stacked = jax.tree_util.tree_map(
+                        lambda *t: jnp.stack(t),
+                        *[restore_pytree(template, f)
+                          for _, f, _, _ in admitted[:8]])
+                    row = np.asarray(score_candidates(
+                        model.apply, params, stacked, lr,
+                        jnp.asarray(xs[members[0]]),
+                        jnp.asarray(ys[members[0]])))
+                    ev = cell_evidence_digest(
+                        epoch, c,
+                        [(a, _hl.sha256(str(a).encode()).digest(), nn,
+                          cc_) for a, _, nn, cc_ in admitted],
+                        [float(v) for v in row], list(range(n_adm)))
+                    blob = partial_blob(part, c, n_adm, ev)
+                    digest = _hl.sha256(blob).digest()
+                    payload = digest + _struct.pack("<qd", n_adm,
+                                                    float(mcost))
+                    conns[c].request(
+                        "upload", addr=w.address, blob=blob,
+                        hash=digest.hex(), n=n_adm, cost=float(mcost),
+                        epoch=epoch,
+                        tag=_sign(w, "upload", epoch, payload))
+                # root committee cells score the candidate partials
+                for c in range(plan.n_cells):
+                    w = aggs[c]
+                    if conns[c].request("state",
+                                        addr=w.address)["role"] != "comm":
+                        continue
+                    ups = conns[c].request("updates")["updates"]
+                    if not ups:
+                        continue
+                    fetched = _chunked_blob_fetch(
+                        conns[c], [u["hash"] for u in ups])
+                    cands = [restore_pytree(
+                                 template,
+                                 split_cellmeta(unpack_pytree(
+                                     fetched[u["hash"]]))[0])
+                             for u in ups]
+                    stacked = jax.tree_util.tree_map(
+                        lambda *t: jnp.stack(t), *cands)
+                    row = [float(v) for v in np.asarray(score_candidates(
+                        model.apply, params, stacked, lr,
+                        jnp.asarray(xs[plan.members[c][0]]),
+                        jnp.asarray(ys[plan.members[c][0]])))]
+                    payload = _struct.pack(f"<{len(row)}d", *row)
+                    conns[c].request(
+                        "scores", addr=w.address, epoch=epoch,
+                        scores=row,
+                        tag=_sign(w, "scores", epoch, payload))
+                deadline = time.monotonic() + 120.0
+                while True:
+                    stats = _root_wire_stats(conns[0])
+                    if stats["epoch"] > epoch:
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"hier leg n={n}: round {rd} never "
+                            f"committed at the root")
+                    time.sleep(0.05)
+                round_stats.append(stats)
+            for c in conns.values():
+                c.close()
+        finally:
+            stop()
+        out.update(_leg_stats(base_stats, round_stats, t_leg))
+        return out
+
+    def _flat_leg(n: int) -> Dict:
+        cfg = _dc.replace(DEFAULT_PROTOCOL, client_num=n, comm_count=4,
+                          aggregate_count=max(n - 4, 1),
+                          needed_update_count=max(n - 4, 1),
+                          learning_rate=lr, batch_size=bs,
+                          local_epochs=1)
+        wallets = [Wallet.from_seed(b"hier-bench-flat|%d|%d" % (n, i))
+                   for i in range(n)]
+        stop, host, port = _spawn_bench_root(cfg, blob0,
+                                             validators=validators)
+        xs, ys = _shards(n)
+        t_leg = time.monotonic()
+        out: Dict = {"clients": n}
+        try:
+            conn = CoordinatorClient(host, port, timeout_s=120.0)
+            for w in wallets:
+                _register(conn, w)
+            committee = set(conn.request("committee")["committee"])
+            base_stats = _root_wire_stats(conn)
+            round_stats = []
+            for rd in range(rounds):
+                epoch = base_stats["epoch"] if not round_stats else \
+                    round_stats[-1]["epoch"]
+                # every client fetches the model FROM THE ROOT — the
+                # single-tier O(N) down-traffic the cell tier removes
+                params = None
+                for i, w in enumerate(wallets):
+                    mr = conn.request("model")
+                    if params is None:
+                        params = restore_pytree(
+                            template,
+                            unpack_pytree(blob_bytes(mr["blob"])))
+                deltas, costs = _train_all(params, xs, ys)
+                for i, w in enumerate(wallets):
+                    if w.address in committee:
+                        continue
+                    blob = pack_pytree(jax.tree_util.tree_map(
+                        lambda a: np.asarray(a[i]), deltas))
+                    digest = _hl.sha256(blob).digest()
+                    payload = digest + _struct.pack(
+                        "<qd", shard_size, float(costs[i]))
+                    conn.request(
+                        "upload", addr=w.address, blob=blob,
+                        hash=digest.hex(), n=shard_size,
+                        cost=float(costs[i]), epoch=epoch,
+                        tag=_sign(w, "upload", epoch, payload))
+                ups = conn.request("updates")["updates"]
+                hashes = [u["hash"] for u in ups]
+                for w in wallets:
+                    if w.address not in committee:
+                        continue
+                    fetched = _chunked_blob_fetch(conn, hashes)
+                    cands = [restore_pytree(template,
+                                            unpack_pytree(fetched[h]))
+                             for h in hashes]
+                    stacked = jax.tree_util.tree_map(
+                        lambda *t: jnp.stack(t), *cands)
+                    row = [float(v) for v in np.asarray(score_candidates(
+                        model.apply, params, stacked, lr,
+                        jnp.asarray(xs[0]), jnp.asarray(ys[0])))]
+                    payload = _struct.pack(f"<{len(row)}d", *row)
+                    conn.request("scores", addr=w.address, epoch=epoch,
+                                 scores=row,
+                                 tag=_sign(w, "scores", epoch, payload))
+                deadline = time.monotonic() + 300.0
+                while True:
+                    stats = _root_wire_stats(conn)
+                    if stats["epoch"] > epoch:
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"flat leg n={n}: round {rd} never "
+                            f"committed")
+                    time.sleep(0.05)
+                round_stats.append(stats)
+                committee = set(conn.request("committee")["committee"])
+            conn.close()
+        finally:
+            stop()
+        out.update(_leg_stats(base_stats, round_stats, t_leg))
+        return out
+
+    out: Dict = {
+        "geometry": {"cells": cells, "validators": validators,
+                     "rounds": rounds, "shard_size": shard_size,
+                     "model": "softmax_regression(5->2)"},
+        "hier": {str(n): _hier_leg(int(n)) for n in clients},
+        "single_tier": {str(n): _flat_leg(int(n)) for n in single_tier},
+    }
+    hs = [out["hier"][str(n)] for n in clients]
+    if len(hs) >= 2 and hs[0]["root_egress_bytes_per_round"]:
+        out["clients_growth_x"] = round(
+            int(clients[-1]) / int(clients[0]), 1)
+        out["hier_egress_ratio"] = round(
+            hs[-1]["root_egress_bytes_per_round"]
+            / hs[0]["root_egress_bytes_per_round"], 3)
+        out["hier_ops_ratio"] = round(
+            hs[-1]["root_ops_per_round"]
+            / max(hs[0]["root_ops_per_round"], 1e-9), 3)
+        if hs[0].get("root_certified_ops_per_round"):
+            out["hier_certified_ops_ratio"] = round(
+                hs[-1]["root_certified_ops_per_round"]
+                / hs[0]["root_certified_ops_per_round"], 3)
+    ft = out["single_tier"].get(str(clients[0])) if single_tier else None
+    if ft and out["hier"].get(str(clients[0])):
+        h0 = out["hier"][str(clients[0])]
+        if h0["root_egress_bytes_per_round"]:
+            out["single_vs_hier_egress_x"] = round(
+                ft["root_egress_bytes_per_round"]
+                / h0["root_egress_bytes_per_round"], 2)
+        if h0["root_ops_per_round"]:
+            out["single_vs_hier_ops_x"] = round(
+                ft["root_ops_per_round"] / h0["root_ops_per_round"], 2)
+    return out
+
+
 def telemetry_overhead_config1(rounds: int = 3, trials: int = 1,
                                **kw) -> Dict:
     """Telemetry overhead measured, not asserted (the observability

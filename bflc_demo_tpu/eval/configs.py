@@ -43,6 +43,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      attest_scores: Optional[bool] = None,
                      chaos_seed: Optional[int] = None,
                      chaos_profile: str = "standard",
+                     cells: int = 0, cell_size: int = 0,
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -65,7 +66,8 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     if runtime != "processes":
         inapplicable += [("standbys", standbys), ("quorum", quorum),
                          ("bft_validators", bft_validators),
-                         ("chaos_seed", chaos_seed is not None)]
+                         ("chaos_seed", chaos_seed is not None),
+                         ("cells", cells), ("cell_size", cell_size)]
     if runtime not in ("executor", "mesh"):
         # attestation exists on both mesh-family runtimes (default-on
         # where wallets exist); elsewhere an explicit request must error
@@ -98,6 +100,30 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         if not process_factory:
             raise ValueError("this preset does not support the 'processes' "
                              "runtime (no model factory registered)")
+        import os as _os
+        if (cells or cell_size) and _os.environ.get("BFLC_HIER_LEGACY"):
+            # the benchmark's single-tier pin: ignore the cell tier and
+            # run the unchanged flat path (documented in README)
+            cells = cell_size = 0
+        if cells or cell_size:
+            # hierarchical cell federation (bflc_demo_tpu.hier): two-tier
+            # process deployment.  Standbys/quorum/chaos_seed belong to
+            # the single-tier runtime (the hier driver takes an explicit
+            # chaos_schedule instead); never silently drop them.
+            dropped = [n for n, v in (("standbys", standbys),
+                                      ("quorum", quorum),
+                                      ("tls_dir", tls_dir),
+                                      ("chaos_seed",
+                                       chaos_seed is not None)) if v]
+            if dropped:
+                raise ValueError(f"options {dropped} are not supported "
+                                 f"with --cells/--cell-size")
+            from bflc_demo_tpu.hier.runtime import run_federated_hier
+            return run_federated_hier(
+                process_factory, shards, test_set, cfg, rounds=rounds,
+                cells=cells, cell_size=cell_size,
+                factory_kw=factory_kw or {},
+                bft_validators=bft_validators, verbose=verbose)
         from bflc_demo_tpu.client.process_runtime import \
             run_federated_processes
         return run_federated_processes(

@@ -1,0 +1,47 @@
+"""Hierarchical cell federation: two-tier committee consensus.
+
+One writer admitting, scoring and certifying every client upload caps the
+fleet at tens of clients (config-1 is 20); Konečný et al. 2016 names
+coordinator communication as THE federated-learning bottleneck and
+Bonawitz et al. 2019 (PAPERS.md) gives the production answer — a tier of
+intermediate aggregators so the root coordinator sees O(cells), not
+O(clients).  This package is that tier, built by RUNNING THE EXISTING
+PROTOCOL TWICE:
+
+- clients are deterministically cohorted into cells (`cells.py`); each
+  cell aggregator (`aggregator.py`) is a full `comm.ledger_service.
+  LedgerServer` over its members — admission gas, Ed25519 tags, committee
+  scoring and stall recovery all reuse unchanged at cell scope;
+- when a cell's round fires, the aggregator computes ONE deterministic
+  partial (`partial.py`: sample-weighted FedAvg of the cell-selected
+  deltas, summed in sorted-address order so arrival order can never leak
+  into the bytes) and submits it to the root ledger as a STANDARD signed
+  `upload` op: payload hash over the partial-sum canonical bytes
+  (including the reserved `#cellmeta` evidence entry), `n` = the admitted
+  client count (the root's FedAvg weight, bounded by the root's cell
+  registry), `cost` = the cell's mean training cost;
+- the root therefore BFT-certifies O(cells) ops per round through the
+  UNCHANGED `comm.bft` machinery (`verify_certificate` byte-compatible),
+  and root-side FedAvg is a client-count-weighted merge of cell partials;
+- the global model flows back down through the existing read fan-out
+  (`comm.dataplane`): each aggregator is a consumer of the root's read
+  set and the serving replica for its own members.
+
+`runtime.run_federated_hier` is the OS-process deployment driver;
+`eval.benchmarks.hier_scaling` is the 10x-clients-flat-root benchmark.
+Single-tier mode (no --cells flag) is untouched and remains the default.
+"""
+
+from bflc_demo_tpu.hier.cells import (CellPlan, cell_protocol, cell_seed,
+                                      plan_cells, root_protocol)
+from bflc_demo_tpu.hier.partial import (CELLMETA_KEY, cell_evidence_digest,
+                                        cell_partial, check_cell_upload_op,
+                                        pack_cellmeta, partial_blob,
+                                        split_cellmeta, unpack_cellmeta)
+
+__all__ = [
+    "CellPlan", "plan_cells", "cell_seed", "cell_protocol",
+    "root_protocol", "CELLMETA_KEY", "cell_partial",
+    "cell_evidence_digest", "pack_cellmeta", "unpack_cellmeta",
+    "split_cellmeta", "partial_blob", "check_cell_upload_op",
+]

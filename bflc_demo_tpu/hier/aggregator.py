@@ -1,0 +1,385 @@
+"""The cell aggregator: a full coordinator for its members, a client of
+the root.
+
+`CellAggregatorServer` IS a `comm.ledger_service.LedgerServer` — its
+members register, upload deltas, and committee-score over the unchanged
+wire protocol, with the unchanged Ed25519 admission, per-sender gas
+budgets and stall recovery, all at cell scope.  What changes is the
+round's ending: where the single-tier coordinator FedAvgs into a NEW
+global model, the cell aggregator computes one deterministic PARTIAL
+(`hier.partial.cell_partial` over the cell-selected deltas) and hands it
+to the bridge thread, which runs the standard client state machine
+against the ROOT ledger:
+
+- root role *trainer*: sign + upload the partial as a cell-aggregate op
+  (standard `upload`: hash over the partial canonical bytes incl. the
+  #cellmeta evidence entry, `n` = admitted client count, `cost` = mean
+  member cost) — one certified root op per cell per round;
+- root role *comm*: fetch the round's candidate partials through the
+  read fan-out and score them on this aggregator's validation shard
+  (the same committee duty the base protocol gives a client, one tier
+  up; without a provisioned shard the aggregator submits a neutral row
+  so a data-less deployment degrades to unweighted selection instead of
+  wedging the root round);
+- on the root's commit: fetch the new global model (hash-verified via
+  `comm.dataplane.ReadRouter` — the aggregator is a CONSUMER of the
+  root's read set), then commit it into the local cell ledger so members
+  see the next epoch — the aggregator is the SERVING REPLICA for its own
+  members (`handle_read` is inherited).
+
+The bridge holds no lock during root I/O: members keep polling/reading
+while the cell waits on the root, and a root failover window degrades to
+retries (FailoverClient semantics) rather than wedging the cell.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.comm.failover import FailoverClient
+from bflc_demo_tpu.comm.identity import _op_bytes
+from bflc_demo_tpu.comm.ledger_service import LedgerServer
+from bflc_demo_tpu.comm.wire import WireError
+from bflc_demo_tpu.hier.partial import (cell_evidence_digest, cell_partial,
+                                        partial_blob, split_cellmeta)
+from bflc_demo_tpu.ledger import LedgerStatus
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+                                               restore_pytree,
+                                               unpack_pytree)
+
+Endpoint = Tuple[str, int]
+
+# --- cell-tier telemetry (obs.metrics; no-ops unless the child armed the
+# registry).  Scraped over the inherited `telemetry` RPC, so fleet_top /
+# profile_round render cell rows off the same scrape plane as every
+# other role.
+_G_CELL = obs_metrics.REGISTRY.gauge(
+    "cell_index", "which cell this aggregator serves")
+_G_ADMIT = obs_metrics.REGISTRY.gauge(
+    "cell_admitted", "clients admitted into the last cell partial")
+_M_PARTIAL = obs_metrics.REGISTRY.histogram(
+    "cell_partial_seconds",
+    "cell-local partial-sum compute time (decode + weighted merge + "
+    "evidence digest)")
+_M_ROOT_ACK = obs_metrics.REGISTRY.histogram(
+    "cell_root_ack_seconds",
+    "cell-aggregate op upload -> (certified) root ack round-trip")
+_M_BRIDGE = obs_metrics.REGISTRY.counter(
+    "cell_bridge_events_total", "bridge state-machine outcomes",
+    ("event",))
+
+
+class CellAggregatorServer(LedgerServer):
+    """One cell's coordinator + the root's client (see module docstring).
+
+    `cfg` is the CELL-tier protocol genome (hier.cells.cell_protocol);
+    the root's genome lives at the root.  `wallet` is this aggregator's
+    provisioned identity — the ONLY key that can submit this cell's
+    partials (the root's cell registry maps its address to the cell's
+    registered membership).  `val_shard` is an optional (x, y_onehot)
+    validation set for root-committee scoring; `model_factory`/
+    `factory_kw` name the model (bflc_demo_tpu.models) it scores with.
+    """
+
+    def __init__(self, cfg: ProtocolConfig, initial_model_blob: bytes,
+                 cell_index: int, wallet,
+                 root_endpoints: List[Endpoint], *,
+                 model_factory: str = "", factory_kw: Optional[dict] = None,
+                 val_shard: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                 root_standby_keys: Optional[Dict[int, bytes]] = None,
+                 root_bft_keys: Optional[Dict[int, bytes]] = None,
+                 root_timeout_s: float = 30.0,
+                 root_tls=None,
+                 **kw):
+        # the cell ledger is plain python-backend by default (tiny chains,
+        # restart-cheap); callers may still override through kw
+        kw.setdefault("ledger_backend", "python")
+        super().__init__(cfg, initial_model_blob, **kw)
+        self.cell_index = cell_index
+        self.wallet = wallet
+        self._root_endpoints = list(root_endpoints)
+        self._root_standby_keys = dict(root_standby_keys or {})
+        self._root_bft_keys = dict(root_bft_keys or {})
+        self._root_timeout_s = root_timeout_s
+        self._root_tls = root_tls
+        self._model_factory = model_factory
+        self._factory_kw = dict(factory_kw or {})
+        self._val = val_shard
+        self._model = None              # built lazily (jax import)
+        self._template = None
+        # the bridge handoff: the computed partial awaiting root
+        # submission for its epoch (one at a time — rounds are serial)
+        self._outbox: Optional[dict] = None
+        self._partial_epoch: Optional[int] = None
+        self._bridge_thread: Optional[threading.Thread] = None
+        if obs_metrics.REGISTRY.enabled:
+            _G_CELL.set(cell_index)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        super().start()
+        t = threading.Thread(target=self._root_loop, daemon=True)
+        t.start()
+        self._bridge_thread = t
+        self._threads.append(t)
+
+    # ------------------------------------------------- cell round ending
+    def _aggregate_and_commit(self) -> None:
+        """Ends the CELL round: compute the deterministic partial from
+        the cell-selected deltas and stage it for the bridge — the local
+        ledger does NOT commit here (the commit happens when the root's
+        round does, with the root's model hash).  Idempotent: the stall
+        monitor re-enters this while the bridge waits on the root."""
+        epoch = self.ledger.epoch
+        if self._partial_epoch == epoch:
+            return
+        t0 = time.perf_counter()
+        pending = self.ledger.pending()
+        updates = self.ledger.query_all_updates()
+        admitted = []
+        for s in pending.selected:
+            u = updates[s]
+            flat = dequantize_entries(
+                unpack_pytree(self._blobs[u.payload_hash]))
+            admitted.append((u.sender, flat, u.n_samples, u.avg_cost))
+        partial, n_clients, mean_cost = cell_partial(admitted)
+        evidence = cell_evidence_digest(
+            epoch, self.cell_index,
+            [(u.sender, u.payload_hash, u.n_samples, u.avg_cost)
+             for u in updates],
+            [float(m) for m in pending.medians],
+            list(pending.selected))
+        blob = partial_blob(partial, self.cell_index, n_clients, evidence)
+        self._outbox = {"epoch": epoch, "blob": blob, "n": n_clients,
+                        "cost": mean_cost,
+                        "hash": hashlib.sha256(blob).digest()}
+        self._partial_epoch = epoch
+        for u in updates:
+            self._blobs.pop(u.payload_hash, None)
+        self._last_progress = time.monotonic()
+        self._cv.notify_all()
+        dt = time.perf_counter() - t0
+        if obs_metrics.REGISTRY.enabled:
+            _G_ADMIT.set(n_clients)
+            _M_PARTIAL.observe(dt)
+        obs_flight.FLIGHT.record(
+            "event", "cell_partial_ready", epoch=epoch,
+            cell=self.cell_index, admitted=n_clients)
+        if self.verbose:
+            print(f"[cell {self.cell_index}] epoch {epoch}: partial over "
+                  f"{n_clients} clients ready ({dt * 1e3:.1f} ms)",
+                  flush=True)
+
+    # ------------------------------------------------------ root bridge
+    def _sign(self, kind: str, epoch: int, payload: bytes) -> str:
+        return self.wallet.sign(_op_bytes(
+            kind, self.wallet.address, epoch, payload)).hex()
+
+    def _root_register(self, client) -> None:
+        deadline = time.monotonic() + 120.0
+        while not self._stop.is_set():
+            r = client.request("register", addr=self.wallet.address,
+                               pubkey=self.wallet.public_bytes.hex(),
+                               tag=self._sign("register", 0, b""))
+            if r.get("ok") or r.get("status") in ("ALREADY_REGISTERED",
+                                                  "DUPLICATE"):
+                return
+            if r.get("status") in ("REPLICATION_TIMEOUT", "CERT_TIMEOUT") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.5)
+                continue
+            raise ConnectionError(f"root register failed: {r}")
+
+    def _build_model(self):
+        if self._model is None:
+            import bflc_demo_tpu.models as models
+            self._model = getattr(models, self._model_factory)(
+                **self._factory_kw)
+            self._template = self._model.init_params(0)
+        return self._model
+
+    def _score_root_candidates(self, router, ups: List[dict],
+                               repoch: int) -> Optional[List[float]]:
+        """This cell's root-committee score row over the round's
+        candidate partials, or None when the round turned under us.
+        With a validation shard: apply each partial to the global model
+        and measure held-out accuracy (core.scoring, the same committee
+        duty a client performs one tier down).  Without one: a neutral
+        constant row (selection degrades to slot order — documented in
+        the class docstring) rather than wedging the root round."""
+        if self._val is None or not self._model_factory:
+            _M_BRIDGE.inc(event="score_neutral")
+            return [0.5] * len(ups)
+        import jax
+        import jax.numpy as jnp
+
+        from bflc_demo_tpu.core.scoring import score_candidates
+        model = self._build_model()
+        mr = router.fetch_model()
+        if not mr.get("ok") or mr["epoch"] != repoch:
+            return None
+        params = restore_pytree(self._template,
+                                unpack_pytree(mr["blob"]))
+        try:
+            blobs = router.fetch_blobs([u["hash"] for u in ups])
+        except (LookupError, ConnectionError):
+            return None
+        deltas = [restore_pytree(self._template,
+                                 split_cellmeta(unpack_pytree(
+                                     blobs[u["hash"]]))[0])
+                  for u in ups]
+        stacked = jax.tree_util.tree_map(lambda *t: jnp.stack(t), *deltas)
+        xv, yv = self._val
+        scores = score_candidates(model.apply, params, stacked,
+                                  self.cfg.learning_rate,
+                                  jnp.asarray(xv), jnp.asarray(yv))
+        return [float(s) for s in np.nan_to_num(
+            np.asarray(scores), nan=0.0, posinf=1.0, neginf=0.0)]
+
+    def _commit_global(self, router) -> bool:
+        """Pull the root's committed model and end the local round with
+        it: commit_model with the GLOBAL hash, refresh the served blob —
+        members' next fetch_model sees the new epoch.  False when the
+        local round is not ready or the fetch failed."""
+        mr = router.fetch_model()
+        if not mr.get("ok"):
+            return False
+        blob = mr["blob"]
+        digest = hashlib.sha256(blob).digest()
+        with self._lock:
+            if not self.ledger.aggregate_ready() \
+                    or self.ledger.epoch >= mr["epoch"]:
+                return False
+            epoch = self.ledger.epoch
+            st = self.ledger.commit_model(digest, epoch)
+            if st != LedgerStatus.OK:
+                return False
+            self._model_blob = blob
+            self._model_hash = digest
+            self._model_schema = {k: (a.shape, a.dtype) for k, a in
+                                  unpack_pytree(blob).items()}
+            if self._outbox is not None \
+                    and self._outbox["epoch"] <= epoch:
+                self._outbox = None
+            self._rounds_completed += 1
+            self._last_progress = time.monotonic()
+            self._cv.notify_all()
+        obs_flight.FLIGHT.record("event", "cell_round_committed",
+                                 epoch=epoch, cell=self.cell_index)
+        _M_BRIDGE.inc(event="commit")
+        if self.verbose:
+            print(f"[cell {self.cell_index}] epoch {epoch}: global model "
+                  f"committed locally", flush=True)
+        return True
+
+    def _root_loop(self) -> None:
+        from bflc_demo_tpu.comm.dataplane import ReadRouter
+        client = FailoverClient(self._root_endpoints,
+                                timeout_s=self._root_timeout_s,
+                                tls=self._root_tls,
+                                standby_keys=self._root_standby_keys
+                                or None,
+                                bft_keys=self._root_bft_keys or None)
+        router = ReadRouter(client, timeout_s=self._root_timeout_s,
+                            tls=self._root_tls)
+        submitted_epoch = -10 ** 9
+        scored_epoch = -10 ** 9
+        known_log = 0
+        registered = False
+        try:
+            while not self._stop.is_set():
+                try:
+                    if not registered:
+                        self._root_register(client)
+                        registered = True
+                    st = client.request("state",
+                                        addr=self.wallet.address)
+                    repoch = st["epoch"]
+                    if repoch < 0:      # root still enrolling cells
+                        known_log = client.request(
+                            "wait", log_size=known_log,
+                            timeout_s=1.0)["log_size"]
+                        continue
+                    acted = False
+                    with self._lock:
+                        outbox = self._outbox
+                    if st["role"] == "trainer" and outbox is not None \
+                            and outbox["epoch"] == repoch \
+                            and repoch > submitted_epoch:
+                        digest = outbox["hash"]
+                        payload = digest + struct.pack(
+                            "<qd", outbox["n"], float(outbox["cost"]))
+                        t0 = time.perf_counter()
+                        r = client.request(
+                            "upload", addr=self.wallet.address,
+                            blob=outbox["blob"], hash=digest.hex(),
+                            n=outbox["n"], cost=float(outbox["cost"]),
+                            epoch=repoch,
+                            tag=self._sign("upload", repoch, payload))
+                        if obs_metrics.REGISTRY.enabled:
+                            _M_ROOT_ACK.observe(
+                                time.perf_counter() - t0)
+                        if r.get("status") in ("OK", "DUPLICATE",
+                                               "CAP_REACHED",
+                                               "WRONG_EPOCH"):
+                            submitted_epoch = repoch
+                            acted = bool(r.get("ok"))
+                            _M_BRIDGE.inc(event="upload_" + (
+                                "ok" if r.get("ok") else "dropped"))
+                        elif r.get("status") == "BAD_ARG":
+                            # a failed-over root can hold a directory
+                            # hole for us — re-present the registration
+                            # (idempotent) and retry next loop
+                            registered = False
+                    elif st["role"] == "comm" and repoch > scored_epoch:
+                        ups = client.request("updates")["updates"]
+                        if ups:
+                            row = self._score_root_candidates(
+                                router, ups, repoch)
+                            if row is not None:
+                                payload = struct.pack(
+                                    f"<{len(row)}d", *row)
+                                r = client.request(
+                                    "scores",
+                                    addr=self.wallet.address,
+                                    epoch=repoch, scores=row,
+                                    tag=self._sign("scores", repoch,
+                                                   payload))
+                                if r.get("status") in ("OK",
+                                                       "WRONG_EPOCH",
+                                                       "DUPLICATE"):
+                                    scored_epoch = repoch
+                                    acted = bool(r.get("ok"))
+                                    _M_BRIDGE.inc(event="score")
+                                elif r.get("status") == "BAD_ARG":
+                                    registered = False
+                    # end the local round when the root committed past it
+                    with self._lock:
+                        local_epoch = self.ledger.epoch
+                        ready = self.ledger.aggregate_ready()
+                    if ready and repoch > local_epoch:
+                        acted = self._commit_global(router) or acted
+                    if not acted:
+                        known_log = client.request(
+                            "wait", log_size=known_log,
+                            timeout_s=1.0)["log_size"]
+                except (ConnectionError, WireError, OSError, KeyError):
+                    # a root failover window (or a reply shape from a
+                    # mid-promotion server): back off and re-drive — the
+                    # bridge must outlive root churn
+                    _M_BRIDGE.inc(event="retry")
+                    if self._stop.is_set():
+                        break
+                    time.sleep(0.5)
+        finally:
+            router.close()
+            client.close()

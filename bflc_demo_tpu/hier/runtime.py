@@ -1,0 +1,527 @@
+"""Two-tier process federation: root + cell aggregators + member clients.
+
+`run_federated_hier` is `client.process_runtime.run_federated_processes`
+one level up: every role is a real OS process over real sockets —
+
+    sponsor (parent)
+      └─ root coordinator (LedgerServer + cell registry)
+           ├─ BFT validator fleet (optional; certifies O(cells) ops/round,
+           │    each validator also enforcing the cell-count bound)
+           ├─ cell aggregator 0 (CellAggregatorServer) ── member clients
+           ├─ cell aggregator 1 ─────────────────────── member clients
+           └─ ...
+
+Member clients are the UNCHANGED `_client_proc` state machine from the
+single-tier runtime — a member cannot tell its coordinator is a cell.
+Each member's endpoint list is [its cell aggregator, the ring sibling]:
+when a cell aggregator dies mid-round, its members' FailoverClient
+rotates to the sibling, re-registers there (self-authenticating TOFU),
+and keeps contributing — the re-home drill in tests/test_chaos.py.  The
+sibling's admitted-count stays within ITS registered membership bound
+because cell admission caps at the cell genome's needed_update_count,
+which is strictly below the registry cap.
+
+The sponsor evaluates the ROOT's committed global model each round, and
+— when a chaos schedule is armed — drives the standard `ChaosCampaign`
+(roles `cell-<c>` kill/restart like any other) with the root as the
+invariant monitor's probe.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.client.process_runtime import (  # noqa: F401 — the
+    ProcessFederationResult, _client_proc, _cpu_spawn_env, _force_cpu_jax,
+    _install_chaos, _install_telemetry, _validator_proc)
+from bflc_demo_tpu.hier.cells import (cell_protocol, cell_seed,
+                                      plan_cells, root_protocol)
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+Endpoint = Tuple[str, int]
+
+
+def _root_proc(cfg_kw: dict, initial_blob: bytes, port_q,
+               stall_timeout_s: float, wal_path: str,
+               cell_registry: dict, bft_endpoints: list, bft_keys: dict,
+               verbose: bool, chaos_spec: Optional[dict] = None,
+               telemetry_spec: Optional[dict] = None) -> None:
+    """The root coordinator: a plain LedgerServer whose clients are the
+    cell aggregators (cell_registry arms the hier admission contract)."""
+    _force_cpu_jax()
+    _install_chaos(chaos_spec)
+    _install_telemetry(telemetry_spec)
+    from bflc_demo_tpu.comm.ledger_service import LedgerServer
+    server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
+                          stall_timeout_s=stall_timeout_s,
+                          wal_path=wal_path,
+                          cell_registry=cell_registry or None,
+                          bft_validators=[tuple(e) for e in bft_endpoints]
+                          or None,
+                          bft_keys=bft_keys or None,
+                          verbose=verbose)
+    port_q.put(server.port)
+    server.serve_forever()
+
+
+def _cell_proc(cell_cfg_kw: dict, initial_blob: bytes, cell_index: int,
+               wallet_seed: bytes, root_endpoints: list,
+               model_factory: str, factory_kw: dict,
+               val_x, val_y, root_bft_keys: dict, port: int, port_q,
+               stall_timeout_s: float, verbose: bool,
+               chaos_spec: Optional[dict] = None,
+               telemetry_spec: Optional[dict] = None) -> None:
+    """One cell aggregator process (hier.aggregator): coordinator for its
+    members on `port` (fixed, so members survive an aggregator restart),
+    bridge client of the root."""
+    _force_cpu_jax()
+    _install_chaos(chaos_spec)
+    _install_telemetry(telemetry_spec)
+    from bflc_demo_tpu.comm.identity import Wallet
+    from bflc_demo_tpu.hier.aggregator import CellAggregatorServer
+    val = None
+    if val_x is not None and len(val_x):
+        val = (np.asarray(val_x), np.asarray(val_y))
+    server = CellAggregatorServer(
+        ProtocolConfig(**cell_cfg_kw), initial_blob, cell_index,
+        Wallet.from_seed(wallet_seed),
+        [tuple(e) for e in root_endpoints],
+        model_factory=model_factory, factory_kw=factory_kw,
+        val_shard=val, root_bft_keys=root_bft_keys or None,
+        port=port, stall_timeout_s=stall_timeout_s, verbose=verbose)
+    port_q.put(server.port)
+    server.serve_forever()
+
+
+def _cell_val_shard(shards, members: Sequence[int], nc: int,
+                    cap: int = 128):
+    """The aggregator's validation shard for root-committee scoring: a
+    small deterministic sample drawn from its OWN members' data (the
+    committee member scores on its own data — reference trust locality,
+    one tier up).  (x, y_onehot) capped at `cap` rows."""
+    from bflc_demo_tpu.data.partition import one_hot
+    per = max(1, cap // max(len(members), 1))
+    xs, ys = [], []
+    for i in members:
+        sx, sy = shards[i]
+        xs.append(np.asarray(sx)[:per])
+        ys.append(np.asarray(sy)[:per])
+    x = np.concatenate(xs, axis=0)[:cap]
+    y = np.concatenate(ys, axis=0)[:cap]
+    return x, one_hot(y, nc)
+
+
+def _info_with_retry(sponsor, attempts: int = 20,
+                     delay_s: float = 0.5) -> dict:
+    """The sponsor's final `info` probe, retried through transient
+    outages (a chaos wire window closing, a failover still promoting) —
+    the fleet is known-finished here, so a few short retries beat dying
+    on one dropped frame."""
+    for i in range(attempts):
+        try:
+            return sponsor.request("info")
+        except ConnectionError:
+            if i == attempts - 1:
+                raise
+            time.sleep(delay_s)
+    raise ConnectionError("unreachable")
+
+
+def run_federated_hier(
+        model_factory: str,
+        shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+        test_set: Tuple[np.ndarray, np.ndarray],
+        cfg: ProtocolConfig,
+        rounds: int = 5, *,
+        cells: int = 0,
+        cell_size: int = 0,
+        factory_kw: Optional[dict] = None,
+        master_seed: bytes = b"hier-federation-master-0001",
+        stall_timeout_s: float = 6.0,
+        root_stall_timeout_s: Optional[float] = None,
+        wal_path: str = "",
+        bft_validators: int = 0,
+        timeout_s: float = 600.0,
+        init_seed: int = 0,
+        kill_cell_at_epoch: Optional[Dict[int, int]] = None,
+        chaos_schedule=None,
+        chaos_dir: str = "",
+        telemetry_dir: str = "",
+        verbose: bool = False) -> ProcessFederationResult:
+    """Run a two-tier federation as OS processes.  Parent = sponsor.
+
+    cells / cell_size: the deterministic cohorting (hier.cells.plan_cells
+    — pass at least one).  cfg is the GLOBAL protocol genome; each cell
+    runs `cell_protocol(cfg, len(members))`, the root runs
+    `root_protocol(cfg, n_cells)`.
+    bft_validators: BFT commit quorum AT THE ROOT — certificates cover
+    O(cells) ops/round through the unchanged comm.bft machinery, and
+    every validator holds the cell registry (a forged/inflated cell op
+    cannot certify).
+    kill_cell_at_epoch: {cell_index: root_epoch} — SIGKILL that cell's
+    aggregator once the root reaches the epoch (the re-home drill: its
+    members fail over to the ring sibling).
+    chaos_schedule: a chaos.FaultSchedule whose events may target
+    `cell-<c>` / `client-<i>` roles; driven by the standard ChaosCampaign
+    with the root as the invariant probe.
+    telemetry_dir: arm the fleet telemetry plane — the root, every
+    validator AND every cell aggregator answer the `telemetry` RPC
+    (cells inherit it from LedgerServer), clients publish file
+    snapshots; `tools/fleet_top.py` renders the tree.
+    """
+    import multiprocessing as mp
+
+    cfg.validate()
+    if len(shards) != cfg.client_num:
+        raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    plan = plan_cells(len(shards), cells, cell_size)
+    factory_kw = factory_kw or {}
+    kill_cell_at_epoch = dict(kill_cell_at_epoch or {})
+    t_start = time.monotonic()
+
+    import jax.numpy as jnp
+
+    import bflc_demo_tpu.models as models
+    from bflc_demo_tpu.comm.identity import Wallet
+    from bflc_demo_tpu.core.local_train import evaluate
+    from bflc_demo_tpu.data.partition import one_hot
+    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+                                                   restore_pytree,
+                                                   unpack_pytree)
+
+    model = getattr(models, model_factory)(**factory_kw)
+    template = model.init_params(0)
+    initial_blob = pack_pytree(model.init_params(init_seed))
+    nc = model.num_classes
+
+    # --- identities + registry: all derived from (master_seed, plan), so
+    # the root, the validators and any auditor agree on membership caps
+    agg_seeds = {c: cell_seed(master_seed, c) for c in range(plan.n_cells)}
+    agg_wallets = {c: Wallet.from_seed(s) for c, s in agg_seeds.items()}
+    cell_registry = {agg_wallets[c].address: (c, len(plan.members[c]))
+                     for c in range(plan.n_cells)}
+    agg_pubs = {c: agg_wallets[c].public_bytes
+                for c in range(plan.n_cells)}
+
+    root_cfg = root_protocol(cfg, plan.n_cells)
+    root_cfg_kw = {f: getattr(root_cfg, f)
+                   for f in root_cfg.__dataclass_fields__}
+    cell_cfgs = {c: cell_protocol(cfg, len(plan.members[c]))
+                 for c in range(plan.n_cells)}
+
+    bft_keys: Dict[int, bytes] = {}
+    bft_endpoints: List[Endpoint] = []
+    if bft_validators:
+        from bflc_demo_tpu.comm.bft import provision_validators
+        _, bft_keys = provision_validators(bft_validators, master_seed)
+
+    ctx = mp.get_context("spawn")
+    host = "127.0.0.1"
+    port_of: Dict[str, int] = {}
+    chaos_t0 = time.time()
+    campaign = None
+    if chaos_schedule is not None:
+        from bflc_demo_tpu.chaos.campaign import ChaosCampaign
+        from bflc_demo_tpu.chaos.invariants import InvariantMonitor
+        if not chaos_dir:
+            import tempfile
+            chaos_dir = tempfile.mkdtemp(prefix="bflc-hier-chaos-")
+        os.makedirs(chaos_dir, exist_ok=True)
+        campaign = ChaosCampaign(
+            chaos_schedule,
+            InvariantMonitor([], bft_enabled=bool(bft_validators),
+                             verbose=verbose),
+            t0=chaos_t0, wal_path=wal_path, verbose=verbose)
+
+    def _wire(role: str):
+        return (chaos_schedule.wire_spec(role, chaos_t0, port_of)
+                if campaign is not None else None)
+
+    def _tspec(role: str):
+        return ({"role": role, "dir": telemetry_dir}
+                if telemetry_dir else None)
+
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+
+    validator_procs: List = []
+
+    def _spawn_validator(v: int, vport: int = 0):
+        q = ctx.Queue()
+        p = ctx.Process(
+            target=_validator_proc,
+            args=(root_cfg_kw, master_seed + b"|bft-validator|"
+                  + struct.pack("<q", v), v, q, bft_keys, verbose,
+                  vport, _wire(f"validator-{v}"),
+                  _tspec(f"validator-{v}"), cell_registry),
+            daemon=True)
+        with _cpu_spawn_env():
+            p.start()
+        return p, q.get(timeout=60)
+
+    for v in range(bft_validators):
+        vp, vport = _spawn_validator(v)
+        bft_endpoints.append((host, vport))
+        port_of[f"validator-{v}"] = vport
+        validator_procs.append(vp)
+        if campaign is not None:
+            campaign.register(f"validator-{v}",
+                              (lambda v=v, vport=vport:
+                               _spawn_validator(v, vport)[0]), vp)
+    if campaign is not None:
+        campaign.monitor.validator_eps = list(bft_endpoints)
+
+    q = ctx.Queue()
+    root = ctx.Process(target=_root_proc,
+                       args=(root_cfg_kw, initial_blob, q,
+                             (root_stall_timeout_s
+                              or max(stall_timeout_s * 2, 8.0)),
+                             wal_path, cell_registry, bft_endpoints,
+                             bft_keys, verbose, _wire("writer"),
+                             _tspec("writer")),
+                       daemon=True)
+    with _cpu_spawn_env():
+        root.start()
+    root_port = q.get(timeout=60)
+    port_of["writer"] = root_port
+    root_endpoints = [(host, root_port)]
+
+    cell_procs: Dict[int, object] = {}
+    cell_ports: Dict[int, int] = {}
+
+    def _spawn_cell(c: int, cport: int = 0):
+        cq = ctx.Queue()
+        cc = cell_cfgs[c]
+        cc_kw = {f: getattr(cc, f) for f in cc.__dataclass_fields__}
+        vx, vy = _cell_val_shard(shards, plan.members[c], nc)
+        p = ctx.Process(
+            target=_cell_proc,
+            args=(cc_kw, initial_blob, c, agg_seeds[c],
+                  root_endpoints, model_factory, factory_kw,
+                  vx, vy, bft_keys, cport, cq, stall_timeout_s,
+                  verbose, _wire(f"cell-{c}"), _tspec(f"cell-{c}")),
+            daemon=True)
+        with _cpu_spawn_env():
+            p.start()
+        return p, cq.get(timeout=60)
+
+    for c in range(plan.n_cells):
+        p, cport = _spawn_cell(c)
+        cell_procs[c] = p
+        cell_ports[c] = cport
+        port_of[f"cell-{c}"] = cport
+        if campaign is not None:
+            campaign.register(f"cell-{c}",
+                              (lambda c=c, cport=cport:
+                               _spawn_cell(c, cport)[0]), p)
+
+    # --- member clients: the unchanged single-tier client state machine
+    # pointed at [its cell, the ring sibling].  The aggregator public
+    # keys ride as the endpoint-evidence keys (no promotion evidence
+    # exists at the cell tier, but FailoverClient's multi-endpoint
+    # poisoning guard wants provisioned keys).
+    clients: List = []
+    cell_cfg_kw_of: Dict[int, dict] = {}
+    for c, cc in cell_cfgs.items():
+        cell_cfg_kw_of[c] = {f: getattr(cc, f)
+                             for f in cc.__dataclass_fields__}
+
+    def _member_endpoints(c: int) -> List[Endpoint]:
+        eps = [(host, cell_ports[c])]
+        if plan.n_cells > 1:
+            eps.append((host, cell_ports[plan.sibling_of(c)]))
+        return eps
+
+    def _spawn_client(i: int):
+        c = plan.cell_of(i)
+        sx, sy = shards[i]
+        sib = plan.sibling_of(c) if plan.n_cells > 1 else c
+        keys = {0: agg_pubs[c], 1: agg_pubs[sib]}
+        # no ack journals at the cell tier (ack path ""): members ack
+        # against CELL ledgers, and the campaign's acked-upload-durability
+        # check replays the ROOT chain — journaling cell acks there would
+        # flag false violations (the root records cell partials, not
+        # member uploads; PARITY.md cell trust story)
+        p = ctx.Process(
+            target=_client_proc,
+            args=(_member_endpoints(c),
+                  master_seed + struct.pack("<q", i),
+                  model_factory, factory_kw,
+                  np.asarray(sx), one_hot(np.asarray(sy), nc),
+                  cell_cfg_kw_of[c], rounds, None, "", keys,
+                  None, _wire(f"client-{i}"), "",
+                  15.0 if campaign is not None else 60.0,
+                  _tspec(f"client-{i}")),
+            daemon=True)
+        with _cpu_spawn_env():
+            p.start()
+        return p
+
+    for i in range(len(shards)):
+        p = _spawn_client(i)
+        clients.append(p)
+        if campaign is not None:
+            campaign.register(f"client-{i}",
+                              (lambda i=i: _spawn_client(i)), p)
+
+    collector = None
+    if telemetry_dir:
+        from bflc_demo_tpu.obs.collector import FleetCollector
+        rpc_roles = {"writer": (host, root_port)}
+        for v in range(bft_validators):
+            rpc_roles[f"validator-{v}"] = (host,
+                                           port_of[f"validator-{v}"])
+        for c in range(plan.n_cells):
+            rpc_roles[f"cell-{c}"] = (host, cell_ports[c])
+        file_roles = {
+            f"client-{i}": os.path.join(telemetry_dir,
+                                        f"client-{i}.metrics.json")
+            for i in range(len(shards))}
+        collector = FleetCollector(
+            rpc_roles, file_roles,
+            jsonl_path=os.path.join(telemetry_dir, "metrics.jsonl"))
+        if campaign is not None:
+            campaign.on_fault = collector.observe_fault
+        collector.note("fleet_up", clients=len(shards),
+                       cells=plan.n_cells, validators=bft_validators)
+        collector.scrape(tag="fleet_up")
+
+    from bflc_demo_tpu.comm.dataplane import ReadRouter
+    from bflc_demo_tpu.comm.failover import FailoverClient
+    xte, yte = test_set
+    xte_j = jnp.asarray(xte)
+    yte_j = jnp.asarray(one_hot(np.asarray(yte), nc))
+    sponsor = FailoverClient(root_endpoints, timeout_s=30.0,
+                             bft_keys=bft_keys or None)
+    sponsor_router = ReadRouter(sponsor, timeout_s=30.0)
+    history: List[Tuple[int, float]] = []
+    epoch_times: List[Tuple[int, float]] = []
+    seen_epoch = 0
+    killed_cells: set = set()
+    deadline = time.monotonic() + timeout_s
+    try:
+        while time.monotonic() < deadline:
+            try:
+                info = sponsor.request("info")
+            except ConnectionError:
+                time.sleep(0.5)
+                continue
+            if campaign is not None:
+                try:
+                    campaign.tick(sponsor, info)
+                except ConnectionError:
+                    time.sleep(0.5)
+                    continue
+            for c, at_epoch in kill_cell_at_epoch.items():
+                if c not in killed_cells and info["epoch"] >= at_epoch:
+                    # the re-home drill: SIGKILL the aggregator MID-ROUND
+                    # — its members must rotate to the ring sibling
+                    cell_procs[c].kill()
+                    cell_procs[c].join(timeout=10)
+                    killed_cells.add(c)
+                    if collector is not None:
+                        collector.observe_fault(
+                            {"kind": "kill", "target": f"cell-{c}",
+                             "t": time.time() - chaos_t0,
+                             "executed": True})
+                    if verbose:
+                        print(f"[drill] cell-{c} aggregator killed at "
+                              f"root epoch {info['epoch']}", flush=True)
+            if info["epoch"] > seen_epoch:
+                try:
+                    mr = sponsor_router.fetch_model()
+                except ConnectionError:
+                    # transient root/replica outage (chaos wire window,
+                    # failover in flight): retry next poll, same as the
+                    # info probe above
+                    time.sleep(0.5)
+                    continue
+                if mr.get("ok") and mr["epoch"] > seen_epoch:
+                    params = restore_pytree(
+                        template, unpack_pytree(mr["blob"]))
+                    acc = float(evaluate(model.apply, params, xte_j,
+                                         yte_j))
+                    history.append((mr["epoch"] - 1, acc))
+                    epoch_times.append((mr["epoch"] - 1,
+                                        time.monotonic() - t_start))
+                    seen_epoch = mr["epoch"]
+                    if verbose:
+                        print(f"Epoch: {mr['epoch'] - 1:03d}, "
+                              f"test_acc: {acc:.4f}", flush=True)
+                    if collector is not None:
+                        collector.note("round_commit",
+                                       epoch=mr["epoch"] - 1, acc=acc)
+                        collector.scrape(tag=f"round-{mr['epoch'] - 1}")
+            if info["epoch"] >= rounds:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"hier federation incomplete after {timeout_s}s "
+                f"({len(history)}/{rounds} rounds)")
+        final = _info_with_retry(sponsor)
+        chaos_report = None
+        if campaign is not None:
+            # no per-member ack journals at the cell tier (see
+            # _spawn_client) — the durability check covers the root chain
+            chaos_report = campaign.finish(sponsor, [])
+            final = _info_with_retry(sponsor)
+        telemetry_report = None
+        if collector is not None:
+            collector.scrape(tag="final")
+            prom_path = os.path.join(telemetry_dir, "metrics.prom")
+            collector.write_prometheus(prom_path)
+            telemetry_report = {"dir": telemetry_dir,
+                                "jsonl": collector.jsonl_path,
+                                "prometheus": prom_path,
+                                **collector.coverage_report()}
+    finally:
+        sponsor_router.close()
+        sponsor.close()
+        client_exitcodes: List[Optional[int]] = []
+        for p in clients:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+            client_exitcodes.append(p.exitcode)
+        for p in cell_procs.values():
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        root.terminate()
+        root.join(timeout=10)
+        for vp in validator_procs:
+            vp.terminate()
+            vp.join(timeout=10)
+        if campaign is not None:
+            for h in campaign.handles.values():
+                if h.proc is not None and h.proc.is_alive():
+                    h.proc.terminate()
+                    h.proc.join(timeout=5)
+
+    result = ProcessFederationResult(
+        accuracy_history=history,
+        rounds_completed=final["epoch"],
+        log_head=final["log_head"],
+        log_size=final["log_size"],
+        recovered_clients=[],
+        replica_report=None,
+        wall_time_s=time.monotonic() - t_start,
+        chaos_report=chaos_report,
+        final_info=final,
+        telemetry_report=telemetry_report)
+    result.epoch_times = epoch_times
+    # the fleet's port map (root / cells / validators) — tools and tests
+    # probe individual tiers with it
+    result.port_of = dict(port_of)
+    result.cell_plan = plan
+    # per-client exit codes (spawn order).  0 = the member finished its
+    # rounds loop — under an aggregator kill that is only reachable by
+    # re-homing to the sibling, which is what the chaos drill asserts.
+    result.client_exitcodes = client_exitcodes
+    return result

@@ -38,6 +38,13 @@ class RunOptions:
     tls_dir: str = ""                # processes runtime: TLS cert dir
     quorum: int = 0                  # processes runtime: quorum-ack
     bft_validators: int = 0          # processes runtime: BFT commit quorum
+    # processes runtime: hierarchical cell federation (bflc_demo_tpu.hier)
+    # — cohort clients into N cells (and/or cells of M members); each cell
+    # aggregates locally and submits ONE certified cell-aggregate op per
+    # round, so the root coordinator's cost is O(cells), not O(clients).
+    # 0/0 (default) = the unchanged single-tier path.
+    cells: int = 0
+    cell_size: int = 0
     # mesh/executor runtimes: score attestation.  Tri-state: None (the
     # default) = on wherever wallets exist; --attest-scores forces on;
     # --no-attest-scores is the explicit benchmarking opt-out

@@ -320,6 +320,68 @@ class TestReadFanoutDegradation:
              f"(cumulative writer reads {at_last_kill} -> {running})")
 
 
+class TestCellAggregatorKill:
+    """Hierarchical-tier chaos (PR 6): `ChaosCampaign` SIGKILLs a cell
+    aggregator mid-federation.  The dead cell's members re-home to the
+    ring sibling (FailoverClient endpoint rotation + TOFU re-register)
+    and keep contributing; the root round heals through the standard
+    stall recovery (close_round / reseat / force_aggregate over the
+    surviving cells) — every invariant holds and every member finishes
+    its rounds loop, which for an orphaned member is only reachable
+    through the sibling."""
+
+    def test_cell_kill_rehomes_members_invariants_hold(self, tmp_path):
+        from bflc_demo_tpu.hier.runtime import run_federated_hier
+        from bflc_demo_tpu.obs.collector import load_timeline
+        cfg = ProtocolConfig(client_num=6, comm_count=2,
+                             aggregate_count=2, needed_update_count=2,
+                             learning_rate=0.05, batch_size=32,
+                             local_epochs=2).validate()
+        shards, test_set = _occupancy_fleet(cfg.client_num)
+        sched = FaultSchedule(11, duration_s=60.0, n_clients=6,
+                              n_standbys=0, n_validators=0,
+                              profile="light")
+        # one surgical fault, deterministically placed: kill cell-1's
+        # aggregator (no restart — the orphaned members must re-home to
+        # sibling cell-2 for the rest of the campaign)
+        sched.events = [FaultEvent(12.0, "kill", "cell-1")]
+        sched.wire_windows = {}
+        tdir = str(tmp_path / "telemetry")
+        res = run_federated_hier(
+            "make_softmax_regression", shards, test_set, cfg,
+            rounds=3, cells=3, timeout_s=300.0,
+            chaos_schedule=sched, chaos_dir=str(tmp_path / "chaos"),
+            telemetry_dir=tdir)
+        rep = res.chaos_report
+        assert rep is not None
+        assert rep["violations"] == [], rep["violations"]
+        assert res.rounds_completed >= 3
+        v = rep["invariant_verdicts"]
+        assert v["monotone_progress"] == "PASS"
+        executed = {(e["kind"], e["target"])
+                    for e in rep["faults_executed"]}
+        assert ("kill", "cell-1") in executed, rep
+        # re-home proof: cell-1's members finished their rounds loop
+        # cleanly (exit 0) — with their aggregator dead, the only route
+        # to the remaining epochs runs through the sibling
+        plan = res.cell_plan
+        orphans = plan.members[1]
+        assert len(orphans) == 2
+        for i in orphans:
+            assert res.client_exitcodes[i] == 0, \
+                (i, res.client_exitcodes)
+        # the kill landed on the chaos-correlated telemetry timeline,
+        # and the dead aggregator shows up as a scrape coverage miss
+        tl = load_timeline(res.telemetry_report["jsonl"])
+        faults = [r for r in tl if r.get("type") == "fault"]
+        assert any(f.get("kind") == "kill" and f.get("target") == "cell-1"
+                   for f in faults), faults
+        scrapes = [r for r in tl if r.get("type") == "scrape"]
+        assert any("cell-1" in s["coverage"]["missing"]
+                   for s in scrapes), \
+            [s["coverage"] for s in scrapes]
+
+
 @pytest.mark.slow
 class TestChaosSoak100:
     """The headline artifact: 100 rounds at config-1 parity geometry

@@ -15,9 +15,13 @@ import pytest
 
 # cert provisioning is x509, which has no pure-Python fallback (unlike the
 # Ed25519/X25519 identity layer, comm.pure25519) — skip rather than fail on
-# hosts without the cryptography wheel
-pytest.importorskip("cryptography",
-                    reason="TLS cert provisioning needs cryptography.x509")
+# hosts without the cryptography wheel (skip condition documented in
+# README "Quick start" test note)
+pytest.importorskip(
+    "cryptography",
+    reason="the 'cryptography' wheel is not installed — x509 cert "
+           "provisioning (comm/tls.py) has no pure-Python fallback; "
+           "pip install cryptography to run the TLS suite")
 
 from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                LedgerServer, replicate)

@@ -114,6 +114,18 @@ def _role_row(role, snap):
         cells.append(f"log {int(log):>5}  votes {n_b}b/{n_s}s "
                      f"({m_b * 1e3:.1f}/{m_s * 1e3:.1f}ms)  "
                      f"repairs {rep:.0f}  abandons {ab:.0f}")
+    elif role.startswith("cell"):
+        # hierarchical cell tier (bflc_demo_tpu.hier): the aggregator is
+        # a LedgerServer for its members, so it also has the writer-class
+        # gauges; the cell-specific axes are admitted count, partial-sum
+        # latency, and the cell-aggregate op's root (certify) round-trip
+        rnd = _gauge_value(snap, "round", 0)
+        adm = _gauge_value(snap, "cell_admitted", 0)
+        n_p, m_p = _merged_hist(snap, "cell_partial_seconds")
+        n_a, m_a = _merged_hist(snap, "cell_root_ack_seconds")
+        cells.append(f"round {int(rnd):>3}  admitted {int(adm):>3}  "
+                     f"partial {n_p}x{m_p * 1e3:5.1f}ms  "
+                     f"root-certify {n_a}x{m_a * 1e3:6.1f}ms")
     elif role.startswith("standby"):
         applied = _gauge_value(snap, "standby_applied_ops", 0)
         lag = _gauge_value(snap, "standby_ack_lag_ops", 0)
@@ -177,6 +189,13 @@ def _scrape_digest(rec) -> str:
         if n_c:
             bits.append(f"certify~{m_c * 1e3:.0f}ms x{n_c}")
     for role in sorted(roles):
+        if role.startswith("cell"):
+            adm = _gauge_value(roles[role], "cell_admitted", 0)
+            n_a, m_a = _merged_hist(roles[role],
+                                    "cell_root_ack_seconds")
+            if adm or n_a:
+                bits.append(f"{role}: adm={int(adm)} "
+                            f"certify~{m_a * 1e3:.0f}ms")
         if role.startswith("standby"):
             lag = _gauge_value(roles[role], "standby_ack_lag_ops", 0)
             promos = _sum_counter(roles[role],

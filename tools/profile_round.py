@@ -48,15 +48,163 @@ def _reexec_legacy() -> None:
     os.execve(sys.executable, [sys.executable] + args, env)
 
 
+def profile_hier(args) -> None:
+    """One hierarchical round, fully in-process: a root LedgerServer
+    (cell registry + validator quorum) + N CellAggregatorServer threads,
+    member wallet-clients driving each cell's round over real sockets.
+    Prints the per-cell telemetry rows (admitted count, partial-sum
+    latency, cell-aggregate root-certify latency) off the same
+    FleetCollector scrape the fleet tools use."""
+    import hashlib
+    import struct
+    import time
+
+    import numpy as np
+
+    from bflc_demo_tpu.comm.bft import ValidatorNode, provision_validators
+    from bflc_demo_tpu.comm.identity import Wallet, _op_bytes
+    from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                   LedgerServer)
+    from bflc_demo_tpu.hier.aggregator import CellAggregatorServer
+    from bflc_demo_tpu.hier.cells import (cell_protocol, cell_seed,
+                                          plan_cells, root_protocol)
+    from bflc_demo_tpu.obs import metrics as obs_metrics
+    from bflc_demo_tpu.obs.collector import FleetCollector
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+    from bflc_demo_tpu.utils import tracing
+    from bflc_demo_tpu.utils.serialization import pack_pytree
+
+    n = max(args.clients, 2 * args.cells)
+    base = ProtocolConfig(client_num=n, comm_count=max(2, n // 4),
+                          aggregate_count=2,
+                          needed_update_count=max(3, n // 2),
+                          learning_rate=0.05, batch_size=16)
+    plan = plan_cells(n, cells=args.cells)
+    blob0 = pack_pytree({"W": np.zeros((5, 2), np.float32),
+                         "b": np.zeros((2,), np.float32)})
+
+    tracing.PROC.enabled = True
+    tracing.PROC.reset()
+    obs_metrics.REGISTRY.enabled = True
+    obs_metrics.REGISTRY.role = "profile"
+
+    agg_wallets = {c: Wallet.from_seed(cell_seed(b"profile-hier", c))
+                   for c in range(plan.n_cells)}
+    registry = {agg_wallets[c].address: (c, len(plan.members[c]))
+                for c in range(plan.n_cells)}
+    root_cfg = root_protocol(base, plan.n_cells)
+    vwallets, vkeys = provision_validators(args.validators,
+                                           b"profile-hier-validators")
+    nodes = [ValidatorNode(root_cfg, w, i, validator_keys=vkeys,
+                           cell_registry=registry)
+             for i, w in enumerate(vwallets)]
+    for v in nodes:
+        v.start()
+    root = LedgerServer(root_cfg, blob0, cell_registry=registry,
+                        bft_validators=[(v.host, v.port) for v in nodes],
+                        bft_keys=vkeys)
+    root.start()
+    cells = []
+    for c in range(plan.n_cells):
+        cc = cell_protocol(base, len(plan.members[c]))
+        srv = CellAggregatorServer(cc, blob0, c, agg_wallets[c],
+                                   [(root.host, root.port)],
+                                   stall_timeout_s=60.0)
+        srv.start()
+        cells.append(srv)
+
+    def sign(w, kind, epoch, payload):
+        return w.sign(_op_bytes(kind, w.address, epoch, payload)).hex()
+
+    t_round = time.perf_counter()
+    conns = []
+    for c, srv in enumerate(cells):
+        cc = srv.cfg
+        wallets = [Wallet.from_seed(b"profile-hier-member|%d|%d" % (c, i))
+                   for i in range(cc.client_num)]
+        conn = CoordinatorClient(srv.host, srv.port)
+        conns.append(conn)
+        for w in wallets:
+            r = conn.request("register", addr=w.address,
+                             pubkey=w.public_bytes.hex(),
+                             tag=sign(w, "register", 0, b""))
+            assert r["ok"], r
+        committee = set(conn.request("committee")["committee"])
+        trainers = [w for w in wallets if w.address not in committee]
+        for i, w in enumerate(trainers[: cc.needed_update_count]):
+            blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
+                                             np.float32),
+                                "b": np.zeros((2,), np.float32)})
+            digest = hashlib.sha256(blob).digest()
+            payload = digest + struct.pack("<qd", 10 + i, 1.0)
+            r = conn.request("upload", addr=w.address, blob=blob,
+                             hash=digest.hex(), n=10 + i, cost=1.0,
+                             epoch=0,
+                             tag=sign(w, "upload", 0, payload))
+            assert r["ok"], r
+        n_up = min(cc.needed_update_count, len(trainers))
+        for j, w in enumerate([w for w in wallets
+                               if w.address in committee]):
+            row = [0.5 + 0.01 * (j + u) for u in range(n_up)]
+            payload = struct.pack(f"<{n_up}d", *row)
+            r = conn.request("scores", addr=w.address, epoch=0,
+                             scores=row,
+                             tag=sign(w, "scores", 0, payload))
+            assert r["ok"] or r.get("status") == "WRONG_EPOCH", r
+
+    probe = CoordinatorClient(root.host, root.port)
+    deadline = time.monotonic() + 60.0
+    while probe.request("info")["epoch"] < 1:
+        if time.monotonic() > deadline:
+            raise TimeoutError("root round never committed")
+        time.sleep(0.05)
+    wall = time.perf_counter() - t_round
+    info = probe.request("info")
+
+    coll = FleetCollector(
+        {"writer": (root.host, root.port),
+         **{f"cell-{c}": (s.host, s.port) for c, s in enumerate(cells)},
+         **{f"validator-{i}": (v.host, v.port)
+            for i, v in enumerate(nodes)}})
+    scrape = coll.scrape(tag="profile_hier")
+
+    for conn in conns:
+        conn.close()
+    probe.close()
+    for s in cells:
+        s.close()
+    root.close()
+    for v in nodes:
+        v.close()
+
+    from fleet_top import _role_row
+    print(f"one hierarchical round: {n} members in {plan.n_cells} "
+          f"cells, {args.validators} validators — root certified "
+          f"{info['certified_size']}/{info['log_size']} ops "
+          f"(O(cells) per round), wall {wall * 1e3:.0f} ms")
+    print(f"telemetry scrape: {scrape['coverage']['answered']}/"
+          f"{scrape['coverage']['expected']} roles answered")
+    for role in sorted(scrape["roles"]):
+        if role.startswith(("cell", "writer")):
+            print(_role_row(role, scrape["roles"][role]))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--validators", type=int, default=4)
+    ap.add_argument("--cells", type=int, default=0,
+                    help="profile the hierarchical tier: N in-process "
+                         "cell aggregators submitting certified "
+                         "cell-aggregate ops to a root quorum")
     ap.add_argument("--legacy", action="store_true",
                     help="profile the pre-PR control plane")
     args = ap.parse_args()
     if args.legacy and not os.environ.get("BFLC_CONTROL_PLANE_LEGACY"):
         _reexec_legacy()
+    if args.cells:
+        profile_hier(args)
+        return
 
     import numpy as np
 
