@@ -39,7 +39,10 @@ telemetry_overhead_config1).  `extra.hier` (PR 6) is the
 hierarchical-federation flatness axis: root egress and certified
 ops/round ratios across a 10x thin-client growth at fixed cell count,
 plus the single-tier leg's multiple (eval.benchmarks.hier_scaling; the
-full 1k->10k artifact is TPU_RESULTS.md round 11).
+full 1k->10k artifact is TPU_RESULTS.md round 11).  `extra.rejoin`
+(PR 7) is the certified-snapshot rejoin axis: cold replay-from-genesis
+vs snapshot state-sync wall time for a joiner at a few-hundred-round
+chain (eval.benchmarks.rejoin_config1).
 BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
@@ -228,6 +231,11 @@ def _child() -> None:
                 for n, leg in hs["hier"].items()},
             "geometry": hs["geometry"],
         }
+        # rejoin axis (PR 7): cold replay-from-genesis vs certified
+        # snapshot state-sync through the real serving surfaces, at a
+        # few-hundred-round chain (eval.benchmarks.rejoin_config1)
+        from bflc_demo_tpu.eval.benchmarks import rejoin_config1
+        extra["rejoin"] = rejoin_config1(rounds=300)
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),
         # measurable on CPU with no tunnel: one 50-round config-1 campaign

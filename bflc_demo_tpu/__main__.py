@@ -73,16 +73,30 @@ def main(argv=None) -> int:
             # failure with the same --chaos-seed
             kw["chaos_seed"] = opts.chaos_seed
             kw["chaos_profile"] = opts.chaos_profile
+        if opts.snapshot_interval or opts.snapshot_dir:
+            # certified snapshots + ledger compaction (ledger.snapshot):
+            # bounded log/WAL growth, snapshot state-sync for rejoiners
+            if opts.snapshot_interval < 0:
+                print(f"--snapshot-interval must be >= 0, got "
+                      f"{opts.snapshot_interval}", file=sys.stderr)
+                return 2
+            if opts.snapshot_dir and not opts.snapshot_interval:
+                print("--snapshot-dir needs --snapshot-interval K > 0 "
+                      "(no snapshots are emitted at interval 0)",
+                      file=sys.stderr)
+                return 2
+            kw["snapshot_interval"] = opts.snapshot_interval
+            kw["snapshot_dir"] = opts.snapshot_dir
         if opts.cells or opts.cell_size:
             # hierarchical cell federation (bflc_demo_tpu.hier): cohort
             # clients into cells; one certified cell-aggregate op per
             # cell per round reaches the root — O(cells) root cost
             if opts.standbys or opts.quorum or opts.tls_dir \
-                    or opts.chaos_seed >= 0:
+                    or opts.chaos_seed >= 0 or opts.snapshot_interval:
                 print("--cells/--cell-size do not compose with "
-                      "--standbys/--quorum/--tls-dir/--chaos-seed yet "
-                      "(the hier driver takes an explicit chaos "
-                      "schedule)", file=sys.stderr)
+                      "--standbys/--quorum/--tls-dir/--chaos-seed/"
+                      "--snapshot-interval yet (the hier driver takes "
+                      "an explicit chaos schedule)", file=sys.stderr)
                 return 2
             kw["cells"] = opts.cells
             kw["cell_size"] = opts.cell_size
@@ -97,9 +111,11 @@ def main(argv=None) -> int:
         if opts.attest_scores is not None:
             kw["attest_scores"] = opts.attest_scores
         if opts.standbys or opts.quorum or opts.bft_validators \
-                or opts.chaos_seed >= 0:
-            print("--standbys/--quorum/--bft-validators/--chaos-seed "
-                  "apply to --runtime processes", file=sys.stderr)
+                or opts.chaos_seed >= 0 or opts.snapshot_interval \
+                or opts.snapshot_dir:
+            print("--standbys/--quorum/--bft-validators/--chaos-seed/"
+                  "--snapshot-interval/--snapshot-dir apply to "
+                  "--runtime processes", file=sys.stderr)
             return 2
     elif opts.runtime == "mesh" and opts.attest_scores is not None \
             and not (opts.standbys or opts.tls_dir or opts.quorum
@@ -115,11 +131,12 @@ def main(argv=None) -> int:
         kw["attest_scores"] = opts.attest_scores
     elif opts.standbys or opts.tls_dir or opts.quorum \
             or opts.attest_scores is not None or opts.bft_validators \
-            or opts.chaos_seed >= 0 or opts.cells or opts.cell_size:
+            or opts.chaos_seed >= 0 or opts.cells or opts.cell_size \
+            or opts.snapshot_interval or opts.snapshot_dir:
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
-              "--chaos-seed/--cells/--cell-size apply to the processes "
-              "runtime; --attest-scores to mesh/executor",
-              file=sys.stderr)
+              "--chaos-seed/--cells/--cell-size/--snapshot-interval/"
+              "--snapshot-dir apply to the processes runtime; "
+              "--attest-scores to mesh/executor", file=sys.stderr)
         return 2
     if opts.secure:
         if opts.config != "config4":

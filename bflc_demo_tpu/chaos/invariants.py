@@ -52,8 +52,17 @@ class InvariantMonitor:
                        "validator_probes": 0, "tip_divergences_seen": 0}
         self._max_epoch = -10 ** 9
         self._max_gen = -1
-        self._ops: List[bytes] = []         # replayed writer chain
+        self._ops: List[bytes] = []         # replayed writer chain tail
         self._heads: List[bytes] = []
+        # certified-snapshot base (ledger.snapshot): when the writer GC'd
+        # its log prefix, the monitor adopts the hash-verified snapshot
+        # as the replay base — _ops[k] is chain position _base + k and
+        # _base_head seeds the head fold (same chain rule as a replica's
+        # state-sync).  _base_epoch marks which acked uploads the
+        # snapshot subsumes (their records went with the prefix).
+        self._base = 0
+        self._base_head = _EMPTY
+        self._base_epoch: Optional[int] = None
 
     def _flag(self, msg: str) -> None:
         self.violations.append(msg)
@@ -82,25 +91,90 @@ class InvariantMonitor:
 
     # ------------------------------------------------------- chain replay
     def _sync_chain(self, probe, upto: int) -> bool:
-        """Extend the replayed writer chain to `upto` ops via log_range."""
-        while len(self._ops) < upto:
-            start = len(self._ops)
+        """Extend the replayed writer chain to `upto` ops via log_range;
+        adopts the writer's certified snapshot as the replay base when
+        the requested prefix was GC'd (ledger.snapshot)."""
+        while self._base + len(self._ops) < upto:
+            start = self._base + len(self._ops)
             end = min(upto, start + 512)
             r = probe.request("log_range", start=start, end=end)
-            if not r.get("ok") or not r.get("ops"):
+            if not r.get("ok"):
+                if r.get("error") == "PREFIX_GC" and \
+                        self._install_snapshot_base(probe, upto):
+                    continue
+                return False
+            if not r.get("ops"):
                 return False
             for h in r["ops"]:
                 op = bytes.fromhex(h)
                 d = hashlib.sha256()
-                if self._heads:
-                    d.update(self._heads[-1])
+                prev = (self._heads[-1] if self._heads
+                        else (self._base_head if self._base else b""))
+                if prev:
+                    d.update(prev)
                 d.update(op)
                 self._ops.append(op)
                 self._heads.append(d.digest())
         return True
 
+    def _install_snapshot_base(self, probe, upto: int) -> bool:
+        """The writer GC'd its log prefix behind a certified snapshot:
+        verify the offer (state bytes hash to the snapshot op's digest,
+        model to the state's model hash — `verify_snapshot_meta`) and
+        adopt it as the replayed chain's base.  An unverifiable offer is
+        itself an invariant violation: a writer must never GC a prefix
+        it cannot account for with a certified checkpoint."""
+        from bflc_demo_tpu.comm.wire import blob_bytes
+        from bflc_demo_tpu.ledger.snapshot import (decode_state,
+                                                   snapshot_base_head,
+                                                   verify_snapshot_meta)
+        try:
+            r = probe.request("snapshot")
+        except (ConnectionError, OSError):
+            return False
+        if not r.get("ok"):
+            self._flag(f"writer GC'd its log prefix but serves no "
+                       f"snapshot: {r.get('error')}")
+            return False
+        try:
+            meta = {"i": int(r["i"]), "op": r["op"],
+                    "prev_head": r["prev_head"], "cert": r.get("cert"),
+                    "state": blob_bytes(r["state"]),
+                    "model": blob_bytes(r["model"]),
+                    "gen": int(r.get("gen", 0))}
+        except (KeyError, TypeError, ValueError) as e:
+            self._flag(f"writer served a malformed snapshot offer: {e}")
+            return False
+        err = verify_snapshot_meta(meta)
+        if err:
+            self._flag(f"writer served an unverifiable snapshot: {err}")
+            return False
+        base = int(meta["i"]) + 1
+        if base <= self._base + len(self._ops):
+            return False        # we already replayed past it: the GC'd
+            #                     range cannot start below our own tip
+        if base > upto:
+            # the offered snapshot is NEWER than the view this walk was
+            # asked to reach (the writer appended + certified + GC'd
+            # past our probed tip mid-walk): adopting it would make the
+            # fold's head the post-snapshot head while the caller still
+            # compares against the stale probed log_head — a spurious
+            # violation.  Fail the sync; the next poll re-probes fresh.
+            return False
+        self._ops, self._heads = [], []
+        self._base = base
+        self._base_head = snapshot_base_head(meta)
+        self._base_epoch = int(decode_state(meta["state"])["epoch"])
+        self.checks["snapshot_bases_installed"] = \
+            self.checks.get("snapshot_bases_installed", 0) + 1
+        return True
+
     def _head_at(self, i: int) -> bytes:
-        return self._heads[i - 1] if i > 0 else _EMPTY
+        if i <= 0:
+            return _EMPTY
+        if i == self._base:
+            return self._base_head
+        return self._heads[i - self._base - 1]
 
     def _probe_validator(self, ep, at: int) -> Optional[dict]:
         from bflc_demo_tpu.comm.bft import ValidatorClient
@@ -129,7 +203,10 @@ class InvariantMonitor:
                 continue
             self.checks["validator_probes"] += 1
             s = min(int(vinfo.get("log_size", 0)), cert_size)
-            if s <= 0:
+            if s <= 0 or s < self._base:
+                # below our snapshot base the prefix heads are gone on
+                # both sides; a replica that lags there is exactly the
+                # state-sync repair's job, not a fork
                 continue
             vh = self._probe_validator(ep, at=s)
             if vh is None or "head_at" not in vh:
@@ -138,6 +215,8 @@ class InvariantMonitor:
                 # tip divergence (depth one) is the repair window; a
                 # mismatch persisting below the tip is a fork
                 self.checks["tip_divergences_seen"] += 1
+                if s - 1 < self._base:
+                    continue
                 vh2 = self._probe_validator(ep, at=s - 1)
                 if vh2 is not None and "head_at" in vh2 and \
                         bytes.fromhex(vh2["head_at"]) != \
@@ -166,8 +245,9 @@ class InvariantMonitor:
         synced = self._sync_chain(probe, size)
         agree, probed = True, 0
         if synced:
-            if self._heads and info.get("log_head") and \
-                    self._heads[-1].hex() != info["log_head"]:
+            tip = self._base + len(self._ops)
+            if tip and info.get("log_head") and \
+                    self._head_at(tip).hex() != info["log_head"]:
                 self._flag("replayed chain head != writer log_head")
                 agree = False
             for ep in self.validator_eps:
@@ -176,6 +256,11 @@ class InvariantMonitor:
                     continue
                 probed += 1
                 s = min(int(vinfo.get("log_size", 0)), size)
+                if s < self._base:
+                    # the replica never caught up past the GC'd prefix;
+                    # its heads there are unprovable either way — skip
+                    # (validators_probed still counts the reach)
+                    continue
                 vh = self._probe_validator(ep, at=s)
                 if vh is None or "head_at" not in vh:
                     continue
@@ -218,6 +303,12 @@ class InvariantMonitor:
                 open_hashes = []
         ok = True
         for a in acked:
+            if self._base_epoch is not None and \
+                    int(a["epoch"]) < self._base_epoch:
+                # the upload's record went with the GC'd prefix; the
+                # certified snapshot IS the proof its round survived
+                # (the quorum re-derived the state those uploads built)
+                continue
             key = (a["addr"], int(a["epoch"]), a["hash"])
             if key not in records:
                 self._flag(f"acked upload missing from the surviving "

@@ -124,7 +124,9 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  standby_keys: dict, quorum: int,
                  bft_endpoints: list, bft_keys: dict,
                  verbose: bool, chaos_spec: Optional[dict] = None,
-                 telemetry_spec: Optional[dict] = None) -> None:
+                 telemetry_spec: Optional[dict] = None,
+                 snapshot_interval: int = 0,
+                 snapshot_dir: str = "") -> None:
     _force_cpu_jax()
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
@@ -137,6 +139,8 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                           bft_validators=[tuple(e) for e in bft_endpoints]
                           or None,
                           bft_keys=bft_keys or None,
+                          snapshot_interval=snapshot_interval,
+                          snapshot_dir=snapshot_dir,
                           verbose=verbose)
     port_q.put(server.port)
     server.serve_forever()
@@ -390,12 +394,17 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                   quorum: int, bft_endpoints: list, bft_keys: dict,
                   verbose: bool, port: int = 0,
                   chaos_spec: Optional[dict] = None,
-                  telemetry_spec: Optional[dict] = None) -> None:
+                  telemetry_spec: Optional[dict] = None,
+                  snapshot_interval: int = 0,
+                  snapshot_dir: str = "") -> None:
     """Hot standby: follow the writer's op stream, promote on its death
     (comm.failover.Standby).  Reports its serving port, then blocks.  A
     fixed `port` makes the role restartable under chaos (clients keep
     their endpoint list); a restarted standby re-follows whatever peer
-    currently serves and rebuilds its replica from op 0."""
+    currently serves, rebuilding its replica from op 0 — or, when the
+    writer runs certified snapshots and GC'd the prefix past its resume
+    point, state-syncing from the latest certified snapshot + tail
+    (ledger.snapshot)."""
     _force_cpu_jax()
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
@@ -412,6 +421,8 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                       bft_validators=[tuple(e) for e in bft_endpoints]
                       or None,
                       bft_keys=bft_keys or None,
+                      snapshot_interval=snapshot_interval,
+                      snapshot_dir=snapshot_dir,
                       verbose=verbose)
     # the placeholder self-endpoint gets the real bound port
     standby.endpoints[index] = (standby.host, standby.port)
@@ -480,6 +491,8 @@ def run_federated_processes(
         chaos_schedule=None,
         chaos_dir: str = "",
         telemetry_dir: str = "",
+        snapshot_interval: int = 0,
+        snapshot_dir: str = "",
         verbose: bool = False) -> ProcessFederationResult:
     """Run a full federation as (1 coordinator + N clients [+ standbys]
     [+ 1 replica]) OS processes.  Parent = sponsor.
@@ -528,6 +541,15 @@ def run_federated_processes(
     events interleaved on the same timeline — plus a Prometheus text
     dump at the end; the report rides result.telemetry_report and each
     role's flight-recorder dump survives its process's death.
+    snapshot_interval: emit a certified snapshot op every K rounds
+    (ledger.snapshot): the writer's log/WAL prefix behind each certified
+    checkpoint is garbage-collected (bounded on-disk growth), standbys
+    mirror + GC behind the same ops, and a standby rejoining past the
+    GC'd prefix state-syncs from the latest certified snapshot + tail
+    instead of replaying from genesis.  0 (default, or
+    BFLC_SNAPSHOT_LEGACY=1) pins the replay-from-genesis behavior.
+    snapshot_dir: persist snapshot artifacts under per-role subdirs
+    (writer/, standby-N/) — tmp-then-rename, newest two retained.
     """
     cfg.validate()
     if len(shards) != cfg.client_num:
@@ -638,6 +660,9 @@ def run_federated_processes(
             p.start()
         return p, q.get(timeout=60)
 
+    def _snap_dir(role: str) -> str:
+        return os.path.join(snapshot_dir, role) if snapshot_dir else ""
+
     def _spawn_server():
         q = ctx.Queue()
         p = ctx.Process(target=_server_proc,
@@ -645,7 +670,8 @@ def run_federated_processes(
                               stall_timeout_s, wal_path, tls_dir,
                               standby_keys, quorum,
                               bft_endpoints, bft_keys, verbose,
-                              _wire("writer"), _tspec("writer")),
+                              _wire("writer"), _tspec("writer"),
+                              snapshot_interval, _snap_dir("writer")),
                         daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -659,7 +685,8 @@ def run_federated_processes(
                               standby_seeds[s], standby_keys,
                               quorum, bft_endpoints, bft_keys,
                               verbose, sbport, _wire(f"standby-{s}"),
-                              _tspec(f"standby-{s}")),
+                              _tspec(f"standby-{s}"),
+                              snapshot_interval, _snap_dir(f"standby-{s}")),
                         daemon=True)
         with _cpu_spawn_env():
             p.start()

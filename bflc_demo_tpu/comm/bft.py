@@ -93,6 +93,19 @@ from bflc_demo_tpu.protocol.types import CommitCertificate
 
 Endpoint = Tuple[str, int]
 
+
+class PrefixCompacted(Exception):
+    """A backlog position below the writer's GC'd snapshot base was
+    requested: the op bytes are gone (ledger.snapshot).  Carries the
+    writer's snapshot OFFER so the assembler can state-sync the lagging
+    validator (`bft_snapshot`) instead of replaying the prefix."""
+
+    def __init__(self, offer, base: int):
+        super().__init__(f"log prefix compacted below {base}")
+        self.offer = offer              # snapshot meta dict or None
+        self.base = base
+
+
 _CERT_MAGIC = b"BFLCCERT1"
 _EMPTY_HEAD = b"\0" * 32        # head digest of the empty chain (log_head())
 
@@ -524,6 +537,12 @@ class ValidatorNode:
         # index -> lowest attempt we will still vote at (abandon promises)
         self._promised: Dict[int, int] = {}
         self._heads: List[bytes] = []           # head after each op
+        # state-synced replica offset (ledger.snapshot): _heads[k] is the
+        # head after chain position _head_base + k; _base_head is the
+        # head AT _head_base (after the certified snapshot op this
+        # replica installed).  0/_EMPTY for a from-genesis replica.
+        self._head_base = 0
+        self._base_head = _EMPTY_HEAD
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -567,17 +586,26 @@ class ValidatorNode:
                         reply = {"ok": True, "validator": self.index,
                                  "log_size": self.ledger.log_size(),
                                  "log_head": self.ledger.log_head().hex(),
+                                 "log_base": self._head_base,
                                  "epoch": self.ledger.epoch}
                         # head at an earlier index: the chaos invariant
-                        # monitor's certified-prefix-agreement probe
+                        # monitor's certified-prefix-agreement probe.
+                        # Heads below a state-synced replica's base are
+                        # gone with the prefix — the key is simply
+                        # omitted and the prober skips this replica.
                         try:
                             at = int(msg.get("at", -1))
                         except (TypeError, ValueError):
                             at = -1
-                        if 0 <= at <= len(self._heads):
+                        if at == 0:
+                            reply["head_at"] = _EMPTY_HEAD.hex()
+                        elif (self._head_base <= at
+                              <= self._head_base + len(self._heads)):
                             reply["head_at"] = (
-                                self._heads[at - 1].hex() if at
-                                else _EMPTY_HEAD.hex())
+                                self._base_head.hex()
+                                if at == self._head_base
+                                else self._heads[
+                                    at - self._head_base - 1].hex())
                 elif method == "telemetry":
                     # FleetCollector scrape surface (obs.collector) —
                     # same shape as the ledger server's reply
@@ -593,6 +621,8 @@ class ValidatorNode:
                     reply = self._vote_batch(msg)
                 elif method == "bft_abandon":
                     reply = self._abandon(msg)
+                elif method == "bft_snapshot":
+                    reply = self._snapshot_install(msg)
                 else:
                     reply = {"ok": False,
                              "error": f"unknown method {method!r}"}
@@ -614,9 +644,18 @@ class ValidatorNode:
         return {"ok": False, "status": status, "detail": detail,
                 "log_size": self.ledger.log_size(), **extra}
 
+    def _prev_head(self, i: int) -> bytes:
+        """Chain head BEFORE position i on this replica (the base head
+        for a state-synced replica's first position)."""
+        if i <= 0:
+            return _EMPTY_HEAD
+        if i == self._head_base:
+            return self._base_head
+        return self._heads[i - self._head_base - 1]
+
     def _sign_position(self, i: int, op: bytes, attempt: int) -> dict:
-        prev = self._heads[i - 1] if i > 0 else _EMPTY_HEAD
-        head = self._heads[i]
+        prev = self._prev_head(i)
+        head = self._heads[i - self._head_base]
         sig = self.wallet.sign(cert_payload(i, prev, op, head, attempt))
         return {"ok": True, "i": i, "validator": self.index, "t": attempt,
                 "head": head.hex(), "sig": sig.hex()}
@@ -651,7 +690,13 @@ class ValidatorNode:
             cert = CommitCertificate.from_wire(cert_wire)
         except ValueError:
             return None
-        prev = self._heads[i - 1] if i > 0 else _EMPTY_HEAD
+        if i < self._head_base:
+            # below our state-synced base the prefix heads are gone:
+            # the binding cannot be checked, so the certificate proves
+            # nothing here (and certified history below a certified
+            # snapshot is never rolled back anyway)
+            return None
+        prev = self._prev_head(i)
         if not verify_certificate(cert, index=i, prev_head=prev, op=op,
                                   quorum=self.quorum,
                                   validator_keys=self.validator_keys):
@@ -665,7 +710,7 @@ class ValidatorNode:
         from bflc_demo_tpu.ledger import clone_prefix
         self.ledger = clone_prefix(self.ledger, i, self.cfg,
                                    backend=self._ledger_backend)
-        del self._heads[i:]
+        del self._heads[i - self._head_base:]
         for j in [k for k in self._voted if k >= i]:
             del self._voted[j]
 
@@ -803,6 +848,63 @@ class ValidatorNode:
                 return r
             self._enroll_register_pubkey(op, msg.get("auth"))
             return self._apply_and_sign(i, op, op_hash, attempt)
+
+    def _snapshot_install(self, msg: dict) -> dict:
+        """State-sync a REJOINING replica that lags below the writer's
+        GC'd prefix: install a certified snapshot instead of replaying
+        ops that no longer exist (ledger.snapshot).
+
+        Trust: the offer must carry a commit certificate quorum-signed
+        by this validator's PROVISIONED peers binding exactly (i,
+        prev_head, snapshot op), and the state bytes must hash to the
+        op's embedded digest — a lying writer cannot fabricate either.
+        Installation is refused when this replica already holds the
+        position (its own chain is never rolled back by an offer; the
+        certificate-resync path handles genuine divergence)."""
+        from bflc_demo_tpu.comm.wire import blob_bytes
+        from bflc_demo_tpu.ledger.snapshot import (restore_snapshot,
+                                                   verify_snapshot_meta)
+        try:
+            i = int(msg["i"])
+            op = bytes.fromhex(msg["op"])
+            prev = bytes.fromhex(msg["prev_head"])
+            state = blob_bytes(msg["state"])
+        except (KeyError, TypeError, ValueError):
+            return self._refuse("BAD_REQUEST")
+        with self._lock:
+            if self.ledger.log_size() >= i + 1:
+                return self._refuse(
+                    "CONFLICT",
+                    f"replica at {self.ledger.log_size()} already "
+                    f"holds position {i}")
+            meta = {"i": i, "op": op, "prev_head": prev, "state": state,
+                    "cert": msg.get("cert"), "gen": 0}
+            err = verify_snapshot_meta(
+                meta, bft_quorum=self.quorum,
+                bft_keys=self.validator_keys or None)
+            if err:
+                return self._refuse("SNAPSHOT", err)
+            if not self.validator_keys:
+                # without peer keys the certificate cannot be checked —
+                # an unverifiable install would let any connected peer
+                # rewrite this replica; refuse rather than trust
+                return self._refuse(
+                    "SNAPSHOT", "no provisioned peer keys to verify "
+                                "the snapshot certificate against")
+            base_head = next_head(prev, op)
+            self.ledger = restore_snapshot(state, self.cfg, i + 1,
+                                           base_head)
+            self._heads = []
+            self._head_base = i + 1
+            self._base_head = base_head
+            self._voted = {k: v for k, v in self._voted.items()
+                           if k > i}
+            _M_REPAIR.inc(kind="snapshot_install")
+            if self.verbose:
+                print(f"[validator {self.index}] state-synced from "
+                      f"snapshot@{i} (epoch "
+                      f"{self.ledger.epoch})", flush=True)
+            return {"ok": True, "log_size": self.ledger.log_size()}
 
     _VOTE_BATCH_MAX = 256
 
@@ -981,7 +1083,17 @@ class CertificateAssembler:
                     if not 0 <= behind < i:
                         break
                     for j in range(behind, i):
-                        entry = self.backlog_fn(j)
+                        try:
+                            entry = self.backlog_fn(j)
+                        except PrefixCompacted as e:
+                            # the backlog below the GC base is gone:
+                            # state-sync the replica from the certified
+                            # snapshot, then re-ask — it reports its new
+                            # (post-install) position and the replay
+                            # continues from there
+                            if not self._offer_snapshot(client, e):
+                                return None
+                            break
                         bop, bauth = entry[0], entry[1]
                         bcert = entry[2] if len(entry) > 2 else None
                         rj = client.request("bft_validate", i=j,
@@ -1022,7 +1134,16 @@ class CertificateAssembler:
         resyncs = 0
         j = behind
         while j < upto:
-            entry = self.backlog_fn(j)
+            try:
+                entry = self.backlog_fn(j)
+            except PrefixCompacted as e:
+                # replay target below the GC base: install the certified
+                # snapshot and continue from the post-install position
+                if not self._offer_snapshot(client, e) \
+                        or e.base <= j:
+                    return False
+                j = e.base
+                continue
             bop, bauth = entry[0], entry[1]
             bcert = entry[2] if len(entry) > 2 else None
             try:
@@ -1254,28 +1375,47 @@ class CertificateAssembler:
                 ValueError):
             client.close()
             return False
-        # our heads over the certified backlog (chain-rule fold)
-        ops = [self.backlog_fn(j) for j in range(size)]
+        # our heads over the certified backlog (chain-rule fold).  On a
+        # compacted writer the fold starts at the certified snapshot's
+        # base instead of genesis — an above-base fork must keep the
+        # op-level resync (a snapshot install would be refused by a
+        # replica whose chain already reaches past the snapshot).
+        base, base_head = 0, _EMPTY_HEAD
+        try:
+            ops = [self.backlog_fn(j) for j in range(size)]
+        except PrefixCompacted as e:
+            if e.offer is None or size <= int(e.offer["i"]) + 1:
+                # the replica itself lags at/below the certified
+                # snapshot: installing it is the only heal
+                return self._offer_snapshot(client, e)
+            from bflc_demo_tpu.ledger.snapshot import snapshot_base_head
+            base = int(e.offer["i"]) + 1
+            base_head = snapshot_base_head(e.offer)
+            try:
+                ops = [self.backlog_fn(j) for j in range(base, size)]
+            except PrefixCompacted:
+                return False            # GC advanced mid-walk: retry
+                #                         lands on the newer snapshot
         heads = []
-        h = _EMPTY_HEAD
+        h = base_head
         for entry in ops:
             heads.append(next_head(h, entry[0]))
             h = heads[-1]
         d = size                        # first divergent index
-        for j in range(size, 0, -1):
+        for j in range(size, base, -1):
             try:
                 r = client.request("info", at=j)
             except (ConnectionError, WireError, OSError):
                 client.close()
                 return False
             if r.get("head_at") and \
-                    bytes.fromhex(r["head_at"]) == heads[j - 1]:
+                    bytes.fromhex(r["head_at"]) == heads[j - base - 1]:
                 break
             d = j - 1
         if d >= size:
             return False                # no divergence below i after all
-        op, auth = ops[d][0], ops[d][1]
-        cert = ops[d][2] if len(ops[d]) > 2 else None
+        op, auth = ops[d - base][0], ops[d - base][1]
+        cert = ops[d - base][2] if len(ops[d - base]) > 2 else None
         if cert is None:
             return False
         try:
@@ -1285,6 +1425,29 @@ class CertificateAssembler:
         except (ConnectionError, WireError, OSError):
             client.close()
             return False
+
+    def _offer_snapshot(self, client: ValidatorClient,
+                        exc: PrefixCompacted) -> bool:
+        """Hand a lagging replica the writer's certified snapshot
+        (`bft_snapshot`); True when it installed.  The validator
+        verifies everything itself — quorum certificate + state digest
+        — so a corrupt offer costs a refusal, never a poisoned
+        replica."""
+        offer = exc.offer
+        if offer is None:
+            return False
+        op = offer["op"]
+        prev = offer["prev_head"]
+        try:
+            r = client.request(
+                "bft_snapshot", i=int(offer["i"]),
+                op=op if isinstance(op, str) else op.hex(),
+                prev_head=prev if isinstance(prev, str) else prev.hex(),
+                state=bytes(offer["state"]), cert=offer.get("cert"))
+        except (ConnectionError, WireError, OSError):
+            client.close()
+            return False
+        return bool(r.get("ok"))
 
     def _abandon_round(self, i: int, attempt: int):
         """Ask every validator for a signed abandon statement at (i,

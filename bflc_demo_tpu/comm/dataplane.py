@@ -132,7 +132,9 @@ def handle_read(method: str, m: dict, *,
                 blob_lookup: Callable[[bytes], Optional[bytes]],
                 model_state: Callable[[], Optional[Tuple[int, bytes,
                                                          bytes]]],
-                read_set: object = ()) -> Optional[dict]:
+                read_set: object = (),
+                snapshot_state: Optional[Callable[[], Optional[dict]]]
+                = None) -> Optional[dict]:
     """Serve one ``blob``/``blobs``/``model`` read; None for any other
     method (the caller falls through to its own dispatch).
 
@@ -191,6 +193,25 @@ def handle_read(method: str, m: dict, *,
             # fattest reply on the control plane (comm.wire, PR 3)
             reply["blob"] = model_blob
         return reply
+    if method == "snapshot" and snapshot_state is not None:
+        # certified-checkpoint state-sync (ledger.snapshot): a replica
+        # serves the snapshot it already mirrored, so a joiner's fattest
+        # fetch — state bytes + model blob — comes off the writer's
+        # accept loop like any other read.  Trust is unchanged: the
+        # joiner verifies the WRITER-asserted (op, cert) binding and the
+        # state/model hashes before installing, so a stale or lying
+        # replica costs a declined/refused round-trip, never wrong state.
+        from bflc_demo_tpu.ledger.snapshot import offer_to_wire
+        snap = snapshot_state()
+        if snap is None:
+            return {"ok": False, "error": "no snapshot mirrored"}
+        want = m.get("want_i")
+        if want is not None and int(want) != int(snap["i"]):
+            # the caller names the exact checkpoint it verified against
+            # the writer: a replica holding a different one declines in
+            # one tiny frame (same shape as the model `want` probe)
+            return {"ok": False, "status": "STALE", "i": int(snap["i"])}
+        return offer_to_wire(snap)
     return None
 
 
@@ -209,9 +230,12 @@ class ReadFanoutServer:
                  blob_lookup: Callable[[bytes], Optional[bytes]],
                  model_state: Callable[[], Optional[Tuple[int, bytes,
                                                           bytes]]],
-                 host: str = "127.0.0.1", port: int = 0, tls=None):
+                 host: str = "127.0.0.1", port: int = 0, tls=None,
+                 snapshot_state: Optional[Callable[[], Optional[dict]]]
+                 = None):
         self._blob_lookup = blob_lookup
         self._model_state = model_state
+        self._snapshot_state = snapshot_state
         self._tls = tls                 # ssl.SSLContext or None
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -265,7 +289,8 @@ class ReadFanoutServer:
                 try:
                     reply = handle_read(
                         method, msg, blob_lookup=self._blob_lookup,
-                        model_state=self._model_state)
+                        model_state=self._model_state,
+                        snapshot_state=self._snapshot_state)
                     if reply is None:
                         reply = {"ok": False,
                                  "error": f"read replica: unknown method "

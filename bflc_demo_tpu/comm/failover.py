@@ -101,6 +101,17 @@ _G_ACK_LAG = obs_metrics.REGISTRY.gauge(
     "applied ops not yet ack-eligible (pending-payload clamp depth)")
 _M_PROMOTIONS = obs_metrics.REGISTRY.counter(
     "standby_promotions_total", "promotions by outcome", ("outcome",))
+# --- snapshot state-sync (ledger.snapshot): how long installing a
+# certified checkpoint + model took vs the replay-from-genesis it
+# replaced, and how often rejoins took the snapshot path at all.
+_M_SYNC_S = obs_metrics.REGISTRY.histogram(
+    "state_sync_seconds",
+    "snapshot fetch + verify + install wall time on rejoin")
+_M_SYNCS = obs_metrics.REGISTRY.counter(
+    "state_syncs_total", "snapshot state-syncs by outcome", ("outcome",))
+_M_GC_OPS = obs_metrics.REGISTRY.counter(
+    "standby_gc_ops_total",
+    "mirrored log ops reclaimed behind streamed certified snapshots")
 
 
 class WriterDead(Exception):
@@ -331,12 +342,30 @@ class Standby:
                  bft_keys: Optional[Dict[int, bytes]] = None,
                  bft_quorum: Optional[int] = None,
                  bft_timeout_s: float = 10.0,
+                 snapshot_interval: int = 0,
+                 snapshot_dir: str = "",
                  verbose: bool = False):
         if not 1 <= index < len(endpoints):
             raise ValueError(f"standby index {index} out of range for "
                              f"{len(endpoints)} endpoints")
         cfg.validate()
         self.cfg = cfg
+        # --- certified snapshots (ledger.snapshot): when the deployment
+        # runs snapshots, this standby (a) STATE-SYNCS from the writer's
+        # newest certified snapshot whenever its resume point was GC'd
+        # (fresh start, or rejoin after a long death), (b) mirrors each
+        # streamed snapshot op's meta and GCs its own replica behind it
+        # (bounded memory fleet-wide), and (c) carries the mirrored
+        # snapshot into the LedgerServer it becomes at promotion so
+        # joiners can state-sync from the new writer immediately.
+        # Compaction needs the python ledger backend (make_ledger below).
+        from bflc_demo_tpu.ledger.snapshot import snapshot_legacy
+        self.snapshot_interval = (0 if snapshot_legacy()
+                                  else max(int(snapshot_interval), 0))
+        self.snapshot_dir = snapshot_dir
+        self._latest_snapshot: Optional[dict] = None
+        if self.snapshot_interval and ledger_backend != "python":
+            ledger_backend = "python"
         self.endpoints = list(endpoints)
         self.index = index
         self.heartbeat_s = heartbeat_s
@@ -433,8 +462,19 @@ class Standby:
         if not data_plane_legacy():
             self.read_server = ReadFanoutServer(
                 self._blobs.get, self._read_model_state, host=host,
-                tls=tls_server)
+                tls=tls_server,
+                snapshot_state=self._read_snapshot_state)
             self.read_server.start()
+
+    def _read_snapshot_state(self):
+        """The mirrored snapshot meta the read fan-out may serve to
+        state-syncing joiners, or None — only a checkpoint whose model
+        blob is present and hash-consistent is offered (a joiner would
+        refuse anything less, so declining is cheaper)."""
+        meta = self._latest_snapshot
+        if meta is None or meta.get("model") is None:
+            return None
+        return meta
 
     def _read_model_state(self):
         """(epoch, hash, blob) of the mirrored model, or None before the
@@ -522,48 +562,33 @@ class Standby:
         """
         host, port = writer
         try:
-            sub = CoordinatorClient(host, port, timeout_s=self.heartbeat_s,
-                                    tls=self.tls_client)
-            sub_msg = {"method": "subscribe",
-                       "from": self.ledger.log_size()}
-            if self.wallet is not None:
-                sub_msg["sb"] = self.index
-                if self.read_server is not None:
-                    # advertise the read fan-out endpoint; the writer
-                    # republishes it only if the handshake below proves
-                    # our provisioned identity (comm.ledger_service)
-                    sub_msg["read_ep"] = list(self.read_server.endpoint)
-            send_msg(sub.sock, sub_msg)
-            if self.wallet is not None:
-                # challenge-response: prove the standby identity so this
-                # subscription's acks count toward the writer's durability
-                # quorum (the nonce makes captured handshakes unreplayable)
-                import struct as _struct
-                from bflc_demo_tpu.comm.ledger_service import \
-                    LedgerServer as _LS
-                sub.sock.settimeout(10.0)      # handshake, not heartbeat
-                ch = recv_msg(sub.sock)
-                sub.sock.settimeout(self.heartbeat_s)
-                if not isinstance(ch, dict) or "challenge" not in ch:
-                    raise WriterDead("subscriber handshake: no challenge")
-                sig = self.wallet.sign(
-                    _LS._SUB_MAGIC + bytes.fromhex(ch["challenge"])
-                    + _struct.pack("<Iq", self.index, sub_msg["from"]))
-                send_msg(sub.sock, {"tag": sig.hex()})
             ctl = CoordinatorClient(host, port, timeout_s=10.0,
                                     tls=self.tls_client)
+        except (ConnectionError, WireError, OSError) as e:
+            raise WriterDead(str(e))
+        try:
             # fence check: never follow a writer whose generation is behind
             # our replayed chain — that's a stale pre-partition writer whose
             # ops would fork us off the promoted chain
             inf = ctl.request("info")
             if int(inf.get("gen", 0)) < self.ledger.generation:
-                sub.close()
-                ctl.close()
                 raise WriterDead(
                     f"stale writer: gen {inf.get('gen')} < "
                     f"ours {self.ledger.generation}")
+            # snapshot state-sync (ledger.snapshot): the writer GC'd its
+            # log past our resume point — replaying the prefix is
+            # impossible, so install the newest certified snapshot +
+            # model and follow only the tail (a refusal of a corrupt or
+            # forged offer raises out of _state_sync, never installs)
+            if self.ledger.log_size() < int(inf.get("log_base", 0) or 0):
+                self._state_sync(ctl)
+            sub = self._open_subscription(writer)
         except (ConnectionError, WireError, OSError) as e:
+            ctl.close()
             raise WriterDead(str(e))
+        except (WriterDead, RuntimeError):
+            ctl.close()
+            raise
         try:
             self._sync_state(ctl)
             last_applied = self.ledger.log_size() - 1
@@ -586,6 +611,21 @@ class Standby:
                     raise WriterDead(str(e))
                 if msg is None:
                     raise WriterDead("op stream closed")
+                if "op" not in msg:
+                    if not msg.get("state_sync"):
+                        continue        # unknown control frame: ignore
+                    # the writer GC'd past our subscribe point BETWEEN
+                    # the info probe and the subscribe (the race the
+                    # stream marker exists for): install the snapshot
+                    # and resubscribe at the post-install position
+                    sub.close()
+                    try:
+                        self._state_sync(ctl)
+                        sub = self._open_subscription(writer)
+                    except (ConnectionError, WireError, OSError) as e:
+                        raise WriterDead(str(e))
+                    last_applied = self.ledger.log_size() - 1
+                    continue
                 op_bytes = bytes.fromhex(msg["op"])
                 op_index = self.ledger.log_size()
                 if self.bft_keys:
@@ -620,6 +660,13 @@ class Standby:
                         f"standby rejected op {msg['i']}: {st.name} — "
                         f"writer/replica divergence, refusing to continue")
                 last_applied = op_index
+                if op_bytes and op_bytes[0] == self._SNAPSHOT_OPCODE:
+                    # the apply above already re-derived the snapshot's
+                    # state digest from OUR replica (pyledger OP_SNAPSHOT
+                    # refuses a mismatch) — mirror the meta and GC this
+                    # replica behind the certified checkpoint
+                    self._note_snapshot_op(op_index, op_bytes,
+                                           msg.get("cert"))
                 self._drop_moot_payloads()
                 try:
                     self._sync_state(ctl)
@@ -634,6 +681,216 @@ class Standby:
         finally:
             sub.close()
             ctl.close()
+
+    def _open_subscription(self, writer: Endpoint) -> CoordinatorClient:
+        """Open the op-stream subscription at our current resume point,
+        proving the provisioned standby identity via the challenge
+        handshake (the nonce makes captured handshakes unreplayable)
+        so this subscription's acks count toward the writer's
+        durability quorum."""
+        host, port = writer
+        sub = CoordinatorClient(host, port, timeout_s=self.heartbeat_s,
+                                tls=self.tls_client)
+        sub_msg = {"method": "subscribe",
+                   "from": self.ledger.log_size()}
+        if self.wallet is not None:
+            sub_msg["sb"] = self.index
+            if self.read_server is not None:
+                # advertise the read fan-out endpoint; the writer
+                # republishes it only if the handshake below proves
+                # our provisioned identity (comm.ledger_service)
+                sub_msg["read_ep"] = list(self.read_server.endpoint)
+        try:
+            send_msg(sub.sock, sub_msg)
+            if self.wallet is not None:
+                import struct as _struct
+                sub.sock.settimeout(10.0)  # handshake, not heartbeat
+                ch = recv_msg(sub.sock)
+                sub.sock.settimeout(self.heartbeat_s)
+                if not isinstance(ch, dict) or "challenge" not in ch:
+                    raise WriterDead("subscriber handshake: no challenge")
+                sig = self.wallet.sign(
+                    LedgerServer._SUB_MAGIC + bytes.fromhex(ch["challenge"])
+                    + _struct.pack("<Iq", self.index, sub_msg["from"]))
+                send_msg(sub.sock, {"tag": sig.hex()})
+        except BaseException:
+            sub.close()
+            raise
+        return sub
+
+    _SNAPSHOT_OPCODE = 9        # ledger op codec (ledger/tool.decode_op)
+
+    def _state_sync(self, ctl: CoordinatorClient) -> None:
+        """Install the writer's newest certified snapshot in place of a
+        GC'd prefix this replica can no longer replay (ledger.snapshot).
+
+        Trust: `verify_snapshot_meta` re-derives every binding — state
+        bytes must hash to the op's embedded digest, the model blob to
+        the state's model hash, the commit certificate (BFT mode) must
+        quorum-bind (i, prev_head, op) under OUR provisioned validator
+        keys, and the generation must not regress below our replayed
+        fence.  A forged/stale/torn offer raises RuntimeError (explicit
+        refusal, same semantics as an uncertified append) and nothing
+        installs; transport failures raise WriterDead (retry later)."""
+        from bflc_demo_tpu.ledger.snapshot import (restore_snapshot,
+                                                   snapshot_base_head,
+                                                   verify_snapshot_meta)
+        t0 = time.perf_counter()
+        try:
+            offer = ctl.request("snapshot", meta=1)
+        except (ConnectionError, WireError, OSError) as e:
+            raise WriterDead(str(e))
+        if not offer.get("ok"):
+            _M_SYNCS.inc(outcome="no_offer")
+            raise WriterDead(
+                f"writer GC'd past our resume point but serves no "
+                f"snapshot: {offer.get('error')}")
+        try:
+            meta = {"i": int(offer["i"]), "epoch": int(offer["epoch"]),
+                    "gen": int(offer.get("gen", 0)), "op": offer["op"],
+                    "prev_head": offer["prev_head"],
+                    "cert": offer.get("cert")}
+        except (KeyError, TypeError, ValueError) as e:
+            _M_SYNCS.inc(outcome="refused")
+            raise RuntimeError(
+                f"standby {self.index}: malformed snapshot offer: {e}")
+        meta["state"], meta["model"] = self._fetch_snapshot_body(
+            ctl, offer)
+        err = verify_snapshot_meta(
+            meta, bft_quorum=self.bft_quorum,
+            bft_keys=self.bft_keys or None,
+            min_generation=self.ledger.generation)
+        if err:
+            _M_SYNCS.inc(outcome="refused")
+            raise RuntimeError(
+                f"standby {self.index}: refusing offered snapshot: "
+                f"{err}")
+        base = int(meta["i"]) + 1
+        self.ledger = restore_snapshot(meta["state"], self.cfg, base,
+                                       snapshot_base_head(meta))
+        self._ledger_backend = "python"     # restored replicas compact
+        self._model_blob = bytes(meta["model"])
+        self._certs = ({int(meta["i"]): meta["cert"]}
+                       if meta.get("cert") else {})
+        self._pending_payload.clear()
+        self._blob_unknown = False
+        self._synced_registered = -1        # force a full sideband
+        self._synced_update_count = -1      # resync against the tail
+        self._latest_snapshot = {**meta, "final": True}
+        dt = time.perf_counter() - t0
+        if obs_metrics.REGISTRY.enabled:
+            _M_SYNC_S.observe(dt)
+            _M_SYNCS.inc(outcome="installed")
+        obs_flight.FLIGHT.record(
+            "event", "state_sync", i=int(meta["i"]),
+            epoch=int(meta["epoch"]), seconds=round(dt, 3))
+        if self.verbose:
+            print(f"[standby {self.index}] state-synced from certified "
+                  f"snapshot@{meta['i']} (epoch {meta['epoch']}, "
+                  f"{dt * 1e3:.0f} ms)", flush=True)
+
+    def _fetch_snapshot_body(self, ctl: CoordinatorClient,
+                             offer: dict) -> Tuple[bytes, bytes]:
+        """(state, model) bytes for the writer-asserted snapshot offer:
+        advertised read-fan-out replicas first (comm.dataplane — the
+        fattest fetch on the plane comes off the writer's accept loop),
+        the writer itself as the always-correct fallback.  Replica bytes
+        are pre-checked against the offer's own digests, so a stale or
+        lying replica costs one round-trip, never a refused install."""
+        from bflc_demo_tpu.ledger.snapshot import (decode_state,
+                                                   parse_snapshot_op)
+        op = offer.get("op", "")
+        op_b = bytes.fromhex(op) if isinstance(op, str) else bytes(op)
+        parsed = parse_snapshot_op(op_b)
+        want_digest = parsed[1] if parsed else None
+        for ep in offer.get("read_set") or []:
+            try:
+                host, port = str(ep[0]), int(ep[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            try:
+                c = CoordinatorClient(host, port, timeout_s=10.0,
+                                      tls=self.tls_client)
+            except (ConnectionError, OSError):
+                continue
+            try:
+                r = c.request("snapshot", want_i=int(offer["i"]))
+            except (ConnectionError, WireError, OSError):
+                continue
+            finally:
+                c.close()
+            if not r.get("ok"):
+                continue
+            try:
+                state = blob_bytes(r.get("state", b""))
+                model = blob_bytes(r.get("model", b""))
+                mh = bytes(decode_state(state)["model_hash"])
+            except ValueError:
+                continue
+            if want_digest is not None \
+                    and hashlib.sha256(state).digest() == want_digest \
+                    and hashlib.sha256(model).digest() == mh:
+                return state, model
+        try:
+            r = ctl.request("snapshot")
+        except (ConnectionError, WireError, OSError) as e:
+            raise WriterDead(str(e))
+        if not r.get("ok"):
+            raise WriterDead(
+                f"snapshot body fetch failed: {r.get('error')}")
+        return blob_bytes(r["state"]), blob_bytes(r["model"])
+
+    def _note_snapshot_op(self, i: int, op: bytes, cert_wire) -> None:
+        """Mirror a streamed snapshot op's full meta and GC this replica
+        behind the certified checkpoint: bounded replica memory
+        fleet-wide, the meta served to state-syncing joiners through the
+        read fan-out, and carried into the LedgerServer this standby
+        becomes at promotion (joiners state-sync from the new writer
+        immediately).  The caller already applied the op, which IS the
+        verification — apply re-derives the state digest locally."""
+        from bflc_demo_tpu.ledger.snapshot import (parse_snapshot_op,
+                                                   prune_snapshots,
+                                                   write_snapshot_file)
+        parsed = parse_snapshot_op(op)
+        if parsed is None:
+            return
+        epoch, _digest = parsed
+        state = self.ledger.encode_state()
+        head_at = getattr(self.ledger, "head_at", None)
+        prev = head_at(i) if head_at is not None else b""
+        model = self._model_blob
+        want_mh, _ = self.ledger.query_global_model()
+        if model is None or hashlib.sha256(model).digest() != want_mh:
+            # stale mirror: never serve/persist a model blob that fails
+            # the snapshot's own hash check (a joiner would refuse the
+            # whole offer) — the meta still rides without it
+            model = None
+        meta = {"i": i, "epoch": epoch, "gen": self.ledger.generation,
+                "op": op, "prev_head": prev or b"\0" * 32,
+                "cert": cert_wire, "state": state, "model": model,
+                "final": True}
+        self._latest_snapshot = meta
+        if self.snapshot_dir and model is not None:
+            try:
+                write_snapshot_file(self.snapshot_dir, meta)
+                prune_snapshots(self.snapshot_dir, 2)
+            except OSError:
+                pass                    # a full disk must not stop the
+                #                         follow loop; retried next snap
+        gc = getattr(self.ledger, "gc_prefix", None)
+        if gc is not None:
+            dropped = gc(i + 1, state)
+            if dropped:
+                # mirrored certificates below the base go with the
+                # prefix (the snapshot op's own cert stays: it is the
+                # offer's chain-link evidence)
+                self._certs = {k: v for k, v in self._certs.items()
+                               if k >= i}
+                if obs_metrics.REGISTRY.enabled:
+                    _M_GC_OPS.inc(dropped)
+                if self.verbose:
+                    print(f"[standby {self.index}] GC: dropped {dropped} "
+                          f"mirrored ops behind snapshot@{i}", flush=True)
 
     def _await_upload_payload(self, op_bytes: bytes,
                               ctl: CoordinatorClient,
@@ -964,16 +1221,27 @@ class Standby:
         stopped: certification unavailability must degrade to delay,
         never to a dead failover ladder.
         """
-        from bflc_demo_tpu.comm.bft import CertificateAssembler
+        from bflc_demo_tpu.comm.bft import (CertificateAssembler,
+                                            PrefixCompacted)
         from bflc_demo_tpu.comm.ledger_service import chain_head_at
+
+        def _backlog(j: int):
+            # a validator that lagged the dead writer resyncs from this
+            # standby's mirrored certificates (auth evidence died with
+            # the writer; the certs carry the quorum's admission).
+            # Below this replica's GC'd base the op bytes are gone: hand
+            # the assembler the mirrored snapshot offer so the lagging
+            # validator state-syncs (`bft_snapshot`) instead of the
+            # vote thread dying on the raw IndexError
+            base = getattr(self.ledger, "log_base", 0)
+            if j < base:
+                raise PrefixCompacted(self._latest_snapshot, base)
+            return (self.ledger.log_op(j), None, self._certs.get(j))
+
         assembler = CertificateAssembler(
             self.bft_validators, self.bft_keys, self.bft_quorum,
             timeout_s=self.bft_timeout_s, tls=None,
-            # a validator that lagged the dead writer resyncs from this
-            # standby's mirrored certificates (auth evidence died with
-            # the writer; the certs carry the quorum's admission)
-            backlog_fn=lambda j: (self.ledger.log_op(j), None,
-                                  self._certs.get(j)))
+            backlog_fn=_backlog)
         try:
             while not self._stop.is_set():
                 ix = self.ledger.log_size() - 1
@@ -1080,6 +1348,9 @@ class Standby:
             bft_quorum=self.bft_quorum or None,
             bft_timeout_s=self.bft_timeout_s,
             resume_certs=dict(self._certs) if self.bft_keys else None,
+            snapshot_interval=self.snapshot_interval,
+            snapshot_dir=self.snapshot_dir,
+            resume_snapshot=self._latest_snapshot,
             verbose=self.verbose)
         # open enrollment on the promoted writer: a client the directory
         # missed re-presents its (self-authenticating) pubkey on register

@@ -84,6 +84,20 @@ _G_BACKLOG = obs_metrics.REGISTRY.gauge(
     "uncertified_backlog", "chain ops not yet quorum-certified")
 _G_SUBS = obs_metrics.REGISTRY.gauge(
     "op_stream_subscribers", "live op-stream subscribers")
+# --- certified snapshots (ledger.snapshot): age of the newest certified
+# checkpoint, its byte weight, the GC'd-prefix depth, and how many log
+# ops GC reclaimed — the bounded-growth evidence tools/fleet_top.py and
+# the endurance run read.
+_G_SNAP_AGE = obs_metrics.REGISTRY.gauge(
+    "snapshot_age_rounds",
+    "epochs since the newest certified snapshot (-1 = none yet)")
+_G_SNAP_BYTES = obs_metrics.REGISTRY.gauge(
+    "snapshot_bytes",
+    "artifact size of the newest certified snapshot (state + model)")
+_G_LOG_BASE = obs_metrics.REGISTRY.gauge(
+    "log_base", "first chain position still held (GC'd prefix depth)")
+_M_GC_OPS = obs_metrics.REGISTRY.counter(
+    "ledger_gc_ops_total", "log ops reclaimed by snapshot GC")
 
 _PROMO_MAGIC = b"BFLCPROM1"
 
@@ -91,11 +105,15 @@ _PROMO_MAGIC = b"BFLCPROM1"
 def chain_head_at(ledger, upto: int) -> bytes:
     """Digest of the op hash chain after ops[0..upto-1] (b"" at upto=0).
 
-    Recomputed from canonical op bytes via the common `log_op` surface, so
-    it works on both the native and Python ledger backends (the chain rule
-    matches ledger.cpp append_log / pyledger._append_log: each head is
-    SHA-256(prev_head || op)).
+    Served by the ledger's own `head_at` (both backends; the python
+    backend additionally answers below a GC'd prefix only at the exact
+    base — heads below it are gone with the compacted ops, and callers
+    that ask get the ValueError).  The chain-rule fold over `log_op`
+    remains as the fallback for ledger-likes without `head_at`.
     """
+    head_at = getattr(ledger, "head_at", None)
+    if head_at is not None:
+        return head_at(upto)
     h = b""
     for i in range(upto):
         d = hashlib.sha256()
@@ -170,7 +188,13 @@ def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
     gen, ix = int(ev["gen"]), int(ev["ix"])
     if gen <= ledger.generation or not 0 <= ix <= ledger.log_size():
         return False
-    return chain_head_at(ledger, ix) == bytes.fromhex(ev["prev"])
+    try:
+        return chain_head_at(ledger, ix) == bytes.fromhex(ev["prev"])
+    except ValueError:
+        # the claimed position sits below OUR GC'd snapshot base: the
+        # heads there are gone, so the chain binding cannot be proven —
+        # unverifiable evidence never demotes a writer
+        return False
 
 
 def _aggregate_flat(global_flat: Dict[str, np.ndarray],
@@ -226,6 +250,10 @@ class LedgerServer:
                  bft_timeout_s: float = 10.0,
                  resume_certs: Optional[Dict[int, dict]] = None,
                  cell_registry: Optional[Dict[str, Tuple[int, int]]] = None,
+                 snapshot_interval: int = 0,
+                 snapshot_dir: str = "",
+                 snapshot_keep: int = 2,
+                 resume_snapshot: Optional[dict] = None,
                  verbose: bool = False):
         """resume_ledger/resume_blobs/sock: the promotion surface
         (comm.failover.Standby) — a server constructed over a replica's
@@ -252,8 +280,41 @@ class LedgerServer:
         # wait on the condition for new log entries
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
+        # --- certified snapshots + ledger compaction (ledger.snapshot):
+        # every `snapshot_interval` rounds the writer appends a snapshot
+        # op (state digest re-derived by every replica/validator before
+        # it binds), persists the artifact tmp-then-rename under
+        # snapshot_dir (newest `snapshot_keep` retained), and GCs the
+        # log/WAL prefix behind it.  0 (the default) or
+        # BFLC_SNAPSHOT_LEGACY=1 pins the replay-from-genesis behavior
+        # byte-for-byte: no snapshot op ever enters the chain.
+        from bflc_demo_tpu.ledger.snapshot import snapshot_legacy
+        self._snap_interval = (0 if snapshot_legacy()
+                               else max(int(snapshot_interval), 0))
+        self._snap_dir = snapshot_dir
+        self._snap_keep = max(int(snapshot_keep), 1)
+        if self._snap_interval and resume_ledger is None:
+            # compaction needs the python backend (the native ledger has
+            # no state-injection/GC ABI — it still APPLIES snapshot ops,
+            # so native replicas and validators stay chain-compatible)
+            if ledger_backend == "native":
+                raise ValueError(
+                    "snapshot_interval > 0 needs the python ledger "
+                    "backend (the native ledger cannot compact its log)")
+            ledger_backend = "python"
         self.ledger = (resume_ledger if resume_ledger is not None
                        else make_ledger(cfg, backend=ledger_backend))
+        # newest snapshot meta: {"i", "epoch", "gen", "op", "prev_head",
+        # "cert", "state", "model", "final"} — the `snapshot` RPC's
+        # serving surface.  A promoted standby passes the one it
+        # mirrored (resume_snapshot) so joiners can state-sync from the
+        # new writer immediately.
+        self._latest_snapshot: Optional[dict] = (
+            dict(resume_snapshot) if resume_snapshot else None)
+        # last FINALIZED (certified) snapshot meta: stays servable while
+        # the next emission is mid-certification — a joiner arriving in
+        # that window must still get an offer for the GC'd prefix
+        self._served_snapshot: Optional[dict] = None
         if wal_path:
             if not self.ledger.attach_wal(wal_path):
                 raise RuntimeError(f"cannot attach WAL at {wal_path}")
@@ -579,8 +640,18 @@ class LedgerServer:
         rides along (a quorum already re-verified those tags) and, for
         register ops, the self-authenticating pubkey is recovered from
         the directory so the rejoining validator's own directory stays
-        complete for FRESH client traffic."""
+        complete for FRESH client traffic.
+
+        Below a GC'd prefix the op bytes are gone: raises
+        comm.bft.PrefixCompacted carrying the snapshot offer, which the
+        CertificateAssembler turns into a `bft_snapshot` install on the
+        lagging validator (state-sync instead of replay)."""
         with self._lock:
+            base = getattr(self.ledger, "log_base", 0)
+            if j < base:
+                from bflc_demo_tpu.comm.bft import PrefixCompacted
+                raise PrefixCompacted(
+                    self._snapshot_offer(require_model=False), base)
             op = self.ledger.log_op(j)
             auth = self._op_auth.get(j)
             if auth is None and op and op[0] == 1:      # register opcode
@@ -738,11 +809,30 @@ class LedgerServer:
             # "starts" at 10**18 must not become able to ack (and fake
             # durability for) ops it was never sent
             start = max(0, min(start, self.ledger.log_size()))
-            self._sub_acked[sub_id] = -1
-            self._sub_sent[sub_id] = start - 1
-            self._sub_eligible[sub_id] = quorum_eligible
-            if read_ep is not None:
-                self._sub_read_ep[sub_id] = read_ep
+            base = getattr(self.ledger, "log_base", 0)
+            if start >= base:
+                # register under the SAME lock as the base check: the
+                # snapshot GC's slowest-live-subscriber clamp must see
+                # this subscriber the instant the check passes, or a GC
+                # slipping between check and registration would compact
+                # the very ops this stream is about to push
+                self._sub_acked[sub_id] = -1
+                self._sub_sent[sub_id] = start - 1
+                self._sub_eligible[sub_id] = quorum_eligible
+                if read_ep is not None:
+                    self._sub_read_ep[sub_id] = read_ep
+        if start < base:
+            # the subscriber's resume point was GC'd behind a certified
+            # snapshot: it cannot replay the prefix — answer with the
+            # state-sync marker and let it install snapshot + tail
+            # (comm.failover Standby / `replicate`).  Standbys normally
+            # probe `info.log_base` before subscribing; this frame
+            # covers the race where GC ran in between.
+            try:
+                send_msg(conn, {"state_sync": 1, "base": base})
+            except (WireError, OSError):
+                pass
+            return
         reader = threading.Thread(target=self._ack_reader,
                                   args=(conn, sub_id), daemon=True)
         reader.start()
@@ -1174,9 +1264,14 @@ class LedgerServer:
                          "log_head": self.ledger.log_head().hex(),
                          "gen": self.ledger.generation,
                          "writer_index": self.ledger.writer_index,
+                         "log_base": getattr(self.ledger, "log_base", 0),
                          "certified_size": (self._certified_size
                                             if self._bft is not None
                                             else None)}
+                snap = self._snapshot_offer()
+                if snap is not None:
+                    reply["snapshot_epoch"] = snap["epoch"]
+                    reply["snapshot_i"] = snap["i"]
                 if tracing.PROC.enabled:
                     # the federation benchmark's attribution surface: the
                     # sponsor reads the writer's own phase accounting
@@ -1187,11 +1282,41 @@ class LedgerServer:
             if method == "log_range":
                 start, end = int(m["start"]), int(m["end"])
                 size = self.ledger.log_size()
+                base = getattr(self.ledger, "log_base", 0)
                 end = min(end, size)
+                if start < base:
+                    # the requested prefix was GC'd behind a certified
+                    # snapshot: the caller must state-sync (`snapshot`
+                    # RPC) instead of replaying it
+                    return {"ok": False, "error": "PREFIX_GC",
+                            "base": base}
                 if not (0 <= start <= end):
                     return {"ok": False, "error": "bad range"}
                 return {"ok": True, "ops": [self.ledger.log_op(i).hex()
                                             for i in range(start, end)]}
+            if method == "snapshot":
+                # the state-sync serving surface (ledger.snapshot): the
+                # newest finalized checkpoint — op + certificate +
+                # chain position + canonical state + model blob, every
+                # part verifiable by the joiner before install.  With
+                # meta=1 only the bindings (op, prev_head, cert) plus
+                # the advertised read set ship: the joiner then pulls
+                # the fat state/model bytes from a read-fan-out replica
+                # (comm.dataplane) and this accept loop serves one tiny
+                # frame instead of the fattest reply on the plane.
+                from bflc_demo_tpu.ledger.snapshot import offer_to_wire
+                snap = self._snapshot_offer()
+                if snap is None:
+                    return {"ok": False,
+                            "error": "no certified snapshot yet"}
+                reply = offer_to_wire(snap)
+                rs = self._read_set()
+                if rs:
+                    reply["read_set"] = [list(ep) for ep in rs]
+                if m.get("meta"):
+                    reply.pop("state")
+                    reply.pop("model")
+                return reply
             if method == "telemetry":
                 # the FleetCollector scrape surface (obs.collector):
                 # instantaneous state gauges are sampled HERE so a scrape
@@ -1207,6 +1332,10 @@ class LedgerServer:
                                       if self._bft is not None
                                       else self.ledger.log_size()))
                     _G_SUBS.set(len(self._sub_acked))
+                    _G_LOG_BASE.set(getattr(self.ledger, "log_base", 0))
+                    snap = self._snapshot_offer()
+                    _G_SNAP_AGE.set(self.ledger.epoch - snap["epoch"]
+                                    if snap is not None else -1)
                 return {"ok": True,
                         "role": obs_metrics.REGISTRY.role or "writer",
                         "snapshot": obs_metrics.REGISTRY.snapshot()}
@@ -1366,6 +1495,9 @@ class LedgerServer:
                               for k, a in new_flat.items()}
         self._rounds_completed += 1
         self._last_progress = time.monotonic()
+        if self._snap_interval and \
+                self.ledger.epoch % self._snap_interval == 0:
+            self._emit_snapshot()
         self._cv.notify_all()
         if tracing.PROC.enabled:
             tracing.PROC.charge("aggregate_s", time.perf_counter() - t0)
@@ -1375,6 +1507,138 @@ class LedgerServer:
         if self.verbose:
             print(f"[coordinator] epoch {epoch} aggregated: "
                   f"loss={self.ledger.last_global_loss:.5f}", flush=True)
+
+    def _emit_snapshot(self) -> None:
+        """Append a snapshot op over the CURRENT (post-commit) state and
+        stage the artifact (lock held — called from the commit path).
+        Certification rides the normal machinery: the op sits in the
+        uncertified backlog like any other, and finalization (artifact
+        write + prefix GC) happens in the monitor loop once its
+        certificate exists — never before, or a joiner could install a
+        checkpoint no quorum re-derived."""
+        from bflc_demo_tpu.ledger.snapshot import make_snapshot_op
+        state = self.ledger.encode_state()
+        pos = self.ledger.log_size()
+        prev = self.ledger.log_head() if pos else b"\0" * 32
+        op = make_snapshot_op(self.ledger)
+        st = self.ledger.apply_op(op)
+        if st != LedgerStatus.OK:       # self-application re-derives the
+            # digest it just computed — only a concurrent-mutation bug
+            # could trip this; surface it, don't wedge the commit
+            if self.verbose:
+                print(f"[coordinator] snapshot op rejected: {st.name}",
+                      flush=True)
+            return
+        self._latest_snapshot = {
+            "i": pos, "epoch": self.ledger.epoch,
+            "gen": self.ledger.generation, "op": op, "prev_head": prev,
+            "cert": None, "state": state, "model": self._model_blob,
+            "final": False}
+        obs_flight.FLIGHT.record("event", "snapshot_emitted",
+                                 position=pos, epoch=self.ledger.epoch)
+
+    def _maybe_finalize_snapshot(self) -> None:
+        """Monitor-loop tail of emission: once the snapshot op is
+        CERTIFIED, persist the artifact (tmp-then-rename + retention
+        prune) and GC the log/WAL prefix behind it — clamped to the
+        slowest live subscriber so an active stream never loses the ops
+        it is mid-push on (a DEAD subscriber holds nothing back: its
+        rejoin is exactly the state-sync path)."""
+        meta = self._latest_snapshot
+        if meta is None or meta.get("final"):
+            return
+        i = int(meta["i"])
+        if self._bft is not None:
+            cert = self._certs.get(i)
+            if cert is None:
+                return                  # not certified yet: wait
+            meta["cert"] = cert
+        self._served_snapshot = meta
+        if not meta.get("artifact_written"):
+            # artifact persistence (an fsync of state + FULL model
+            # bytes) runs OUTSIDE the dispatch lock: the meta is
+            # immutable byte snapshots, only the monitor loop calls
+            # here, and a multi-MB disk sync must not stall every
+            # client RPC at the snapshot boundary
+            if self._snap_dir:
+                from bflc_demo_tpu.ledger.snapshot import (
+                    prune_snapshots, write_snapshot_file)
+                try:
+                    write_snapshot_file(self._snap_dir, meta)
+                    prune_snapshots(self._snap_dir, self._snap_keep)
+                except OSError as e:        # full disk must not kill
+                    if self.verbose:        # the writer; retried next
+                        print(f"[coordinator] snapshot artifact "
+                              f"write failed: {e}", flush=True)
+                    return
+            meta["artifact_written"] = True
+            if obs_metrics.REGISTRY.enabled:
+                _G_SNAP_BYTES.set(len(meta["state"])
+                                  + len(meta["model"]))
+        with self._lock:
+            gc = getattr(self.ledger, "gc_prefix", None)
+            base = getattr(self.ledger, "log_base", 0)
+            if gc is None or base >= i + 1:
+                meta["final"] = True    # nothing (more) to reclaim
+                return
+            # GC exactly to the snapshot boundary, but never past the
+            # slowest LIVE subscriber's send watermark (an active
+            # stream must not lose the ops it is mid-push on; a dead
+            # subscriber holds nothing back — its rejoin is the
+            # state-sync path)
+            floor = i + 1
+            for sent in self._sub_sent.values():
+                floor = min(floor, sent + 1)
+            if floor < i + 1:
+                return                  # a live stream is behind: retry
+            dropped = gc(i + 1, meta["state"])
+            meta["final"] = True
+            if dropped:
+                # the per-op sideband below the base goes with the
+                # prefix — auth evidence and certificates for GC'd ops
+                # can never be served again (the snapshot op's own cert
+                # stays: it is the offer's chain-link evidence).  This
+                # is what actually bounds writer MEMORY alongside the
+                # on-disk log/WAL bound.
+                self._op_auth = {k: v for k, v in self._op_auth.items()
+                                 if k >= i}
+                kept = {k: v for k, v in self._certs.items() if k >= i}
+                kept_hashes = {w.get("op_hash") for w in kept.values()}
+                self._certs = kept
+                self._certs_by_ophash = {
+                    h: w for h, w in self._certs_by_ophash.items()
+                    if h in kept_hashes}
+            if obs_metrics.REGISTRY.enabled and dropped:
+                _M_GC_OPS.inc(dropped)
+            obs_flight.FLIGHT.record("event", "ledger_gc", base=i + 1,
+                                     dropped=dropped)
+            if self.verbose and dropped:
+                print(f"[coordinator] GC: dropped {dropped} log ops "
+                      f"behind snapshot@{i}", flush=True)
+
+    def _snapshot_offer(self, require_model: bool = True) \
+            -> Optional[dict]:
+        """The newest FINALIZED (certified when BFT) snapshot meta, or
+        None — what the `snapshot` RPC and the validator-resync path
+        hand out.  require_model=False serves a model-less meta too: a
+        validator installs ledger STATE only (`bft_snapshot`), so a
+        promotion-resumed meta whose model mirror was stale at snapshot
+        time must still unblock validator catch-up."""
+        for meta in (self._latest_snapshot, self._served_snapshot):
+            if meta is None or \
+                    (require_model and meta.get("model") is None):
+                # a promotion-resumed meta can lack the model blob (the
+                # standby's mirror was stale at snapshot time): nothing
+                # to offer a JOINER until this writer emits its own
+                # snapshot
+                continue
+            if self._bft is not None and meta.get("cert") is None:
+                # newest emission still mid-certification: fall back to
+                # the last finalized offer (the GC'd prefix must always
+                # have a servable account)
+                continue
+            return meta
+        return None
 
     def _monitor_loop(self) -> None:
         """Failure detector: when a round stalls (dead client processes),
@@ -1396,6 +1660,14 @@ class LedgerServer:
                 self._ensure_certified(
                     self.ledger.log_size(),
                     timeout_s=min(self.stall_timeout_s / 4, 1.0))
+            if self._snap_interval or self._latest_snapshot is not None:
+                try:
+                    self._maybe_finalize_snapshot()
+                except Exception as e:  # noqa: BLE001 — snapshot
+                    # finalization must never kill the failure detector
+                    if self.verbose:
+                        print(f"[coordinator] snapshot finalize failed: "
+                              f"{type(e).__name__}: {e}", flush=True)
             with self._lock:
                 if self.ledger.epoch < 0:
                     continue
@@ -1492,29 +1764,80 @@ def replicate(host: str, port: int, cfg: ProtocolConfig,
     writer at the end — the multi-node replication consistency contract
     (reference: identical state on all 4 PBFT nodes, imgs/runtime.jpg).
 
-    Returns the replica ledger once `until_ops` ops are applied (or raises
-    on divergence/timeout).
+    Returns the replica ledger once its log reaches `until_ops` ops (or
+    raises on divergence/timeout).  Against a writer whose log prefix was
+    GC'd behind a certified snapshot (ledger.snapshot) the replica
+    STATE-SYNCS first — installs the hash-verified snapshot and replays
+    only the tail — which is exactly the joiner path this module's
+    Standby uses.
     """
-    replica = make_ledger(cfg, backend=ledger_backend)
-    sub = CoordinatorClient(host, port, timeout_s=timeout_s, tls=tls)
+    def _install_from(probe):
+        from bflc_demo_tpu.ledger.snapshot import (
+            restore_snapshot, snapshot_base_head, verify_snapshot_meta)
+        offer = probe.request("snapshot")
+        if not offer.get("ok"):
+            raise RuntimeError(
+                f"writer GC'd its prefix but serves no snapshot: "
+                f"{offer.get('error')}")
+        meta = {"i": offer["i"], "op": offer["op"],
+                "prev_head": offer["prev_head"],
+                "state": blob_bytes(offer["state"]),
+                "model": blob_bytes(offer["model"]),
+                "cert": offer.get("cert"),
+                "gen": offer.get("gen", 0)}
+        err = verify_snapshot_meta(meta)
+        if err:
+            raise RuntimeError(f"refusing offered snapshot: {err}")
+        return restore_snapshot(meta["state"], cfg,
+                                int(meta["i"]) + 1,
+                                snapshot_base_head(meta))
+
+    probe0 = CoordinatorClient(host, port, timeout_s=timeout_s, tls=tls)
     try:
-        send_msg(sub.sock, {"method": "subscribe", "from": 0})
-        applied = 0
-        deadline = time.monotonic() + timeout_s
-        while applied < until_ops:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"replica saw {applied}/{until_ops} ops in {timeout_s}s")
-            msg = recv_msg(sub.sock)
-            if msg is None:
-                raise ConnectionError("writer closed the op stream")
-            st = replica.apply_op(bytes.fromhex(msg["op"]))
-            if st != LedgerStatus.OK:
-                raise RuntimeError(
-                    f"replica rejected op {msg['i']}: {st.name}")
-            applied += 1
+        base = int(probe0.request("info").get("log_base", 0) or 0)
+        replica = (_install_from(probe0) if base > 0
+                   else make_ledger(cfg, backend=ledger_backend))
     finally:
-        sub.close()
+        probe0.close()
+    deadline = time.monotonic() + timeout_s
+    for _ in range(3):
+        resync = False
+        sub = CoordinatorClient(host, port, timeout_s=timeout_s, tls=tls)
+        try:
+            send_msg(sub.sock, {"method": "subscribe",
+                                "from": replica.log_size()})
+            while replica.log_size() < until_ops:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica saw {replica.log_size()}/{until_ops} "
+                        f"ops in {timeout_s}s")
+                msg = recv_msg(sub.sock)
+                if msg is None:
+                    raise ConnectionError("writer closed the op stream")
+                if msg.get("state_sync"):
+                    # GC passed our resume point between the probe and
+                    # the subscribe (the by-design race the marker
+                    # exists for): install the NEWER snapshot and
+                    # re-subscribe from its tail
+                    resync = True
+                    break
+                if "op" not in msg:
+                    raise RuntimeError(f"unexpected stream frame: {msg}")
+                st = replica.apply_op(bytes.fromhex(msg["op"]))
+                if st != LedgerStatus.OK:
+                    raise RuntimeError(
+                        f"replica rejected op {msg['i']}: {st.name}")
+        finally:
+            sub.close()
+        if not resync:
+            break
+        p = CoordinatorClient(host, port, timeout_s=timeout_s, tls=tls)
+        try:
+            replica = _install_from(p)
+        finally:
+            p.close()
+    else:
+        raise RuntimeError("subscribe kept racing snapshot GC")
     if not replica.verify_log():
         raise RuntimeError("replica chain verification failed")
     probe = CoordinatorClient(host, port, tls=tls)
@@ -1523,7 +1846,7 @@ def replicate(host: str, port: int, cfg: ProtocolConfig,
         # when the writer hasn't moved past our view, the chained head must
         # match byte-for-byte (the replicas-agree-by-construction contract);
         # if it has moved on, callers re-run with the larger until_ops
-        if info["log_size"] == applied and \
+        if info["log_size"] == replica.log_size() and \
                 info["log_head"] != replica.log_head().hex():
             raise RuntimeError("replica/writer head digest divergence")
     finally:

@@ -44,18 +44,28 @@ def provision_tls(cert_dir: str, common_name: str = "127.0.0.1",
     cleanly.  Clients enforce the SAN match (client_context keeps
     check_hostname on), so a cert provisioned for one host is useless for
     impersonating another even inside the same CA.
-    """
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
 
+    Without the `cryptography` wheel, generation falls back to the
+    pure-Python Ed25519 x509 path (comm.x509mini — same files, same SAN
+    policy; OpenSSL >= 1.1.1 negotiates TLS 1.3 with Ed25519 certs), so
+    TLS provisioning works everywhere the repo's identity layer does.
+    """
     os.makedirs(cert_dir, exist_ok=True)
     ca_path = os.path.join(cert_dir, CA_PEM)
     crt_path = os.path.join(cert_dir, SERVER_PEM)
     key_path = os.path.join(cert_dir, SERVER_KEY)
     if all(os.path.exists(p) for p in (ca_path, crt_path, key_path)):
         return ca_path, crt_path, key_path
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        from bflc_demo_tpu.comm.x509mini import provision_tls_pure
+        return provision_tls_pure(cert_dir, common_name=common_name,
+                                  days=days,
+                                  include_loopback=include_loopback)
 
     now = datetime.datetime.now(datetime.timezone.utc)
     ca_key = ec.generate_private_key(ec.SECP256R1())

@@ -1123,3 +1123,129 @@ def telemetry_overhead_config1(rounds: int = 3, trials: int = 1,
         "last_trial_on": on_last["fast"],
         "last_trial_off": off_last["fast"],
     }
+
+
+# ---------------------------------------------- certified snapshots (PR 7)
+def rejoin_config1(rounds: int = 300, snapshot_every: int = 50) -> Dict:
+    """Rejoin cost at a few-hundred-round chain: cold replay-from-genesis
+    vs certified-snapshot state-sync, through the real serving surfaces.
+
+    Builds a config-1-geometry ledger with `rounds` committed rounds
+    directly on the ledger surface (no sockets — op application is the
+    replica-side work both paths share), captures the snapshot offer the
+    writer would emit at the last `snapshot_every` boundary, then serves
+    the chain from a real LedgerServer and times a joiner doing
+
+    - **cold replay** (the pre-PR path): `log_range` chunks from genesis,
+      every op re-applied;
+    - **state-sync** (ledger.snapshot): fetch the `snapshot` offer,
+      `verify_snapshot_meta`, `restore_snapshot`, replay only the tail.
+
+    The writer keeps its full log for this measurement (a GC'd writer
+    cannot serve the cold leg at all — that is the point of the feature);
+    both joiners must land on the writer's exact chain head or the
+    result is discarded.
+    """
+    import hashlib as _hl
+
+    import numpy as np
+
+    from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                   LedgerServer)
+    from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
+    from bflc_demo_tpu.ledger.snapshot import (make_snapshot_op,
+                                               restore_snapshot,
+                                               snapshot_base_head,
+                                               verify_snapshot_meta)
+    from bflc_demo_tpu.utils.serialization import pack_pytree
+
+    cfg = DEFAULT_PROTOCOL
+    addrs = [f"0x{i:040x}" for i in range(cfg.client_num)]
+    led = make_ledger(cfg, backend="python")
+    for a in addrs:
+        assert led.register_node(a) == LedgerStatus.OK
+    snap_round = (rounds - 1) // snapshot_every * snapshot_every
+    meta = None
+    model_blob = pack_pytree({"W": np.zeros((5, 2), np.float32)})
+    for r in range(rounds):
+        ep = led.epoch
+        committee = set(led.committee())
+        got = 0
+        for a in addrs:
+            if a in committee:
+                continue
+            h = _hl.sha256(f"{ep}|{a}".encode()).digest()
+            if led.upload_local_update(a, h, 10, 1.0,
+                                       ep) == LedgerStatus.OK:
+                got += 1
+            if got >= cfg.needed_update_count:
+                break
+        row = [0.5 + 0.01 * u for u in range(cfg.needed_update_count)]
+        for a in committee:
+            assert led.upload_scores(a, ep, row) == LedgerStatus.OK
+        model_blob = pack_pytree(
+            {"W": np.full((5, 2), float(ep + 1), np.float32)})
+        mh = _hl.sha256(model_blob).digest()
+        assert led.commit_model(mh, ep) == LedgerStatus.OK
+        if led.epoch == snap_round and meta is None and snap_round:
+            pos, prev = led.log_size(), led.log_head()
+            state = led.encode_state()
+            op = make_snapshot_op(led)
+            assert led.apply_op(op) == LedgerStatus.OK
+            meta = {"i": pos, "epoch": led.epoch,
+                    "gen": led.generation, "op": op, "prev_head": prev,
+                    "cert": None, "state": state, "model": model_blob,
+                    "final": True}
+    assert meta is not None, "rounds too small for snapshot_every"
+    size, head = led.log_size(), led.log_head()
+
+    server = LedgerServer(cfg, model_blob, resume_ledger=led,
+                          resume_snapshot=meta)
+    server.start()
+    client = CoordinatorClient(server.host, server.port)
+    try:
+        def _fetch_apply(dst, start, end, chunk=1024):
+            for lo in range(start, end, chunk):
+                r = client.request("log_range", start=lo,
+                                   end=min(lo + chunk, end))
+                assert r["ok"], r
+                for o in r["ops"]:
+                    st = dst.apply_op(bytes.fromhex(o))
+                    assert st == LedgerStatus.OK, st
+            return dst
+
+        t0 = time.perf_counter()
+        cold = _fetch_apply(make_ledger(cfg, backend="python"), 0, size)
+        cold_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        offer = client.request("snapshot")
+        assert offer.get("ok"), offer
+        # hex under the legacy wire pin, raw bytes on the binary frame —
+        # normalize exactly like every other offer consumer
+        from bflc_demo_tpu.comm.wire import blob_bytes
+        offer["state"] = blob_bytes(offer["state"])
+        offer["model"] = blob_bytes(offer["model"])
+        reason = verify_snapshot_meta(offer)
+        assert not reason, reason
+        synced = restore_snapshot(offer["state"], cfg,
+                                  int(offer["i"]) + 1,
+                                  snapshot_base_head(offer))
+        _fetch_apply(synced, int(offer["i"]) + 1, size)
+        sync_s = time.perf_counter() - t0
+    finally:
+        client.close()
+        server.close()
+
+    heads_equal = (cold.log_head() == head == synced.log_head())
+    return {
+        "rounds": rounds, "snapshot_every": snapshot_every,
+        "snapshot_at_round": int(meta["epoch"]),
+        "log_ops": size, "tail_ops": size - int(meta["i"]) - 1,
+        "snapshot_state_bytes": len(meta["state"]),
+        "snapshot_model_bytes": len(meta["model"]),
+        "cold_replay_s": round(cold_s, 4),
+        "state_sync_s": round(sync_s, 4),
+        "speedup_x": round(cold_s / sync_s, 2) if sync_s else None,
+        "heads_equal": bool(heads_equal),
+    }

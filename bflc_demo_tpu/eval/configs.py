@@ -44,6 +44,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      chaos_seed: Optional[int] = None,
                      chaos_profile: str = "standard",
                      cells: int = 0, cell_size: int = 0,
+                     snapshot_interval: int = 0, snapshot_dir: str = "",
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -67,7 +68,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         inapplicable += [("standbys", standbys), ("quorum", quorum),
                          ("bft_validators", bft_validators),
                          ("chaos_seed", chaos_seed is not None),
-                         ("cells", cells), ("cell_size", cell_size)]
+                         ("cells", cells), ("cell_size", cell_size),
+                         ("snapshot_interval", snapshot_interval),
+                         ("snapshot_dir", snapshot_dir)]
     if runtime not in ("executor", "mesh"):
         # attestation exists on both mesh-family runtimes (default-on
         # where wallets exist); elsewhere an explicit request must error
@@ -114,7 +117,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                                       ("quorum", quorum),
                                       ("tls_dir", tls_dir),
                                       ("chaos_seed",
-                                       chaos_seed is not None)) if v]
+                                       chaos_seed is not None),
+                                      ("snapshot_interval",
+                                       snapshot_interval)) if v]
             if dropped:
                 raise ValueError(f"options {dropped} are not supported "
                                  f"with --cells/--cell-size")
@@ -131,7 +136,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
             factory_kw=factory_kw or {}, standbys=standbys,
             tls_dir=tls_dir, quorum=quorum,
             bft_validators=bft_validators, chaos_seed=chaos_seed,
-            chaos_profile=chaos_profile, verbose=verbose)
+            chaos_profile=chaos_profile,
+            snapshot_interval=snapshot_interval,
+            snapshot_dir=snapshot_dir, verbose=verbose)
     if runtime == "executor":
         if not process_factory:
             raise ValueError("this preset does not support the 'executor' "

@@ -36,9 +36,26 @@ def clone_prefix(src, upto: int, cfg: ProtocolConfig, *,
     rollback-to-prefix primitive (BFT repair: a replica drops a suffix
     that quorum evidence just proved uncertifiable).  Raises RuntimeError
     if the prefix does not replay, which cannot happen on a chain the
-    source ledger itself accepted."""
-    fresh = make_ledger(cfg, backend=backend)
-    for j in range(upto):
+    source ledger itself accepted.
+
+    A compacted source (ledger.snapshot: ops below `log_base` GC'd
+    behind a certified snapshot) clones by re-installing its base state
+    and replaying only the retained tail — `upto` below the base is an
+    error (certified history is never rolled back past a snapshot)."""
+    base = getattr(src, "log_base", 0)
+    if base:
+        if upto < base:
+            raise RuntimeError(
+                f"clone_prefix({upto}) below GC base {base}: the "
+                f"prefix was compacted behind a certified snapshot")
+        from bflc_demo_tpu.ledger.snapshot import restore_snapshot
+        fresh = restore_snapshot(src._base_state, cfg, base,
+                                 src._base_head)
+        start = base
+    else:
+        fresh = make_ledger(cfg, backend=backend)
+        start = 0
+    for j in range(start, upto):
         st = fresh.apply_op(src.log_op(j))
         if st != LedgerStatus.OK:
             raise RuntimeError(

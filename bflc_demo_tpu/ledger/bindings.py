@@ -126,6 +126,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.bflc_detach_wal.argtypes = [p]
     lib.bflc_replay_wal.restype = i64
     lib.bflc_replay_wal.argtypes = [p, ctypes.c_char_p]
+    lib.bflc_encode_state.restype = i64
+    lib.bflc_encode_state.argtypes = [p, u8p, i64]
+    lib.bflc_state_digest.argtypes = [p, u8p]
     lib.bflc_sha256.argtypes = [u8p, i64, u8p]
 
 
@@ -351,6 +354,38 @@ class NativeLedger:
                     f"native->python mirror replay rejected op {i}: "
                     f"{st.name}")
         return mirror.validate_op(op)
+
+    # --- certified snapshots (ledger/snapshot.py) ---
+    @property
+    def log_base(self) -> int:
+        """The native backend never compacts its in-memory log (no
+        state-injection C ABI); a GC'd/restored replica runs the python
+        backend.  It still APPLIES snapshot ops (chain compatibility)."""
+        return 0
+
+    def head_at(self, upto: int) -> bytes:
+        """Chain head after ops[0..upto) recomputed from op bytes (the
+        chain-rule fold comm.ledger_service.chain_head_at runs)."""
+        import hashlib as _hl
+        h = b""
+        for i in range(upto):
+            d = _hl.sha256()
+            if h:
+                d.update(h)
+            d.update(self.log_op(i))
+            h = d.digest()
+        return h
+
+    def encode_state(self) -> bytes:
+        size = self._lib.bflc_encode_state(self._h, None, 0)
+        buf = (ctypes.c_uint8 * int(size))()
+        self._lib.bflc_encode_state(self._h, buf, size)
+        return bytes(buf)
+
+    def state_digest(self) -> bytes:
+        out = (ctypes.c_uint8 * 32)()
+        self._lib.bflc_state_digest(self._h, out)
+        return bytes(out)
 
     # --- write-ahead log ---
     def attach_wal(self, path: str) -> bool:

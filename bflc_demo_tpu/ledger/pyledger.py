@@ -22,6 +22,7 @@ from bflc_demo_tpu.ledger.base import (LedgerStatus, PendingInfo,
 
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES, _OP_COMMIT = 1, 2, 3, 4
 _OP_CLOSE, _OP_FORCE, _OP_RESEAT, _OP_PROMOTE = 5, 6, 7, 8
+_OP_SNAPSHOT = 9
 
 
 def _put_str(b: bytearray, s: str) -> None:
@@ -55,12 +56,24 @@ class PyLedger:
         self._ops: List[bytes] = []
         self._log: List[bytes] = []
         self._wal = None
+        self._wal_path = ""
+        # ledger compaction (ledger.snapshot): ops[0.._base) were
+        # garbage-collected behind a certified snapshot; _base_head is
+        # the chain head digest at that offset (the head AFTER the
+        # snapshot op) and _base_state the canonical state bytes the
+        # prefix reduced to — kept so clone_prefix/rollback and WAL
+        # compaction stay possible without the GC'd ops.
+        self._base = 0
+        self._base_head = b""
+        self._base_state: Optional[bytes] = None
 
     # --- log plumbing (must match ledger.cpp append_log exactly) ---
     def _append_log(self, op: bytes) -> None:
         h = hashlib.sha256()
         if self._log:
             h.update(self._log[-1])
+        elif self._base:
+            h.update(self._base_head)
         h.update(op)
         self._ops.append(op)
         self._log.append(h.digest())
@@ -76,6 +89,14 @@ class PyLedger:
 
     # --- write-ahead log (format matches ledger.cpp / capi.cpp) ---
     _WAL_MAGIC = b"BFLCWAL1"
+    # compacted WAL (ledger.snapshot): the journal of a ledger whose
+    # prefix was GC'd behind a certified snapshot.  Self-contained:
+    # magic + <q> base + 32-byte base head + <q> state length + the
+    # canonical state bytes, then the tail records in WAL1 framing —
+    # replayable into a fresh python-backend ledger without the GC'd
+    # prefix.  The native backend keeps writing/reading WAL1 only
+    # (it never compacts); BFLC_SNAPSHOT_LEGACY pins WAL1 everywhere.
+    _WAL2_MAGIC = b"BFLCWAL2"
 
     def attach_wal(self, path: str) -> bool:
         self.detach_wal()
@@ -83,17 +104,50 @@ class PyLedger:
             f = open(path, "wb")
         except OSError:
             return False
-        f.write(self._WAL_MAGIC)
+        self._write_wal_body(f)
+        self._wal = f
+        self._wal_path = path
+        return True
+
+    def _write_wal_body(self, f) -> None:
+        """THE journal serialization (header + retained records) —
+        attach_wal seeds with it, compact_wal rewrites with it, and
+        `save_wal` is the offline surface (tools/ledger_gc.py)."""
+        self._write_wal_head(f)
         for op in self._ops:
             f.write(struct.pack("<Q", len(op)) + op)
         f.flush()
-        self._wal = f
-        return True
+
+    def save_wal(self, path: str) -> None:
+        """One-shot journal write to `path` tmp-then-rename, without
+        attaching.  Raises OSError on failure with `path` untouched."""
+        import os as _os
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            self._write_wal_body(f)
+            _os.fsync(f.fileno())
+        _os.replace(tmp, path)
+
+    def _write_wal_head(self, f) -> None:
+        if not self._base:
+            f.write(self._WAL_MAGIC)
+            return
+        state = self._base_state
+        if state is None:
+            raise RuntimeError(
+                "compacted ledger without base state bytes — cannot "
+                "journal a self-contained WAL")
+        f.write(self._WAL2_MAGIC)
+        f.write(struct.pack("<q", self._base))
+        f.write(self._base_head)
+        f.write(struct.pack("<q", len(state)))
+        f.write(state)
 
     def detach_wal(self) -> None:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
+            self._wal_path = ""
 
     def replay_wal(self, path: str) -> int:
         try:
@@ -102,9 +156,12 @@ class PyLedger:
         except OSError as e:     # parity with NativeLedger's ValueError
             raise ValueError(
                 f"not a bflc WAL (or unreadable): {path}") from e
-        if not blob.startswith(self._WAL_MAGIC):
+        if blob.startswith(self._WAL2_MAGIC):
+            off = self._replay_wal2_head(blob, path)
+        elif blob.startswith(self._WAL_MAGIC):
+            off = len(self._WAL_MAGIC)
+        else:
             raise ValueError(f"not a bflc WAL (or unreadable): {path}")
-        off = len(self._WAL_MAGIC)
         applied = 0
         while off + 8 <= len(blob):
             (n,) = struct.unpack_from("<Q", blob, off)
@@ -116,6 +173,71 @@ class PyLedger:
                 raise ValueError(f"WAL replay rejected op {applied}: {path}")
             applied += 1
         return applied
+
+    def _replay_wal2_head(self, blob: bytes, path: str) -> int:
+        """Install a compacted WAL's snapshot header into this (fresh)
+        ledger; returns the offset of the first tail record.  A torn
+        header refuses the whole file — the snapshot state is the tail's
+        ground truth, so there is nothing safe to salvage without it."""
+        if self.log_size() or self._epoch != self.genesis_epoch:
+            raise ValueError(
+                f"compacted WAL replays only into a fresh ledger: {path}")
+        off = len(self._WAL2_MAGIC)
+        if off + 8 + 32 + 8 > len(blob):
+            raise ValueError(f"torn compacted-WAL header: {path}")
+        (base,) = struct.unpack_from("<q", blob, off)
+        off += 8
+        base_head = blob[off:off + 32]
+        off += 32
+        (n_state,) = struct.unpack_from("<q", blob, off)
+        off += 8
+        if base < 0 or n_state < 0 or off + n_state > len(blob):
+            raise ValueError(f"torn compacted-WAL header: {path}")
+        state = blob[off:off + n_state]
+        off += n_state
+        try:
+            self._install_state(state, base, base_head)
+        except ValueError as e:
+            raise ValueError(
+                f"corrupt compacted-WAL snapshot state: {path}: "
+                f"{e}") from e
+        return off
+
+    def compact_wal(self) -> bool:
+        """Rewrite the attached WAL as a compacted (WAL2) file holding
+        only the snapshot header + tail records — tmp-then-rename, so a
+        SIGKILL at any point leaves either the full old journal or the
+        complete compacted one, never a torn hybrid.  True on success;
+        False (journal unchanged) when no WAL is attached or the
+        rewrite failed (the old WAL keeps journaling)."""
+        if self._wal is None or not self._wal_path:
+            return False
+        path, tmp = self._wal_path, self._wal_path + ".tmp"
+        import os as _os
+        new = None
+        try:
+            with open(tmp, "wb") as f:
+                self._write_wal_body(f)
+                _os.fsync(f.fileno())
+            # reopen BEFORE the rename: the append handle tracks the
+            # inode, so once replace succeeds later appends land in the
+            # compacted file — whereas a reopen failure AFTER a
+            # successful replace would leave this ledger journaling to
+            # the old unlinked inode, silently dropping every later op
+            # from crash recovery
+            new = open(tmp, "ab")
+            _os.replace(tmp, path)
+        except OSError:
+            if new is not None:
+                new.close()
+            try:
+                _os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        self._wal.close()
+        self._wal = new
+        return True
 
     # --- protocol surface ---
     def register_node(self, addr: str) -> LedgerStatus:
@@ -373,13 +495,15 @@ class PyLedger:
 
     # --- op log ---
     def log_size(self) -> int:
-        return len(self._log)
+        return self._base + len(self._log)
 
     def log_head(self) -> bytes:
-        return self._log[-1] if self._log else b"\0" * 32
+        if self._log:
+            return self._log[-1]
+        return self._base_head if self._base else b"\0" * 32
 
     def verify_log(self) -> bool:
-        prev = b""
+        prev = self._base_head if self._base else b""
         for op, dig in zip(self._ops, self._log):
             h = hashlib.sha256()
             if prev:
@@ -391,7 +515,124 @@ class PyLedger:
         return True
 
     def log_op(self, i: int) -> bytes:
-        return self._ops[i]
+        j = i - self._base
+        if j < 0:
+            raise IndexError(
+                f"op {i} was garbage-collected (log base {self._base})")
+        return self._ops[j]
+
+    # --- ledger compaction (ledger.snapshot) ---
+    @property
+    def log_base(self) -> int:
+        """First chain position this ledger still HOLDS the op bytes
+        for; everything below was GC'd behind a certified snapshot."""
+        return self._base
+
+    def head_at(self, upto: int) -> bytes:
+        """Chain head digest after ops[0..upto) — b"" at upto == 0 (the
+        empty-chain convention of comm.ledger_service.chain_head_at).
+        Raises ValueError below the GC base: those heads are gone with
+        the prefix."""
+        if upto < self._base:
+            raise ValueError(
+                f"chain head at {upto} was garbage-collected "
+                f"(log base {self._base})")
+        if upto == self._base:
+            return self._base_head if self._base else b""
+        return self._log[upto - self._base - 1]
+
+    def encode_state(self) -> bytes:
+        """Canonical bytes of the CURRENT protocol state (the snapshot
+        payload; ledger.snapshot defines the one layout both backends
+        share)."""
+        from bflc_demo_tpu.ledger.snapshot import encode_state_dict
+        pend = None
+        if self._pending is not None:
+            pend = ([float(v) for v in self._pending.medians],
+                    list(self._pending.order),
+                    list(self._pending.selected),
+                    self._pending.global_loss)
+        return encode_state_dict({
+            "epoch": self._epoch, "model_hash": self._model_hash,
+            "last_loss": self._last_loss,
+            "generation": self._generation,
+            "writer_index": self._writer_index, "closed": self._closed,
+            "reg_order": self._reg_order, "roles": self._roles,
+            "updates": [(u.sender, u.payload_hash, u.n_samples,
+                         u.avg_cost) for u in self._updates],
+            "scores": self._scores, "pending": pend})
+
+    def state_digest(self) -> bytes:
+        """SHA-256 of the canonical state — what a snapshot op embeds
+        and every replica re-derives before co-signing."""
+        return hashlib.sha256(self.encode_state()).digest()
+
+    def _install_state(self, state_bytes: bytes, base: int,
+                       base_head: bytes) -> None:
+        """Install decoded canonical state at chain offset `base` (used
+        by snapshot restore and compacted-WAL replay; the caller has
+        already verified the bytes against a certified digest)."""
+        from bflc_demo_tpu.ledger.snapshot import decode_state
+        d = decode_state(state_bytes)
+        self._epoch = int(d["epoch"])
+        self._model_hash = bytes(d["model_hash"])
+        self._last_loss = float(d["last_loss"])
+        self._generation = int(d["generation"])
+        self._writer_index = int(d["writer_index"])
+        self._closed = bool(d["closed"])
+        self._reg_order = list(d["reg_order"])
+        self._roles = dict(d["roles"])
+        self._updates = [UpdateInfo(s, bytes(ph), int(n), float(c))
+                         for s, ph, n, c in d["updates"]]
+        self._update_slot = {u.sender: i
+                             for i, u in enumerate(self._updates)}
+        self._scores = {k: list(v) for k, v in d["scores"].items()}
+        pend = d.get("pending")
+        if pend is None:
+            self._pending = None
+        else:
+            medians, order, selected, loss = pend
+            self._pending = PendingInfo(
+                medians=np.asarray(medians, np.float32),
+                order=list(order), selected=list(selected),
+                global_loss=float(np.float32(loss)))
+        self._ops = []
+        self._log = []
+        self._base = int(base)
+        self._base_head = bytes(base_head)
+        self._base_state = bytes(state_bytes)
+
+    def gc_prefix(self, upto: int,
+                  state_bytes: Optional[bytes] = None) -> int:
+        """Drop ops[_base..upto) — they are garbage behind a certified
+        snapshot at `upto` (the position AFTER the snapshot op).  The
+        caller passes the snapshot's canonical state bytes (the state
+        the prefix reduced to); when omitted and upto == log_size the
+        current state is encoded.  Compacts the attached WAL in the
+        same step (tmp-then-rename).  Returns the number of ops
+        dropped."""
+        if not self._base <= upto <= self.log_size():
+            raise ValueError(
+                f"gc_prefix({upto}) outside [{self._base}, "
+                f"{self.log_size()}]")
+        if state_bytes is None:
+            if upto != self.log_size():
+                raise ValueError(
+                    "gc_prefix mid-chain needs the snapshot's state "
+                    "bytes at that position")
+            state_bytes = self.encode_state()
+        dropped = upto - self._base
+        if dropped == 0:
+            return 0
+        new_head = self.head_at(upto)
+        del self._ops[:dropped]
+        del self._log[:dropped]
+        self._base = upto
+        self._base_head = new_head
+        self._base_state = bytes(state_bytes)
+        if self._wal is not None:
+            self.compact_wal()
+        return dropped
 
     # --- validate-without-apply (the BFT validator hook, comm.bft) ---
     def _snapshot(self):
@@ -484,6 +725,22 @@ class PyLedger:
                 gen, = struct.unpack_from("<q", body, 0)
                 idx, = struct.unpack_from("<q", body, 8)
                 return self.promote_writer(gen, idx)
+            if code == _OP_SNAPSHOT:
+                # certified checkpoint marker (ledger.snapshot): binds
+                # the writer's claimed state digest into the hash chain.
+                # The replica RE-DERIVES the digest from its own state —
+                # a BFT validator's co-signature on this op is therefore
+                # its independent proof of the snapshot's correctness,
+                # and a lying writer's corrupt snapshot can never
+                # certify (the quorum's replicas all refuse here).
+                if len(body) != 40:
+                    return LedgerStatus.BAD_ARG
+                ep, = struct.unpack_from("<q", body, 0)
+                digest = body[8:40]
+                if ep != self._epoch or digest != self.state_digest():
+                    return LedgerStatus.BAD_ARG
+                self._append_log(op)
+                return LedgerStatus.OK
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)

@@ -261,6 +261,21 @@ int64_t bflc_replay_wal(void* h, const char* path) {
   return applied;
 }
 
+// --- certified snapshots (ledger/snapshot.py) ---
+// Canonical state bytes: returns the size; copies into buf when cap is
+// large enough (call with cap=0 to size the buffer first).
+int64_t bflc_encode_state(void* h, uint8_t* buf, int64_t cap) {
+  auto state = static_cast<CommitteeLedger*>(h)->encode_state();
+  if (buf && int64_t(state.size()) <= cap)
+    std::memcpy(buf, state.data(), state.size());
+  return int64_t(state.size());
+}
+
+void bflc_state_digest(void* h, uint8_t* out32) {
+  Digest d = static_cast<CommitteeLedger*>(h)->state_digest();
+  std::memcpy(out32, d.data(), 32);
+}
+
 // stand-alone SHA-256 so Python and C++ agree on payload hashing
 void bflc_sha256(const uint8_t* data, int64_t len, uint8_t* out32) {
   Digest d = bflc::Sha256::hash(data, size_t(len));

@@ -11,7 +11,9 @@ namespace {
 // op codes for the serialized log
 enum OpCode : uint8_t { OP_REGISTER = 1, OP_UPLOAD = 2, OP_SCORES = 3,
                         OP_COMMIT = 4, OP_CLOSE = 5, OP_FORCE = 6,
-                        OP_RESEAT = 7, OP_PROMOTE = 8 };
+                        OP_RESEAT = 7, OP_PROMOTE = 8, OP_SNAPSHOT = 9 };
+
+constexpr char kStateMagic[] = "BFLCSNST1";  // 9 bytes, no terminator use
 
 void put_i64(std::vector<uint8_t>& b, int64_t v) {
   for (int i = 0; i < 8; ++i) b.push_back(uint8_t(uint64_t(v) >> (8 * i)));
@@ -404,6 +406,66 @@ Status CommitteeLedger::commit_model(const Digest& new_model_hash,
   return Status::OK;
 }
 
+std::vector<uint8_t> CommitteeLedger::encode_state() const {
+  // canonical state bytes — must match ledger/snapshot.py
+  // encode_state_dict field for field (differential-tested in
+  // tests/test_snapshot.py).  Score rows iterate std::map order ==
+  // bytewise string order == Python sorted() for ASCII addresses.
+  std::vector<uint8_t> b(kStateMagic, kStateMagic + 9);
+  put_i64(b, epoch_);
+  put_digest(b, global_model_hash_);
+  put_f32(b, last_global_loss_);
+  put_i64(b, generation_);
+  put_i64(b, writer_index_);
+  b.push_back(closed_ ? 1 : 0);
+  put_i64(b, int64_t(registration_order_.size()));
+  for (const auto& addr : registration_order_) {
+    put_str(b, addr);
+    auto it = roles_.find(addr);
+    b.push_back(it != roles_.end() && it->second == Role::COMMITTEE ? 1
+                                                                    : 0);
+  }
+  put_i64(b, int64_t(updates_.size()));
+  for (const auto& u : updates_) {
+    put_str(b, u.sender);
+    put_digest(b, u.payload_hash);
+    put_i64(b, u.n_samples);
+    put_f32(b, u.avg_cost);
+  }
+  put_i64(b, int64_t(scores_.size()));
+  for (const auto& kv : scores_) {
+    put_str(b, kv.first);
+    put_i64(b, int64_t(kv.second.size()));
+    for (float v : kv.second) put_f32(b, v);
+  }
+  if (!pending_) {
+    b.push_back(0);
+  } else {
+    b.push_back(1);
+    put_i64(b, int64_t(pending_->medians.size()));
+    for (float v : pending_->medians) put_f32(b, v);
+    put_i64(b, int64_t(pending_->order.size()));
+    for (int32_t s : pending_->order) {
+      for (int i = 0; i < 4; ++i)
+        b.push_back(uint8_t(uint32_t(s) >> (8 * i)));
+    }
+    put_i64(b, int64_t(pending_->selected.size()));
+    for (int32_t s : pending_->selected) {
+      for (int i = 0; i < 4; ++i)
+        b.push_back(uint8_t(uint32_t(s) >> (8 * i)));
+    }
+    put_f32(b, pending_->global_loss);
+  }
+  return b;
+}
+
+Digest CommitteeLedger::state_digest() const {
+  auto state = encode_state();
+  Sha256 h;
+  h.update(state.data(), state.size());
+  return h.finish();
+}
+
 std::vector<std::string> CommitteeLedger::committee() const {
   std::vector<std::string> out;
   for (const auto& addr : registration_order_) {
@@ -465,6 +527,18 @@ Status CommitteeLedger::apply_serialized(const std::vector<uint8_t>& op) {
       int64_t idx = r.i64();
       if (!r.ok) return Status::BAD_ARG;
       return promote_writer(gen, idx);
+    }
+    case OP_SNAPSHOT: {
+      // certified checkpoint marker: the digest is RE-DERIVED from this
+      // replica's own state — a corrupt or lying snapshot refuses here,
+      // which is exactly what makes a quorum co-signature on this op an
+      // independent proof of the checkpoint (ledger/snapshot.py)
+      int64_t ep = r.i64();
+      Digest claimed = r.digest();
+      if (!r.ok || r.p != r.end) return Status::BAD_ARG;
+      if (ep != epoch_ || claimed != state_digest()) return Status::BAD_ARG;
+      append_log(op);
+      return Status::OK;
     }
     case OP_RESEAT: {
       int64_t ep = r.i64();

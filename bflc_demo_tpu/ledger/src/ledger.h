@@ -148,6 +148,16 @@ class CommitteeLedger {
   const LedgerConfig& config() const { return cfg_; }
   std::vector<std::string> committee() const;
 
+  // --- certified snapshots (ledger/snapshot.py defines the layout) ---
+  // Canonical bytes of the CURRENT protocol state — byte-identical to
+  // PyLedger.encode_state (differential-tested), so replicas on either
+  // backend derive the same state digest from the same history.  The
+  // snapshot op (opcode 9) embeds sha256(encode_state()); applying it
+  // re-derives the digest locally, which is what makes a BFT quorum's
+  // co-signature an independent proof of the snapshot's correctness.
+  std::vector<uint8_t> encode_state() const;
+  Digest state_digest() const;
+
   // --- hash-chained op log ---
   size_t log_size() const { return log_.size(); }
   Digest log_head() const;

@@ -36,18 +36,42 @@ from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 _OP_NAMES = {1: "register", 2: "upload", 3: "scores", 4: "commit",
              5: "close_round", 6: "force_aggregate", 7: "reseat_committee",
-             8: "promote_writer"}
+             8: "promote_writer", 9: "snapshot"}
+
+
+def wal_base(path: str) -> int:
+    """Chain offset of a WAL's first record: 0 for a full (WAL1) journal,
+    the GC base for a compacted (WAL2) one."""
+    with open(path, "rb") as f:
+        head = f.read(len(PyLedger._WAL2_MAGIC) + 8)
+    if not head.startswith(PyLedger._WAL2_MAGIC):
+        return 0
+    if len(head) < len(PyLedger._WAL2_MAGIC) + 8:
+        raise ValueError(f"truncated WAL2 header: {path}")
+    (base,) = struct.unpack_from("<q", head, len(PyLedger._WAL2_MAGIC))
+    return base
 
 
 def iter_wal_ops(path: str) -> Iterator[Tuple[int, bytes]]:
     """Yield (index, op_bytes) from a WAL; stops at the first torn/corrupt
-    record (the recovery semantics of `replay_wal`, ledger.cpp)."""
+    record (the recovery semantics of `replay_wal`, ledger.cpp).  A
+    compacted WAL's records start at its snapshot base offset."""
     with open(path, "rb") as f:
         blob = f.read()
-    magic = PyLedger._WAL_MAGIC
-    if not blob.startswith(magic):
+    if blob.startswith(PyLedger._WAL2_MAGIC):
+        # compacted journal: skip magic + base + head + state
+        off = len(PyLedger._WAL2_MAGIC)
+        if off + 48 > len(blob):
+            return
+        (i,) = struct.unpack_from("<q", blob, off)
+        (n_state,) = struct.unpack_from("<q", blob, off + 40)
+        off += 48 + max(n_state, 0)
+        if n_state < 0 or off > len(blob):
+            return
+    elif blob.startswith(PyLedger._WAL_MAGIC):
+        off, i = len(PyLedger._WAL_MAGIC), 0
+    else:
         raise ValueError(f"not a bflc WAL: {path}")
-    off, i = len(magic), 0
     while off + 8 <= len(blob):
         (n,) = struct.unpack_from("<Q", blob, off)
         if n > (1 << 26) or off + 8 + n > len(blob):
@@ -102,6 +126,9 @@ def decode_op(op: bytes) -> dict:
         elif code == 8:
             out["generation"], = struct.unpack_from("<q", body, 0)
             out["writer_index"], = struct.unpack_from("<q", body, 8)
+        elif code == 9:
+            out["epoch"], = struct.unpack_from("<q", body, 0)
+            out["state_digest"] = body[8:40].hex()
     except (struct.error, ValueError, UnicodeDecodeError) as e:
         out["malformed"] = f"{type(e).__name__}: {e}"
     return out

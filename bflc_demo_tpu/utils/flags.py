@@ -52,6 +52,13 @@ class RunOptions:
     chaos_seed: int = -1             # processes runtime: >= 0 runs the
     #                                  seeded fault campaign (chaos/)
     chaos_profile: str = "standard"  # chaos schedule intensity profile
+    # processes runtime: certified snapshots + ledger compaction
+    # (ledger.snapshot) — every K rounds the writer appends a
+    # quorum-certified snapshot op and GCs the log/WAL prefix behind it;
+    # rejoining replicas state-sync instead of replaying from genesis.
+    # 0 (default, or BFLC_SNAPSHOT_LEGACY=1) pins replay-from-genesis.
+    snapshot_interval: int = 0
+    snapshot_dir: str = ""           # persist artifacts here (per role)
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 

@@ -13,15 +13,10 @@ import struct
 import numpy as np
 import pytest
 
-# cert provisioning is x509, which has no pure-Python fallback (unlike the
-# Ed25519/X25519 identity layer, comm.pure25519) — skip rather than fail on
-# hosts without the cryptography wheel (skip condition documented in
-# README "Quick start" test note)
-pytest.importorskip(
-    "cryptography",
-    reason="the 'cryptography' wheel is not installed — x509 cert "
-           "provisioning (comm/tls.py) has no pure-Python fallback; "
-           "pip install cryptography to run the TLS suite")
+# cert provisioning no longer needs the `cryptography` wheel: without it,
+# provision_tls falls back to the pure-Python Ed25519 x509 path
+# (comm.x509mini) — this suite runs everywhere the identity layer does
+# (the former ROADMAP skip is closed)
 
 from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                LedgerServer, replicate)

@@ -134,6 +134,17 @@ def _role_row(role, snap):
         cells.append(f"applied {int(applied):>5}  ack-lag {int(lag)}  "
                      f"mirror {n_m}x{m_m * 1e3:.1f}ms  "
                      f"promotions {promos:.0f}")
+        # certified-snapshot state-sync (PR 7): rejoins that installed a
+        # checkpoint instead of replaying, and the mirrored ops GC'd
+        # behind streamed snapshot ops
+        n_ss, m_ss = _merged_hist(snap, "state_sync_seconds")
+        refused = _sum_counter(snap, "state_syncs_total",
+                               outcome="refused")
+        gc = _sum_counter(snap, "standby_gc_ops_total")
+        if n_ss or refused or gc:
+            cells.append(f"state-sync {n_ss}x{m_ss * 1e3:.0f}ms"
+                         + (f"  refused {refused:.0f}" if refused else "")
+                         + (f"  gc {gc:.0f}ops" if gc else ""))
     else:                               # writer / executor
         rnd = _gauge_value(snap, "round", 0)
         backlog = _gauge_value(snap, "uncertified_backlog", 0)
@@ -142,6 +153,16 @@ def _role_row(role, snap):
         cells.append(f"round {int(rnd):>3}  backlog {int(backlog):>3}  "
                      f"certify {n_c}x{m_c * 1e3:6.1f}ms  "
                      f"batch-mean {m_bt:4.1f}")
+        # certified snapshots + compaction (PR 7): checkpoint freshness
+        # and the bounded-log evidence (GC'd prefix depth + reclaimed ops)
+        age = _gauge_value(snap, "snapshot_age_rounds")
+        if age is not None and age >= 0:
+            sbytes = _gauge_value(snap, "snapshot_bytes", 0)
+            base = _gauge_value(snap, "log_base", 0)
+            gc = _sum_counter(snap, "ledger_gc_ops_total")
+            cells.append(f"snap age {int(age)}r/"
+                         f"{sbytes / 1e6:.2f}MB  base {int(base)}  "
+                         f"gc {gc:.0f}ops")
     wire_in = costs.get("wire.bytes_in", 0)
     wire_out = costs.get("wire.bytes_out", 0)
     if wire_in or wire_out:
@@ -188,6 +209,10 @@ def _scrape_digest(rec) -> str:
         n_c, m_c = _merged_hist(w, "certify_latency_seconds")
         if n_c:
             bits.append(f"certify~{m_c * 1e3:.0f}ms x{n_c}")
+        age = _gauge_value(w, "snapshot_age_rounds")
+        if age is not None and age >= 0:
+            bits.append(f"snap-age={int(age)} "
+                        f"base={int(_gauge_value(w, 'log_base', 0))}")
     for role in sorted(roles):
         if role.startswith("cell"):
             adm = _gauge_value(roles[role], "cell_admitted", 0)
@@ -203,6 +228,9 @@ def _scrape_digest(rec) -> str:
             if lag or promos:
                 bits.append(f"{role}: lag={int(lag)} "
                             f"promos={promos:.0f}")
+            n_ss, _ = _merged_hist(roles[role], "state_sync_seconds")
+            if n_ss:
+                bits.append(f"{role}: state-syncs={n_ss}")
         if role.startswith("validator"):
             rep = _sum_counter(roles[role], "repair_events_total")
             if rep:

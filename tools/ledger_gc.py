@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Operator tool for ledger compaction artifacts (ledger.snapshot).
+
+A live fleet GCs itself (the writer compacts its log/WAL behind every
+certified snapshot it emits); this tool covers the OFFLINE half an
+operator actually meets: a WAL from a dead or stopped writer, a snapshot
+directory of retained artifacts, and the question "how big is this, is
+it intact, and can I shrink it without losing certified history?".
+
+    # what is in this journal / directory?
+    python tools/ledger_gc.py inspect --wal coordinator.wal \
+        --snapshot-dir snaps/writer
+
+    # compact the WAL behind the newest intact snapshot artifact and
+    # prune old artifacts (tmp-then-rename; SIGKILL-safe at every step)
+    python tools/ledger_gc.py gc --wal coordinator.wal \
+        --snapshot-dir snaps/writer --keep 2
+
+    # preview without touching anything
+    python tools/ledger_gc.py gc --wal coordinator.wal \
+        --snapshot-dir snaps/writer --dry-run
+
+Safety rules the `gc` verb enforces (refusing beats shrinking):
+- the snapshot artifact must pass its own integrity checks
+  (`read_snapshot_file`: torn/bit-flipped files are skipped, older
+  intact artifacts are tried next);
+- the artifact's snapshot op must be byte-identical to the op the WAL
+  itself holds at that chain position — an artifact from some OTHER
+  deployment (or a forged one) can never rewrite a journal;
+- the replayed ledger must accept the whole retained tail (a WAL whose
+  tail is torn compacts only up to the tear, same recovery semantics as
+  `replay_wal`).
+
+The compacted journal is the standard WAL2 format
+(`pyledger._write_wal_head`): any python-backend ledger replays it
+directly; `iter_wal_ops`/`wal_base` (ledger.tool) read it.
+"""
+
+import argparse
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _wal_stats(path):
+    from bflc_demo_tpu.ledger.tool import decode_op, iter_wal_ops, wal_base
+    ops = list(iter_wal_ops(path))
+    kinds = {}
+    for _, op in ops:
+        k = decode_op(op).get("op", "?")
+        kinds[k] = kinds.get(k, 0) + 1
+    return {"path": path, "bytes": os.path.getsize(path),
+            "base": wal_base(path), "records": len(ops),
+            "first_index": ops[0][0] if ops else None,
+            "last_index": ops[-1][0] if ops else None,
+            "ops_by_kind": kinds}
+
+
+def _snapshot_stats(dirpath):
+    from bflc_demo_tpu.ledger.snapshot import (list_snapshot_files,
+                                               read_snapshot_file)
+    out = []
+    for p in list_snapshot_files(dirpath):
+        row = {"path": p, "bytes": os.path.getsize(p)}
+        try:
+            meta = read_snapshot_file(p)
+            row.update(i=meta["i"], epoch=meta["epoch"],
+                       gen=meta["gen"], intact=True,
+                       certified=meta.get("cert") is not None)
+        except ValueError as e:
+            row.update(intact=False, error=str(e))
+        out.append(row)
+    return out
+
+
+def cmd_inspect(args) -> int:
+    report = {}
+    if args.wal:
+        try:
+            report["wal"] = _wal_stats(args.wal)
+        except (ValueError, OSError) as e:
+            # a torn journal is a report, not a crash — that is the
+            # operator's whole question
+            report["wal"] = {"path": args.wal,
+                             "error": f"{type(e).__name__}: {e}"}
+    if args.snapshot_dir:
+        report["snapshots"] = _snapshot_stats(args.snapshot_dir)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def _replay(path, cfg):
+    """Fresh python-backend ledger from a WAL (WAL1 or compacted WAL2);
+    returns (ledger, records_applied)."""
+    from bflc_demo_tpu.ledger.pyledger import PyLedger
+    led = PyLedger(cfg.client_num, cfg.comm_count, cfg.aggregate_count,
+                   cfg.needed_update_count, cfg.genesis_epoch)
+    applied = led.replay_wal(path)
+    return led, applied
+
+
+def cmd_gc(args) -> int:
+    from bflc_demo_tpu.ledger.snapshot import (list_snapshot_files,
+                                               prune_snapshots,
+                                               read_snapshot_file)
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+    cfg_kw = json.loads(args.cfg) if args.cfg else {}
+    cfg = ProtocolConfig(**cfg_kw) if cfg_kw else ProtocolConfig()
+    try:
+        led, applied = _replay(args.wal, cfg)
+    except (RuntimeError, ValueError, OSError) as e:
+        print(json.dumps({
+            "wal": args.wal, "result": "error",
+            "error": f"{type(e).__name__}: {e}",
+            "hint": "journal would not replay — wrong --cfg geometry "
+                    "for this deployment, or a corrupt file; nothing "
+                    "was modified"}, indent=2))
+        return 1
+    before = os.path.getsize(args.wal)
+    report = {"wal": args.wal, "bytes_before": before,
+              "records_replayed": applied, "base_before": led.log_base,
+              "log_size": led.log_size()}
+
+    # newest artifact that (a) is intact, (b) sits inside the journal's
+    # retained range, and (c) holds the SAME op bytes the journal holds
+    # at that position — the binding that stops a foreign artifact from
+    # rewriting this journal
+    chosen = None
+    for p in reversed(list_snapshot_files(args.snapshot_dir)):
+        try:
+            meta = read_snapshot_file(p)
+        except ValueError as e:
+            report.setdefault("skipped", []).append(
+                {"path": p, "reason": str(e)})
+            continue
+        i = int(meta["i"])
+        if not led.log_base <= i < led.log_size():
+            report.setdefault("skipped", []).append(
+                {"path": p,
+                 "reason": f"position {i} outside the journal's retained "
+                           f"range [{led.log_base}, {led.log_size()})"})
+            continue
+        op = meta["op"]
+        op_b = bytes.fromhex(op) if isinstance(op, str) else bytes(op)
+        if led.log_op(i) != op_b:
+            report.setdefault("skipped", []).append(
+                {"path": p,
+                 "reason": f"artifact op at {i} does not match the "
+                           f"journal's op (foreign or forged artifact)"})
+            continue
+        chosen = (p, meta)
+        break
+    if chosen is None:
+        report["result"] = "nothing to do: no usable snapshot artifact"
+        print(json.dumps(report, indent=2))
+        return 1
+    path, meta = chosen
+    i = int(meta["i"])
+    report["snapshot"] = {"path": path, "i": i, "epoch": meta["epoch"]}
+    dropped = i + 1 - led.log_base
+    report["records_dropped"] = dropped
+    if args.dry_run:
+        report["result"] = f"dry-run: would drop {dropped} records " \
+                           f"behind snapshot@{i}"
+        print(json.dumps(report, indent=2))
+        return 0
+    led.gc_prefix(i + 1, bytes(meta["state"]))
+    led.save_wal(args.wal)              # tmp-then-rename, SIGKILL-safe
+    pruned = prune_snapshots(args.snapshot_dir, args.keep)
+    report.update(bytes_after=os.path.getsize(args.wal),
+                  base_after=led.log_base, artifacts_pruned=pruned,
+                  result="ok")
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="verb", required=True)
+    pi = sub.add_parser("inspect", help="report WAL/snapshot-dir state")
+    pi.add_argument("--wal", default="")
+    pi.add_argument("--snapshot-dir", default="")
+    pg = sub.add_parser("gc", help="compact a WAL behind the newest "
+                                   "matching snapshot artifact")
+    pg.add_argument("--wal", required=True)
+    pg.add_argument("--snapshot-dir", required=True)
+    pg.add_argument("--keep", type=int, default=2,
+                    help="snapshot artifacts to retain (default 2)")
+    pg.add_argument("--dry-run", action="store_true")
+    pg.add_argument("--cfg", default="",
+                    help="ProtocolConfig overrides as JSON (the journal "
+                         "replays under this geometry; default preset)")
+    args = p.parse_args(argv)
+    return cmd_inspect(args) if args.verb == "inspect" else cmd_gc(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,6 +199,9 @@ def main() -> None:
                          "cell-aggregate ops to a root quorum")
     ap.add_argument("--legacy", action="store_true",
                     help="profile the pre-PR control plane")
+    ap.add_argument("--snapshot-interval", type=int, default=0,
+                    help="emit a certified snapshot every N rounds and "
+                         "print the compaction row (0 = off)")
     args = ap.parse_args()
     if args.legacy and not os.environ.get("BFLC_CONTROL_PLANE_LEGACY"):
         _reexec_legacy()
@@ -238,9 +241,15 @@ def main() -> None:
              for i, w in enumerate(vwallets)]
     for v in nodes:
         v.start()
+    snap_dir = ""
+    if args.snapshot_interval:
+        import tempfile
+        snap_dir = tempfile.mkdtemp(prefix="bflc-profile-snap-")
     server = LedgerServer(cfg, blob0,
                           bft_validators=[(v.host, v.port) for v in nodes],
-                          bft_keys=vkeys)
+                          bft_keys=vkeys,
+                          snapshot_interval=args.snapshot_interval,
+                          snapshot_dir=snap_dir)
     server.start()
     client = CoordinatorClient(server.host, server.port)
 
@@ -277,6 +286,16 @@ def main() -> None:
     info = client.request("info")
     assert info["epoch"] == 1, info
     wall = time.perf_counter() - t_round
+    if args.snapshot_interval:
+        # snapshot finalization (certify -> artifact -> prefix GC) rides
+        # the monitor loop — wait for the GC'd base so the scrape below
+        # carries the compaction row
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            inf = client.request("info")
+            if inf.get("snapshot_i") is not None and inf["log_base"]:
+                break
+            time.sleep(0.05)
 
     # the numbers ride the fleet path: one FleetCollector scrape of the
     # telemetry RPC (writer + every validator answer the same surface
@@ -349,6 +368,20 @@ def main() -> None:
     if hits or misses:
         line += f"   cache {hits:.0f}h/{misses:.0f}m"
     print(line)
+
+    # certified snapshots + compaction (PR 7): checkpoint freshness,
+    # artifact weight, and the bounded-log evidence off the same scrape
+    from fleet_top import _gauge_value as _gv
+
+    age = _gv(writer_snap, "snapshot_age_rounds")
+    if age is not None and age >= 0:
+        print(f"snapshots: age {int(age)}r   "
+              f"{_gv(writer_snap, 'snapshot_bytes', 0) / 1e6:.2f} MB   "
+              f"log base {int(_gv(writer_snap, 'log_base', 0))}   "
+              f"gc {_csum(writer_snap, 'ledger_gc_ops_total'):.0f} ops")
+    if snap_dir:
+        import shutil
+        shutil.rmtree(snap_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":
