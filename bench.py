@@ -184,6 +184,23 @@ def _child() -> None:
         # answering / roles expected across the federation run's
         # per-round scrapes (the federation leg runs telemetry-armed)
         extra["telemetry"] = extra["federation"]["fast"].get("telemetry")
+        # causal-tracing overhead (obs.trace): every-op-traced vs
+        # untraced round time at config-1 — the 5% bar tracked per
+        # round, plus the reassembly evidence (traces spanning >= 4
+        # roles, critical-path attribution fraction)
+        from bflc_demo_tpu.eval.benchmarks import trace_overhead_config1
+        # trials=2: the leg-order alternation only de-biases the
+        # session-warmup artifact with an even number of trials
+        # (TPU_RESULTS.md round 13)
+        to = trace_overhead_config1(rounds=2, trials=2)
+        extra["trace_overhead"] = {
+            "overhead_frac": to.get("overhead_frac"),
+            "round_wall_time_s_trace_on": to[
+                "round_wall_time_s_trace_on"],
+            "round_wall_time_s_trace_off": to[
+                "round_wall_time_s_trace_off"],
+            "trace": to.get("trace"),
+        }
         # data-plane axes (PR 5): coordinator egress bytes/round,
         # read-source shares, cache hit ratio, compression ratio and
         # the quantized-delta accuracy gap, vs a

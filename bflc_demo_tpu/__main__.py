@@ -87,6 +87,21 @@ def main(argv=None) -> int:
                 return 2
             kw["snapshot_interval"] = opts.snapshot_interval
             kw["snapshot_dir"] = opts.snapshot_dir
+        if opts.telemetry_dir:
+            kw["telemetry_dir"] = opts.telemetry_dir
+        if opts.trace_sample:
+            # causal op tracing (obs.trace): spans land beside the
+            # telemetry artifacts, so the sampling flag needs the dir
+            if not 0.0 < opts.trace_sample <= 1.0:
+                print(f"--trace-sample must be in (0, 1], got "
+                      f"{opts.trace_sample}", file=sys.stderr)
+                return 2
+            if not opts.telemetry_dir:
+                print("--trace-sample needs --telemetry-dir (spans are "
+                      "telemetry artifacts; see tools/trace_report.py)",
+                      file=sys.stderr)
+                return 2
+            kw["trace_sample"] = opts.trace_sample
         if opts.cells or opts.cell_size:
             # hierarchical cell federation (bflc_demo_tpu.hier): cohort
             # clients into cells; one certified cell-aggregate op per
@@ -112,10 +127,12 @@ def main(argv=None) -> int:
             kw["attest_scores"] = opts.attest_scores
         if opts.standbys or opts.quorum or opts.bft_validators \
                 or opts.chaos_seed >= 0 or opts.snapshot_interval \
-                or opts.snapshot_dir:
+                or opts.snapshot_dir or opts.telemetry_dir \
+                or opts.trace_sample:
             print("--standbys/--quorum/--bft-validators/--chaos-seed/"
-                  "--snapshot-interval/--snapshot-dir apply to "
-                  "--runtime processes", file=sys.stderr)
+                  "--snapshot-interval/--snapshot-dir/--telemetry-dir/"
+                  "--trace-sample apply to --runtime processes",
+                  file=sys.stderr)
             return 2
     elif opts.runtime == "mesh" and opts.attest_scores is not None \
             and not (opts.standbys or opts.tls_dir or opts.quorum
@@ -132,11 +149,13 @@ def main(argv=None) -> int:
     elif opts.standbys or opts.tls_dir or opts.quorum \
             or opts.attest_scores is not None or opts.bft_validators \
             or opts.chaos_seed >= 0 or opts.cells or opts.cell_size \
-            or opts.snapshot_interval or opts.snapshot_dir:
+            or opts.snapshot_interval or opts.snapshot_dir \
+            or opts.telemetry_dir or opts.trace_sample:
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
               "--chaos-seed/--cells/--cell-size/--snapshot-interval/"
-              "--snapshot-dir apply to the processes runtime; "
-              "--attest-scores to mesh/executor", file=sys.stderr)
+              "--snapshot-dir/--telemetry-dir/--trace-sample apply to "
+              "the processes runtime; --attest-scores to mesh/executor",
+              file=sys.stderr)
         return 2
     if opts.secure:
         if opts.config != "config4":

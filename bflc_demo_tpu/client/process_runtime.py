@@ -39,6 +39,7 @@ import numpy as np
 
 from bflc_demo_tpu.comm.wire import blob_bytes
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 # client-side phase telemetry (obs.metrics; no-op unless the child
@@ -97,10 +98,14 @@ def _install_chaos(chaos_spec) -> None:
 def _install_telemetry(spec: Optional[dict]) -> None:
     """Arm this child's telemetry plane (no-op without a spec): metrics
     registry + tracer under the role name, flight recorder + snapshot
-    publisher into the run's telemetry dir (bflc_demo_tpu.obs)."""
+    publisher into the run's telemetry dir (bflc_demo_tpu.obs), and —
+    when the spec carries a `trace_sample` — the causal span recorder
+    (obs.trace) flushing <role>.spans.jsonl into the same dir."""
     if spec:
         from bflc_demo_tpu import obs
-        obs.install_process_telemetry(spec["role"], spec["dir"])
+        obs.install_process_telemetry(
+            spec["role"], spec["dir"],
+            trace_sample=float(spec.get("trace_sample", 0.0)))
 
 
 def _client_tls(tls_dir: str):
@@ -272,12 +277,20 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             continue
         acted = False
         if st["role"] == "trainer" and epoch > trained_epoch:
-            with _M_PHASE.time(phase="fetch"):
+          # causal trace ROOT (obs.trace): the head-sampling decision
+          # for this upload op happens here; the context then follows
+          # the op across writer admission, vote batches, the standby
+          # mirror and the commit (null span when off/unsampled)
+          with obs_trace.TRACE.start_trace("client.upload_op",
+                                           epoch=epoch):
+            with obs_trace.TRACE.span("fetch"), \
+                    _M_PHASE.time(phase="fetch"):
                 mr = router.fetch_model()
             if not mr.get("ok") or mr["epoch"] != epoch:
                 continue        # round turned over mid-step; resync
             params = restore_pytree(template, unpack_pytree(mr["blob"]))
-            with _M_PHASE.time(phase="train"):
+            with obs_trace.TRACE.span("train"), \
+                    _M_PHASE.time(phase="train"):
                 delta, cost = local_train(
                     model.apply, params, xj, yj, lr=cfg.learning_rate,
                     batch_size=cfg.batch_size,
@@ -291,7 +304,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             router.cache.put(digest.hex(), blob)
             n = int(x.shape[0])
             payload = digest + struct.pack("<qd", n, float(cost))
-            with _M_PHASE.time(phase="upload"):
+            with obs_trace.TRACE.span("upload"), \
+                    _M_PHASE.time(phase="upload"):
                 r = client.request(
                     "upload", addr=wallet.address, blob=blob,
                     hash=digest.hex(), n=n, cost=float(cost), epoch=epoch,
@@ -325,35 +339,45 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             t_score = (time.perf_counter()
                        if obs_metrics.REGISTRY.enabled else 0.0)
             if ups:
+              # causal trace ROOT for the committee action (obs.trace):
+              # the scores op — and the aggregate/commit it may trigger
+              # writer-side — inherits this context
+              with obs_trace.TRACE.start_trace("client.score_op",
+                                               epoch=epoch):
                 import jax
                 # cache -> replica read set -> coordinator, every part
                 # hash-verified; a batched reply that omits/garbles a
                 # hash falls back per-hash and COUNTS the fallback
                 # (dataplane_blob_fallback_total — the silent-partial-
                 # batch fix)
-                fetched = router.fetch_blobs([u["hash"] for u in ups])
-                deltas = [restore_pytree(
-                              template,
-                              dequantize_entries(
-                                  unpack_pytree(fetched[u["hash"]])))
-                          for u in ups]
-                mr = router.fetch_model()
+                with obs_trace.TRACE.span("fetch"):
+                    fetched = router.fetch_blobs(
+                        [u["hash"] for u in ups])
+                    deltas = [restore_pytree(
+                                  template,
+                                  dequantize_entries(
+                                      unpack_pytree(fetched[u["hash"]])))
+                              for u in ups]
+                    mr = router.fetch_model()
                 if not mr.get("ok"):
                     continue
                 params = restore_pytree(template,
                                         unpack_pytree(mr["blob"]))
-                stacked = jax.tree_util.tree_map(
-                    lambda *t: jnp.stack(t), *deltas)
-                scores = score_candidates(model.apply, params, stacked,
-                                          cfg.learning_rate, xj, yj)
+                with obs_trace.TRACE.span("score"):
+                    stacked = jax.tree_util.tree_map(
+                        lambda *t: jnp.stack(t), *deltas)
+                    scores = score_candidates(model.apply, params,
+                                              stacked,
+                                              cfg.learning_rate, xj, yj)
                 score_list = [float(s) for s in
                               np.nan_to_num(np.asarray(scores), nan=0.0,
                                             posinf=1.0, neginf=0.0)]
                 payload = struct.pack(f"<{len(score_list)}d", *score_list)
-                r = client.request(
-                    "scores", addr=wallet.address, epoch=epoch,
-                    scores=score_list,
-                    tag=_sign(wallet, "scores", epoch, payload))
+                with obs_trace.TRACE.span("submit"):
+                    r = client.request(
+                        "scores", addr=wallet.address, epoch=epoch,
+                        scores=score_list,
+                        tag=_sign(wallet, "scores", epoch, payload))
                 if r.get("status") in ("OK", "WRONG_EPOCH", "DUPLICATE"):
                     scored_epoch = epoch
                     acted = r["ok"]
@@ -491,6 +515,7 @@ def run_federated_processes(
         chaos_schedule=None,
         chaos_dir: str = "",
         telemetry_dir: str = "",
+        trace_sample: float = 0.0,
         snapshot_interval: int = 0,
         snapshot_dir: str = "",
         verbose: bool = False) -> ProcessFederationResult:
@@ -541,6 +566,14 @@ def run_federated_processes(
     events interleaved on the same timeline — plus a Prometheus text
     dump at the end; the report rides result.telemetry_report and each
     role's flight-recorder dump survives its process's death.
+    trace_sample: head-sampling rate for causal op tracing (obs.trace;
+    requires telemetry_dir — the spans land beside the other telemetry
+    artifacts as <role>.spans.jsonl).  Each client decides ONCE per
+    round action whether its op is traced; the context then follows the
+    op across writer admission, BFT vote batches, the standby mirror
+    and the read fan-out, and tools/trace_report.py reassembles the
+    per-round critical path offline.  0 (default, or
+    BFLC_TRACE_LEGACY=1) records and sends nothing.
     snapshot_interval: emit a certified snapshot op every K rounds
     (ledger.snapshot): the writer's log/WAL prefix behind each certified
     checkpoint is garbage-collected (bounded on-disk growth), standbys
@@ -554,6 +587,9 @@ def run_federated_processes(
     cfg.validate()
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    if trace_sample and not telemetry_dir:
+        raise ValueError("trace_sample > 0 needs telemetry_dir (the "
+                         "spans land beside the telemetry artifacts)")
     if kill_writer_at_epoch is not None and standbys < 1:
         raise ValueError("kill_writer_at_epoch requires standbys >= 1")
     if quorum and standbys < quorum + 1:
@@ -639,7 +675,8 @@ def run_federated_processes(
                 if campaign is not None else None)
 
     def _tspec(role: str):
-        return ({"role": role, "dir": telemetry_dir}
+        return ({"role": role, "dir": telemetry_dir,
+                 "trace_sample": trace_sample}
                 if telemetry_dir else None)
 
     if telemetry_dir:
@@ -867,6 +904,13 @@ def run_federated_processes(
             telemetry_report = {"dir": telemetry_dir,
                                 "jsonl": collector.jsonl_path,
                                 "prometheus": prom_path,
+                                # span artifacts gathered into the same
+                                # dir (obs.trace; empty when untraced) —
+                                # tools/trace_report.py's input
+                                "spans": sorted(
+                                    os.path.join(telemetry_dir, n)
+                                    for n in os.listdir(telemetry_dir)
+                                    if n.endswith(".spans.jsonl")),
                                 **collector.coverage_report()}
         final_ep = sponsor.current_endpoint
         replica_report = None

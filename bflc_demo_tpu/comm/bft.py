@@ -83,6 +83,7 @@ from bflc_demo_tpu.comm.identity import (PublicDirectory, _op_bytes,
                                          address_of, verify_signature,
                                          verify_signatures_batch)
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.comm.wire import WireError, recv_msg, send_msg
 from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
@@ -783,17 +784,19 @@ class ValidatorNode:
             return self._refuse("BAD_REQUEST")
         op_hash = hashlib.sha256(op).digest()
         tr = tracing.PROC
-        if tr.enabled or obs_metrics.REGISTRY.enabled:
-            t0 = time.perf_counter()
-            try:
-                return self._validate_inner(i, op, op_hash, attempt, msg)
-            finally:
-                dt = time.perf_counter() - t0
-                if tr.enabled:
-                    tr.charge("bft.validate_s", dt)
-                    tr.charge("bft.validate_n")
-                _M_VOTE.observe(dt, kind="single")
-        return self._validate_inner(i, op, op_hash, attempt, msg)
+        with obs_trace.server_span(msg, "vote", links_key="tps", i=i):
+            if tr.enabled or obs_metrics.REGISTRY.enabled:
+                t0 = time.perf_counter()
+                try:
+                    return self._validate_inner(i, op, op_hash, attempt,
+                                                msg)
+                finally:
+                    dt = time.perf_counter() - t0
+                    if tr.enabled:
+                        tr.charge("bft.validate_s", dt)
+                        tr.charge("bft.validate_n")
+                    _M_VOTE.observe(dt, kind="single")
+            return self._validate_inner(i, op, op_hash, attempt, msg)
 
     def _validate_inner(self, i: int, op: bytes, op_hash: bytes,
                         attempt: int, msg: dict) -> dict:
@@ -929,7 +932,10 @@ class ValidatorNode:
         stopped = None
         t0 = time.perf_counter() if (
             tracing.PROC.enabled or obs_metrics.REGISTRY.enabled) else 0.0
-        with self._lock:
+        # causal span linked to EVERY op in the batch (obs.trace): one
+        # vote round-trip serves several clients' traces at once
+        with obs_trace.server_span(msg, "vote_batch", links_key="tps",
+                                   i=start, n_ops=len(ops)), self._lock:
             for k, op in enumerate(ops):
                 r = self._vote_locked(start + k, op, auths[k], attempt)
                 if not r.get("ok"):
@@ -1067,14 +1073,19 @@ class CertificateAssembler:
 
     def _vote_one(self, client: ValidatorClient, i: int, op: bytes,
                   auth: Optional[dict], attempt: int,
-                  repair: Optional[dict]) -> Optional[dict]:
+                  repair: Optional[dict],
+                  tp: Optional[str] = None) -> Optional[dict]:
         """One validator's reply for (i, op, attempt), resyncing its
         replica from the backlog when it reports OUT_OF_ORDER.  Returns
-        the final reply dict (ok or refusal); None = transport failure."""
+        the final reply dict (ok or refusal); None = transport failure.
+        `tp` is the op's originating traceparent (obs.trace), carried so
+        the validator's vote span links into the op's trace."""
+        extra = {"tps": [tp]} if tp else {}
         for retry in (0, 1):            # one reconnect per certify call
             try:
                 r = client.request("bft_validate", i=i, op=op.hex(),
-                                   auth=auth, t=attempt, repair=repair)
+                                   auth=auth, t=attempt, repair=repair,
+                                   **extra)
                 resyncs = 0
                 while (not r.get("ok")
                        and r.get("status") == "OUT_OF_ORDER"
@@ -1113,7 +1124,8 @@ class CertificateAssembler:
                                 return None
                             break
                     r = client.request("bft_validate", i=i, op=op.hex(),
-                                       auth=auth, t=attempt, repair=repair)
+                                       auth=auth, t=attempt,
+                                       repair=repair, **extra)
                 return r
             except (ConnectionError, WireError, OSError):
                 client.close()
@@ -1171,19 +1183,24 @@ class CertificateAssembler:
         return True
 
     def _vote_batch_one(self, client: ValidatorClient, start: int,
-                        entries) -> Optional[List[dict]]:
+                        entries,
+                        tps: Optional[list] = None
+                        ) -> Optional[List[dict]]:
         """One validator's vote list for the contiguous ops `entries` at
         positions [start, start+len(entries)) — one `bft_vote_batch`
         round-trip, with a certified-backlog replay + one re-ask when the
         replica reports OUT_OF_ORDER below `start`.  None on transport
         failure or a validator that does not speak the batch method (an
-        old-version peer): the caller falls back to single-op voting."""
+        old-version peer): the caller falls back to single-op voting.
+        `tps` (originating traceparents per op, obs.trace) rides along
+        so the validator's vote span links into every covered trace."""
         ops_hex = [op.hex() for op, _ in entries]
         auths = [a for _, a in entries]
+        extra = {"tps": tps} if tps and any(tps) else {}
         for retry in (0, 1):            # one reconnect per call
             try:
                 r = client.request("bft_vote_batch", i=start, ops=ops_hex,
-                                    auths=auths)
+                                    auths=auths, **extra)
                 if not r.get("ok"):
                     return None         # old peer / malformed: fall back
                 stopped = r.get("stopped")
@@ -1195,7 +1212,8 @@ class CertificateAssembler:
                         behind = -1
                     if self._catch_up(client, behind, start):
                         r = client.request("bft_vote_batch", i=start,
-                                           ops=ops_hex, auths=auths)
+                                           ops=ops_hex, auths=auths,
+                                           **extra)
                         if not r.get("ok"):
                             return None
                 return r.get("votes") or []
@@ -1205,8 +1223,9 @@ class CertificateAssembler:
                     return None
         return None
 
-    def certify_range(self, start: int, entries,
-                      prev_head: bytes) -> List[Optional[CommitCertificate]]:
+    def certify_range(self, start: int, entries, prev_head: bytes,
+                      tps: Optional[list] = None
+                      ) -> List[Optional[CommitCertificate]]:
         """Batched fast path (PR 3): certify the contiguous ops
         `entries` = [(op, auth), ...] at positions [start, ...) in ONE
         vote round-trip per validator instead of one per op.  Votes are
@@ -1233,9 +1252,20 @@ class CertificateAssembler:
         # position -> attempt -> {validator: sig}; raw first, verify bulk
         raw: List[List[Tuple[int, int, bytes]]] = [[] for _ in range(n)]
         lock = threading.Lock()
+        # one causal span per vote ROUND-TRIP, linked to every op in the
+        # batch (obs.trace): the ambient context is captured here — the
+        # ask threads have none of their own — and activated inside each
+        # span so the vote request frames carry it onward
+        amb = (obs_trace.TRACE.current_traceparent()
+               if obs_trace.TRACE.enabled else None)
+        links = [t for t in (tps or ()) if t] or None
 
-        def ask(client):
-            vs = self._vote_batch_one(client, start, entries)
+        def ask(client, vidx):
+            with obs_trace.TRACE.span_from(
+                    amb or (links[0] if links else None), "bft.vote_rtt",
+                    links=links, validator=vidx, n_ops=n):
+                vs = self._vote_batch_one(client, start, entries,
+                                          tps=tps)
             if not vs:
                 return
             for v in vs:
@@ -1250,8 +1280,9 @@ class CertificateAssembler:
                     with lock:
                         raw[k].append((vidx, vt, sig))
 
-        threads = [threading.Thread(target=ask, args=(c,), daemon=True)
-                   for c in self._clients]
+        threads = [threading.Thread(target=ask, args=(c, ci),
+                                    daemon=True)
+                   for ci, c in enumerate(self._clients)]
         for t in threads:
             t.start()
         for t in threads:
@@ -1309,7 +1340,7 @@ class CertificateAssembler:
 
     def _gather_votes(self, i: int, op: bytes, auth: Optional[dict],
                       prev_head: bytes, attempt: int,
-                      repair: Optional[dict]):
+                      repair: Optional[dict], tp: Optional[str] = None):
         """-> (sigs_by_attempt, refusals, diverged): verified signatures
         grouped by the attempt each validator actually signed at (an
         idempotent re-sign may report a higher attempt than requested;
@@ -1324,8 +1355,15 @@ class CertificateAssembler:
         diverged: List[ValidatorClient] = []
         lock = threading.Lock()
 
+        amb = (obs_trace.TRACE.current_traceparent()
+               if obs_trace.TRACE.enabled else None)
+
         def ask(client):
-            r = self._vote_one(client, i, op, auth, attempt, repair)
+            with obs_trace.TRACE.span_from(
+                    amb or tp, "bft.vote_rtt",
+                    links=[tp] if tp else None, i=i):
+                r = self._vote_one(client, i, op, auth, attempt, repair,
+                                   tp=tp)
             if r is None:
                 return
             if not r.get("ok"):
@@ -1489,14 +1527,15 @@ class CertificateAssembler:
         return stmts, attempt
 
     def certify(self, i: int, op: bytes, auth: Optional[dict],
-                prev_head: bytes) -> Optional[CommitCertificate]:
+                prev_head: bytes,
+                tp: Optional[str] = None) -> Optional[CommitCertificate]:
         self.superseded_op = None
         op_hash = hashlib.sha256(op).digest()
         new_head = next_head(prev_head, op)
         attempt, repair = 0, None
         for _ in range(self.max_repair_rounds + 1):
             votes, refusals, diverged = self._gather_votes(
-                i, op, auth, prev_head, attempt, repair)
+                i, op, auth, prev_head, attempt, repair, tp=tp)
             if diverged:
                 # heal stale-fork replicas BEFORE taking the quorum exit:
                 # a diverged validator silently erodes the f margin, and

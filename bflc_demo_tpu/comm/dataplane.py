@@ -55,6 +55,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from bflc_demo_tpu.comm.wire import (WireError, blob_bytes, recv_msg,
                                      send_msg, split_blob_parts)
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 
 Endpoint = Tuple[str, int]
 
@@ -287,10 +288,14 @@ class ReadFanoutServer:
                     return
                 method = msg.get("method", "")
                 try:
-                    reply = handle_read(
-                        method, msg, blob_lookup=self._blob_lookup,
-                        model_state=self._model_state,
-                        snapshot_state=self._snapshot_state)
+                    # causal span adopted from the frame's `_tp` — the
+                    # replica-side leg of a traced read fan-out fetch
+                    with obs_trace.server_span(msg, "replica.read",
+                                               method=method):
+                        reply = handle_read(
+                            method, msg, blob_lookup=self._blob_lookup,
+                            model_state=self._model_state,
+                            snapshot_state=self._snapshot_state)
                     if reply is None:
                         reply = {"ok": False,
                                  "error": f"read replica: unknown method "
@@ -403,6 +408,13 @@ class ReadRouter:
         """The committed global model as ``{ok, epoch, hash, blob}`` with
         ``blob`` always raw bytes and ``source`` recording who actually
         moved them (cache / replica / writer)."""
+        with obs_trace.TRACE.span("read.model") as sp:
+            r = self._fetch_model()
+            if isinstance(r, dict) and r.get("source"):
+                sp["source"] = r["source"]
+            return r
+
+    def _fetch_model(self) -> dict:
         if self.legacy:
             r = self.control.request("model")
             if r.get("ok"):
@@ -460,6 +472,10 @@ class ReadRouter:
         fallback (counted per hash: a batched reply that silently omits
         or garbles a part costs visible round-trips, never silence).
         Raises LookupError when a hash cannot be fetched anywhere."""
+        with obs_trace.TRACE.span("read.blobs", n=len(hashes)):
+            return self._fetch_blobs(hashes)
+
+    def _fetch_blobs(self, hashes: Sequence[str]) -> Dict[str, bytes]:
         out: Dict[str, bytes] = {}
         need: List[str] = []
         for h in hashes:

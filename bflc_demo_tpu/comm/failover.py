@@ -84,6 +84,7 @@ from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 Endpoint = Tuple[str, int]
@@ -628,45 +629,59 @@ class Standby:
                     continue
                 op_bytes = bytes.fromhex(msg["op"])
                 op_index = self.ledger.log_size()
-                if self.bft_keys:
-                    # BFT mode: an append binds here only with a commit
-                    # certificate quorum-signed over OUR chain prefix —
-                    # a Byzantine writer streaming forged/forked/
-                    # uncertified state is refused, not replicated
-                    self._require_certificate(msg, op_index, op_bytes)
-                # a pushed upload op may carry its payload blob inline
-                # (binary frame piggyback, PR 3): hash-verify against the
-                # op and mirror it without the fetch round-trip the
-                # mirror-before-apply gate would otherwise spend on the
-                # ack critical path.  A wrong-hash blob is ignored — the
-                # gate below then fetches/fails exactly as before, so a
-                # lying writer gains nothing.
-                self._harvest_pushed_blob(msg, op_bytes)
-                # mirror-BEFORE-apply: an upload op binds here only once
-                # its payload blob landed, so this replica can never hold
-                # an update record without its payload — in async mode
-                # just as in quorum mode.  If the writer dies mid-fetch
-                # the op never applied: the promoted chain lacks the
-                # record entirely and the uploader's signed retry
-                # re-supplies it.  Returns False only on an authoritative
-                # "unknown blob" (round already aggregated it away): the
-                # op then applies as historical record with its ack
-                # clamped until the replayed epoch moves past it.
-                if not self._await_upload_payload(op_bytes, ctl, writer):
-                    self._pending_payload[op_index] = op_bytes
-                st = self.ledger.apply_op(op_bytes)
-                if st != LedgerStatus.OK:
-                    raise RuntimeError(
-                        f"standby rejected op {msg['i']}: {st.name} — "
-                        f"writer/replica divergence, refusing to continue")
-                last_applied = op_index
-                if op_bytes and op_bytes[0] == self._SNAPSHOT_OPCODE:
-                    # the apply above already re-derived the snapshot's
-                    # state digest from OUR replica (pyledger OP_SNAPSHOT
-                    # refuses a mismatch) — mirror the meta and GC this
-                    # replica behind the certified checkpoint
-                    self._note_snapshot_op(op_index, op_bytes,
-                                           msg.get("cert"))
+                # causal mirror span in the op's originating trace (the
+                # stream frame's `tp`, obs.trace; null for untraced
+                # ops): certificate check + payload mirror + apply —
+                # the edge the writer's quorum-ack wait blocks on
+                with obs_trace.TRACE.span_from(msg.get("tp"),
+                                               "standby.mirror",
+                                               i=op_index):
+                    if self.bft_keys:
+                        # BFT mode: an append binds here only with a
+                        # commit certificate quorum-signed over OUR
+                        # chain prefix — a Byzantine writer streaming
+                        # forged/forked/uncertified state is refused,
+                        # not replicated
+                        self._require_certificate(msg, op_index,
+                                                  op_bytes)
+                    # a pushed upload op may carry its payload blob
+                    # inline (binary frame piggyback, PR 3): hash-verify
+                    # against the op and mirror it without the fetch
+                    # round-trip the mirror-before-apply gate would
+                    # otherwise spend on the ack critical path.  A
+                    # wrong-hash blob is ignored — the gate below then
+                    # fetches/fails exactly as before, so a lying writer
+                    # gains nothing.
+                    self._harvest_pushed_blob(msg, op_bytes)
+                    # mirror-BEFORE-apply: an upload op binds here only
+                    # once its payload blob landed, so this replica can
+                    # never hold an update record without its payload —
+                    # in async mode just as in quorum mode.  If the
+                    # writer dies mid-fetch the op never applied: the
+                    # promoted chain lacks the record entirely and the
+                    # uploader's signed retry re-supplies it.  Returns
+                    # False only on an authoritative "unknown blob"
+                    # (round already aggregated it away): the op then
+                    # applies as historical record with its ack clamped
+                    # until the replayed epoch moves past it.
+                    if not self._await_upload_payload(op_bytes, ctl,
+                                                      writer):
+                        self._pending_payload[op_index] = op_bytes
+                    st = self.ledger.apply_op(op_bytes)
+                    if st != LedgerStatus.OK:
+                        raise RuntimeError(
+                            f"standby rejected op {msg['i']}: {st.name} "
+                            f"— writer/replica divergence, refusing to "
+                            f"continue")
+                    last_applied = op_index
+                    if op_bytes and op_bytes[0] == self._SNAPSHOT_OPCODE:
+                        # the apply above already re-derived the
+                        # snapshot's state digest from OUR replica
+                        # (pyledger OP_SNAPSHOT refuses a mismatch) —
+                        # mirror the meta and GC this replica behind the
+                        # certified checkpoint
+                        self._note_snapshot_op(op_index, op_bytes,
+                                               msg.get("cert"))
                 self._drop_moot_payloads()
                 try:
                     self._sync_state(ctl)
@@ -677,7 +692,10 @@ class Standby:
                 # confirm apply + mirror upstream: the writer's quorum-ack
                 # mode counts these before acknowledging mutations
                 # (best-effort — a lost ack only delays, never corrupts)
-                self._send_ack(sub, last_applied)
+                with obs_trace.TRACE.span_from(msg.get("tp"),
+                                               "standby.ack",
+                                               i=last_applied):
+                    self._send_ack(sub, last_applied)
         finally:
             sub.close()
             ctl.close()

@@ -45,6 +45,7 @@ from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
                                      WireError)
 from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
@@ -98,6 +99,15 @@ _G_LOG_BASE = obs_metrics.REGISTRY.gauge(
     "log_base", "first chain position still held (GC'd prefix depth)")
 _M_GC_OPS = obs_metrics.REGISTRY.counter(
     "ledger_gc_ops_total", "log ops reclaimed by snapshot GC")
+# --- straggler evidence (the async-aggregation item's baseline): how
+# far behind the round's FIRST admitted upload each later upload lands,
+# writer-side.  Heavy-tailed client delay shows up as a fat tail here;
+# tools/trace_report.py cross-checks the per-client ranking off the
+# causal traces against this aggregate distribution.
+_M_UPLOAD_LAG = obs_metrics.REGISTRY.histogram(
+    "upload_lag_seconds",
+    "per-round client upload admission lag behind the round's first "
+    "admitted upload")
 
 _PROMO_MAGIC = b"BFLCPROM1"
 
@@ -407,6 +417,16 @@ class LedgerServer:
         # ops one certify_range round-trip may carry
         self._cert_batch = 1 if self._legacy else 128
         self._op_auth: Dict[int, dict] = {}
+        # chain position -> originating traceparent (obs.trace): recorded
+        # at append time for ops born inside a TRACED request, streamed
+        # to subscribers as `tp` (standby mirror spans) and linked into
+        # batched-vote spans.  Empty whenever tracing is off/unsampled —
+        # the hot path pays one truthiness check.
+        self._op_trace: Dict[int, str] = {}
+        # upload-lag tracking for the straggler histogram: (epoch, wall
+        # time of that epoch's first admitted upload)
+        self._lag_epoch = -1
+        self._lag_t0 = 0.0
         # hierarchical cell federation (bflc_demo_tpu.hier): when a cell
         # registry {aggregator address -> registered membership} is
         # provisioned, this server is a ROOT — uploads are cell-aggregate
@@ -568,43 +588,49 @@ class LedgerServer:
                         return
                 t_req = (time.perf_counter()
                          if obs_metrics.REGISTRY.enabled else 0.0)
+                # causal span over the request's WHOLE server-side life
+                # (dispatch + certification + quorum wait) — adopted
+                # from the frame's `_tp` context; the null span for
+                # untraced frames (obs.trace)
                 try:
-                    reply = self._dispatch(method, msg)
-                    post_size = reply.pop("_post_size", None)
-                    if self._bft is not None and post_size is not None:
-                        # BFT mode: the ack may only carry state that a
-                        # validator quorum co-signed — certify the ops this
-                        # request appended (and any predecessors) first
-                        cert = self._ensure_certified(post_size)
-                        if cert is None:
-                            reply = {"ok": False, "status": "CERT_TIMEOUT",
-                                     "error": "no validator quorum "
-                                              "co-signed the op"}
-                            post_size = None
-                        else:
-                            # attach the certificate of THIS request's op
-                            # (reconstructed from its own fields), not
-                            # merely the newest one: for DUPLICATE-class
-                            # replies the op bound earlier, and a client
-                            # rightly rejects a certificate that does not
-                            # bind the op it asked about
-                            from bflc_demo_tpu.comm.bft import \
-                                expected_op_hash
-                            oh = expected_op_hash(method, msg)
-                            if oh is not None:
-                                cert = self._certs_by_ophash.get(
-                                    oh.hex(), None)
-                            reply["cert"] = cert
-                    if (self._quorum
-                            and post_size is not None
-                            and not self._await_quorum(post_size)):
-                        # the op is in the local chain but not provably on
-                        # quorum replicas: do NOT acknowledge durability.
-                        # The client's signed retry is safe (DUPLICATE =
-                        # progress) once followers catch up.
-                        reply = {"ok": False,
-                                 "status": "REPLICATION_TIMEOUT",
-                                 "error": "op not yet on quorum replicas"}
+                    with obs_trace.server_span(msg, "serve",
+                                               method=method):
+                        reply = self._dispatch(method, msg)
+                        post_size = reply.pop("_post_size", None)
+                        if self._bft is not None and post_size is not None:
+                            # BFT mode: the ack may only carry state that a
+                            # validator quorum co-signed — certify the ops this
+                            # request appended (and any predecessors) first
+                            cert = self._ensure_certified(post_size)
+                            if cert is None:
+                                reply = {"ok": False, "status": "CERT_TIMEOUT",
+                                         "error": "no validator quorum "
+                                                  "co-signed the op"}
+                                post_size = None
+                            else:
+                                # attach the certificate of THIS request's op
+                                # (reconstructed from its own fields), not
+                                # merely the newest one: for DUPLICATE-class
+                                # replies the op bound earlier, and a client
+                                # rightly rejects a certificate that does not
+                                # bind the op it asked about
+                                from bflc_demo_tpu.comm.bft import \
+                                    expected_op_hash
+                                oh = expected_op_hash(method, msg)
+                                if oh is not None:
+                                    cert = self._certs_by_ophash.get(
+                                        oh.hex(), None)
+                                reply["cert"] = cert
+                        if (self._quorum
+                                and post_size is not None
+                                and not self._await_quorum(post_size)):
+                            # the op is in the local chain but not provably on
+                            # quorum replicas: do NOT acknowledge durability.
+                            # The client's signed retry is safe (DUPLICATE =
+                            # progress) once followers catch up.
+                            reply = {"ok": False,
+                                     "status": "REPLICATION_TIMEOUT",
+                                     "error": "op not yet on quorum replicas"}
                 except Exception as e:      # noqa: BLE001 — any dispatch
                     # failure (including a RuntimeError thrown by
                     # aggregation inside the scores handler) must produce an
@@ -708,12 +734,19 @@ class LedgerServer:
                     entries = [(self.ledger.log_op(j),
                                 self._op_auth.get(j))
                                for j in range(i, hi)]
+                    # originating trace context per op in the window
+                    # (obs.trace): the vote round-trip spans link to
+                    # every one of them, so a batch that certifies five
+                    # clients' ops shows up in five traces
+                    tps = ([self._op_trace.get(j) for j in range(i, hi)]
+                           if self._op_trace else None)
                 if len(entries) > 1:
                     tr = tracing.PROC
                     t0 = time.perf_counter() if (
                         tr.enabled or obs_metrics.REGISTRY.enabled) \
                         else 0.0
-                    certs = self._bft.certify_range(i, entries, prev)
+                    certs = self._bft.certify_range(i, entries, prev,
+                                                    tps=tps)
                     dt = time.perf_counter() - t0 if t0 else 0.0
                     if tr.enabled:
                         tr.charge("bft.certify_s", dt)
@@ -738,7 +771,8 @@ class LedgerServer:
                 tr = tracing.PROC
                 t0 = time.perf_counter() if (
                     tr.enabled or obs_metrics.REGISTRY.enabled) else 0.0
-                cert = self._bft.certify(i, op, auth, prev)
+                cert = self._bft.certify(i, op, auth, prev,
+                                         tp=(tps[0] if tps else None))
                 dt = time.perf_counter() - t0 if t0 else 0.0
                 if tr.enabled:
                     tr.charge("bft.certify_s", dt)
@@ -861,6 +895,13 @@ class LedgerServer:
                     frame = {"i": next_i + i, "op": op.hex()}
                     if self._bft is not None:
                         frame["cert"] = self._certs.get(next_i + i)
+                    if self._op_trace:
+                        # originating trace context rides the push so a
+                        # standby's mirror/ack lands in the op's trace
+                        # (obs.trace; absent for untraced ops)
+                        tp = self._op_trace.get(next_i + i)
+                        if tp:
+                            frame["tp"] = tp
                     blob = (None if self._legacy
                             else self._op_payload_blob(op))
                     if blob is not None:
@@ -1076,7 +1117,16 @@ class LedgerServer:
 
     def _dispatch(self, method: str, m: dict) -> dict:
         with self._lock:            # RLock: the inner re-acquires freely
+            size0 = (self.ledger.log_size()
+                     if obs_trace.TRACE.enabled else 0)
             reply = self._dispatch_inner(method, m)
+            if obs_trace.TRACE.enabled and "_tp" in m:
+                # bind every op THIS traced request appended (an upload
+                # appends one; a scores request may also append
+                # close/aggregate/commit ops) to its originating trace:
+                # the op stream and the vote batches carry it onward
+                for j in range(size0, self.ledger.log_size()):
+                    self._op_trace[j] = m["_tp"]
             if method in self._MUTATING and (
                     reply.get("ok")
                     or reply.get("status") in ("DUPLICATE",
@@ -1195,6 +1245,17 @@ class LedgerServer:
                     addr, digest, int(m["n"]), float(m["cost"]),
                     int(m["epoch"]))
                 if st == LedgerStatus.OK:
+                    if obs_metrics.REGISTRY.enabled:
+                        # straggler evidence: admission lag behind this
+                        # round's FIRST admitted upload (0 for the
+                        # leader) — the heavy-tail axis the async-
+                        # aggregation roadmap item needs measured
+                        now = time.monotonic()
+                        ep = int(m["epoch"])
+                        if self._lag_epoch != ep:
+                            self._lag_epoch = ep
+                            self._lag_t0 = now
+                        _M_UPLOAD_LAG.observe(now - self._lag_t0)
                     self._blobs[digest] = blob
                     self._consume_tag(int(m["epoch"]), m.get("tag", ""))
                     # f64 originals ride along: the op stores f32, the tag
@@ -1459,6 +1520,11 @@ class LedgerServer:
         (.cpp:349-456): weighted-FedAvg the ledger-selected deltas into the
         global model, commit the new model's content hash, publish blob."""
         t0 = time.perf_counter() if tracing.PROC.enabled else 0.0
+        with obs_trace.TRACE.span("aggregate",
+                                  epoch=self.ledger.epoch):
+            self._aggregate_and_commit_inner(t0)
+
+    def _aggregate_and_commit_inner(self, t0: float) -> None:
         pending = self.ledger.pending()
         updates = self.ledger.query_all_updates()
         epoch = self.ledger.epoch
@@ -1602,6 +1668,9 @@ class LedgerServer:
                 # on-disk log/WAL bound.
                 self._op_auth = {k: v for k, v in self._op_auth.items()
                                  if k >= i}
+                self._op_trace = {k: v
+                                  for k, v in self._op_trace.items()
+                                  if k >= i}
                 kept = {k: v for k, v in self._certs.items() if k >= i}
                 kept_hashes = {w.get("op_hash") for w in kept.values()}
                 self._certs = kept

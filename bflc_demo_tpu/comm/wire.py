@@ -91,6 +91,7 @@ except ImportError:                     # pragma: no cover - env dependent
 _SEND_ZSTD = _zstd is not None and bool(os.environ.get("BFLC_WIRE_ZSTD"))
 
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.utils import tracing
 
 MAX_FRAME = 256 << 20
@@ -308,6 +309,15 @@ def _decompress(body: bytes) -> bytes:
 
 
 def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    # causal trace context (obs.trace, Dapper-style): while a sampled
+    # span is active on THIS thread, its traceparent rides as a `_tp`
+    # header field — plain JSON data, so it survives the BIN1, legacy
+    # hex-JSON and compressed variants unchanged and untraced peers
+    # ignore the extra key.  Tracing off = one attribute check.
+    if obs_trace.TRACE.enabled and "_tp" not in msg:
+        _tp = obs_trace.TRACE.current_traceparent()
+        if _tp is not None:
+            msg = {**msg, "_tp": _tp}
     tr = tracing.PROC
     t0 = time.perf_counter() if tr.enabled else 0.0
     data = _encode(msg)

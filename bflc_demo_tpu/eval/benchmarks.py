@@ -279,6 +279,7 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                        validators: int = 4, quorum: int = 1,
                        compare_sequential: bool = False,
                        telemetry: bool = True,
+                       trace_sample: float = 0.0,
                        timeout_s: float = 420.0) -> Dict:
     """Process-federation benchmark at the paper's config-1 BFT geometry —
     the topology that actually reproduces the reference's deployment (20
@@ -298,7 +299,13 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
     driver scrapes every role each committed round and the result
     carries `telemetry` scrape coverage (roles answering / expected) —
     bench.py surfaces it as extra.telemetry.  telemetry=False is the
-    overhead baseline leg (TPU_RESULTS.md telemetry-overhead axis)."""
+    overhead baseline leg (TPU_RESULTS.md telemetry-overhead axis).
+
+    trace_sample > 0 additionally arms causal op tracing (obs.trace;
+    implies telemetry) and the leg result carries a `trace` summary —
+    reassembled trace count, role coverage per trace, and the critical-
+    path attribution fraction — computed from the run's span files
+    before the tempdir goes away."""
     from bflc_demo_tpu.data import load_occupancy, iid_shards
 
     cfg = DEFAULT_PROTOCOL
@@ -315,6 +322,7 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
         else:
             os.environ.pop("BFLC_CONTROL_PLANE_LEGACY", None)
         os.environ["BFLC_PROC_TRACE"] = "1"
+        trace_summary = None
         try:
             with tempfile.TemporaryDirectory(prefix="bflc-fed-bench-") \
                     as td:
@@ -324,8 +332,15 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                     bft_validators=validators,
                     wal_path=os.path.join(td, "writer.wal"),
                     telemetry_dir=(os.path.join(td, "telemetry")
-                                   if telemetry else ""),
+                                   if telemetry or trace_sample else ""),
+                    trace_sample=trace_sample,
                     timeout_s=timeout_s)
+                if trace_sample:
+                    # summarize the causal traces BEFORE the tempdir is
+                    # reclaimed: the artifact of record is the summary,
+                    # not the span files
+                    trace_summary = _trace_summary(
+                        os.path.join(td, "telemetry"))
         finally:
             for k, v in saved.items():
                 if v is None:
@@ -386,6 +401,10 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                                      "answered_total", "expected_total",
                                      "coverage")}
                           if res.telemetry_report else None),
+            # causal-trace summary (None when untraced): how many op
+            # journeys reassembled and how completely the critical path
+            # attributes round wall time (obs.trace)
+            "trace": trace_summary,
         }
 
     out: Dict = {
@@ -1120,6 +1139,68 @@ def telemetry_overhead_config1(rounds: int = 3, trials: int = 1,
         "round_times_on": on_times, "round_times_off": off_times,
         "overhead_frac": round(on_t / off_t - 1.0, 4) if off_t else None,
         "scrape_coverage": on_last["fast"].get("telemetry"),
+        "last_trial_on": on_last["fast"],
+        "last_trial_off": off_last["fast"],
+    }
+
+
+def _trace_summary(telemetry_dir: str) -> Optional[Dict]:
+    """Compact artifact of a traced run's causal spans: trace counts,
+    per-trace role coverage, and the critical-path attribution fraction
+    per round (obs.trace).  None when no spans were flushed."""
+    from bflc_demo_tpu.obs import trace as obs_trace
+    spans = obs_trace.gather_spans(telemetry_dir)
+    if not spans:
+        return None
+    traces = obs_trace.assemble_traces(spans)
+    role_counts = [len(obs_trace.trace_role_classes(ts))
+                   for ts in traces.values()]
+    reports = obs_trace.round_reports(spans)
+    return {
+        "spans": len(spans),
+        "traces": len(traces),
+        "traces_ge4_roles": sum(1 for n in role_counts if n >= 4),
+        "max_roles_per_trace": max(role_counts, default=0),
+        "rounds_reassembled": len(reports),
+        "critical_path_cover": ([round(r["covered_frac"], 3)
+                                 for r in reports] or None),
+    }
+
+
+def trace_overhead_config1(rounds: int = 3, trials: int = 1,
+                           **kw) -> Dict:
+    """Causal-tracing overhead measured, not asserted (the tracing PR's
+    5% acceptance bar, same harness as telemetry_overhead_config1): the
+    identical config-1 federation with every op traced (sample=1.0) vs
+    tracing off, telemetry armed on BOTH legs so the delta isolates the
+    span/record/`_tp` cost.  The traced leg's `trace` summary rides
+    along as the reassembly evidence.
+
+    Leg order ALTERNATES per trial: on this contended host the FIRST
+    federation of a pair consistently runs ~20% hotter than the second
+    regardless of code path (measured while landing the tracing PR —
+    TPU_RESULTS.md round 13), so a fixed order would charge that
+    session-warmup artifact to whichever leg always went first."""
+    on_times, off_times, on_last, off_last = [], [], None, None
+    for trial in range(trials):
+        legs = [1.0, 0.0] if trial % 2 == 0 else [0.0, 1.0]
+        for sample in legs:
+            res = federation_config1(rounds=rounds, telemetry=True,
+                                     trace_sample=sample, **kw)
+            if sample:
+                on_last = res
+                on_times.append(res["fast"]["round_wall_time_s"])
+            else:
+                off_last = res
+                off_times.append(res["fast"]["round_wall_time_s"])
+    on_t, off_t = min(on_times), min(off_times)
+    return {
+        "rounds": rounds, "trials": trials,
+        "round_wall_time_s_trace_on": on_t,
+        "round_wall_time_s_trace_off": off_t,
+        "round_times_on": on_times, "round_times_off": off_times,
+        "overhead_frac": round(on_t / off_t - 1.0, 4) if off_t else None,
+        "trace": on_last["fast"].get("trace"),
         "last_trial_on": on_last["fast"],
         "last_trial_off": off_last["fast"],
     }

@@ -45,6 +45,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      chaos_profile: str = "standard",
                      cells: int = 0, cell_size: int = 0,
                      snapshot_interval: int = 0, snapshot_dir: str = "",
+                     telemetry_dir: str = "", trace_sample: float = 0.0,
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -70,7 +71,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                          ("chaos_seed", chaos_seed is not None),
                          ("cells", cells), ("cell_size", cell_size),
                          ("snapshot_interval", snapshot_interval),
-                         ("snapshot_dir", snapshot_dir)]
+                         ("snapshot_dir", snapshot_dir),
+                         ("telemetry_dir", telemetry_dir),
+                         ("trace_sample", trace_sample)]
     if runtime not in ("executor", "mesh"):
         # attestation exists on both mesh-family runtimes (default-on
         # where wallets exist); elsewhere an explicit request must error
@@ -128,7 +131,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                 process_factory, shards, test_set, cfg, rounds=rounds,
                 cells=cells, cell_size=cell_size,
                 factory_kw=factory_kw or {},
-                bft_validators=bft_validators, verbose=verbose)
+                bft_validators=bft_validators,
+                telemetry_dir=telemetry_dir, trace_sample=trace_sample,
+                verbose=verbose)
         from bflc_demo_tpu.client.process_runtime import \
             run_federated_processes
         return run_federated_processes(
@@ -138,7 +143,9 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
             bft_validators=bft_validators, chaos_seed=chaos_seed,
             chaos_profile=chaos_profile,
             snapshot_interval=snapshot_interval,
-            snapshot_dir=snapshot_dir, verbose=verbose)
+            snapshot_dir=snapshot_dir,
+            telemetry_dir=telemetry_dir, trace_sample=trace_sample,
+            verbose=verbose)
     if runtime == "executor":
         if not process_factory:
             raise ValueError("this preset does not support the 'executor' "

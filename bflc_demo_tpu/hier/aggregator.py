@@ -51,6 +51,7 @@ from bflc_demo_tpu.hier.partial import (cell_evidence_digest, cell_partial,
 from bflc_demo_tpu.ledger import LedgerStatus
 from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import (dequantize_entries,
                                                restore_pytree,
@@ -145,23 +146,33 @@ class CellAggregatorServer(LedgerServer):
         t0 = time.perf_counter()
         pending = self.ledger.pending()
         updates = self.ledger.query_all_updates()
-        admitted = []
-        for s in pending.selected:
-            u = updates[s]
-            flat = dequantize_entries(
-                unpack_pytree(self._blobs[u.payload_hash]))
-            admitted.append((u.sender, flat, u.n_samples, u.avg_cost))
-        partial, n_clients, mean_cost = cell_partial(admitted)
-        evidence = cell_evidence_digest(
-            epoch, self.cell_index,
-            [(u.sender, u.payload_hash, u.n_samples, u.avg_cost)
-             for u in updates],
-            [float(m) for m in pending.medians],
-            list(pending.selected))
-        blob = partial_blob(partial, self.cell_index, n_clients, evidence)
+        with obs_trace.TRACE.span("cell.partial", epoch=epoch,
+                                  cell=self.cell_index):
+            admitted = []
+            for s in pending.selected:
+                u = updates[s]
+                flat = dequantize_entries(
+                    unpack_pytree(self._blobs[u.payload_hash]))
+                admitted.append((u.sender, flat, u.n_samples,
+                                 u.avg_cost))
+            partial, n_clients, mean_cost = cell_partial(admitted)
+            evidence = cell_evidence_digest(
+                epoch, self.cell_index,
+                [(u.sender, u.payload_hash, u.n_samples, u.avg_cost)
+                 for u in updates],
+                [float(m) for m in pending.medians],
+                list(pending.selected))
+            blob = partial_blob(partial, self.cell_index, n_clients,
+                                evidence)
+        # the member's trace context (ambient here: the partial computes
+        # inside the triggering member's scores dispatch) rides the
+        # outbox so the BRIDGE upload to the root continues the same
+        # trace one tier up (obs.trace; None when untraced)
         self._outbox = {"epoch": epoch, "blob": blob, "n": n_clients,
                         "cost": mean_cost,
-                        "hash": hashlib.sha256(blob).digest()}
+                        "hash": hashlib.sha256(blob).digest(),
+                        "tp": (obs_trace.TRACE.current_traceparent()
+                               if obs_trace.TRACE.enabled else None)}
         self._partial_epoch = epoch
         for u in updates:
             self._blobs.pop(u.payload_hash, None)
@@ -319,12 +330,21 @@ class CellAggregatorServer(LedgerServer):
                         payload = digest + struct.pack(
                             "<qd", outbox["n"], float(outbox["cost"]))
                         t0 = time.perf_counter()
-                        r = client.request(
-                            "upload", addr=self.wallet.address,
-                            blob=outbox["blob"], hash=digest.hex(),
-                            n=outbox["n"], cost=float(outbox["cost"]),
-                            epoch=repoch,
-                            tag=self._sign("upload", repoch, payload))
+                        # bridge upload continues the member trace the
+                        # partial was computed under — the root writer's
+                        # serve span then parents here, so one trace
+                        # crosses both tiers (obs.trace)
+                        with obs_trace.TRACE.span_from(
+                                outbox.get("tp"), "cell.bridge_upload",
+                                epoch=repoch, cell=self.cell_index):
+                            r = client.request(
+                                "upload", addr=self.wallet.address,
+                                blob=outbox["blob"], hash=digest.hex(),
+                                n=outbox["n"],
+                                cost=float(outbox["cost"]),
+                                epoch=repoch,
+                                tag=self._sign("upload", repoch,
+                                               payload))
                         if obs_metrics.REGISTRY.enabled:
                             _M_ROOT_ACK.observe(
                                 time.perf_counter() - t0)

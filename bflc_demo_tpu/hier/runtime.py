@@ -152,6 +152,7 @@ def run_federated_hier(
         chaos_schedule=None,
         chaos_dir: str = "",
         telemetry_dir: str = "",
+        trace_sample: float = 0.0,
         verbose: bool = False) -> ProcessFederationResult:
     """Run a two-tier federation as OS processes.  Parent = sponsor.
 
@@ -173,12 +174,19 @@ def run_federated_hier(
     validator AND every cell aggregator answer the `telemetry` RPC
     (cells inherit it from LedgerServer), clients publish file
     snapshots; `tools/fleet_top.py` renders the tree.
+    trace_sample: head-sampling rate for causal op tracing (obs.trace,
+    requires telemetry_dir) — a traced member op's context crosses the
+    cell aggregator's bridge into the root tier, so one trace covers
+    member -> cell -> root -> validators.
     """
     import multiprocessing as mp
 
     cfg.validate()
     if len(shards) != cfg.client_num:
         raise ValueError(f"need {cfg.client_num} shards, got {len(shards)}")
+    if trace_sample and not telemetry_dir:
+        raise ValueError("trace_sample > 0 needs telemetry_dir (the "
+                         "spans land beside the telemetry artifacts)")
     plan = plan_cells(len(shards), cells, cell_size)
     factory_kw = factory_kw or {}
     kill_cell_at_epoch = dict(kill_cell_at_epoch or {})
@@ -243,7 +251,8 @@ def run_federated_hier(
                 if campaign is not None else None)
 
     def _tspec(role: str):
-        return ({"role": role, "dir": telemetry_dir}
+        return ({"role": role, "dir": telemetry_dir,
+                 "trace_sample": trace_sample}
                 if telemetry_dir else None)
 
     if telemetry_dir:
@@ -479,6 +488,10 @@ def run_federated_hier(
             telemetry_report = {"dir": telemetry_dir,
                                 "jsonl": collector.jsonl_path,
                                 "prometheus": prom_path,
+                                "spans": sorted(
+                                    os.path.join(telemetry_dir, n)
+                                    for n in os.listdir(telemetry_dir)
+                                    if n.endswith(".spans.jsonl")),
                                 **collector.coverage_report()}
     finally:
         sponsor_router.close()

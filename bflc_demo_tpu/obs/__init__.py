@@ -35,12 +35,17 @@ _PUBLISHER: "threading.Thread | None" = None
 def install_process_telemetry(role: str, out_dir: str, *,
                               interval_s: float = 1.0,
                               enable_tracing: bool = True,
-                              signals: bool = True) -> None:
+                              signals: bool = True,
+                              trace_sample: float = 0.0) -> None:
     """Arm this process's telemetry: enable the metrics registry under
     `role`, flip the cost tracer on (the charge sites are shared), arm
     the flight recorder at <out_dir>/<role>.flight.jsonl, and start the
     snapshot publisher writing <out_dir>/<role>.metrics.json — the
-    scrape surface for roles that serve no socket.  Idempotent."""
+    scrape surface for roles that serve no socket.  Idempotent.
+
+    trace_sample > 0 additionally arms the causal span recorder
+    (obs.trace) at <out_dir>/<role>.spans.jsonl with that head-sampling
+    rate (BFLC_TRACE_LEGACY=1 pins it out regardless)."""
     global _PUBLISHER
     metrics.REGISTRY.enabled = True
     metrics.REGISTRY.role = role
@@ -49,6 +54,10 @@ def install_process_telemetry(role: str, out_dir: str, *,
         tracing.PROC.enabled = True
     flight.FLIGHT.install(role, out_dir, interval_s=interval_s,
                           signals=signals)
+    if trace_sample > 0.0:
+        from bflc_demo_tpu.obs import trace as obs_trace
+        obs_trace.TRACE.install(role, out_dir, sample=trace_sample,
+                                interval_s=interval_s)
     if _PUBLISHER is None:
         import os
 

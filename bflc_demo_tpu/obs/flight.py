@@ -33,6 +33,22 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 
+#: extra flush callables chained onto the terminal paths (SIGTERM /
+#: unhandled exception / atexit) the flight recorder already owns — the
+#: span recorder (obs.trace) registers here so a terminated role's spans
+#: land on disk with the same guarantees as its flight dump.  Read at
+#: fire time, so late registration is fine.
+TERMINAL_FLUSHES: List = []
+
+
+def _run_terminal_flushes() -> None:
+    for fn in list(TERMINAL_FLUSHES):
+        try:
+            fn()
+        except Exception:       # noqa: BLE001 — a failing secondary
+            pass                # flush must never block the primary one
+
+
 class FlightRecorder:
     """Bounded event ring + periodic/terminal flusher (module doc)."""
 
@@ -116,13 +132,15 @@ class FlightRecorder:
             self._flusher.start()
         if signals:
             import atexit
-            atexit.register(lambda: self.flush("atexit"))
+            atexit.register(lambda: (self.flush("atexit"),
+                                     _run_terminal_flushes()))
             prev_hook = sys.excepthook
 
             def _hook(tp, val, tb):
                 self.record("event", "unhandled_exception",
                             error=f"{tp.__name__}: {val}")
                 self.flush("exception")
+                _run_terminal_flushes()
                 prev_hook(tp, val, tb)
 
             sys.excepthook = _hook
@@ -131,6 +149,7 @@ class FlightRecorder:
                 def _on_term(signum, frame):
                     self.record("event", "sigterm")
                     self.flush("sigterm")
+                    _run_terminal_flushes()
                     signal.signal(signal.SIGTERM, signal.SIG_DFL)
                     os.kill(os.getpid(), signal.SIGTERM)
 

@@ -1,0 +1,582 @@
+"""Causal op tracing: fleet-wide spans + wire trace-context (Dapper).
+
+The metrics plane (obs.metrics) says *how much* and the flight recorders
+say *what last happened*; nothing could answer *why was round N slow* —
+an upload's journey (client train -> writer admission -> BFT vote batch
+-> certificate -> standby mirror -> aggregate -> commit -> read fan-out)
+crosses five processes and no identifier followed it.  This module is
+the Dapper design (Sigelman et al., 2010) grafted onto the certified op
+stream:
+
+- **head-based sampling**: the trace decision is made ONCE, where an op
+  originates (the client's round action), and propagates with the
+  context — `--trace-sample P` (default 0 = off); an unsampled op costs
+  one float compare and nothing else.
+- **context propagation**: an active span's `traceparent`
+  (``00-<trace_id>-<span_id>-01``, W3C shape) rides as a `_tp` field in
+  every wire frame `comm.wire.send_msg` emits while the span is open.
+  The field is plain JSON header data, so it survives BIN1, legacy
+  hex-JSON and compressed frames unchanged, and an untraced peer simply
+  ignores the extra key — mixed-fleet safe by construction.
+- **local span emission**: each process appends finished spans to a
+  bounded ring flushed tmp-then-rename to ``<role>.spans.jsonl`` in the
+  telemetry dir (the flight-recorder discipline: the artifact either
+  parses or is the previous complete flush).  Spans carry MONOTONIC
+  timestamps plus a per-process (wall, mono) anchor in the file header,
+  so durations are immune to wall-clock steps and the offline reader
+  re-anchors each process onto the shared wall timeline.
+- **offline reassembly**: `gather_spans` / `assemble_traces` /
+  `round_reports` rebuild causality after the fact — including spans
+  that belong to MANY traces at once (a batched BFT vote round-trip
+  certifies ops from several clients; it records `links` to every op's
+  trace) — and compute the per-round **critical path**: a timeline walk
+  that attributes every instant of the round to the deepest span active
+  then, so the segment sum equals the round wall time by construction
+  and "which edge did the round wait on" is read off, not guessed.
+
+Trust: spans are ADVISORY observability data.  Nothing here touches
+admission, certification or the certificate byte format; a forged or
+absent `_tp` can at worst mislabel a trace (PARITY.md).
+
+``BFLC_TRACE_LEGACY=1`` pins tracing out entirely (install becomes a
+no-op), the before/after benchmark switch
+(`eval.benchmarks.trace_overhead_config1`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def trace_legacy() -> bool:
+    """True when tracing is pinned out (benchmark before-leg)."""
+    return bool(os.environ.get("BFLC_TRACE_LEGACY"))
+
+
+# ---------------------------------------------------------- traceparent
+_TP_VERSION = "00"
+_TP_FLAGS = "01"
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"{_TP_VERSION}-{trace_id}-{span_id}-{_TP_FLAGS}"
+
+
+def parse_traceparent(tp) -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) or None on anything malformed — a hostile or
+    garbled header must never raise out of a dispatch path."""
+    if not isinstance(tp, str):
+        return None
+    parts = tp.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[1], 16)
+        int(parts[2], 16)
+    except ValueError:
+        return None
+    return parts[1], parts[2]
+
+
+# ------------------------------------------------------------- recorder
+class _NullSpan:
+    """Singleton no-op context manager for the disabled/unsampled path:
+    no span allocation, no clock read, no context mutation."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return _SINK
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+#: attrs sink handed out by the null span so call sites can always write
+#: `sp["key"] = v`; never read, bounded by the handful of attr keys the
+#: instrumentation sites use
+_SINK: Dict[str, Any] = {}
+
+
+class _SpanCM:
+    """One live span: activates its context thread-locally on enter,
+    records the finished span on exit (exception or not)."""
+
+    __slots__ = ("_rec", "trace", "parent", "name", "links", "attrs",
+                 "_sid", "_t0", "_prev")
+
+    def __init__(self, rec: "SpanRecorder", trace: str,
+                 parent: Optional[str], name: str,
+                 links: Optional[List[str]], attrs: Dict[str, Any]):
+        self._rec = rec
+        self.trace = trace
+        self.parent = parent
+        self.name = name
+        self.links = links
+        self.attrs = attrs
+
+    def __enter__(self):
+        rec = self._rec
+        self._sid = os.urandom(8).hex()
+        self._prev = getattr(rec._local, "ctx", None)
+        rec._local.ctx = (self.trace, self._sid)
+        self._t0 = time.monotonic()
+        return self.attrs
+
+    def __exit__(self, *exc):
+        t1 = time.monotonic()
+        rec = self._rec
+        rec._local.ctx = self._prev
+        span = dict(self.attrs)
+        span.update({"trace": self.trace, "span": self._sid,
+                     "parent": self.parent, "role": rec.role,
+                     "name": self.name, "t0": self._t0, "t1": t1})
+        if self.links:
+            span["links"] = self.links
+        with rec._lock:
+            rec._ring.append(span)
+        return False
+
+
+class SpanRecorder:
+    """Process-wide span buffer + head sampler + context holder.
+
+    Disabled by default: every entry point is one attribute check and a
+    singleton return.  Armed by `obs.install_process_telemetry(...,
+    trace_sample=P)` (the federation spawner threads the sample rate
+    through each child's telemetry spec) or `install` directly.
+    Access as `trace.TRACE` (module attribute) — same aliasing rule as
+    metrics.REGISTRY.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self.enabled = False
+        self.sample = 0.0
+        self.role = ""
+        self.path = ""
+        self.anchor_wall = 0.0
+        self.anchor_mono = 0.0
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.RLock()
+        self._local = threading.local()
+        self._rng = random.Random()
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ context
+    def _ctx(self) -> Optional[Tuple[str, str]]:
+        return getattr(self._local, "ctx", None)
+
+    def current_traceparent(self) -> Optional[str]:
+        """The active span's wire context, or None.  `comm.wire.send_msg`
+        calls this behind an `enabled` check — the one hot-path hook."""
+        ctx = self._ctx()
+        return format_traceparent(*ctx) if ctx is not None else None
+
+    # -------------------------------------------------------------- spans
+    def start_trace(self, name: str, **attrs):
+        """Root span: the HEAD sampling decision happens here and only
+        here — an unsampled root returns the null span, no context
+        activates, and nothing downstream (wire `_tp`, server spans,
+        stream tp, vote links) ever sees the op."""
+        if not self.enabled or self._rng.random() >= self.sample:
+            return _NULL
+        return _SpanCM(self, os.urandom(16).hex(), None, name, None,
+                       attrs)
+
+    def span(self, name: str, **attrs):
+        """Child of the thread's ambient span; null without one (so
+        instrumentation sites need no sampled/unsampled awareness)."""
+        if not self.enabled:
+            return _NULL
+        ctx = self._ctx()
+        if ctx is None:
+            return _NULL
+        return _SpanCM(self, ctx[0], ctx[1], name, None, attrs)
+
+    def span_from(self, traceparent, name: str,
+                  links: Optional[Sequence[str]] = None, **attrs):
+        """Child of an EXPLICIT remote parent (`traceparent` from a wire
+        frame or a stashed context), optionally linked into further
+        traces (`links`: traceparents of every op a batched operation
+        covers).  With no parseable parent but usable links, the span
+        roots itself in the first linked trace — a monitor-loop certify
+        sweep still lands in the traces it served."""
+        if not self.enabled:
+            return _NULL
+        link_ids = None
+        if links:
+            link_ids = [pc[0] for pc in
+                        (parse_traceparent(t) for t in links)
+                        if pc is not None]
+            link_ids = link_ids or None
+        pc = parse_traceparent(traceparent)
+        if pc is None:
+            if not link_ids:
+                return _NULL
+            return _SpanCM(self, link_ids[0], None, name, link_ids,
+                           attrs)
+        return _SpanCM(self, pc[0], pc[1], name, link_ids, attrs)
+
+    # -------------------------------------------------------------- flush
+    def flush(self, reason: str = "periodic") -> bool:
+        """Persist the ring atomically (tmp + rename — the flight
+        recorder discipline: a kill mid-flush leaves the previous
+        complete file, never a torn one)."""
+        if not self.path:
+            return False
+        with self._lock:
+            spans = list(self._ring)
+        header = {"type": "spans_header", "role": self.role,
+                  "pid": os.getpid(), "sample": self.sample,
+                  "anchor_wall": self.anchor_wall,
+                  "anchor_mono": self.anchor_mono,
+                  "reason": reason, "flushed_at": time.time(),
+                  "n_spans": len(spans)}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(json.dumps(header) + "\n")
+                for s in spans:
+                    fh.write(json.dumps(s) + "\n")
+            os.replace(tmp, self.path)
+            return True
+        except (OSError, TypeError, ValueError):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+
+    def _flush_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.flush("periodic")
+
+    # ------------------------------------------------------------ install
+    def install(self, role: str, out_dir: str, *, sample: float,
+                interval_s: float = 1.0) -> None:
+        """Arm the recorder: per-role spans file, periodic flusher, and a
+        terminal flush chained onto the flight recorder's SIGTERM /
+        excepthook / atexit paths (obs.flight.TERMINAL_FLUSHES) so a
+        terminated role loses at most one flush interval of tail.
+        BFLC_TRACE_LEGACY=1 or sample <= 0 leaves tracing pinned out."""
+        if trace_legacy() or sample <= 0.0:
+            return
+        os.makedirs(out_dir, exist_ok=True)
+        self.role = role
+        self.sample = min(float(sample), 1.0)
+        self.path = os.path.join(out_dir, f"{role}.spans.jsonl")
+        self.anchor_wall = time.time()
+        self.anchor_mono = time.monotonic()
+        self.enabled = True
+        if self._flusher is None:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, args=(interval_s,), daemon=True)
+            self._flusher.start()
+            import atexit
+
+            from bflc_demo_tpu.obs import flight
+            flight.TERMINAL_FLUSHES.append(
+                lambda: self.flush("terminal"))
+            atexit.register(lambda: self.flush("atexit"))
+        # file exists from role bring-up (even an instant kill leaves a
+        # parseable, possibly empty artifact)
+        self.flush("install")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self.enabled:
+            self.flush("close")
+        self.enabled = False
+
+
+#: process-wide recorder every instrumentation site consults.  Access as
+#: `trace.TRACE` (module attribute), never `from ... import TRACE`.
+TRACE = SpanRecorder()
+
+
+def server_span(msg: dict, name: str, links_key: str = "", **attrs):
+    """Serve-side adoption helper: a span parented on the request's
+    `_tp` wire context (and linked via `msg[links_key]` when given) —
+    the null span when tracing is off or the frame is untraced, so
+    dispatch loops call it unconditionally."""
+    if not TRACE.enabled:
+        return _NULL
+    links = msg.get(links_key) if links_key else None
+    return TRACE.span_from(msg.get("_tp"), name,
+                           links=links if isinstance(links, list)
+                           else None, **attrs)
+
+
+# ===================================================== offline analysis
+_CORE_KEYS = ("trace", "span", "parent", "role", "name", "t0", "t1",
+              "links")
+
+
+def load_spans(path: str) -> List[dict]:
+    """Parse one ``<role>.spans.jsonl``: spans with t0/t1 re-anchored to
+    WALL time via the header's (wall, mono) anchor pair, role attached.
+    Garbled lines are skipped (same tolerance as the flight loader)."""
+    out: List[dict] = []
+    header: Optional[dict] = None
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("type") == "spans_header":
+                    header = rec
+                    continue
+                if "trace" not in rec or "t0" not in rec:
+                    continue
+                out.append(rec)
+    except OSError:
+        return []
+    off = 0.0
+    if header is not None:
+        off = (float(header.get("anchor_wall", 0.0))
+               - float(header.get("anchor_mono", 0.0)))
+    for s in out:
+        s["t0"] = float(s["t0"]) + off
+        s["t1"] = float(s["t1"]) + off
+        s.setdefault("role", (header or {}).get("role", ""))
+    return out
+
+
+def gather_spans(telemetry_dir: str) -> List[dict]:
+    """Every span from every ``*.spans.jsonl`` in the telemetry dir —
+    the whole-fleet view the FleetCollector's artifact directory holds."""
+    spans: List[dict] = []
+    try:
+        names = sorted(os.listdir(telemetry_dir))
+    except OSError:
+        return spans
+    for n in names:
+        if n.endswith(".spans.jsonl"):
+            spans.extend(load_spans(os.path.join(telemetry_dir, n)))
+    return spans
+
+
+def assemble_traces(spans: Iterable[dict]) -> Dict[str, List[dict]]:
+    """{trace_id: [span, ...]} with multi-trace spans (batched votes /
+    certifies carrying `links`) attached to EVERY trace they served."""
+    traces: Dict[str, List[dict]] = {}
+    for s in spans:
+        traces.setdefault(s["trace"], []).append(s)
+        for lt in s.get("links") or ():
+            if lt != s["trace"]:
+                traces.setdefault(lt, []).append(s)
+    return traces
+
+
+def role_class(role: str) -> str:
+    """'client-7' -> 'client' (aggregation key); 'writer' -> 'writer'."""
+    head, sep, tail = role.rpartition("-")
+    return head if sep and tail.isdigit() else role
+
+
+def span_label(s: dict, full_role: bool = False) -> str:
+    role = s.get("role", "") if full_role else role_class(
+        s.get("role", ""))
+    name = s.get("name", "?")
+    if "method" in s:
+        name = f"{name}[{s['method']}]"
+    return f"{role}:{name}" if role else name
+
+
+def trace_role_classes(trace_spans: Iterable[dict]) -> List[str]:
+    return sorted({role_class(s.get("role", "")) for s in trace_spans})
+
+
+def critical_path(spans: List[dict], lo: float,
+                  hi: float) -> List[Tuple[str, float]]:
+    """Attribute every instant of [lo, hi] to the DEEPEST span active
+    then (children start after parents, so latest-start ~= deepest; ties
+    go to the shorter span).  Instants no span covers are ``(wait)``.
+    The segment durations therefore sum to exactly hi - lo — the
+    critical path is a partition of the round wall time, not a guess."""
+    if hi <= lo:
+        return []
+    cuts = {lo, hi}
+    for s in spans:
+        for t in (s["t0"], s["t1"]):
+            if lo < t < hi:
+                cuts.add(t)
+    bounds = sorted(cuts)
+    segs: List[Tuple[str, float]] = []
+    for a, b in zip(bounds, bounds[1:]):
+        active = [s for s in spans if s["t0"] <= a < s["t1"]]
+        if active:
+            deepest = max(active,
+                          key=lambda s: (s["t0"], s["t0"] - s["t1"]))
+            label = span_label(deepest, full_role=True)
+        else:
+            label = "(wait)"
+        if segs and segs[-1][0] == label:
+            segs[-1] = (label, segs[-1][1] + (b - a))
+        else:
+            segs.append((label, b - a))
+    return segs
+
+
+def _dedupe(spans: Iterable[dict]) -> List[dict]:
+    """Unique spans by span id (a linked span reached through several
+    traces must be walked once, not once per trace)."""
+    seen: Dict[str, dict] = {}
+    for s in spans:
+        seen.setdefault(s.get("span", id(s)), s)
+    return list(seen.values())
+
+
+def _upload_arrivals(trace_lists: List[Tuple[str, List[dict]]]
+                     ) -> Dict[str, float]:
+    """{client role: wall time its upload reached writer admission} for
+    each upload-op trace of one round — writer serve-span start when
+    present, else the client upload span's end."""
+    arrivals: Dict[str, float] = {}
+    for _tid, tspans in trace_lists:
+        root = next((s for s in tspans
+                     if s.get("name") == "client.upload_op"), None)
+        if root is None:
+            continue
+        t = None
+        for s in tspans:
+            if s.get("name") == "serve" and s.get("method") == "upload" \
+                    and role_class(s.get("role", "")) != "client":
+                t = s["t0"] if t is None else min(t, s["t0"])
+        if t is None:
+            ups = [s["t1"] for s in tspans if s.get("name") == "upload"]
+            t = min(ups) if ups else None
+        if t is not None:
+            prev = arrivals.get(root.get("role", "?"))
+            arrivals[root.get("role", "?")] = (
+                t if prev is None else min(prev, t))
+    return arrivals
+
+
+def round_reports(spans: Iterable[dict],
+                  faults: Optional[List[dict]] = None) -> List[dict]:
+    """Per-round reassembly: group traces by their root's `epoch` attr,
+    compute the round interval, the critical path, the straggler ranking
+    (upload arrival lag behind the round's first upload) and — when
+    chaos fault events are supplied — which segment each fault landed
+    in.  Returns reports sorted by epoch."""
+    traces = assemble_traces(spans)
+    by_epoch: Dict[int, List[Tuple[str, List[dict]]]] = {}
+    for tid, tspans in traces.items():
+        ep = None
+        for s in sorted(tspans, key=lambda s: s["t0"]):
+            if "epoch" in s:
+                ep = s["epoch"]
+                break
+        if ep is None:
+            continue
+        try:
+            by_epoch.setdefault(int(ep), []).append((tid, tspans))
+        except (TypeError, ValueError):
+            continue
+    reports: List[dict] = []
+    for ep in sorted(by_epoch):
+        trace_lists = by_epoch[ep]
+        allspans = _dedupe(s for _t, ts in trace_lists for s in ts)
+        lo = min(s["t0"] for s in allspans)
+        hi = max(s["t1"] for s in allspans)
+        segs = critical_path(allspans, lo, hi)
+        by_label: Dict[str, float] = {}
+        for label, dur in segs:
+            by_label[label] = by_label.get(label, 0.0) + dur
+        wait = by_label.get("(wait)", 0.0)
+        arrivals = _upload_arrivals(trace_lists)
+        first = min(arrivals.values()) if arrivals else 0.0
+        stragglers = sorted(((r, t - first)
+                             for r, t in arrivals.items()),
+                            key=lambda rt: -rt[1])
+        fault_hits: List[dict] = []
+        for f in faults or ():
+            t = f.get("t")
+            if not isinstance(t, (int, float)) or not lo <= t <= hi:
+                continue
+            active = [s for s in allspans if s["t0"] <= t < s["t1"]]
+            label = (span_label(max(active, key=lambda s: s["t0"]),
+                                full_role=True)
+                     if active else "(wait)")
+            fault_hits.append({"kind": f.get("kind"),
+                               "target": f.get("target"),
+                               "landed_in": label})
+        reports.append({
+            "epoch": ep, "t0": lo, "t1": hi,
+            "wall_s": hi - lo,
+            "segments": segs,
+            "by_label": by_label,
+            "covered_frac": (1.0 - wait / (hi - lo)) if hi > lo else 0.0,
+            "traces": len(trace_lists),
+            "stragglers": stragglers,
+            "faults": fault_hits,
+        })
+    return reports
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[k]
+
+
+def segment_stats(reports: List[dict]) -> Dict[str, dict]:
+    """Across-round distribution per segment label (role-CLASS labels so
+    20 clients aggregate into one row): total per round -> p50/p95."""
+    per: Dict[str, List[float]] = {}
+    for rep in reports:
+        acc: Dict[str, float] = {}
+        for label, dur in rep["segments"]:
+            cls = label
+            if ":" in label:
+                role, name = label.split(":", 1)
+                cls = f"{role_class(role)}:{name}"
+            acc[cls] = acc.get(cls, 0.0) + dur
+        for cls, tot in acc.items():
+            per.setdefault(cls, []).append(tot)
+    out: Dict[str, dict] = {}
+    for cls, vals in per.items():
+        vals.sort()
+        out[cls] = {"rounds": len(vals),
+                    "p50_s": _pctl(vals, 0.50),
+                    "p95_s": _pctl(vals, 0.95),
+                    "mean_s": sum(vals) / len(vals)}
+    return out
+
+
+def format_round_report(rep: dict, top: int = 6) -> str:
+    """One round's report as the text block trace_report / fleet_top
+    print."""
+    wall = rep["wall_s"]
+    lines = [f"round {rep['epoch']}: wall {wall:.3f}s  "
+             f"traces {rep['traces']}  "
+             f"attributed {rep['covered_frac']:.0%}"]
+    ranked = sorted(rep["by_label"].items(), key=lambda kv: -kv[1])
+    path = "  ".join(f"{label} {dur:.3f}s ({dur / wall:.0%})"
+                     for label, dur in ranked[:top] if wall)
+    lines.append(f"  critical path: {path}")
+    if rep["stragglers"]:
+        worst = ", ".join(f"{r} +{lag:.3f}s"
+                          for r, lag in rep["stragglers"][:5])
+        lines.append(f"  upload stragglers: {worst}")
+    for f in rep["faults"]:
+        lines.append(f"  fault {f['kind']} {f['target']} -> landed in "
+                     f"{f['landed_in']}")
+    return "\n".join(lines)

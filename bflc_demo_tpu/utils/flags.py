@@ -59,6 +59,13 @@ class RunOptions:
     # 0 (default, or BFLC_SNAPSHOT_LEGACY=1) pins replay-from-genesis.
     snapshot_interval: int = 0
     snapshot_dir: str = ""           # persist artifacts here (per role)
+    # processes runtime: fleet telemetry + causal op tracing (obs/).
+    # --telemetry-dir arms the scrape plane (metrics.jsonl + flight
+    # dumps there); --trace-sample P (0..1, needs --telemetry-dir) head-
+    # samples causal traces into <role>.spans.jsonl for
+    # tools/trace_report.py.  BFLC_TRACE_LEGACY=1 pins tracing out.
+    telemetry_dir: str = ""
+    trace_sample: float = 0.0
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 

@@ -356,6 +356,59 @@ class TestTelemetryRPCAndCollector:
                 v.close()
 
 
+class TestTraceZeroOverheadWhenOff:
+    """Sampling disabled (the default) must mean literally nothing on
+    the hot path: no span records, no context, no `_tp` wire bytes, and
+    the null span a shared singleton (no per-call allocation)."""
+
+    def test_disabled_recorder_allocates_and_sends_nothing(self):
+        from bflc_demo_tpu.comm import wire
+        from bflc_demo_tpu.obs import trace as obs_trace
+        t = obs_trace.TRACE
+        assert not t.enabled            # default in the test process
+        before = len(t._ring)
+        with t.start_trace("root", epoch=1) as sp:
+            sp["attr"] = "ignored"
+            with t.span("child"):
+                assert t.current_traceparent() is None
+        assert len(t._ring) == before
+        # the null span is ONE object, returned by every entry point
+        assert t.span("a") is t.start_trace("b") \
+            is t.span_from(None, "c") \
+            is obs_trace.server_span({"_tp": "x"}, "d")
+        # and the wire encoding is byte-identical to an untraced sender
+        with t.start_trace("root"):
+            assert wire._encode({"method": "m"}) == b'{"method":"m"}'
+
+    def test_upload_lag_histogram_writer_side(self, enabled_registry):
+        """Straggler-evidence satellite: every admitted upload observes
+        its lag behind the round's first admitted upload into
+        `upload_lag_seconds` (the async-aggregation baseline metric),
+        exported via the existing scrape."""
+        def lag_sample():
+            snap = obs_metrics.REGISTRY.snapshot()
+            m = snap["metrics"].get("upload_lag_seconds")
+            return (m or {}).get("samples") or [{"count": 0, "sum": 0.0}]
+
+        before = lag_sample()[0]["count"]
+        cfg, server, nodes, client = _mini_control_plane()
+        try:
+            s = lag_sample()[0]
+            # the mini plane admitted two uploads in epoch 0: the first
+            # observes lag 0, the second a tiny positive lag
+            assert s["count"] == before + 2
+            assert s["sum"] < 5.0       # both lags are sub-second
+            rec = FleetCollector(
+                {"writer": (server.host, server.port)}).scrape()
+            assert "upload_lag_seconds" in \
+                rec["roles"]["writer"]["metrics"]
+        finally:
+            client.close()
+            server.close()
+            for v in nodes:
+                v.close()
+
+
 class TestObserveFaultTimestamps:
     def test_schedule_relative_t_cannot_clobber_wall_clock(self,
                                                            tmp_path):

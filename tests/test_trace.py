@@ -1,0 +1,329 @@
+"""Causal op tracing (bflc_demo_tpu.obs.trace): recorder + context
+semantics, span-file durability, offline reassembly (multi-trace links,
+critical path, stragglers, fault attribution), and one end-to-end traced
+federation where a chaos delay fault targeting ONE client must be
+attributed to that client's segments by the report.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.obs import trace as obs_trace
+from bflc_demo_tpu.obs.trace import (SpanRecorder, assemble_traces,
+                                     critical_path, format_traceparent,
+                                     gather_spans, load_spans,
+                                     parse_traceparent, round_reports,
+                                     segment_stats, trace_role_classes)
+
+
+@pytest.fixture
+def rec():
+    r = SpanRecorder()
+    r.enabled = True
+    r.sample = 1.0
+    r.role = "tester"
+    return r
+
+
+class TestRecorder:
+    def test_disabled_recorder_records_and_propagates_nothing(self):
+        r = SpanRecorder()
+        with r.start_trace("root") as sp:
+            sp["k"] = 1                 # the sink accepts writes
+            with r.span("child"):
+                assert r.current_traceparent() is None
+        assert list(r._ring) == []
+
+    def test_null_span_is_a_shared_singleton(self):
+        """Zero-allocation contract for the off path: every disabled
+        entry point returns the SAME object."""
+        r = SpanRecorder()
+        assert r.span("a") is r.span("b") is r.start_trace("c") \
+            is r.span_from(None, "d")
+
+    def test_sample_zero_keeps_roots_unsampled(self, rec):
+        rec.sample = 0.0
+        with rec.start_trace("root"):
+            assert rec.current_traceparent() is None
+        assert list(rec._ring) == []
+
+    def test_root_child_linkage_and_context_restore(self, rec):
+        with rec.start_trace("root", epoch=3):
+            tp_root = rec.current_traceparent()
+            with rec.span("child"):
+                tp_child = rec.current_traceparent()
+            assert rec.current_traceparent() == tp_root
+        assert rec.current_traceparent() is None
+        spans = {s["name"]: s for s in rec._ring}
+        root, child = spans["root"], spans["child"]
+        assert root["parent"] is None and root["epoch"] == 3
+        assert child["trace"] == root["trace"]
+        assert child["parent"] == root["span"]
+        assert parse_traceparent(tp_root) == (root["trace"],
+                                              root["span"])
+        assert parse_traceparent(tp_child) == (child["trace"],
+                                               child["span"])
+        assert root["t0"] <= child["t0"] <= child["t1"] <= root["t1"]
+
+    def test_span_without_ambient_context_is_noop(self, rec):
+        with rec.span("orphan"):
+            pass
+        assert list(rec._ring) == []
+
+    def test_span_from_remote_parent_and_links(self, rec):
+        tp = format_traceparent("ab" * 16, "cd" * 8)
+        link = format_traceparent("11" * 16, "22" * 8)
+        with rec.span_from(tp, "serve", links=[link, None, "garbage"],
+                           method="upload"):
+            pass
+        s = list(rec._ring)[-1]
+        assert s["trace"] == "ab" * 16 and s["parent"] == "cd" * 8
+        assert s["links"] == ["11" * 16]
+        assert s["method"] == "upload"
+
+    def test_span_from_garbage_parent_without_links_is_noop(self, rec):
+        assert rec.span_from("not-a-traceparent", "x") is \
+            rec.span_from(None, "y")
+        with rec.span_from(17, "z"):
+            pass
+        assert list(rec._ring) == []
+
+    def test_span_from_links_only_roots_in_first_link(self, rec):
+        """A monitor-sweep certify has no ambient parent but still
+        belongs to the traces it served."""
+        link = format_traceparent("33" * 16, "44" * 8)
+        with rec.span_from(None, "bft.vote_rtt", links=[link]):
+            pass
+        s = list(rec._ring)[-1]
+        assert s["trace"] == "33" * 16 and s["parent"] is None
+
+    def test_contexts_are_thread_local(self, rec):
+        seen = {}
+
+        def other():
+            seen["tp"] = rec.current_traceparent()
+
+        with rec.start_trace("root"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen["tp"] is None
+
+    def test_trace_legacy_env_pins_install_off(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("BFLC_TRACE_LEGACY", "1")
+        r = SpanRecorder()
+        r.install("w", str(tmp_path), sample=1.0)
+        assert not r.enabled
+
+    def test_install_flush_load_roundtrip_with_wall_anchor(
+            self, tmp_path):
+        r = SpanRecorder()
+        r.install("w", str(tmp_path), sample=1.0)
+        try:
+            assert r.enabled
+            with r.start_trace("root", epoch=1):
+                time.sleep(0.01)
+            assert r.flush("test")
+            spans = load_spans(str(tmp_path / "w.spans.jsonl"))
+        finally:
+            r.close()
+        assert len(spans) == 1
+        s = spans[0]
+        assert s["role"] == "w" and s["name"] == "root"
+        # monotonic t0/t1 were re-anchored onto the wall clock
+        assert abs(s["t0"] - time.time()) < 60.0
+        assert s["t1"] - s["t0"] >= 0.008
+        assert gather_spans(str(tmp_path)) == spans
+
+
+def _mk(trace, role, name, t0, t1, parent=None, links=None, **attrs):
+    s = {"trace": trace, "span": f"{role}-{name}-{t0}",
+         "parent": parent, "role": role, "name": name,
+         "t0": float(t0), "t1": float(t1), **attrs}
+    if links:
+        s["links"] = links
+    return s
+
+
+class TestReassembly:
+    def test_linked_span_lands_in_every_trace(self):
+        spans = [_mk("A", "client-0", "client.upload_op", 0, 5),
+                 _mk("B", "client-1", "client.upload_op", 0, 6),
+                 _mk("A", "validator-0", "vote_batch", 2, 3,
+                     links=["A", "B"])]
+        traces = assemble_traces(spans)
+        assert {s["name"] for s in traces["A"]} == {"client.upload_op",
+                                                    "vote_batch"}
+        assert {s["name"] for s in traces["B"]} == {"client.upload_op",
+                                                    "vote_batch"}
+        assert trace_role_classes(traces["B"]) == ["client",
+                                                   "validator"]
+
+    def test_critical_path_partitions_the_interval_exactly(self):
+        spans = [_mk("A", "client-0", "client.upload_op", 0, 10),
+                 _mk("A", "client-0", "train", 1, 4),
+                 _mk("A", "writer", "serve", 6, 8, method="upload")]
+        segs = critical_path(spans, 0.0, 10.0)
+        assert sum(d for _l, d in segs) == pytest.approx(10.0)
+        labels = [l for l, _d in segs]
+        assert labels == ["client-0:client.upload_op", "client-0:train",
+                          "client-0:client.upload_op",
+                          "writer:serve[upload]",
+                          "client-0:client.upload_op"]
+        by = dict(segs[1:2])
+        assert by["client-0:train"] == pytest.approx(3.0)
+
+    def test_uncovered_time_becomes_wait(self):
+        spans = [_mk("A", "client-0", "train", 2, 4)]
+        segs = critical_path(spans, 0.0, 6.0)
+        assert segs == [("(wait)", pytest.approx(2.0)),
+                        ("client-0:train", pytest.approx(2.0)),
+                        ("(wait)", pytest.approx(2.0))]
+
+    def _round_spans(self):
+        # two upload traces in epoch 2: client-1 arrives 0.8s late
+        return [
+            _mk("A", "client-0", "client.upload_op", 0.0, 1.0, epoch=2),
+            _mk("A", "client-0", "upload", 0.4, 0.6, parent="p"),
+            _mk("A", "writer", "serve", 0.45, 0.55, method="upload"),
+            _mk("B", "client-1", "client.upload_op", 0.0, 2.0, epoch=2),
+            _mk("B", "client-1", "upload", 1.0, 1.4, parent="p"),
+            _mk("B", "writer", "serve", 1.25, 1.35, method="upload"),
+        ]
+
+    def test_round_report_wall_stragglers_and_coverage(self):
+        reps = round_reports(self._round_spans())
+        assert len(reps) == 1
+        rep = reps[0]
+        assert rep["epoch"] == 2
+        assert rep["wall_s"] == pytest.approx(2.0)
+        # segment partition: totals sum to the wall exactly
+        assert sum(rep["by_label"].values()) == pytest.approx(2.0)
+        assert rep["covered_frac"] == pytest.approx(1.0)
+        # straggler ranking off writer-admission arrival
+        assert rep["stragglers"][0][0] == "client-1"
+        assert rep["stragglers"][0][1] == pytest.approx(0.8)
+        assert rep["stragglers"][1] == ("client-0", pytest.approx(0.0))
+
+    def test_fault_attribution_names_the_active_segment(self):
+        faults = [{"t": 1.3, "kind": "delay", "target": "client-1"}]
+        rep = round_reports(self._round_spans(), faults=faults)[0]
+        assert rep["faults"] == [{"kind": "delay", "target": "client-1",
+                                  "landed_in": "writer:serve[upload]"}]
+        txt = obs_trace.format_round_report(rep)
+        assert "critical path" in txt and "delay client-1" in txt
+
+    def test_segment_stats_aggregate_role_classes(self):
+        reps = round_reports(self._round_spans())
+        stats = segment_stats(reps)
+        # client-0 and client-1 fold into one client: row
+        assert "client:client.upload_op" in stats
+        st = stats["client:client.upload_op"]
+        assert st["rounds"] == 1 and st["p50_s"] > 0
+
+
+class TestEndToEndTraced:
+    """The acceptance drill: a traced config-1-shaped federation (scaled
+    to tier-1 budget) with a chaos DELAY fault pinned on client-1's link
+    to the writer.  Every committed upload op must reassemble into a
+    trace spanning client + writer + validator + standby; the per-round
+    critical path must partition the round wall time; and the straggler
+    ranking must finger client-1."""
+
+    def test_traced_federation_reassembles_and_attributes_delay(
+            self, tmp_path):
+        from bflc_demo_tpu.chaos.schedule import FaultSchedule, WireWindow
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        from bflc_demo_tpu.data import iid_shards, load_occupancy
+        from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+        cfg = ProtocolConfig(client_num=4, comm_count=2,
+                             aggregate_count=2, needed_update_count=2,
+                             learning_rate=0.05, batch_size=32,
+                             local_epochs=2).validate()
+        xtr, ytr, xte, yte = load_occupancy()
+        shards = iid_shards(np.asarray(xtr), np.asarray(ytr),
+                            cfg.client_num)
+        sched = FaultSchedule(11, duration_s=150.0, n_clients=4,
+                              n_standbys=1, n_validators=2,
+                              profile="light")
+        sched.events = []               # no kills: the fault under test
+        sched.wire_windows = {          # is a pure targeted delay
+            "client-1": [WireWindow(0.0, 300.0, "delay", ("writer",),
+                                    p=1.0, delay_ms=250.0)],
+        }
+        tdir = str(tmp_path / "telemetry")
+        res = run_federated_processes(
+            "make_softmax_regression", shards,
+            (np.asarray(xte), np.asarray(yte)), cfg,
+            rounds=3, standbys=1, bft_validators=2,
+            chaos_schedule=sched, telemetry_dir=tdir,
+            trace_sample=1.0, timeout_s=300.0)
+        assert res.rounds_completed >= 3
+        assert res.chaos_report is not None
+        assert res.chaos_report["violations"] == []
+        tel = res.telemetry_report
+        assert tel is not None and tel["spans"], tel
+
+        spans = gather_spans(tdir)
+        roles = {s["role"] for s in spans}
+        assert any(r.startswith("client-") for r in roles), roles
+        assert "writer" in roles
+        assert any(r.startswith("validator-") for r in roles), roles
+        assert any(r.startswith("standby-") for r in roles), roles
+
+        # every committed upload op reassembles into a trace crossing
+        # >= 4 role classes (client, writer, validator, standby)
+        traces = assemble_traces(spans)
+        upload_traces = {
+            tid: ts for tid, ts in traces.items()
+            if any(s["name"] == "client.upload_op" for s in ts)}
+        assert upload_traces
+        four_role = [tid for tid, ts in upload_traces.items()
+                     if {"client", "writer", "validator", "standby"}
+                     <= set(trace_role_classes(ts))]
+        assert four_role, {
+            tid: trace_role_classes(ts)
+            for tid, ts in upload_traces.items()}
+
+        # per-round critical path: the segment partition must account
+        # for the round wall time (exact by construction; the 10%
+        # acceptance bar with slack for float noise)
+        reports = round_reports(spans)
+        assert reports, "no rounds reassembled"
+        for rep in reports:
+            assert sum(d for _l, d in rep["segments"]) == \
+                pytest.approx(rep["wall_s"], rel=0.10)
+            assert rep["covered_frac"] > 0.5, rep
+
+        # the chaos delay fault pinned on client-1 shows up as the
+        # straggler: in at least one round client-1 tops the upload-lag
+        # ranking with a lag the 250 ms/frame delay explains
+        tops = [rep["stragglers"][0] for rep in reports
+                if rep["stragglers"]]
+        assert any(role == "client-1" and lag > 0.2
+                   for role, lag in tops), tops
+
+        # the report tooling renders end to end
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        try:
+            import fleet_top
+            import trace_report
+        finally:
+            sys.path.pop(0)
+        report = trace_report.build_report(tdir)
+        assert report["n_traces"] >= len(upload_traces)
+        txt = trace_report.render(report)
+        assert "critical path" in txt and "stragglers" in txt
+        from bflc_demo_tpu.obs.collector import load_timeline
+        tl = load_timeline(tel["jsonl"])
+        timeline_txt = fleet_top.render_timeline(tl, spans_dir=tdir)
+        assert "critical paths" in timeline_txt

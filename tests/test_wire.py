@@ -12,6 +12,7 @@ silently skipped the fattest frames would be theater.
 import json
 import socket
 import struct
+import threading
 import time
 
 import pytest
@@ -20,6 +21,7 @@ from bflc_demo_tpu.chaos.hooks import FaultInjector
 from bflc_demo_tpu.comm import wire
 from bflc_demo_tpu.comm.wire import (MAX_FRAME, WireError, blob_bytes,
                                      recv_msg, send_msg)
+from bflc_demo_tpu.obs import trace as obs_trace
 
 
 @pytest.fixture
@@ -28,6 +30,21 @@ def pair():
     yield a, b
     a.close()
     b.close()
+
+
+@pytest.fixture
+def armed_trace():
+    """Arm the process-global span recorder for the test, restore after
+    (no flush path: context/propagation only)."""
+    t = obs_trace.TRACE
+    saved = (t.enabled, t.sample, t.role)
+    t.enabled, t.sample, t.role = True, 1.0, "test"
+    try:
+        yield t
+    finally:
+        t.enabled, t.sample, t.role = saved
+        t._ring.clear()
+        t._local = threading.local()
 
 
 class TestBinaryFrames:
@@ -260,6 +277,107 @@ class TestFrameCaps:
         a.sendall(struct.pack(">I", len(body)) + body)
         with pytest.raises(WireError, match="overruns"):
             recv_msg(b)
+
+
+class TestTraceparentOnWire:
+    """Causal trace context (obs.trace): while a sampled span is active,
+    every frame carries `_tp` — through the BIN1, legacy hex-JSON and
+    compressed variants unchanged — and an untraced peer just sees one
+    extra JSON key.  Sampling off ⇒ not a byte on the wire."""
+
+    def test_tp_rides_binary_frames(self, pair, armed_trace):
+        a, b = pair
+        blob = b"\xab" * 2000
+        with armed_trace.start_trace("root"):
+            tp = armed_trace.current_traceparent()
+            send_msg(a, {"method": "upload", "blob": blob})
+        m = recv_msg(b)
+        assert m["_tp"] == tp and m["blob"] == blob
+
+    def test_tp_rides_legacy_hex_json_frames(self, pair, armed_trace,
+                                             monkeypatch):
+        a, b = pair
+        monkeypatch.setattr(wire, "_JSON_ONLY", True)
+        with armed_trace.start_trace("root"):
+            tp = armed_trace.current_traceparent()
+            send_msg(a, {"method": "upload", "blob": b"\x05\x06"})
+        m = recv_msg(b)
+        assert m["_tp"] == tp
+        assert m["blob"] == "0506"      # really the legacy encoding
+
+    def test_tp_rides_compressed_frames(self, pair, armed_trace):
+        a, b = pair
+        blob = bytes(range(256)) * 400          # compressible
+        with armed_trace.start_trace("root"):
+            tp = armed_trace.current_traceparent()
+            body = wire._maybe_compress(wire._encode(
+                {"blob": blob, "_tp": tp}))
+            assert body[:5] in (wire._ZLIB_MAGIC, wire._ZSTD_MAGIC)
+            send_msg(a, {"method": "m", "blob": blob})
+        m = recv_msg(b)
+        assert m["_tp"] == tp and m["blob"] == blob
+
+    def test_untraced_peer_ignores_the_extra_key(self, pair,
+                                                 armed_trace):
+        """A traced frame against a peer that knows nothing about
+        tracing: the read dispatch answers normally (the `_tp` key is
+        inert data)."""
+        from bflc_demo_tpu.comm.dataplane import handle_read
+        a, b = pair
+        with armed_trace.start_trace("root"):
+            send_msg(a, {"method": "model", "meta": 1})
+        m = recv_msg(b)
+        assert "_tp" in m
+        r = handle_read(m["method"], m,
+                        blob_lookup=lambda d: None,
+                        model_state=lambda: (3, b"\0" * 32, b"x"))
+        assert r == {"ok": True, "epoch": 3, "hash": "00" * 32}
+
+    def test_no_tp_bytes_when_sampling_off(self, pair):
+        """The zero-overhead-off contract at the wire: the default
+        (disabled) recorder adds nothing — the encoded frame is
+        byte-identical to an untraced sender's."""
+        a, b = pair
+        assert not obs_trace.TRACE.enabled
+        with obs_trace.TRACE.start_trace("root"):
+            send_msg(a, {"method": "m", "x": 1})
+        m = recv_msg(b)
+        assert "_tp" not in m
+        assert wire._encode({"method": "m", "x": 1}) == \
+            json.dumps({"method": "m", "x": 1},
+                       separators=(",", ":")).encode()
+
+    def test_chaos_drop_still_fires_on_traced_frames(self, pair,
+                                                     armed_trace,
+                                                     monkeypatch):
+        a, b = pair
+        inj = FaultInjector({
+            "t0": time.time() - 1.0, "role": "test", "seed": 1,
+            "windows": [{"start": 0.0, "end": 3600.0, "mode": "drop",
+                         "ports": [], "p": 1.0}]})
+        monkeypatch.setattr(wire, "_INJECTOR", inj)
+        with armed_trace.start_trace("root"):
+            with pytest.raises(WireError, match="dropped"):
+                send_msg(a, {"method": "m", "blob": b"\x01" * 1000})
+        assert inj.injected["drop"] == 1
+
+    def test_chaos_delay_still_fires_on_traced_frames(self, pair,
+                                                      armed_trace,
+                                                      monkeypatch):
+        a, b = pair
+        inj = FaultInjector({
+            "t0": time.time() - 1.0, "role": "test", "seed": 1,
+            "windows": [{"start": 0.0, "end": 3600.0, "mode": "delay",
+                         "ports": [], "p": 1.0, "delay_ms": 30.0}]})
+        monkeypatch.setattr(wire, "_INJECTOR", inj)
+        t0 = time.perf_counter()
+        with armed_trace.start_trace("root"):
+            tp = armed_trace.current_traceparent()
+            send_msg(a, {"method": "m", "blob": b"\x03" * 10})
+        assert time.perf_counter() - t0 >= 0.025
+        monkeypatch.setattr(wire, "_INJECTOR", None)
+        m = recv_msg(b)
+        assert m["_tp"] == tp and m["blob"] == b"\x03" * 10
 
 
 class TestChaosOnBinaryFrames:

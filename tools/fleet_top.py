@@ -241,7 +241,7 @@ def _scrape_digest(rec) -> str:
     return "  ".join(bits) or "(quiet)"
 
 
-def render_timeline(timeline) -> str:
+def render_timeline(timeline, spans_dir: str = "") -> str:
     recs = [r for r in timeline
             if r.get("type") in ("scrape", "fault", "note")]
     if not recs:
@@ -263,7 +263,34 @@ def render_timeline(timeline) -> str:
         else:
             lines.append(f"+{dt:7.1f}s  scrape  "
                          f"[{r.get('tag')}] {_scrape_digest(r)}")
+    lines.extend(_critical_path_block(timeline, spans_dir))
     return "\n".join(lines)
+
+
+def _critical_path_block(timeline, spans_dir: str):
+    """Per-round critical paths from the run's causal traces
+    (obs.trace), appended under the fault/metric timeline so an
+    injected delay is read next to the segment it stretched.  Empty
+    when the run was untraced (no *.spans.jsonl in the dir)."""
+    if not spans_dir:
+        return []
+    try:
+        names = os.listdir(spans_dir)
+    except OSError:
+        return []
+    if not any(n.endswith(".spans.jsonl") for n in names):
+        return []
+    from bflc_demo_tpu.obs import trace as obs_trace
+    spans = obs_trace.gather_spans(spans_dir)
+    faults = [r for r in timeline if r.get("type") == "fault"]
+    reports = obs_trace.round_reports(spans, faults=faults)
+    if not reports:
+        return []
+    lines = ["", "critical paths (causal traces, tools/trace_report.py "
+                 "for the full view):"]
+    for rep in reports:
+        lines.append(obs_trace.format_round_report(rep))
+    return lines
 
 
 def main(argv=None) -> int:
@@ -287,7 +314,9 @@ def main(argv=None) -> int:
         return 2
 
     if args.timeline:
-        print(render_timeline(load_timeline(path)))
+        print(render_timeline(load_timeline(path),
+                              spans_dir=os.path.dirname(
+                                  os.path.abspath(path))))
         return 0
     if args.once:
         print(render_once(load_timeline(path)))

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""trace_report: reassemble causal op traces and print round critical
+paths (obs.trace).
+
+Input is a telemetry directory (a federation run with
+`telemetry_dir=... , trace_sample>0`, or `--telemetry-dir/--trace-sample`
+from the CLI): every role flushed its spans to `<role>.spans.jsonl`
+there, and `metrics.jsonl` (when present) supplies chaos fault markers
+and the writer's upload-lag histogram for cross-checking.
+
+Per round the report answers *why was this round slow*:
+
+- the **critical path**: every instant of the round attributed to the
+  deepest span active then (segment sums equal the round wall time by
+  construction — attribution, not estimation);
+- the **straggler ranking**: each client's upload admission lag behind
+  the round's first upload, read off the traces and cross-checked
+  against the writer's `upload_lag_seconds` histogram;
+- **fault attribution**: which segment each chaos fault landed in.
+
+Usage:
+    python tools/trace_report.py <telemetry_dir> [--round N] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bflc_demo_tpu.obs import trace as obs_trace            # noqa: E402
+from bflc_demo_tpu.obs.collector import load_timeline      # noqa: E402
+
+
+def _writer_upload_lag(timeline):
+    """(count, mean_s, p95ish_s) from the newest scrape's writer
+    `upload_lag_seconds` histogram, or None — the metric-side view the
+    trace ranking is cross-checked against."""
+    for rec in reversed(timeline):
+        if rec.get("type") != "scrape":
+            continue
+        snap = (rec.get("roles") or {}).get("writer")
+        if not snap:
+            continue
+        m = (snap.get("metrics") or {}).get("upload_lag_seconds")
+        if not m or not m.get("samples"):
+            continue
+        s = m["samples"][0]
+        count = s.get("count", 0)
+        if not count:
+            return None
+        p95 = None
+        thresh = 0.95 * count
+        for le, cum in s.get("buckets", {}).items():
+            if cum >= thresh:
+                p95 = float("inf") if le == "+Inf" else float(le)
+                break
+        return {"count": count, "mean_s": s.get("sum", 0.0) / count,
+                "p95_le_s": p95}
+    return None
+
+
+def build_report(telemetry_dir: str) -> dict:
+    """The whole artifact as one dict: per-round reports, across-round
+    segment stats, and the metric cross-check."""
+    spans = obs_trace.gather_spans(telemetry_dir)
+    timeline = load_timeline(os.path.join(telemetry_dir,
+                                          "metrics.jsonl"))
+    faults = [r for r in timeline if r.get("type") == "fault"]
+    reports = obs_trace.round_reports(spans, faults=faults)
+    return {
+        "telemetry_dir": telemetry_dir,
+        "n_spans": len(spans),
+        "n_traces": len(obs_trace.assemble_traces(spans)),
+        "rounds": reports,
+        "segment_stats": obs_trace.segment_stats(reports),
+        "writer_upload_lag": _writer_upload_lag(timeline),
+    }
+
+
+def render(report: dict, only_round=None) -> str:
+    lines = [f"{report['n_traces']} traces / {report['n_spans']} spans "
+             f"from {report['telemetry_dir']}"]
+    if not report["rounds"]:
+        lines.append("no reassembled rounds (tracing off, sample too "
+                     "low, or no spans flushed)")
+        return "\n".join(lines)
+    for rep in report["rounds"]:
+        if only_round is not None and rep["epoch"] != only_round:
+            continue
+        lines.append(obs_trace.format_round_report(rep))
+    stats = sorted(report["segment_stats"].items(),
+                   key=lambda kv: -kv[1]["p95_s"])
+    lines.append("per-segment totals across rounds (p50/p95):")
+    for label, st in stats[:12]:
+        lines.append(f"  {label:<32} {st['p50_s']:7.3f}s /"
+                     f" {st['p95_s']:7.3f}s  ({st['rounds']} rounds)")
+    lag = report.get("writer_upload_lag")
+    if lag:
+        # the metric-side cross-check of the trace-side straggler
+        # ranking: same distribution, independently measured
+        p95 = lag["p95_le_s"]
+        lines.append(
+            f"writer upload_lag_seconds histogram: {lag['count']} "
+            f"uploads, mean {lag['mean_s']:.3f}s, p95 bucket <= "
+            f"{'inf' if p95 in (None, float('inf')) else f'{p95:.3g}s'}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="telemetry dir holding *.spans.jsonl")
+    ap.add_argument("--round", type=int, default=None,
+                    help="only this round's critical path")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+    path = args.path
+    if os.path.isfile(path):
+        path = os.path.dirname(os.path.abspath(path))
+    if not os.path.isdir(path):
+        print(f"no such telemetry dir: {path}", file=sys.stderr)
+        return 2
+    report = build_report(path)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report, only_round=args.round))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
