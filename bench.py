@@ -42,7 +42,11 @@ plus the single-tier leg's multiple (eval.benchmarks.hier_scaling; the
 full 1k->10k artifact is TPU_RESULTS.md round 11).  `extra.rejoin`
 (PR 7) is the certified-snapshot rejoin axis: cold replay-from-genesis
 vs snapshot state-sync wall time for a joiner at a few-hundred-round
-chain (eval.benchmarks.rejoin_config1).
+chain (eval.benchmarks.rejoin_config1).  `extra.async_agg` (PR 9) is
+the async buffered-aggregation axis: sync vs async round throughput +
+time-to-accuracy under the heavytail straggler chaos profile
+(eval.benchmarks.async_agg_config1; the full config-1 artifact with
+critical-path evidence is TPU_RESULTS.md round 14).
 BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
@@ -253,6 +257,28 @@ def _child() -> None:
         # few-hundred-round chain (eval.benchmarks.rejoin_config1)
         from bflc_demo_tpu.eval.benchmarks import rejoin_config1
         extra["rejoin"] = rejoin_config1(rounds=300)
+        # async buffered aggregation (PR 9): sync vs async legs under
+        # the heavytail straggler chaos profile — this is the
+        # bench-budget twin (8 clients, short legs); the full config-1
+        # artifact with the trace evidence is TPU_RESULTS.md round 14
+        from bflc_demo_tpu.eval.benchmarks import async_agg_config1
+        aa = async_agg_config1(rounds=3, async_rounds=9, buffer_k=4,
+                               clients=8, trace_sample=0.0,
+                               timeout_s=420)
+        extra["async_agg"] = {
+            "round_throughput_speedup": aa.get(
+                "round_throughput_speedup"),
+            "time_to_acc_target": aa.get("time_to_acc_target"),
+            "time_to_acc_speedup": aa.get("time_to_acc_speedup"),
+            "sync_round_wall_time_s": aa["sync"]["round_wall_time_s"],
+            "async_round_wall_time_s": aa["async"][
+                "round_wall_time_s"],
+            "sync_best_acc": aa["sync"]["best_acc"],
+            "async_best_acc": aa["async"]["best_acc"],
+            "chaos_violations": (aa["sync"]["chaos_violations"] or [])
+            + (aa["async"]["chaos_violations"] or []),
+            "geometry": aa["geometry"],
+        }
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),
         # measurable on CPU with no tunnel: one 50-round config-1 campaign

@@ -288,6 +288,7 @@ class InvariantMonitor:
         from bflc_demo_tpu.ledger.tool import decode_op
         records = set()
         open_hashes = []                # uploads after the last commit
+        open_async = []                 # async-buffered uploads (FIFO)
         for op in self._ops:
             if not op:
                 continue
@@ -301,13 +302,33 @@ class InvariantMonitor:
                     continue
             elif op[0] == 4:            # commit opcode closes the round
                 open_hashes = []
+            elif op[0] == 10:           # async upload (base-epoch keyed)
+                try:
+                    d = decode_op(op)
+                    records.add((d["sender"], int(d["epoch"]),
+                                 d["payload_hash"]))
+                    open_async.append(d["payload_hash"])
+                except (KeyError, ValueError):
+                    continue
+            elif op[0] == 12:           # async commit drains oldest k
+                try:
+                    k = int(decode_op(op)["drained"])
+                except (KeyError, ValueError):
+                    k = len(open_async)
+                del open_async[:k]
         ok = True
         for a in acked:
             if self._base_epoch is not None and \
-                    int(a["epoch"]) < self._base_epoch:
+                    (a.get("async") or
+                     int(a["epoch"]) < self._base_epoch):
                 # the upload's record went with the GC'd prefix; the
                 # certified snapshot IS the proof its round survived
-                # (the quorum re-derived the state those uploads built)
+                # (the quorum re-derived the state those uploads built).
+                # An async ack's epoch is its BASE epoch — it orders
+                # nothing about the op's chain position, so once a
+                # snapshot base is installed no async record can be
+                # proven missing by this walk (the snapshot state
+                # carried any still-buffered entries)
                 continue
             key = (a["addr"], int(a["epoch"]), a["hash"])
             if key not in records:
@@ -323,6 +344,30 @@ class InvariantMonitor:
                 self._flag(f"open-round upload {h[:12]} has no "
                            f"fetchable payload blob")
                 ok = False
+        if open_async:
+            # async entries that looked open at our chain snapshot may
+            # have DRAINED since (stall recovery keeps aggregating
+            # during this walk, and a drain drops the payload blob):
+            # an unfetchable blob is only a violation while the entry
+            # is still buffered — otherwise its round settled, the
+            # certified acommit op is the durability proof
+            try:
+                au = probe.request("aupdates")
+            except (ConnectionError, OSError):
+                return "SKIP(writer unreachable)"
+            live = {u.get("hash") for u in au.get("updates", [])} \
+                if au.get("ok") else set(open_async)
+            for h in open_async:
+                if h not in live:
+                    continue
+                try:
+                    r = probe.request("blob", hash=h)
+                except (ConnectionError, OSError):
+                    return "SKIP(writer unreachable)"
+                if not r.get("ok"):
+                    self._flag(f"buffered async upload {h[:12]} has "
+                               f"no fetchable payload blob")
+                    ok = False
         return "PASS" if ok else "FAIL"
 
 

@@ -58,6 +58,27 @@ PROFILES: Dict[str, Dict[str, float]] = {
                   drop_every=20.0, drop_len=(3.0, 7.0), drop_p=0.3,
                   standby_partitions=2, tear_wal_p=0.7,
                   restart_after=(2.0, 6.0)),
+    # the STRAGGLER regime (not a kill regime): every client gets one
+    # persistent coordinator-bound delay for the whole campaign, drawn
+    # from a seeded lognormal — a few clients land deep in the tail and
+    # pace every synchronous round (the distribution production FL
+    # reports: Bonawitz 2019 §straggler/over-selection; FedBuff's
+    # motivating regime).  The async-aggregation benchmark runs its
+    # sync-vs-async legs under exactly this profile; no kills, no
+    # partitions, so the measured delta is pure round-barrier cost.
+    "heavytail": dict(client_kill_every=0.0, validator_kill_every=0.0,
+                      standby_kill_every=0.0, writer_kills=0,
+                      partition_every=0.0, partition_len=(0.0, 0.0),
+                      delay_every=0.0, delay_len=(0.0, 0.0),
+                      delay_ms=(0.0, 0.0), delay_p=0.0,
+                      drop_every=0.0, drop_len=(0.0, 0.0), drop_p=0.0,
+                      standby_partitions=0, tear_wal_p=0.0,
+                      restart_after=(2.0, 5.0),
+                      # lognormal(ln(median), sigma) per-client frame
+                      # delay, clamped at cap — median 40 ms, sigma 1.4
+                      # puts the p95 client near ~400 ms/frame
+                      heavytail_median_ms=40.0, heavytail_sigma=1.4,
+                      heavytail_cap_ms=1500.0),
 }
 
 
@@ -148,6 +169,25 @@ class FaultSchedule:
         hi = max(lo, self.duration_s * (1.0 - settle_frac))
         f = max((self.n_validators - 1) // 3, 0)
         restart_lo, restart_hi = p["restart_after"]
+
+        if "heavytail_median_ms" in p:
+            # heavy-tailed straggler regime: ONE whole-campaign delay
+            # window per client toward the coordinator side, lognormal
+            # per-client magnitude (seeded — the same seed always ranks
+            # the same clients as stragglers).  No settle tail: the
+            # delay is the environment, not a fault to recover from.
+            import math
+            coordinator_roles = tuple(
+                ["writer"] + [f"standby-{k}"
+                              for k in range(1, self.n_standbys + 1)])
+            mu = math.log(max(p["heavytail_median_ms"], 1e-3))
+            for c in range(self.n_clients):
+                delay = min(rng.lognormvariate(mu, p["heavytail_sigma"]),
+                            p["heavytail_cap_ms"])
+                self._add_window(f"client-{c}", WireWindow(
+                    lo, self.duration_s, "delay", coordinator_roles,
+                    p=1.0, delay_ms=delay))
+            return
 
         def restart_delay():
             return rng.uniform(restart_lo, restart_hi)

@@ -185,6 +185,168 @@ def _sign(wallet, kind: str, epoch: int, payload: bytes) -> str:
                                  payload)).hex()
 
 
+def _client_async_loop(client, router, wallet, model, template, cfg,
+                       xj, yj, x: np.ndarray, rounds: int,
+                       crash_at_epoch: Optional[int],
+                       ack_log_path: str) -> None:
+    """The async-mode client body (FedBuff; see _client_proc's branch).
+
+    Trainers: fetch -> train -> aupload(base_epoch) continuously, one
+    in-flight delta per fetched model version.  Committee: fetch the
+    buffered candidate set (aupdates) -> score the unscored ones on the
+    local shard -> ascores (aseq, score) pairs.  Same trace roots and
+    phase metrics as the synchronous loop so tools/trace_report.py and
+    fleet_top read both modes identically."""
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from bflc_demo_tpu.core.local_train import local_train
+    from bflc_demo_tpu.core.scoring import score_candidates
+    from bflc_demo_tpu.comm.identity import _op_bytes
+    from bflc_demo_tpu.ledger.base import ascores_sign_payload
+    from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+                                                   pack_pytree,
+                                                   pack_quantized,
+                                                   unpack_pytree,
+                                                   restore_pytree)
+
+    uploaded_base = cfg.initial_trained_epoch
+    scored_aseqs: set = set()
+    known_log = 0
+    while True:
+        st = client.request("state", addr=wallet.address)
+        epoch = st["epoch"]
+        if epoch >= rounds or epoch > cfg.max_epoch:
+            break
+        if crash_at_epoch is not None and 0 <= crash_at_epoch <= epoch:
+            os._exit(17)        # simulated hard crash
+        if epoch < 0:           # registration phase
+            known_log = client.request("wait", log_size=known_log,
+                                       timeout_s=2.0)["log_size"]
+            continue
+        acted = False
+        if st["role"] == "trainer":
+          with obs_trace.TRACE.start_trace("client.upload_op",
+                                           epoch=epoch):
+            with obs_trace.TRACE.span("fetch"), \
+                    _M_PHASE.time(phase="fetch"):
+                mr = router.fetch_model()
+            if not mr.get("ok"):
+                continue
+            base_epoch = int(mr["epoch"])
+            if base_epoch <= uploaded_base:
+                # our delta for this model version is already in flight
+                # (or admitted): wait for the chain to move instead of
+                # re-deriving the identical delta
+                known_log = client.request(
+                    "wait", log_size=known_log,
+                    timeout_s=2.0)["log_size"]
+                continue
+            params = restore_pytree(template, unpack_pytree(mr["blob"]))
+            with obs_trace.TRACE.span("train"), \
+                    _M_PHASE.time(phase="train"):
+                delta, cost = local_train(
+                    model.apply, params, xj, yj, lr=cfg.learning_rate,
+                    batch_size=cfg.batch_size,
+                    local_epochs=cfg.local_epochs)
+            blob = (pack_pytree(delta) if cfg.delta_dtype == "f32"
+                    else pack_quantized(delta, cfg.delta_dtype))
+            digest = hashlib.sha256(blob).digest()
+            router.cache.put(digest.hex(), blob)
+            n = int(x.shape[0])
+            payload = digest + struct.pack("<qd", n, float(cost))
+            with obs_trace.TRACE.span("upload"), \
+                    _M_PHASE.time(phase="upload"):
+                r = client.request(
+                    "aupload", addr=wallet.address, blob=blob,
+                    hash=digest.hex(), n=n, cost=float(cost),
+                    base_epoch=base_epoch,
+                    tag=_sign(wallet, "aupload", base_epoch, payload))
+            if r.get("status") in ("OK", "DUPLICATE"):
+                uploaded_base = base_epoch
+                acted = r.get("ok", False)
+                if r.get("ok"):
+                    _M_ACTIONS.inc(action="upload")
+            # CAP_REACHED / WRONG_EPOCH: buffer full or our base went
+            # over the staleness cap mid-flight — refetch and retrain
+            if r.get("ok") and ack_log_path:
+                with open(ack_log_path, "a") as fh:
+                    fh.write(_json.dumps(
+                        {"addr": wallet.address, "epoch": base_epoch,
+                         "hash": digest.hex(), "n": n,
+                         "cost": float(cost), "async": 1}) + "\n")
+            if r.get("status") == "BAD_ARG":
+                # directory-hole self-heal (same as the sync loop)
+                client.request("register", addr=wallet.address,
+                               pubkey=wallet.public_bytes.hex(),
+                               tag=_sign(wallet, "register", 0, b""))
+        elif st["role"] == "comm":
+            au = client.request("aupdates")
+            ups = [u for u in au.get("updates", [])
+                   if u["aseq"] not in scored_aseqs]
+            if ups:
+              with obs_trace.TRACE.start_trace("client.score_op",
+                                               epoch=epoch):
+                with obs_trace.TRACE.span("fetch"):
+                    try:
+                        fetched = router.fetch_blobs(
+                            [u["hash"] for u in ups])
+                    except (LookupError, ConnectionError):
+                        # an entry drained (its blob went with it)
+                        # between aupdates and the fetch: re-poll
+                        continue
+                    deltas = [restore_pytree(
+                                  template,
+                                  dequantize_entries(
+                                      unpack_pytree(
+                                          fetched[u["hash"]])))
+                              for u in ups]
+                    mr = router.fetch_model()
+                if not mr.get("ok"):
+                    continue
+                params = restore_pytree(template,
+                                        unpack_pytree(mr["blob"]))
+                t_score = (time.perf_counter()
+                           if obs_metrics.REGISTRY.enabled else 0.0)
+                with obs_trace.TRACE.span("score"):
+                    stacked = jax.tree_util.tree_map(
+                        lambda *t: jnp.stack(t), *deltas)
+                    scores = score_candidates(model.apply, params,
+                                              stacked,
+                                              cfg.learning_rate, xj, yj)
+                score_list = [float(s) for s in
+                              np.nan_to_num(np.asarray(scores), nan=0.0,
+                                            posinf=1.0, neginf=0.0)]
+                pairs = [(int(u["aseq"]), s)
+                         for u, s in zip(ups, score_list)]
+                with obs_trace.TRACE.span("submit"):
+                    r = client.request(
+                        "ascores", addr=wallet.address,
+                        pairs=[[a, s] for a, s in pairs],
+                        tag=wallet.sign(_op_bytes(
+                            "ascores", wallet.address, 0,
+                            ascores_sign_payload(pairs))).hex())
+                if t_score:
+                    _M_PHASE.observe(time.perf_counter() - t_score,
+                                     phase="score")
+                if r.get("status") in ("OK", "NOT_READY", "DUPLICATE"):
+                    # NOT_READY = every scored entry drained first —
+                    # either way these aseqs never need scoring again
+                    scored_aseqs.update(u["aseq"] for u in ups)
+                    acted = r.get("ok", False)
+                    if r.get("ok"):
+                        _M_ACTIONS.inc(action="score")
+                if r.get("status") == "BAD_ARG":
+                    client.request("register", addr=wallet.address,
+                                   pubkey=wallet.public_bytes.hex(),
+                                   tag=_sign(wallet, "register", 0, b""))
+        if not acted:
+            known_log = client.request("wait", log_size=known_log,
+                                       timeout_s=2.0)["log_size"]
+
+
 def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                  model_factory: str, factory_kw: dict,
                  x: np.ndarray, y_onehot: np.ndarray, cfg_kw: dict,
@@ -245,6 +407,7 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     # hash-verified and the coordinator stays the correctness fallback
     router = ReadRouter(client, timeout_s=request_timeout_s,
                         tls=_client_tls(tls_dir))
+    from bflc_demo_tpu.ledger.base import async_enabled
     reg_deadline = time.monotonic() + 120.0
     while True:
         reply = client.request("register", addr=wallet.address,
@@ -261,6 +424,22 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
             time.sleep(0.5)
             continue
         raise RuntimeError(f"register failed: {reply}")
+
+    if async_enabled(cfg):
+        # asynchronous buffered aggregation (--async-buffer K): no round
+        # barrier.  A trainer trains against WHATEVER model it last
+        # fetched and uploads with that base epoch (one in-flight delta
+        # per model version — the writer stamps staleness at admission);
+        # a committee member scores every buffered candidate it hasn't
+        # scored yet, no epoch gate on submit.  Stragglers therefore
+        # never hold a round open: their deltas land late with a
+        # staleness tag and a discounted weight instead.
+        _client_async_loop(client, router, wallet, model, template, cfg,
+                           xj, yj, x, rounds, crash_at_epoch,
+                           ack_log_path)
+        router.close()
+        client.close()
+        return
 
     trained_epoch = scored_epoch = cfg.initial_trained_epoch
     known_log = 0
@@ -583,6 +762,12 @@ def run_federated_processes(
     BFLC_SNAPSHOT_LEGACY=1) pins the replay-from-genesis behavior.
     snapshot_dir: persist snapshot artifacts under per-role subdirs
     (writer/, standby-N/) — tmp-then-rename, newest two retained.
+
+    Async buffered aggregation rides the PROTOCOL genome, not a driver
+    flag: cfg.async_buffer = K > 0 (CLI --async-buffer) switches every
+    role — writer admission/trigger, validators, standbys, clients —
+    into the FedBuff mode (ledger.base.async_enabled;
+    BFLC_ASYNC_LEGACY=1 pins it off fleet-wide).
     """
     cfg.validate()
     if len(shards) != cfg.client_num:

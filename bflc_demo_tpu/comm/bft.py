@@ -110,8 +110,10 @@ class PrefixCompacted(Exception):
 _CERT_MAGIC = b"BFLCCERT1"
 _EMPTY_HEAD = b"\0" * 32        # head digest of the empty chain (log_head())
 
-# ledger op codec (must match pyledger/ledger.cpp opcode table)
+# ledger op codec (must match pyledger/ledger.cpp opcode table);
+# 10/11 are the async buffered-aggregation client ops (ledger.base)
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES = 1, 2, 3
+_OP_AUPLOAD, _OP_ASCORES = 10, 11
 
 # --- validator-side telemetry (obs.metrics; no-ops unless the process
 # registry is enabled): vote latency by transport shape, refusals by
@@ -251,6 +253,18 @@ def expected_op_hash(method: str, fields: dict) -> Optional[bytes]:
         elif method == "scores":
             op = encode_scores_op(fields["addr"], int(fields["epoch"]),
                                   [float(s) for s in fields["scores"]])
+        elif method == "aupload":
+            from bflc_demo_tpu.ledger.base import encode_aupload_op
+            op = encode_aupload_op(fields["addr"],
+                                   bytes.fromhex(fields["hash"]),
+                                   int(fields["n"]),
+                                   float(fields["cost"]),
+                                   int(fields["base_epoch"]))
+        elif method == "ascores":
+            from bflc_demo_tpu.ledger.base import encode_ascores_op
+            op = encode_ascores_op(
+                fields["addr"],
+                [(int(a), float(s)) for a, s in fields["pairs"]])
         else:
             return None
         return hashlib.sha256(op).digest()
@@ -276,7 +290,8 @@ def check_op_auth(op: bytes, auth: Optional[dict],
     re-execution (`validate_op`), the same authority split the
     AuthenticatedLedger applies.
     """
-    if not op or op[0] not in (_OP_REGISTER, _OP_UPLOAD, _OP_SCORES):
+    if not op or op[0] not in (_OP_REGISTER, _OP_UPLOAD, _OP_SCORES,
+                               _OP_AUPLOAD, _OP_ASCORES):
         return ""
     if not isinstance(auth, dict):
         return "client op without auth evidence"
@@ -318,7 +333,10 @@ def check_op_auth(op: bytes, auth: Optional[dict],
                                                     b""), tag):
                 return "register: bad tag"
             return ""
-        if op[0] == _OP_UPLOAD:
+        if op[0] in (_OP_UPLOAD, _OP_AUPLOAD):
+            # async upload shares the upload layout; the trailing epoch
+            # is the BASE epoch the tag binds (kind "aupload")
+            kind = "upload" if op[0] == _OP_UPLOAD else "aupload"
             sender, off = _str_at(0)
             payload_hash = body[off:off + 32]
             ns, = struct.unpack_from("<q", body, off + 32)
@@ -326,16 +344,42 @@ def check_op_auth(op: bytes, auth: Optional[dict],
             epoch, = struct.unpack_from("<q", body, off + 44)
             n, cost = int(auth["n"]), float(auth["cost"])
             if n != ns:
-                return "upload: n_samples mismatch"
+                return f"{kind}: n_samples mismatch"
             if struct.pack("<f", np.float32(cost)) != \
                     struct.pack("<f", cost_f32):
-                return "upload: cost not the f32 image of the signed value"
+                return f"{kind}: cost not the f32 image of the signed value"
             payload = payload_hash + struct.pack("<qd", n, cost)
             _tofu_repair(sender)
-            if not directory.verify(sender, _op_bytes("upload", sender,
+            if not directory.verify(sender, _op_bytes(kind, sender,
                                                       epoch, payload), tag):
-                return (f"upload: bad tag (sender {sender[:12]}, "
+                return (f"{kind}: bad tag (sender {sender[:12]}, "
                         f"epoch {epoch}, "
+                        f"known={directory.knows(sender)})")
+            return ""
+        if op[0] == _OP_ASCORES:
+            from bflc_demo_tpu.ledger.base import ascores_sign_payload
+            sender, off = _str_at(0)
+            cnt, = struct.unpack_from("<q", body, off)
+            if cnt <= 0 or off + 8 + 12 * cnt > len(body):
+                return "ascores: malformed op"
+            pairs = [(int(a), float(s)) for a, s in auth["pairs"]]
+            if len(pairs) != cnt:
+                return "ascores: pair count mismatch"
+            p = off + 8
+            for aseq, claimed in pairs:
+                got_a, = struct.unpack_from("<q", body, p)
+                got_s, = struct.unpack_from("<f", body, p + 8)
+                if got_a != aseq or struct.pack(
+                        "<f", np.float32(claimed)) != \
+                        struct.pack("<f", got_s):
+                    return ("ascores: pairs not the f32 image of the "
+                            "signed values")
+                p += 12
+            _tofu_repair(sender)
+            if not directory.verify(
+                    sender, _op_bytes("ascores", sender, 0,
+                                      ascores_sign_payload(pairs)), tag):
+                return (f"ascores: bad tag (sender {sender[:12]}, "
                         f"known={directory.knows(sender)})")
             return ""
         # _OP_SCORES

@@ -153,7 +153,7 @@ class FailoverClient:
     failure and the client rotates/raises instead of trusting it.
     """
 
-    _BFT_ACKED = ("register", "upload", "scores")
+    _BFT_ACKED = ("register", "upload", "scores", "aupload", "ascores")
 
     def __init__(self, endpoints: List[Endpoint], timeout_s: float = 30.0,
                  max_cycles: int = 6, tls=None,
@@ -917,7 +917,7 @@ class Standby:
         writer authoritatively reports it unknown (False — apply with a
         clamped ack), or the writer dies (raises WriterDead — the op must
         NOT apply, or a promoted chain would hold a blob-less record)."""
-        if not op_bytes or op_bytes[0] != self._UPLOAD_OPCODE:
+        if not op_bytes or op_bytes[0] not in self._PAYLOAD_OPCODES:
             return True
         while not self._stop.is_set():
             self._blob_unknown = False
@@ -939,9 +939,26 @@ class Standby:
         if not self._pending_payload:
             return
         from bflc_demo_tpu.ledger.tool import decode_op
+        buffered = None
         for i in list(self._pending_payload):
+            op = self._pending_payload[i]
+            if op and op[0] == 10:      # async upload (ledger.base)
+                # moot once the entry drained from the admission buffer
+                # (its base epoch says nothing — buffered entries
+                # legitimately outlive epochs)
+                if buffered is None:
+                    view = getattr(self.ledger, "async_buffer_view",
+                                   lambda: [])()
+                    buffered = {e.payload_hash for e in view}
+                try:
+                    ph = bytes.fromhex(decode_op(op)["payload_hash"])
+                except (KeyError, ValueError):
+                    ph = None
+                if ph is None or ph not in buffered:
+                    del self._pending_payload[i]
+                continue
             try:
-                ep = int(decode_op(self._pending_payload[i])["epoch"])
+                ep = int(decode_op(op)["epoch"])
             except (KeyError, ValueError):
                 ep = None
             if ep is None or ep < self.ledger.epoch:
@@ -1000,6 +1017,12 @@ class Standby:
 
     _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
     _COMMIT_OPCODE = 4
+    # async buffered aggregation (ledger.base): the payload/model blob
+    # mirroring paths treat the async twins exactly like their sync
+    # originals — an aupload references a payload blob, an acommit a new
+    # model blob
+    _PAYLOAD_OPCODES = (2, 10)
+    _MODEL_OPCODES = (4, 12)
 
     def _harvest_pushed_blob(self, msg: dict, op_bytes: bytes) -> None:
         """Mirror an op-stream frame's piggybacked blob iff it hashes to
@@ -1012,7 +1035,7 @@ class Standby:
         if blob_field is None or not op_bytes:
             return
         from bflc_demo_tpu.ledger.tool import decode_op
-        if op_bytes[0] == self._COMMIT_OPCODE:
+        if op_bytes[0] in self._MODEL_OPCODES:
             try:
                 blob = blob_bytes(blob_field)
                 mh = bytes.fromhex(decode_op(op_bytes)["model_hash"])
@@ -1021,7 +1044,7 @@ class Standby:
             if hashlib.sha256(blob).digest() == mh:
                 self._model_blob = blob
             return
-        if op_bytes[0] != self._UPLOAD_OPCODE:
+        if op_bytes[0] not in self._PAYLOAD_OPCODES:
             return
         try:
             blob = blob_bytes(blob_field)
@@ -1038,7 +1061,7 @@ class Standby:
         `_sync_state`'s scan).  True = nothing to do or blob mirrored;
         False = this op's payload is still missing (caller withholds the
         quorum ack).  Non-upload ops always return True."""
-        if not op_bytes or op_bytes[0] != self._UPLOAD_OPCODE:
+        if not op_bytes or op_bytes[0] not in self._PAYLOAD_OPCODES:
             return True
         from bflc_demo_tpu.ledger.tool import decode_op
         try:

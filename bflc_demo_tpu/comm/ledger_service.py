@@ -47,7 +47,8 @@ from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.utils import tracing
-from bflc_demo_tpu.ledger import make_ledger, LedgerStatus
+from bflc_demo_tpu.ledger import (async_enabled, make_ledger,
+                                  LedgerStatus)
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
 from bflc_demo_tpu.utils.serialization import (dequantize_entries,
                                                pack_entries, unpack_pytree)
@@ -108,6 +109,21 @@ _M_UPLOAD_LAG = obs_metrics.REGISTRY.histogram(
     "upload_lag_seconds",
     "per-round client upload admission lag behind the round's first "
     "admitted upload")
+# --- async buffered aggregation (--async-buffer K; FedBuff): buffer
+# occupancy sampled at scrape time, the staleness distribution of every
+# admitted delta (epochs behind the current model at admission), and the
+# aggregation counter whose timeline slope IS aggregations/sec —
+# rendered by tools/fleet_top.py and tools/profile_round.py.
+_G_ABUF_DEPTH = obs_metrics.REGISTRY.gauge(
+    "async_buffer_depth",
+    "staleness-tagged deltas currently buffered (async mode)")
+_M_ASTALENESS = obs_metrics.REGISTRY.histogram(
+    "async_admitted_staleness",
+    "staleness (epochs) of each admitted async delta",
+    buckets=(0, 1, 2, 3, 5, 8, 13, 21, float("inf")))
+_M_AAGG = obs_metrics.REGISTRY.counter(
+    "async_aggregations_total",
+    "buffered aggregations committed (async mode)")
 
 _PROMO_MAGIC = b"BFLCPROM1"
 
@@ -209,15 +225,19 @@ def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
 
 def _aggregate_flat(global_flat: Dict[str, np.ndarray],
                     delta_flats: List[Dict[str, np.ndarray]],
-                    n_samples: List[int], selected: List[int],
+                    weights: List[float], selected: List[int],
                     lr: float) -> Dict[str, np.ndarray]:
     """Server-side FedAvg on flat entries: global -= lr * weighted mean of
     the selected deltas (CommitteePrecompiled.cpp:403-414 semantics, the
     same arithmetic `core.aggregate.apply_selection` implements on device —
-    numpy float32 here so the coordinator needs no accelerator)."""
+    numpy float32 here so the coordinator needs no accelerator).
+
+    `weights` is the per-delta merge weight: n_samples on the sync path,
+    n_samples * 1/sqrt(1+staleness) on the async buffered path
+    (ledger.base.staleness_weight) — one arithmetic, two weightings."""
     w = np.zeros(len(delta_flats), np.float32)
     for s in selected:
-        w[s] = float(n_samples[s])
+        w[s] = float(weights[s])
     wsum = max(float(w.sum()), 1e-12)
     out: Dict[str, np.ndarray] = {}
     for key, g in global_flat.items():
@@ -437,6 +457,16 @@ class LedgerServer:
         # the unchanged single-tier server.
         self._cell_registry: Optional[Dict[str, Tuple[int, int]]] = (
             dict(cell_registry) if cell_registry is not None else None)
+        # asynchronous buffered aggregation (--async-buffer K; FedBuff on
+        # the certified op stream): the writer admits staleness-tagged
+        # deltas at any time (aupload), committee members score the
+        # buffer with no epoch gate (ascores), and every K admissions the
+        # oldest k entries aggregate with staleness-discounted weights —
+        # all as ops in the certified total order, so validators/standbys
+        # re-derive the same buffer and async stays no-fork by
+        # construction.  False (K=0 or BFLC_ASYNC_LEGACY=1) pins the
+        # synchronous round barrier byte-for-byte.
+        self._async = async_enabled(cfg)
         if bft_validators:
             from bflc_demo_tpu.comm.bft import CertificateAssembler
             from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
@@ -924,6 +954,8 @@ class LedgerServer:
 
     _UPLOAD_OPCODE = 2          # ledger op codec (ledger/tool.decode_op)
     _COMMIT_OPCODE = 4
+    _AUPLOAD_OPCODE = 10        # async twins (ledger.base)
+    _ACOMMIT_OPCODE = 12
 
     def _op_payload_blob(self, op: bytes) -> Optional[bytes]:
         """The blob a streamed op references, when this writer still
@@ -937,7 +969,7 @@ class LedgerServer:
         layout."""
         if not op:
             return None
-        if op[0] == self._COMMIT_OPCODE:
+        if op[0] in (self._COMMIT_OPCODE, self._ACOMMIT_OPCODE):
             if data_plane_legacy():
                 return None
             from bflc_demo_tpu.ledger.tool import decode_op
@@ -948,7 +980,7 @@ class LedgerServer:
             with self._lock:
                 return self._model_blob if self._model_hash == mh \
                     else None
-        if op[0] != self._UPLOAD_OPCODE:
+        if op[0] not in (self._UPLOAD_OPCODE, self._AUPLOAD_OPCODE):
             return None
         from bflc_demo_tpu.ledger.tool import decode_op
         try:
@@ -1069,8 +1101,14 @@ class LedgerServer:
     def _consume_tag(self, epoch: int, tag_hex: str) -> None:
         if not self.require_auth:
             return
-        self._replay.consume(self.ledger.epoch, epoch,
-                             bytes.fromhex(tag_hex))
+        # async mode prunes with the staleness floor, not the current
+        # epoch: a sync-path consume here (e.g. a mid-run register)
+        # must not drop the aupload tag buckets inside the staleness
+        # window, or a pruned-then-replayed signed aupload would
+        # re-enter the buffer as a fresh delta
+        floor = (self.ledger.epoch - self.cfg.max_staleness
+                 if self._async else self.ledger.epoch)
+        self._replay.consume(floor, epoch, bytes.fromhex(tag_hex))
 
     def _charge_gas(self, addr: str, cost: int) -> bool:
         """Debit `cost` from addr's current-epoch budget; False = broke.
@@ -1113,7 +1151,7 @@ class LedgerServer:
     _OUT_OF_GAS = {"ok": False, "status": "OUT_OF_GAS",
                    "error": "per-epoch storage budget exhausted"}
 
-    _MUTATING = ("register", "upload", "scores")
+    _MUTATING = ("register", "upload", "scores", "aupload", "ascores")
 
     def _dispatch(self, method: str, m: dict) -> dict:
         with self._lock:            # RLock: the inner re-acquires freely
@@ -1207,6 +1245,14 @@ class LedgerServer:
                 return {"ok": True, "role": role, "epoch": epoch,
                         "round_closed": self.ledger.round_closed}
             if method == "upload":
+                if self._async:
+                    # one protocol per chain: a client whose local
+                    # BFLC_ASYNC_LEGACY disagrees with the fleet's must
+                    # not interleave synchronous rounds into an async
+                    # chain (it would silently inflate every buffered
+                    # entry's staleness)
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "async mode is on: use aupload"}
                 addr = m["addr"]
                 blob = blob_bytes(m["blob"])
                 digest = hashlib.sha256(blob).digest()
@@ -1282,7 +1328,27 @@ class LedgerServer:
                 return {"ok": True, "updates": [
                     {"sender": u.sender, "hash": u.payload_hash.hex(),
                      "n": u.n_samples, "cost": u.avg_cost} for u in ups]}
+            if method == "aupload":
+                return self._dispatch_aupload(m)
+            if method == "aupdates":
+                # the async committee's scoring surface: every buffered
+                # candidate with its admission id + staleness tag
+                if not self._async:
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "async mode is off"}
+                return {"ok": True, "epoch": self.ledger.epoch,
+                        "updates": [
+                            {"aseq": e.aseq, "sender": e.sender,
+                             "hash": e.payload_hash.hex(),
+                             "n": e.n_samples, "cost": e.avg_cost,
+                             "staleness": e.staleness}
+                            for e in self.ledger.async_buffer_view()]}
+            if method == "ascores":
+                return self._dispatch_ascores(m)
             if method == "scores":
+                if self._async:
+                    return {"ok": False, "status": "BAD_ARG",
+                            "error": "async mode is on: use ascores"}
                 addr = m["addr"]
                 scores = [float(s) for s in m["scores"]]
                 payload = struct.pack(f"<{len(scores)}d", *scores)
@@ -1329,6 +1395,9 @@ class LedgerServer:
                          "certified_size": (self._certified_size
                                             if self._bft is not None
                                             else None)}
+                if self._async:
+                    reply["async_buffer_depth"] = \
+                        self.ledger.async_buffer_depth
                 snap = self._snapshot_offer()
                 if snap is not None:
                     reply["snapshot_epoch"] = snap["epoch"]
@@ -1394,6 +1463,9 @@ class LedgerServer:
                                       else self.ledger.log_size()))
                     _G_SUBS.set(len(self._sub_acked))
                     _G_LOG_BASE.set(getattr(self.ledger, "log_base", 0))
+                    if self._async:
+                        _G_ABUF_DEPTH.set(
+                            self.ledger.async_buffer_depth)
                     snap = self._snapshot_offer()
                     _G_SNAP_AGE.set(self.ledger.epoch - snap["epoch"]
                                     if snap is not None else -1)
@@ -1415,6 +1487,192 @@ class LedgerServer:
                     self._cv.wait(timeout=remaining)
                 return {"ok": True, "log_size": self.ledger.log_size()}
             return {"ok": False, "error": f"unknown method {method!r}"}
+
+    # --------------------------------------- async buffered aggregation
+    def _seen_in_window(self, tag: bytes) -> bool:
+        """Replay check across the staleness window: async score tags
+        are bucketed by the ledger epoch AT ADMISSION (their signed
+        payload carries no epoch), so a replayed tag can only hide by
+        claiming a different bucket — scan the whole live window."""
+        ep = self.ledger.epoch
+        return any(self._replay.seen(e, tag)
+                   for e in range(max(ep - self.cfg.max_staleness, 0),
+                                  ep + 1))
+
+    def _dispatch_aupload(self, m: dict) -> dict:
+        """Admit a staleness-tagged delta into the async buffer — no
+        epoch gate: the op carries the BASE epoch the client trained
+        from, admission stamps s = epoch_now - base_epoch (capped at
+        cfg.max_staleness), and the K-th admission triggers a buffered
+        aggregation.  Mirrors the sync upload path's auth/gas/schema
+        order exactly."""
+        if not self._async:
+            return {"ok": False, "status": "BAD_ARG",
+                    "error": "async mode is off (--async-buffer 0 or "
+                             "BFLC_ASYNC_LEGACY=1)"}
+        addr = m["addr"]
+        base_epoch = int(m["base_epoch"])
+        blob = blob_bytes(m["blob"])
+        digest = hashlib.sha256(blob).digest()
+        if digest.hex() != m["hash"]:
+            return {"ok": False, "status": "BAD_ARG",
+                    "error": "blob/hash mismatch"}
+        payload = digest + struct.pack("<qd", int(m["n"]),
+                                       float(m["cost"]))
+        v = self._verify("aupload", addr, base_epoch, payload,
+                         m.get("tag", ""))
+        if v != LedgerStatus.OK:
+            if v == LedgerStatus.DUPLICATE:
+                self._resupply_async_blob(digest, blob)
+            return {"ok": False, "status": v.name,
+                    "error": "bad signature" if
+                    v == LedgerStatus.BAD_ARG else "replayed tag"}
+        if not self._charge_gas(addr, GAS_UPLOAD_BASE + len(blob)):
+            return dict(self._OUT_OF_GAS)
+        err = self._delta_shape_error(blob)
+        if err:
+            return {"ok": False, "status": "BAD_ARG", "error": err}
+        st = self.ledger.async_upload(addr, digest, int(m["n"]),
+                                      float(m["cost"]), base_epoch)
+        if st == LedgerStatus.OK:
+            self._blobs[digest] = blob
+            if self.require_auth:
+                # prune floor = epoch - max_staleness: a tag bucket must
+                # outlive every base epoch the staleness cap still
+                # admits, or a pruned-then-replayed op would re-enter
+                # the buffer as a fresh delta
+                self._replay.consume(
+                    self.ledger.epoch - self.cfg.max_staleness,
+                    base_epoch, bytes.fromhex(m.get("tag", "")))
+            self._op_auth[self.ledger.log_size() - 1] = {
+                "tag": m.get("tag", ""), "n": int(m["n"]),
+                "cost": float(m["cost"]),
+                "pubkey": self._sender_pubkey_hex(addr)}
+            if obs_metrics.REGISTRY.enabled:
+                _M_ASTALENESS.observe(
+                    self.ledger.epoch - base_epoch)
+        elif st == LedgerStatus.DUPLICATE:
+            self._resupply_async_blob(digest, blob)
+        self._touch(addr)
+        self._note_progress(st)
+        reply = {"ok": st == LedgerStatus.OK, "status": st.name,
+                 "epoch": self.ledger.epoch}
+        if st == LedgerStatus.OK and \
+                self.ledger.async_buffer_depth >= self.cfg.async_buffer:
+            # the K-th admission: aggregate INSIDE the request (lock
+            # held) so the committed epoch rides this ack and the
+            # trigger is deterministic in the op order
+            self._async_aggregate_and_commit()
+            reply["epoch"] = self.ledger.epoch
+        return reply
+
+    def _dispatch_ascores(self, m: dict) -> dict:
+        """Committee scores over buffered candidates — (aseq, score)
+        pairs, no epoch gate on submit (the admission id IS the
+        binding; pairs for entries already drained are skipped
+        deterministically by the ledger)."""
+        if not self._async:
+            return {"ok": False, "status": "BAD_ARG",
+                    "error": "async mode is off"}
+        addr = m["addr"]
+        try:
+            pairs = [(int(a), float(s)) for a, s in m["pairs"]]
+        except (TypeError, ValueError):
+            return {"ok": False, "status": "BAD_ARG",
+                    "error": "malformed pairs"}
+        from bflc_demo_tpu.ledger.base import ascores_sign_payload
+        payload = ascores_sign_payload(pairs)
+        if self.require_auth:
+            tag = bytes.fromhex(m.get("tag", ""))
+            if not self.directory.verify(
+                    addr, _op_bytes("ascores", addr, 0, payload), tag):
+                return {"ok": False, "status": "BAD_ARG",
+                        "error": "bad signature"}
+            if self._seen_in_window(tag):
+                return {"ok": False, "status": "DUPLICATE",
+                        "error": "replayed tag"}
+        if not self._charge_gas(addr, GAS_SCORES):
+            return dict(self._OUT_OF_GAS)
+        st = self.ledger.async_scores(addr, pairs)
+        if st == LedgerStatus.OK:
+            if self.require_auth:
+                self._replay.consume(
+                    self.ledger.epoch - self.cfg.max_staleness,
+                    self.ledger.epoch, bytes.fromhex(m.get("tag", "")))
+            self._op_auth[self.ledger.log_size() - 1] = {
+                "tag": m.get("tag", ""),
+                "pairs": [[a, s] for a, s in pairs],
+                "pubkey": self._sender_pubkey_hex(addr)}
+        self._touch(addr)
+        self._note_progress(st)
+        return {"ok": st == LedgerStatus.OK, "status": st.name,
+                "epoch": self.ledger.epoch}
+
+    def _resupply_async_blob(self, digest: bytes, blob: bytes) -> None:
+        """Async twin of _resupply_blob: re-accept a hash-verified
+        payload for a BUFFERED entry this writer lacks the blob for
+        (promoted-standby window)."""
+        if digest in self._blobs:
+            return
+        if any(e.payload_hash == digest
+               for e in self.ledger.async_buffer_view()):
+            self._blobs[digest] = blob
+
+    def _async_aggregate_and_commit(self) -> None:
+        """Drain the oldest k buffered entries with staleness-discounted
+        weights (FedBuff: n_samples / sqrt(1 + s)) and commit — the
+        async analogue of _aggregate_and_commit, caller holds the
+        lock."""
+        k = min(self.ledger.async_buffer_depth, self.cfg.async_buffer)
+        if k <= 0:
+            return
+        t0 = time.perf_counter() if tracing.PROC.enabled else 0.0
+        with obs_trace.TRACE.span("aggregate", epoch=self.ledger.epoch,
+                                  mode="async"):
+            entries, selected, weights, _ = \
+                self.ledger.async_selection(k)
+            epoch = self.ledger.epoch
+            global_flat = unpack_pytree(self._model_blob)
+            delta_flats = [dequantize_entries(
+                               unpack_pytree(
+                                   self._blobs[e.payload_hash]))
+                           for e in entries]
+            new_flat = _aggregate_flat(global_flat, delta_flats,
+                                       weights, list(selected),
+                                       self.cfg.learning_rate)
+            blob = pack_entries(new_flat)
+            digest = hashlib.sha256(blob).digest()
+            st = self.ledger.async_commit(digest, epoch, k)
+            if st != LedgerStatus.OK:
+                raise RuntimeError(f"async commit rejected: {st.name}")
+            for e in entries:
+                self._blobs.pop(e.payload_hash, None)
+            self._model_blob = blob
+            self._model_hash = digest
+            self._model_schema = {key: (a.shape, a.dtype)
+                                  for key, a in new_flat.items()}
+            self._rounds_completed += 1
+            self._last_progress = time.monotonic()
+            if self._snap_interval and \
+                    self.ledger.epoch % self._snap_interval == 0:
+                self._emit_snapshot()
+            self._cv.notify_all()
+        if tracing.PROC.enabled:
+            tracing.PROC.charge("aggregate_s",
+                                time.perf_counter() - t0)
+        if obs_metrics.REGISTRY.enabled:
+            _M_AAGG.inc()
+        obs_flight.FLIGHT.record(
+            "event", "async_round_committed", epoch=epoch, drained=k,
+            max_staleness=max((e.staleness for e in entries),
+                              default=0),
+            loss=float(self.ledger.last_global_loss))
+        if self.verbose:
+            print(f"[coordinator] epoch {epoch} async-aggregated "
+                  f"({k} deltas, stalest "
+                  f"{max((e.staleness for e in entries), default=0)}): "
+                  f"loss={self.ledger.last_global_loss:.5f}",
+                  flush=True)
 
     def _sender_pubkey_hex(self, addr: str) -> str:
         """The sender's enrolled public key (hex, '' when unknown) — the
@@ -1757,6 +2015,18 @@ class LedgerServer:
 
     def _recover(self) -> None:
         led = self.ledger
+        if self._async:
+            # async stall: the buffer sat below K for stall_timeout_s
+            # (e.g. the fleet's tail as clients exit) — drain what's
+            # there so buffered work is never stranded (the async
+            # analogue of close_round + force_aggregate)
+            if led.async_buffer_depth > 0:
+                if self.verbose:
+                    print(f"[coordinator] recovery: async partial "
+                          f"aggregate of {led.async_buffer_depth} "
+                          f"buffered deltas@{led.epoch}", flush=True)
+                self._async_aggregate_and_commit()
+            return
         if led.aggregate_ready():
             self._aggregate_and_commit()
             return

@@ -66,6 +66,13 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
     # an error, not a run without them (mirrors the CLI's guards)
     inapplicable = []
     if runtime != "processes":
+        # async buffered aggregation is a process-runtime protocol mode
+        # (cfg.async_buffer > 0): the mesh/host/threaded runtimes drive
+        # the synchronous round loop and would silently ignore it
+        from bflc_demo_tpu.ledger.base import async_enabled
+        if async_enabled(cfg):
+            inapplicable += [("async_buffer (protocol)",
+                              cfg.async_buffer)]
         inapplicable += [("standbys", standbys), ("quorum", quorum),
                          ("bft_validators", bft_validators),
                          ("chaos_seed", chaos_seed is not None),
@@ -116,13 +123,16 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
             # process deployment.  Standbys/quorum/chaos_seed belong to
             # the single-tier runtime (the hier driver takes an explicit
             # chaos_schedule instead); never silently drop them.
+            from bflc_demo_tpu.ledger.base import async_enabled
             dropped = [n for n, v in (("standbys", standbys),
                                       ("quorum", quorum),
                                       ("tls_dir", tls_dir),
                                       ("chaos_seed",
                                        chaos_seed is not None),
                                       ("snapshot_interval",
-                                       snapshot_interval)) if v]
+                                       snapshot_interval),
+                                      ("async_buffer (protocol)",
+                                       async_enabled(cfg))) if v]
             if dropped:
                 raise ValueError(f"options {dropped} are not supported "
                                  f"with --cells/--cell-size")

@@ -9,17 +9,31 @@ surface and produce byte-identical op logs; replicas replay op streams with
 from __future__ import annotations
 
 from bflc_demo_tpu.ledger.base import (  # noqa: F401
-    LedgerStatus, UpdateInfo, PendingInfo, ADDR_CAP)
+    LedgerStatus, UpdateInfo, PendingInfo, AsyncUpdateInfo, ADDR_CAP,
+    async_enabled, async_legacy, staleness_weight)
 from bflc_demo_tpu.ledger.pyledger import PyLedger  # noqa: F401
 from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
 
 
 def make_ledger(cfg: ProtocolConfig = DEFAULT_PROTOCOL, *,
                 backend: str = "auto"):
-    """Create a committee ledger. backend: 'auto' | 'native' | 'python'."""
+    """Create a committee ledger. backend: 'auto' | 'native' | 'python'.
+
+    Async buffered aggregation (cfg.async_buffer > 0, unless
+    BFLC_ASYNC_LEGACY pins it off) needs the python backend: the native
+    ledger has no async-op ABI, and gating here — the one construction
+    point — keeps every role (writer, validators, standbys, replicas)
+    on a backend that can apply the op family."""
     cfg.validate()
     args = (cfg.client_num, cfg.comm_count, cfg.aggregate_count,
             cfg.needed_update_count, cfg.genesis_epoch)
+    if async_enabled(cfg):
+        if backend == "native":
+            raise ValueError(
+                "async_buffer > 0 needs the python ledger backend (the "
+                "native ledger has no async-op ABI)")
+        return PyLedger(*args, async_buffer=cfg.async_buffer,
+                        max_staleness=cfg.max_staleness)
     if backend in ("auto", "native"):
         from bflc_demo_tpu.ledger import bindings
         if bindings.native_available():

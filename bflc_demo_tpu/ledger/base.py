@@ -16,16 +16,46 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import math
+import os
 import struct
-from typing import List, Sequence
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
 ADDR_CAP = 128   # max address string length crossing the C ABI (incl. NUL)
 
 # op codec opcodes (pyledger mirrors ledger.cpp's table; the full set
-# lives there — only the client-originated three need shared encoders)
+# lives there — only the client-originated ones need shared encoders)
 OP_REGISTER, OP_UPLOAD, OP_SCORES = 1, 2, 3
+# asynchronous buffered aggregation (FedBuff on the certified op stream;
+# ProtocolConfig.async_buffer): a python-backend-only op family — the
+# native ledger never applies these (make_ledger gates them out), so the
+# C++ opcode table stays untouched and chain-compatible for sync chains.
+OP_AUPLOAD, OP_ASCORES, OP_ACOMMIT = 10, 11, 12
+
+
+def async_legacy() -> bool:
+    """True when BFLC_ASYNC_LEGACY pins the synchronous round barrier
+    regardless of ProtocolConfig.async_buffer (the benchmark's sync
+    baseline switch)."""
+    return bool(os.environ.get("BFLC_ASYNC_LEGACY"))
+
+
+def async_enabled(cfg) -> bool:
+    """The ONE decision point for the async buffered mode: a positive
+    buffer size in the protocol genome AND no legacy pin.  Shared by
+    make_ledger, the writer, the clients and the tools so no layer can
+    disagree about which protocol is running."""
+    return getattr(cfg, "async_buffer", 0) > 0 and not async_legacy()
+
+
+def staleness_weight(staleness: int) -> float:
+    """FedBuff's default staleness discount 1/sqrt(1+s) (Nguyen et al.
+    2022, PAPERS.md §async) — THE one definition: writer aggregation,
+    replica loss re-derivation and the benchmarks all call here, so the
+    certified arithmetic cannot drift between them."""
+    return 1.0 / math.sqrt(1.0 + max(int(staleness), 0))
 
 
 def _put_str(b: bytearray, s: str) -> None:
@@ -61,6 +91,45 @@ def encode_scores_op(sender: str, epoch: int,
     return bytes(op)
 
 
+def encode_aupload_op(sender: str, payload_hash: bytes, n_samples: int,
+                      avg_cost: float, base_epoch: int) -> bytes:
+    """Async upload: like OP_UPLOAD but the trailing epoch is the BASE
+    epoch the client trained from — admission stamps staleness
+    s = epoch_now - base_epoch at apply time, which is deterministic on
+    every replica because ops apply in the one certified total order."""
+    op = bytearray([OP_AUPLOAD])
+    _put_str(op, sender)
+    op += bytes(payload_hash)
+    op += struct.pack("<q", n_samples)
+    op += struct.pack("<f", np.float32(avg_cost))
+    op += struct.pack("<q", base_epoch)
+    return bytes(op)
+
+
+def encode_ascores_op(sender: str,
+                      pairs: Sequence[Tuple[int, float]]) -> bytes:
+    """Async committee scores: (buffer admission seq, score) pairs — no
+    epoch gate, the buffer entry id IS the binding.  Pairs for entries
+    already drained are skipped deterministically at apply time."""
+    op = bytearray([OP_ASCORES])
+    _put_str(op, sender)
+    op += struct.pack("<q", len(pairs))
+    for aseq, s in pairs:
+        op += struct.pack("<q", int(aseq))
+        op += struct.pack("<f", np.float32(s))
+    return bytes(op)
+
+
+def ascores_sign_payload(pairs: Sequence[Tuple[int, float]]) -> bytes:
+    """The f64 payload an async score tag signs (clients sign f64, the
+    op stores f32 — comm.bft.check_op_auth pins the quantisation, the
+    same care the sync scores path takes)."""
+    b = bytearray()
+    for aseq, s in pairs:
+        b += struct.pack("<qd", int(aseq), float(s))
+    return bytes(b)
+
+
 class LedgerStatus(enum.IntEnum):
     OK = 0
     NOT_STARTED = 1        # registration phase (epoch at genesis sentinel)
@@ -80,6 +149,18 @@ class UpdateInfo:
     payload_hash: bytes
     n_samples: int
     avg_cost: float
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncUpdateInfo:
+    """One staleness-tagged entry in the async admission buffer."""
+    aseq: int                  # admission sequence number (chain-global)
+    sender: str
+    payload_hash: bytes
+    n_samples: int
+    avg_cost: float
+    base_epoch: int            # epoch of the model the client trained on
+    staleness: int             # epoch_at_admission - base_epoch
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,13 +16,20 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from bflc_demo_tpu.ledger.base import (LedgerStatus, PendingInfo,
-                                       UpdateInfo, encode_register_op,
-                                       encode_scores_op, encode_upload_op)
+from bflc_demo_tpu.ledger.base import (AsyncUpdateInfo, LedgerStatus,
+                                       PendingInfo, UpdateInfo,
+                                       encode_aupload_op,
+                                       encode_ascores_op,
+                                       encode_register_op,
+                                       encode_scores_op, encode_upload_op,
+                                       staleness_weight)
 
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES, _OP_COMMIT = 1, 2, 3, 4
 _OP_CLOSE, _OP_FORCE, _OP_RESEAT, _OP_PROMOTE = 5, 6, 7, 8
 _OP_SNAPSHOT = 9
+# asynchronous buffered aggregation (FedBuff op family — python backend
+# only; ledger/base.py OP_AUPLOAD/OP_ASCORES/OP_ACOMMIT)
+_OP_AUPLOAD, _OP_ASCORES, _OP_ACOMMIT = 10, 11, 12
 
 
 def _put_str(b: bytearray, s: str) -> None:
@@ -34,12 +41,22 @@ class PyLedger:
     backend = "python"
 
     def __init__(self, client_num: int, comm_count: int, aggregate_count: int,
-                 needed_update_count: int, genesis_epoch: int = -999):
+                 needed_update_count: int, genesis_epoch: int = -999,
+                 async_buffer: int = 0, max_staleness: int = 20):
         self.client_num = client_num
         self.comm_count = comm_count
         self.aggregate_count = aggregate_count
         self.needed_update_count = needed_update_count
         self.genesis_epoch = genesis_epoch
+        # asynchronous buffered aggregation (ProtocolConfig.async_buffer,
+        # FedBuff): async_buffer = K > 0 arms the OP_AUPLOAD/OP_ASCORES/
+        # OP_ACOMMIT family; 0 refuses those ops so a synchronous chain
+        # can never contain them (the byte-for-byte legacy pin)
+        self.async_buffer = max(int(async_buffer), 0)
+        self.max_staleness = max(int(max_staleness), 0)
+        self._abuf: List[AsyncUpdateInfo] = []
+        self._ascores: Dict[int, Dict[str, float]] = {}
+        self._aseq_next = 0
 
         self._epoch = genesis_epoch
         self._model_hash = b"\0" * 32
@@ -469,6 +486,141 @@ class PyLedger:
         self._append_log(bytes(op))
         return LedgerStatus.OK
 
+    # --- asynchronous buffered aggregation (FedBuff op family) --------
+    # The round barrier falls: staleness-tagged deltas are admitted at
+    # ANY time into a bounded buffer (async_upload), committee members
+    # score buffered candidates with no epoch gate (async_scores), and
+    # every K admissions the writer drains the oldest k entries with
+    # staleness-discounted weights (async_commit).  Every transition is
+    # an op in the certified total order, so replicas/validators
+    # re-derive the same buffer, the same staleness stamps and the same
+    # selection — async stays no-fork by construction.
+
+    def async_upload(self, sender: str, payload_hash: bytes,
+                     n_samples: int, avg_cost: float,
+                     base_epoch: int) -> LedgerStatus:
+        if not self.async_buffer:
+            return LedgerStatus.BAD_ARG     # sync chain: op family off
+        if not sender or n_samples <= 0:
+            return LedgerStatus.BAD_ARG
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if base_epoch < 0 or base_epoch > self._epoch:
+            return LedgerStatus.BAD_ARG     # trained on the future
+        # staleness stamped HERE — deterministic: every replica applies
+        # this op at the same chain position, hence the same epoch
+        if self._epoch - base_epoch > self.max_staleness:
+            return LedgerStatus.WRONG_EPOCH
+        if any(e.sender == sender for e in self._abuf):
+            return LedgerStatus.DUPLICATE   # one in-flight delta/sender
+        if len(self._abuf) >= self.async_buffer:
+            return LedgerStatus.CAP_REACHED
+        self._abuf.append(AsyncUpdateInfo(
+            aseq=self._aseq_next, sender=sender,
+            payload_hash=bytes(payload_hash), n_samples=int(n_samples),
+            avg_cost=float(np.float32(avg_cost)),
+            base_epoch=int(base_epoch),
+            staleness=int(self._epoch - base_epoch)))
+        self._aseq_next += 1
+        self._append_log(encode_aupload_op(sender, payload_hash,
+                                           n_samples, avg_cost,
+                                           base_epoch))
+        return LedgerStatus.OK
+
+    def async_scores(self, sender: str, pairs) -> LedgerStatus:
+        if not self.async_buffer:
+            return LedgerStatus.BAD_ARG
+        if not sender or not pairs:
+            return LedgerStatus.BAD_ARG
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if self._roles.get(sender) != "comm":
+            return LedgerStatus.NOT_COMMITTEE
+        with np.errstate(over="ignore"):
+            vals = [(int(a), float(np.float32(s))) for a, s in pairs]
+        if any(not math.isfinite(v) for _, v in vals):
+            return LedgerStatus.BAD_ARG
+        live = {e.aseq for e in self._abuf}
+        if not any(a in live for a, _ in vals):
+            # nothing to bind: the scored entries all drained — refuse
+            # the append (deterministic: replicas share the buffer)
+            return LedgerStatus.NOT_READY
+        for a, v in vals:
+            if a in live:
+                self._ascores.setdefault(a, {})[sender] = v
+        self._append_log(encode_ascores_op(sender, pairs))
+        return LedgerStatus.OK
+
+    def async_selection(self, k: int):
+        """Deterministic committee selection over the oldest `k` buffered
+        entries: (entries, selected_indices, weights, global_loss).
+
+        Median committee score per entry (0.0 when unscored — liveness:
+        an idle committee must not wedge aggregation), ranked
+        (median desc, aseq asc), top aggregate_count selected, each
+        weighted n_samples * 1/sqrt(1+staleness) (the FedBuff discount).
+        Pure function of ledger state — the writer aggregates with it
+        and any replica can re-derive it from the same certified
+        prefix."""
+        entries = list(self._abuf[:k])
+        medians = []
+        for e in entries:
+            row = sorted(np.float32(v)
+                         for v in self._ascores.get(e.aseq, {}).values())
+            if not row:
+                medians.append(0.0)
+            else:
+                n = len(row)
+                medians.append(
+                    float(np.float32(0.5 * (row[(n - 1) // 2]
+                                            + row[n // 2]))))
+        order = sorted(range(len(entries)),
+                       key=lambda i: (-medians[i], entries[i].aseq))
+        take = min(self.aggregate_count, len(entries))
+        selected = order[:take]
+        weights = [float(np.float32(entries[i].n_samples
+                                    * staleness_weight(
+                                        entries[i].staleness)))
+                   for i in range(len(entries))]
+        wsum = sum(weights[i] for i in selected)
+        loss = (float(np.float32(
+            sum(weights[i] * entries[i].avg_cost for i in selected)
+            / wsum)) if wsum > 0 else 0.0)
+        return entries, selected, weights, loss
+
+    def async_commit(self, new_model_hash: bytes, epoch: int,
+                     k: int) -> LedgerStatus:
+        if not self.async_buffer:
+            return LedgerStatus.BAD_ARG
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if epoch != self._epoch:
+            return LedgerStatus.WRONG_EPOCH
+        if not 0 < k <= len(self._abuf):
+            return LedgerStatus.NOT_READY
+        _, _, _, loss = self.async_selection(k)
+        for e in self._abuf[:k]:
+            self._ascores.pop(e.aseq, None)
+        del self._abuf[:k]
+        self._model_hash = bytes(new_model_hash)
+        self._last_loss = loss
+        self._epoch += 1
+        op = bytearray([_OP_ACOMMIT])
+        op += bytes(new_model_hash)
+        op += struct.pack("<q", epoch)
+        op += struct.pack("<q", k)
+        self._append_log(bytes(op))
+        return LedgerStatus.OK
+
+    def async_buffer_view(self) -> List[AsyncUpdateInfo]:
+        """Current buffered entries, admission order (the committee's
+        scoring surface and the standby's blob-liveness oracle)."""
+        return list(self._abuf)
+
+    @property
+    def async_buffer_depth(self) -> int:
+        return len(self._abuf)
+
     # --- inspection ---
     @property
     def epoch(self) -> int:
@@ -552,6 +704,17 @@ class PyLedger:
                     list(self._pending.order),
                     list(self._pending.selected),
                     self._pending.global_loss)
+        # async buffered-aggregation state rides a trailing section ONLY
+        # when the mode is armed: synchronous ledgers emit the exact
+        # legacy byte layout (pinned by test), and decode_state treats
+        # an absent tail as "no async section" for old artifacts
+        asy = None
+        if self.async_buffer:
+            asy = (self._aseq_next,
+                   [(e.aseq, e.sender, e.payload_hash, e.n_samples,
+                     e.avg_cost, e.base_epoch, e.staleness)
+                    for e in self._abuf],
+                   {a: dict(rows) for a, rows in self._ascores.items()})
         return encode_state_dict({
             "epoch": self._epoch, "model_hash": self._model_hash,
             "last_loss": self._last_loss,
@@ -560,7 +723,7 @@ class PyLedger:
             "reg_order": self._reg_order, "roles": self._roles,
             "updates": [(u.sender, u.payload_hash, u.n_samples,
                          u.avg_cost) for u in self._updates],
-            "scores": self._scores, "pending": pend})
+            "scores": self._scores, "pending": pend, "async": asy})
 
     def state_digest(self) -> bytes:
         """SHA-256 of the canonical state — what a snapshot op embeds
@@ -596,6 +759,18 @@ class PyLedger:
                 medians=np.asarray(medians, np.float32),
                 order=list(order), selected=list(selected),
                 global_loss=float(np.float32(loss)))
+        asy = d.get("async")
+        if asy is None:
+            self._abuf, self._ascores, self._aseq_next = [], {}, 0
+        else:
+            aseq_next, entries, rows = asy
+            self._aseq_next = int(aseq_next)
+            self._abuf = [AsyncUpdateInfo(int(a), s, bytes(ph), int(n),
+                                          float(c), int(be), int(st))
+                          for a, s, ph, n, c, be, st in entries]
+            self._ascores = {int(a): {k: float(v)
+                                      for k, v in r.items()}
+                             for a, r in rows.items()}
         self._ops = []
         self._log = []
         self._base = int(base)
@@ -644,13 +819,17 @@ class PyLedger:
                 list(self._updates), dict(self._update_slot),
                 {k: list(v) for k, v in self._scores.items()},
                 self._pending, self._closed, self._generation,
-                self._writer_index, len(self._ops))
+                self._writer_index,
+                list(self._abuf),
+                {k: dict(v) for k, v in self._ascores.items()},
+                self._aseq_next, len(self._ops))
 
     def _restore(self, snap) -> None:
         (self._epoch, self._model_hash, self._last_loss, self._reg_order,
          self._roles, self._updates, self._update_slot, self._scores,
          self._pending, self._closed, self._generation,
-         self._writer_index, n_ops) = snap
+         self._writer_index, self._abuf, self._ascores,
+         self._aseq_next, n_ops) = snap
         del self._ops[n_ops:]
         del self._log[n_ops:]
 
@@ -741,6 +920,34 @@ class PyLedger:
                     return LedgerStatus.BAD_ARG
                 self._append_log(op)
                 return LedgerStatus.OK
+            if code == _OP_AUPLOAD:
+                sender, off = _str_at(0)
+                payload = body[off:off + 32]
+                ns, = struct.unpack_from("<q", body, off + 32)
+                cost, = struct.unpack_from("<f", body, off + 40)
+                base_ep, = struct.unpack_from("<q", body, off + 44)
+                return self.async_upload(sender, payload, ns, cost,
+                                         base_ep)
+            if code == _OP_ASCORES:
+                sender, off = _str_at(0)
+                cnt, = struct.unpack_from("<q", body, off)
+                if cnt <= 0 or off + 8 + 12 * cnt > len(body):
+                    return LedgerStatus.BAD_ARG
+                pairs = []
+                p = off + 8
+                for _ in range(cnt):
+                    a, = struct.unpack_from("<q", body, p)
+                    s, = struct.unpack_from("<f", body, p + 8)
+                    pairs.append((a, s))
+                    p += 12
+                return self.async_scores(sender, pairs)
+            if code == _OP_ACOMMIT:
+                if len(body) != 48:
+                    return LedgerStatus.BAD_ARG
+                payload = body[:32]
+                ep, = struct.unpack_from("<q", body, 32)
+                k, = struct.unpack_from("<q", body, 40)
+                return self.async_commit(payload, ep, k)
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)

@@ -113,6 +113,35 @@ def encode_state_dict(d: Dict) -> bytes:
         for s in selected:
             b += struct.pack("<i", int(s))
         b += struct.pack("<f", _np.float32(loss))
+    # async buffered-aggregation tail (ProtocolConfig.async_buffer;
+    # python backend only): EMITTED ONLY when the mode is armed, so a
+    # synchronous ledger's state bytes stay byte-identical to the
+    # pre-async layout (the C++ encode_state never emits it — the
+    # native backend cannot run async mode, make_ledger gates it)
+    asy = d.get("async")
+    if asy is not None:
+        aseq_next, entries, rows = asy
+        b += struct.pack("<q", int(aseq_next))
+        b += struct.pack("<q", len(entries))
+        for aseq, sender, ph, n, cost, base_ep, stale in entries:
+            b += struct.pack("<q", int(aseq))
+            _put_str(b, sender)
+            ph = bytes(ph)
+            if len(ph) != 32:
+                raise ValueError("async payload_hash must be 32 bytes")
+            b += ph
+            b += struct.pack("<q", int(n))
+            b += struct.pack("<f", _np.float32(cost))
+            b += struct.pack("<q", int(base_ep))
+            b += struct.pack("<q", int(stale))
+        b += struct.pack("<q", len(rows))
+        for aseq in sorted(rows):
+            b += struct.pack("<q", int(aseq))
+            row = rows[aseq]
+            b += struct.pack("<q", len(row))
+            for scorer in sorted(row):
+                _put_str(b, scorer)
+                b += struct.pack("<f", _np.float32(row[scorer]))
     return bytes(b)
 
 
@@ -212,6 +241,34 @@ def decode_state(blob: bytes) -> Dict:
         d["pending"] = (medians, order, selected, rd_f())
     else:
         d["pending"] = None
+    if off == len(blob):
+        d["async"] = None               # legacy / synchronous layout
+        return d
+    # async buffered-aggregation tail (present iff the emitting ledger
+    # ran with async_buffer > 0)
+    aseq_next = rd_q()
+    n_ab = rd_q()
+    if not 0 <= n_ab <= len(blob):
+        raise ValueError("snapshot state: bad async buffer count")
+    entries = []
+    for _ in range(n_ab):
+        aseq = rd_q()
+        sender = rd_str()
+        ph = rd_bytes(32)
+        entries.append((aseq, sender, ph, rd_q(), rd_f(), rd_q(),
+                        rd_q()))
+    n_rows = rd_q()
+    if not 0 <= n_rows <= len(blob):
+        raise ValueError("snapshot state: bad async score-row count")
+    rows = {}
+    for _ in range(n_rows):
+        aseq = rd_q()
+        ln = rd_q()
+        if not 0 <= ln <= len(blob):
+            raise ValueError("snapshot state: bad async score-row "
+                             "length")
+        rows[aseq] = {rd_str(): rd_f() for _ in range(ln)}
+    d["async"] = (aseq_next, entries, rows)
     if off != len(blob):
         raise ValueError(f"snapshot state: {len(blob) - off} trailing "
                          f"bytes")
@@ -243,9 +300,13 @@ def restore_snapshot(state_bytes: bytes, cfg, base: int, base_head: bytes):
     AFTER the certified snapshot op).  The installer's trust argument is
     the caller's (`verify_snapshot_meta`): this only decodes + installs,
     raising ValueError on malformed bytes."""
+    from bflc_demo_tpu.ledger.base import async_enabled
     from bflc_demo_tpu.ledger.pyledger import PyLedger
     led = PyLedger(cfg.client_num, cfg.comm_count, cfg.aggregate_count,
-                   cfg.needed_update_count, cfg.genesis_epoch)
+                   cfg.needed_update_count, cfg.genesis_epoch,
+                   async_buffer=(cfg.async_buffer
+                                 if async_enabled(cfg) else 0),
+                   max_staleness=getattr(cfg, "max_staleness", 20))
     led._install_state(state_bytes, base, base_head)
     return led
 

@@ -36,7 +36,8 @@ from bflc_demo_tpu.protocol.constants import ProtocolConfig
 
 _OP_NAMES = {1: "register", 2: "upload", 3: "scores", 4: "commit",
              5: "close_round", 6: "force_aggregate", 7: "reseat_committee",
-             8: "promote_writer", 9: "snapshot"}
+             8: "promote_writer", 9: "snapshot", 10: "async_upload",
+             11: "async_scores", 12: "async_commit"}
 
 
 def wal_base(path: str) -> int:
@@ -129,6 +130,30 @@ def decode_op(op: bytes) -> dict:
         elif code == 9:
             out["epoch"], = struct.unpack_from("<q", body, 0)
             out["state_digest"] = body[8:40].hex()
+        elif code == 10:
+            # async upload: layout of opcode 2 with the trailing epoch
+            # reinterpreted as the BASE epoch the client trained from
+            out["sender"], off = s_at(0)
+            out["payload_hash"] = body[off:off + 32].hex()
+            out["n_samples"], = struct.unpack_from("<q", body, off + 32)
+            out["avg_cost"] = round(
+                struct.unpack_from("<f", body, off + 40)[0], 6)
+            out["epoch"], = struct.unpack_from("<q", body, off + 44)
+            out["base_epoch"] = out["epoch"]
+        elif code == 11:
+            out["sender"], off = s_at(0)
+            cnt, = struct.unpack_from("<q", body, off)
+            pairs, p = [], off + 8
+            for _ in range(max(0, min(cnt, (len(body) - off - 8) // 12))):
+                a, = struct.unpack_from("<q", body, p)
+                s, = struct.unpack_from("<f", body, p + 8)
+                pairs.append([a, round(s, 4)])
+                p += 12
+            out["pairs"] = pairs
+        elif code == 12:
+            out["model_hash"] = body[:32].hex()
+            out["epoch"], = struct.unpack_from("<q", body, 32)
+            out["drained"], = struct.unpack_from("<q", body, 40)
     except (struct.error, ValueError, UnicodeDecodeError) as e:
         out["malformed"] = f"{type(e).__name__}: {e}"
     return out

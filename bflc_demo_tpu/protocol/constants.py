@@ -59,6 +59,21 @@ class ProtocolConfig:
     # quantized canonical bytes (utils.serialization).
     delta_dtype: str = "f32"
 
+    # asynchronous buffered aggregation (FedBuff, Nguyen et al. 2022 —
+    # PAPERS.md): with async_buffer = K > 0 the round barrier falls.
+    # Clients train continuously against whatever model they last
+    # fetched; each async upload op carries the BASE epoch it trained
+    # from, admission stamps staleness s = epoch_now - base_epoch
+    # (capped at max_staleness), and the writer aggregates every K
+    # admissions with staleness-discounted weights
+    # (n_samples / sqrt(1 + s), ledger.base.staleness_weight).
+    # Part of the protocol genome: validators re-execute async ops
+    # against the same K / staleness cap, so a writer cannot certify an
+    # over-stale or over-full buffer.  0 (the default) or
+    # BFLC_ASYNC_LEGACY=1 pins the synchronous path byte-for-byte.
+    async_buffer: int = 0
+    max_staleness: int = 20
+
     def validate(self) -> "ProtocolConfig":
         if not (0 < self.comm_count < self.client_num):
             raise ValueError(
@@ -79,6 +94,18 @@ class ProtocolConfig:
             raise ValueError(
                 f"delta_dtype must be one of ('f32', 'f16', 'i8'), got "
                 f"{self.delta_dtype!r}")
+        if self.async_buffer < 0 or self.max_staleness < 0:
+            raise ValueError(
+                f"async_buffer and max_staleness must be >= 0, got "
+                f"{self.async_buffer}/{self.max_staleness}")
+        if self.async_buffer > self.client_num - self.comm_count:
+            raise ValueError(
+                f"async_buffer ({self.async_buffer}) exceeds the "
+                f"trainer population "
+                f"({self.client_num - self.comm_count}): with one "
+                f"in-flight delta per sender the buffer could never "
+                f"fill and every aggregation would wait on stall "
+                f"recovery")
         return self
 
     @property

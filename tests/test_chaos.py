@@ -44,7 +44,8 @@ class TestFaultSchedule:
         assert c.summary() != a.summary()   # the seed IS the campaign
 
     def test_profiles_and_structure(self):
-        assert set(PROFILES) == {"light", "standard", "heavy"}
+        assert set(PROFILES) == {"light", "standard", "heavy",
+                                 "heavytail"}
         with pytest.raises(ValueError):
             FaultSchedule(1, duration_s=60, n_clients=4, n_standbys=1,
                           n_validators=4, profile="nope")

@@ -36,7 +36,14 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=7,
                    help="campaign seed (replays the exact schedule)")
     p.add_argument("--profile", default="standard",
-                   choices=["light", "standard", "heavy"])
+                   choices=["light", "standard", "heavy", "heavytail"],
+                   help="fault intensity; 'heavytail' is the pure "
+                        "straggler regime (seeded lognormal per-client "
+                        "delays, no kills) the async-aggregation bench "
+                        "runs under")
+    p.add_argument("--async-buffer", type=int, default=0,
+                   help="run the soak in async buffered-aggregation "
+                        "mode (--async-buffer K; 0 = synchronous)")
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--clients", type=int, default=20)
     p.add_argument("--standbys", type=int, default=2)
@@ -78,6 +85,10 @@ def main(argv=None) -> int:
         client_num=n, comm_count=max(2, n // 5),
         aggregate_count=max(2, n // 4),
         needed_update_count=max(2, n // 2))).validate()
+    if args.async_buffer:
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, async_buffer=args.async_buffer).validate()
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(np.asarray(xtr), np.asarray(ytr), cfg.client_num)
 
@@ -119,7 +130,8 @@ def main(argv=None) -> int:
         "geometry": {"clients": cfg.client_num,
                      "standbys": args.standbys,
                      "validators": args.validators,
-                     "quorum": args.quorum, "rounds": args.rounds},
+                     "quorum": args.quorum, "rounds": args.rounds,
+                     "async_buffer": cfg.async_buffer},
         "wall_time_s": round(time.time() - t0, 1),
         "failure": failure,
         "rounds_completed": (res.rounds_completed if res else 0),

@@ -163,6 +163,15 @@ def _role_row(role, snap):
             cells.append(f"snap age {int(age)}r/"
                          f"{sbytes / 1e6:.2f}MB  base {int(base)}  "
                          f"gc {gc:.0f}ops")
+        # async buffered aggregation (--async-buffer K): buffer
+        # occupancy, admitted-staleness distribution, aggregations
+        aggs = _sum_counter(snap, "async_aggregations_total")
+        n_st, m_st = _merged_hist(snap, "async_admitted_staleness")
+        if aggs or n_st:
+            depth = _gauge_value(snap, "async_buffer_depth", 0)
+            cells.append(f"async buf {int(depth)}  "
+                         f"staleness {n_st}x~{m_st:.1f}ep  "
+                         f"aggs {aggs:.0f}")
     wire_in = costs.get("wire.bytes_in", 0)
     wire_out = costs.get("wire.bytes_out", 0)
     if wire_in or wire_out:
@@ -213,6 +222,11 @@ def _scrape_digest(rec) -> str:
         if age is not None and age >= 0:
             bits.append(f"snap-age={int(age)} "
                         f"base={int(_gauge_value(w, 'log_base', 0))}")
+        aggs = _sum_counter(w, "async_aggregations_total")
+        if aggs:
+            bits.append(
+                f"async-buf={int(_gauge_value(w, 'async_buffer_depth', 0))} "
+                f"aggs={aggs:.0f}")
     for role in sorted(roles):
         if role.startswith("cell"):
             adm = _gauge_value(roles[role], "cell_admitted", 0)

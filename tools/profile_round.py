@@ -202,6 +202,13 @@ def main() -> None:
     ap.add_argument("--snapshot-interval", type=int, default=0,
                     help="emit a certified snapshot every N rounds and "
                          "print the compaction row (0 = off)")
+    ap.add_argument("--async-buffer", type=int, default=0,
+                    help="profile ONE async buffered aggregation "
+                         "instead of a synchronous round: K staleness-"
+                         "tagged admissions trigger the commit and the "
+                         "async telemetry row (buffer depth, staleness "
+                         "histogram, aggregations) prints off the same "
+                         "scrape (0 = sync round)")
     args = ap.parse_args()
     if args.legacy and not os.environ.get("BFLC_CONTROL_PLANE_LEGACY"):
         _reexec_legacy()
@@ -226,7 +233,8 @@ def main() -> None:
     cfg = ProtocolConfig(client_num=n, comm_count=max(2, n // 4),
                          aggregate_count=2,
                          needed_update_count=max(3, n // 2),
-                         learning_rate=0.05, batch_size=16)
+                         learning_rate=0.05, batch_size=16,
+                         async_buffer=max(args.async_buffer, 0)).validate()
     wallets, _ = provision_wallets(n, b"profile-round-seed")
     vwallets, vkeys = provision_validators(args.validators,
                                            b"profile-round-validators")
@@ -264,25 +272,61 @@ def main() -> None:
         assert r["ok"], r
     committee = set(client.request("committee")["committee"])
     trainers = [w for w in wallets if w.address not in committee]
-    for i, w in enumerate(trainers[: cfg.needed_update_count]):
-        blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
-                                         np.float32),
-                            "b": np.zeros((2,), np.float32)})
-        digest = hashlib.sha256(blob).digest()
-        payload = digest + struct.pack("<qd", 10 + i, 1.0)
-        r = client.request("upload", addr=w.address, blob=blob,
-                           hash=digest.hex(), n=10 + i, cost=1.0, epoch=0,
-                           tag=sign(w, "upload", 0, payload))
-        assert r["ok"], r
-    n_up = cfg.needed_update_count
-    for j, w in enumerate([w for w in wallets
-                           if w.address in committee]):
-        scores = [0.5 + 0.01 * (j + u) for u in range(n_up)]
-        payload = struct.pack(f"<{n_up}d", *scores)
-        r = client.request("scores", addr=w.address, epoch=0,
-                           scores=scores,
-                           tag=sign(w, "scores", 0, payload))
-        assert r["ok"] or r.get("status") == "WRONG_EPOCH", r
+    if args.async_buffer:
+        # one async aggregation: K-1 admissions, committee scores over
+        # the live buffer (no epoch gate), then the K-th admission
+        # triggers the staleness-weighted commit inside its own ack
+        from bflc_demo_tpu.ledger.base import ascores_sign_payload
+
+        def aupload(i, w):
+            blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
+                                             np.float32),
+                                "b": np.zeros((2,), np.float32)})
+            digest = hashlib.sha256(blob).digest()
+            payload = digest + struct.pack("<qd", 10 + i, 1.0)
+            return client.request(
+                "aupload", addr=w.address, blob=blob,
+                hash=digest.hex(), n=10 + i, cost=1.0, base_epoch=0,
+                tag=sign(w, "aupload", 0, payload))
+
+        k = min(args.async_buffer, len(trainers))
+        for i, w in enumerate(trainers[: k - 1]):
+            assert aupload(i, w)["ok"]
+        au = client.request("aupdates")
+        pairs = [(u["aseq"], 0.5 + 0.01 * u["aseq"])
+                 for u in au["updates"]]
+        for w in [w for w in wallets if w.address in committee]:
+            if not pairs:
+                break
+            r = client.request(
+                "ascores", addr=w.address,
+                pairs=[[a, s] for a, s in pairs],
+                tag=w.sign(_op_bytes("ascores", w.address, 0,
+                                     ascores_sign_payload(pairs))).hex())
+            assert r["ok"], r
+        r = aupload(k - 1, trainers[k - 1])
+        assert r["ok"] and r["epoch"] == 1, r
+    else:
+        for i, w in enumerate(trainers[: cfg.needed_update_count]):
+            blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
+                                             np.float32),
+                                "b": np.zeros((2,), np.float32)})
+            digest = hashlib.sha256(blob).digest()
+            payload = digest + struct.pack("<qd", 10 + i, 1.0)
+            r = client.request("upload", addr=w.address, blob=blob,
+                               hash=digest.hex(), n=10 + i, cost=1.0,
+                               epoch=0,
+                               tag=sign(w, "upload", 0, payload))
+            assert r["ok"], r
+        n_up = cfg.needed_update_count
+        for j, w in enumerate([w for w in wallets
+                               if w.address in committee]):
+            scores = [0.5 + 0.01 * (j + u) for u in range(n_up)]
+            payload = struct.pack(f"<{n_up}d", *scores)
+            r = client.request("scores", addr=w.address, epoch=0,
+                               scores=scores,
+                               tag=sign(w, "scores", 0, payload))
+            assert r["ok"] or r.get("status") == "WRONG_EPOCH", r
     info = client.request("info")
     assert info["epoch"] == 1, info
     wall = time.perf_counter() - t_round
@@ -379,6 +423,19 @@ def main() -> None:
               f"{_gv(writer_snap, 'snapshot_bytes', 0) / 1e6:.2f} MB   "
               f"log base {int(_gv(writer_snap, 'log_base', 0))}   "
               f"gc {_csum(writer_snap, 'ledger_gc_ops_total'):.0f} ops")
+
+    # async buffered aggregation (--async-buffer): the same row
+    # fleet_top renders — buffer depth, staleness distribution of the
+    # admitted deltas, aggregations committed
+    from fleet_top import _merged_hist as _mh
+
+    aggs = _csum(writer_snap, "async_aggregations_total")
+    n_st, m_st = _mh(writer_snap, "async_admitted_staleness")
+    if aggs or n_st:
+        print(f"async: buffer {int(_gv(writer_snap, 'async_buffer_depth', 0))}"
+              f"   admitted {n_st} (staleness mean {m_st:.2f} epochs)"
+              f"   aggregations {aggs:.0f}"
+              f"   ({aggs / wall:.1f}/s this round)")
     if snap_dir:
         import shutil
         shutil.rmtree(snap_dir, ignore_errors=True)
