@@ -46,7 +46,13 @@ chain (eval.benchmarks.rejoin_config1).  `extra.async_agg` (PR 9) is
 the async buffered-aggregation axis: sync vs async round throughput +
 time-to-accuracy under the heavytail straggler chaos profile
 (eval.benchmarks.async_agg_config1; the full config-1 artifact with
-critical-path evidence is TPU_RESULTS.md round 14).
+critical-path evidence is TPU_RESULTS.md round 14).  `extra.mesh_agg`
+(ISSUE 11) is the on-mesh batched-aggregation axis: compiled-leg vs
+host-loop merge latency at 64/256 stacked deltas with the certified-
+hash-equality verdict, and `extra.platform_detail` records the jax
+backend, device count/kind and whether the meshagg engine ran jitted —
+device evidence every artifact now carries (eval.benchmarks.
+mesh_agg_config1; full curve in TPU_RESULTS.md round 15).
 BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
@@ -159,6 +165,14 @@ def _child() -> None:
                           "polling floor (sleep-bound); accuracy parity "
                           "and samples/sec/chip are the compute axes"),
         "platform": "cpu-fallback" if on_cpu else platform,
+        # the real accelerator story (ISSUE 11): jax backend + device
+        # evidence + whether the meshagg engine actually ran jitted —
+        # a "cpu-fallback" line with no device story is uninterpretable
+        "platform_detail": {
+            "jax_backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+        },
     }
     if rp.get("flops_per_round"):
         extra["flops_per_round"] = round(rp["flops_per_round"])
@@ -257,6 +271,26 @@ def _child() -> None:
         # few-hundred-round chain (eval.benchmarks.rejoin_config1)
         from bflc_demo_tpu.eval.benchmarks import rejoin_config1
         extra["rejoin"] = rejoin_config1(rounds=300)
+        # on-mesh batched aggregation (ISSUE 11): compiled mesh leg vs
+        # the pre-engine host loop at 64/256 stacked deltas (the bench-
+        # budget twin — the full 64/256/1024 curve lives in
+        # TPU_RESULTS.md round 15), with the certified-hash-equality
+        # verdict, compile count, and the engine's which-leg-ran
+        # evidence
+        from bflc_demo_tpu.eval.benchmarks import mesh_agg_config1
+        ma = mesh_agg_config1(batch_sizes=(64, 256), repeats=3)
+        extra["mesh_agg"] = {
+            "hashes_equal": ma["hashes_equal"],
+            "legs": ma["legs"],
+            "programs_compiled": ma["programs_compiled"],
+            "engine": ma["engine"],
+        }
+        extra["platform_detail"]["mesh_agg"] = {
+            "selfcheck": ma["engine"]["selfcheck"],
+            # did the COMPILED leg actually execute in this process,
+            # or did everything fall back to the host loop?
+            "jitted": ma["engine"]["calls"].get("mesh", 0) > 0,
+        }
         # async buffered aggregation (PR 9): sync vs async legs under
         # the heavytail straggler chaos profile — this is the
         # bench-budget twin (8 clients, short legs); the full config-1

@@ -199,11 +199,7 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
     fleet_top read both modes identically."""
     import json as _json
 
-    import jax
-    import jax.numpy as jnp
-
     from bflc_demo_tpu.core.local_train import local_train
-    from bflc_demo_tpu.core.scoring import score_candidates
     from bflc_demo_tpu.comm.identity import _op_bytes
     from bflc_demo_tpu.ledger.base import ascores_sign_payload
     from bflc_demo_tpu.utils.serialization import (dequantize_entries,
@@ -311,11 +307,14 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
                 t_score = (time.perf_counter()
                            if obs_metrics.REGISTRY.enabled else 0.0)
                 with obs_trace.TRACE.span("score"):
-                    stacked = jax.tree_util.tree_map(
-                        lambda *t: jnp.stack(t), *deltas)
-                    scores = score_candidates(model.apply, params,
-                                              stacked,
-                                              cfg.learning_rate, xj, yj)
+                    # same one-program batched scorer as the sync
+                    # committee path (meshagg): the async buffer's
+                    # candidate set is scored in a single dispatch
+                    from bflc_demo_tpu.meshagg.engine import \
+                        score_candidates_batched
+                    scores = score_candidates_batched(
+                        model.apply, params, deltas,
+                        cfg.learning_rate, xj, yj)
                 score_list = [float(s) for s in
                               np.nan_to_num(np.asarray(scores), nan=0.0,
                                             posinf=1.0, neginf=0.0)]
@@ -385,7 +384,6 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     from bflc_demo_tpu.comm.failover import FailoverClient
     from bflc_demo_tpu.comm.identity import Wallet
     from bflc_demo_tpu.core.local_train import local_train
-    from bflc_demo_tpu.core.scoring import score_candidates
     from bflc_demo_tpu.utils.serialization import (dequantize_entries,
                                                    pack_pytree,
                                                    pack_quantized,
@@ -523,7 +521,6 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
               # writer-side — inherits this context
               with obs_trace.TRACE.start_trace("client.score_op",
                                                epoch=epoch):
-                import jax
                 # cache -> replica read set -> coordinator, every part
                 # hash-verified; a batched reply that omits/garbles a
                 # hash falls back per-hash and COUNTS the fallback
@@ -543,11 +540,15 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                 params = restore_pytree(template,
                                         unpack_pytree(mr["blob"]))
                 with obs_trace.TRACE.span("score"):
-                    stacked = jax.tree_util.tree_map(
-                        lambda *t: jnp.stack(t), *deltas)
-                    scores = score_candidates(model.apply, params,
-                                              stacked,
-                                              cfg.learning_rate, xj, yj)
+                    # one batched program over the stacked candidate
+                    # axis, sharded over a clients device mesh when one
+                    # exists (meshagg; same vmapped arithmetic — scores
+                    # are per-candidate independent)
+                    from bflc_demo_tpu.meshagg.engine import \
+                        score_candidates_batched
+                    scores = score_candidates_batched(
+                        model.apply, params, deltas,
+                        cfg.learning_rate, xj, yj)
                 score_list = [float(s) for s in
                               np.nan_to_num(np.asarray(scores), nan=0.0,
                                             posinf=1.0, neginf=0.0)]

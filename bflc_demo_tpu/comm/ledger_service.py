@@ -229,25 +229,22 @@ def _aggregate_flat(global_flat: Dict[str, np.ndarray],
                     lr: float) -> Dict[str, np.ndarray]:
     """Server-side FedAvg on flat entries: global -= lr * weighted mean of
     the selected deltas (CommitteePrecompiled.cpp:403-414 semantics, the
-    same arithmetic `core.aggregate.apply_selection` implements on device —
-    numpy float32 here so the coordinator needs no accelerator).
+    same arithmetic `core.aggregate.apply_selection` implements on device).
 
     `weights` is the per-delta merge weight: n_samples on the sync path,
     n_samples * 1/sqrt(1+staleness) on the async buffered path
-    (ledger.base.staleness_weight) — one arithmetic, two weightings."""
-    w = np.zeros(len(delta_flats), np.float32)
-    for s in selected:
-        w[s] = float(weights[s])
-    wsum = max(float(w.sum()), 1e-12)
-    out: Dict[str, np.ndarray] = {}
-    for key, g in global_flat.items():
-        acc = np.zeros_like(np.asarray(g), dtype=np.float32)
-        for i, d in enumerate(delta_flats):
-            if w[i] > 0.0:
-                acc += np.asarray(d[key], np.float32) * (w[i] / wsum)
-        out[key] = (np.asarray(g, np.float32) - lr * acc).astype(
-            np.asarray(g).dtype)
-    return out
+    (ledger.base.staleness_weight) — one arithmetic, two weightings.
+
+    The reduction runs through the batched meshagg engine under
+    REDUCTION SPEC v1 (meshagg.spec): at round geometry the N admitted
+    deltas stack into one pytree and reduce in a single jitted program;
+    small batches and `BFLC_MESH_AGG_LEGACY=1` keep the pre-engine host
+    loop.  The legs are byte-identical by construction (fixed-order
+    float32 accumulation, differential-tested), so the certified model
+    hash never depends on which leg ran."""
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+    return ENGINE.aggregate_flat(global_flat, delta_flats, weights,
+                                 selected, lr)
 
 
 class LedgerServer:
@@ -349,6 +346,14 @@ class LedgerServer:
             if not self.ledger.attach_wal(wal_path):
                 raise RuntimeError(f"cannot attach WAL at {wal_path}")
         self._blobs: Dict[bytes, bytes] = dict(resume_blobs or {})
+        # meshagg staging (best-effort): payload hash -> the delta's
+        # flattened f32 row (meshagg.engine.flatten_delta), built at
+        # admission — where the blob is decoded for the schema check
+        # anyway — so the mesh-leg aggregate is one stack + one compiled
+        # program with no per-leaf Python on the commit critical path.
+        # A missing row (promoted-standby resupply, resumed writer) is
+        # re-derived from the blob at aggregate time.
+        self._staged: Dict[bytes, np.ndarray] = {}
         self._model_blob = initial_model_blob
         self._model_hash = hashlib.sha256(initial_model_blob).digest()
         # {key: (shape, dtype)} of the current model — the delta admission
@@ -1282,15 +1287,19 @@ class LedgerServer:
                 # additionally enforces the cell contract (registered
                 # aggregator, #cellmeta present, claimed client count
                 # within registered membership — hier.partial).
-                err = (self._cell_admission_error(addr, blob, int(m["n"]))
-                       if self._cell_registry is not None
-                       else self._delta_shape_error(blob))
+                err, aggflat = (
+                    self._decode_cell_partial(addr, blob, int(m["n"]))
+                    if self._cell_registry is not None
+                    else self._decode_delta(blob))
                 if err:
                     return {"ok": False, "status": "BAD_ARG", "error": err}
                 st = self.ledger.upload_local_update(
                     addr, digest, int(m["n"]), float(m["cost"]),
                     int(m["epoch"]))
                 if st == LedgerStatus.OK:
+                    # stage the admission decode for the meshagg
+                    # aggregate (one stack + one program at commit)
+                    self._stage_delta(digest, aggflat)
                     if obs_metrics.REGISTRY.enabled:
                         # straggler evidence: admission lag behind this
                         # round's FIRST admitted upload (0 for the
@@ -1529,13 +1538,14 @@ class LedgerServer:
                     v == LedgerStatus.BAD_ARG else "replayed tag"}
         if not self._charge_gas(addr, GAS_UPLOAD_BASE + len(blob)):
             return dict(self._OUT_OF_GAS)
-        err = self._delta_shape_error(blob)
+        err, aggflat = self._decode_delta(blob)
         if err:
             return {"ok": False, "status": "BAD_ARG", "error": err}
         st = self.ledger.async_upload(addr, digest, int(m["n"]),
                                       float(m["cost"]), base_epoch)
         if st == LedgerStatus.OK:
             self._blobs[digest] = blob
+            self._stage_delta(digest, aggflat)
             if self.require_auth:
                 # prune floor = epoch - max_staleness: a tag bucket must
                 # outlive every base epoch the staleness cap still
@@ -1633,13 +1643,24 @@ class LedgerServer:
                 self.ledger.async_selection(k)
             epoch = self.ledger.epoch
             global_flat = unpack_pytree(self._model_blob)
-            delta_flats = [dequantize_entries(
-                               unpack_pytree(
-                                   self._blobs[e.payload_hash]))
-                           for e in entries]
-            new_flat = _aggregate_flat(global_flat, delta_flats,
-                                       weights, list(selected),
-                                       self.cfg.learning_rate)
+            from bflc_demo_tpu.meshagg.engine import ENGINE
+            if ENGINE.choose_leg(len(entries)) == "mesh":
+                # meshagg drain: the FedBuff n/sqrt(1+s) weights enter
+                # as spec coefficients; same one-program reduction as
+                # the sync merge, byte-identical to the host loop
+                rows = [self._staged_row(e.payload_hash)
+                        for e in entries]
+                new_flat = ENGINE.aggregate_rows(
+                    global_flat, rows, weights, list(selected),
+                    self.cfg.learning_rate)
+            else:
+                delta_flats = [dequantize_entries(
+                                   unpack_pytree(
+                                       self._blobs[e.payload_hash]))
+                               for e in entries]
+                new_flat = _aggregate_flat(global_flat, delta_flats,
+                                           weights, list(selected),
+                                           self.cfg.learning_rate)
             blob = pack_entries(new_flat)
             digest = hashlib.sha256(blob).digest()
             st = self.ledger.async_commit(digest, epoch, k)
@@ -1647,6 +1668,7 @@ class LedgerServer:
                 raise RuntimeError(f"async commit rejected: {st.name}")
             for e in entries:
                 self._blobs.pop(e.payload_hash, None)
+                self._staged.pop(e.payload_hash, None)
             self._model_blob = blob
             self._model_hash = digest
             self._model_schema = {key: (a.shape, a.dtype)
@@ -1691,62 +1713,102 @@ class LedgerServer:
                for u in self.ledger.query_all_updates()):
             self._blobs[digest] = blob
 
-    def _delta_shape_error(self, blob: bytes) -> str:
-        """'' if the delta blob's flat entries mirror the current global
-        model's keys, shapes, AND dtypes; a reason string otherwise.
-        Dtype equality matters as much as shape: a string-typed leaf with
-        the right geometry would otherwise defer the failure to the
-        float32 cast inside aggregation.
+    def _decode_delta(self, blob: bytes):
+        """(reason, decoded flat entries or None): '' reason iff the
+        delta blob's flat entries mirror the current global model's
+        keys, shapes, AND dtypes.  Dtype equality matters as much as
+        shape: a string-typed leaf with the right geometry would
+        otherwise defer the failure to the float32 cast inside
+        aggregation.
 
-        With quantized deltas enabled (cfg.delta_dtype != "f32", opt-in)
-        the check runs over the DEQUANTIZED image — the same
+        With quantized deltas enabled (cfg.delta_dtype != "f32",
+        opt-in) the check runs over the DEQUANTIZED image — the same
         deterministic decode scorers and the aggregator apply — so the
-        admitted structure is exactly what aggregation will walk.  With
-        quantization off the pre-PR strict check is unchanged: reduced-
-        precision blobs are rejected at the door."""
+        admitted structure is exactly what aggregation will walk; with
+        quantization off the strict check is unchanged (reduced-
+        precision blobs are rejected at the door).  The decoded image
+        is returned so admission can STAGE it for the meshagg
+        aggregate instead of throwing the work away and re-decoding at
+        commit."""
         try:
             delta = unpack_pytree(blob)
             if self.cfg.delta_dtype != "f32":
                 delta = dequantize_entries(delta)
         except (ValueError, TypeError, struct.error) as e:
-            return f"undecodable delta blob: {e}"
-        return self._schema_error(delta)
+            return f"undecodable delta blob: {e}", None
+        err = self._schema_error(delta)
+        return err, (None if err else delta)
 
-    def _cell_admission_error(self, addr: str, blob: bytes,
-                              claimed_n: int) -> str:
-        """'' when a cell-aggregate upload honors the cell contract
-        (hier root mode): the sender is a REGISTERED cell aggregator,
-        the blob carries a well-formed #cellmeta evidence entry whose
-        cell index matches the sender's registered cell (a lying
-        aggregator cannot attribute its partial to another cell), whose
-        claimed client count matches the op's `n` weight field, that
-        count does not exceed the sender's registered membership (it
-        cannot inflate its FedAvg weight either), and the partial's
-        tensor entries mirror the model schema."""
+    def _stage_delta(self, digest: bytes,
+                     flat: Optional[Dict[str, np.ndarray]]) -> None:
+        """Remember an ADMITTED delta's flattened row for the mesh-leg
+        aggregate (meshagg).  Best-effort: staging nothing just means
+        the aggregate re-derives the row from the stored blob — and a
+        geometry the compiled leg can never serve (small rounds, the
+        legacy pin) stages nothing at all, keeping the flatten copy
+        off the admission path."""
+        if flat is None:
+            return
+        from bflc_demo_tpu.meshagg.engine import ENGINE, flatten_delta
+        if not ENGINE.staging_worthwhile(
+                max(self.cfg.needed_update_count, self.cfg.async_buffer)):
+            return
+        self._staged[digest] = flatten_delta(flat, sorted(flat.keys()))
+
+    def _staged_row(self, digest: bytes) -> np.ndarray:
+        """The staged row for an admitted payload, re-derived from the
+        blob when staging missed (resumed/promoted writer)."""
+        row = self._staged.pop(digest, None)
+        if row is not None:
+            return row
+        from bflc_demo_tpu.hier.partial import split_cellmeta
+        from bflc_demo_tpu.meshagg.engine import flatten_delta
+        flat = dequantize_entries(unpack_pytree(self._blobs[digest]))
+        if self._cell_registry is not None:
+            flat = split_cellmeta(flat)[0]
+        return flatten_delta(flat, sorted(flat.keys()))
+
+    def _decode_cell_partial(self, addr: str, blob: bytes,
+                             claimed_n: int):
+        """(reason, stripped partial entries or None): '' reason iff a
+        cell-aggregate upload honors the cell contract (hier root
+        mode) — the sender is a REGISTERED cell aggregator, the blob
+        carries a well-formed #cellmeta evidence entry whose cell
+        index matches the sender's registered cell (a lying aggregator
+        cannot attribute its partial to another cell), whose claimed
+        client count matches the op's `n` weight field, that count
+        does not exceed the sender's registered membership (it cannot
+        inflate its FedAvg weight either), and the partial's tensor
+        entries mirror the model schema.  The #cellmeta-stripped
+        partial is returned so root admission can stage it for the
+        meshagg aggregate (the evidence entry rode the certified hash
+        but is not a model tensor)."""
         from bflc_demo_tpu.hier.partial import split_cellmeta
         ent = self._cell_registry.get(addr)
         if ent is None:
             return (f"sender {addr[:12]} is not a registered cell "
-                    f"aggregator")
+                    f"aggregator"), None
         reg_index, cap = ent
         try:
             flat = unpack_pytree(blob)
             partial, meta = split_cellmeta(flat)
         except (ValueError, TypeError, struct.error) as e:
-            return f"undecodable cell partial: {e}"
+            return f"undecodable cell partial: {e}", None
         if meta is None:
-            return "cell partial without a #cellmeta evidence entry"
+            return "cell partial without a #cellmeta evidence entry", \
+                None
         cell_index, n_clients, _evidence = meta
         if cell_index != reg_index:
             return (f"#cellmeta cell index {cell_index} != registered "
-                    f"cell {reg_index} for sender {addr[:12]}")
+                    f"cell {reg_index} for sender {addr[:12]}"), None
         if n_clients != claimed_n:
             return (f"#cellmeta client count {n_clients} != op weight "
-                    f"{claimed_n}")
+                    f"{claimed_n}"), None
         if not 0 < n_clients <= cap:
             return (f"claimed client count {n_clients} exceeds "
-                    f"registered membership {cap}")
-        return self._schema_error(partial)
+                    f"registered membership {cap}"), None
+        err = self._schema_error(partial)
+        return err, (None if err else partial)
 
     def _schema_error(self, delta: Dict[str, np.ndarray]) -> str:
         """'' iff flat entries mirror the current model's keys, shapes
@@ -1783,29 +1845,42 @@ class LedgerServer:
             self._aggregate_and_commit_inner(t0)
 
     def _aggregate_and_commit_inner(self, t0: float) -> None:
+        from bflc_demo_tpu.meshagg.engine import ENGINE
         pending = self.ledger.pending()
         updates = self.ledger.query_all_updates()
         epoch = self.ledger.epoch
         global_flat = unpack_pytree(self._model_blob)
-        # dequantize is the ONE shared decode (utils.serialization): an
-        # identity on plain f32 blobs, the deterministic inverse for
-        # opt-in f16/i8 uploads — scorer, aggregator and re-validators
-        # therefore agree on every delta's numeric meaning
-        delta_flats = [dequantize_entries(
-                           unpack_pytree(self._blobs[u.payload_hash]))
-                       for u in updates]
-        if self._cell_registry is not None:
-            # hier root: each "delta" is a cell partial whose reserved
-            # #cellmeta evidence entry rode the certified hash but is not
-            # a model tensor; strip it before the weighted merge (the
-            # weights — u.n_samples — are the admitted CLIENT counts the
-            # admission check bounded against the registry)
-            from bflc_demo_tpu.hier.partial import split_cellmeta
-            delta_flats = [split_cellmeta(f)[0] for f in delta_flats]
-        new_flat = _aggregate_flat(global_flat, delta_flats,
-                                   [u.n_samples for u in updates],
-                                   list(pending.selected),
-                                   self.cfg.learning_rate)
+        if ENGINE.choose_leg(len(updates)) == "mesh":
+            # meshagg: the admitted deltas were staged as flattened
+            # rows at admission — the merge is one stack + one compiled
+            # program (REDUCTION SPEC v1, byte-identical to the host
+            # loop below; a missing row is re-derived from its blob)
+            rows = [self._staged_row(u.payload_hash) for u in updates]
+            new_flat = ENGINE.aggregate_rows(
+                global_flat, rows, [u.n_samples for u in updates],
+                list(pending.selected), self.cfg.learning_rate)
+        else:
+            # host loop: dequantize is the ONE shared decode
+            # (utils.serialization): an identity on plain f32 blobs,
+            # the deterministic inverse for opt-in f16/i8 uploads —
+            # scorer, aggregator and re-validators therefore agree on
+            # every delta's numeric meaning
+            delta_flats = [dequantize_entries(
+                               unpack_pytree(self._blobs[u.payload_hash]))
+                           for u in updates]
+            if self._cell_registry is not None:
+                # hier root: each "delta" is a cell partial whose
+                # reserved #cellmeta evidence entry rode the certified
+                # hash but is not a model tensor; strip it before the
+                # weighted merge (the weights — u.n_samples — are the
+                # admitted CLIENT counts the admission check bounded
+                # against the registry)
+                from bflc_demo_tpu.hier.partial import split_cellmeta
+                delta_flats = [split_cellmeta(f)[0] for f in delta_flats]
+            new_flat = _aggregate_flat(global_flat, delta_flats,
+                                       [u.n_samples for u in updates],
+                                       list(pending.selected),
+                                       self.cfg.learning_rate)
         blob = pack_entries(new_flat)
         digest = hashlib.sha256(blob).digest()
         st = self.ledger.commit_model(digest, epoch)
@@ -1813,6 +1888,7 @@ class LedgerServer:
             raise RuntimeError(f"commit rejected: {st.name}")
         for u in updates:
             self._blobs.pop(u.payload_hash, None)
+            self._staged.pop(u.payload_hash, None)
         self._model_blob = blob
         self._model_hash = digest
         self._model_schema = {k: (a.shape, a.dtype)

@@ -119,7 +119,9 @@ def bench_config1(rounds: int = 10, ledger_backend: str = "auto",
 
 
 def endurance_config1(rounds: int = 50, ledger_backend: str = "auto",
-                      seed: int = 0, rounds_per_dispatch: int = 5) -> Dict:
+                      seed: int = 0, rounds_per_dispatch: int = 5,
+                      snapshot_interval: int = 0,
+                      wal_rounds: int = 240) -> Dict:
     """The DECLARED metric axis, finally measured (VERDICT r5 missing #2):
     BASELINE.json's metric is "test-acc @ round 50", yet no artifact ever
     ran 50 rounds.  This does — config 1 end to end on whatever platform
@@ -128,8 +130,17 @@ def endurance_config1(rounds: int = 50, ledger_backend: str = "auto",
     the whole campaign (every sponsor observation advances the epoch; no
     round is lost or replayed).
 
+    snapshot_interval > 0 additionally runs the SNAPSHOT-ARMED
+    endurance leg (the ROADMAP "endurance at snapshot scale" item):
+    `wal_rounds` scripted config-1-geometry rounds on a WAL-attached
+    ledger, once with a certified snapshot + prefix GC every
+    `snapshot_interval` rounds and once unarmed — returned under
+    ``wal`` with the per-round journal-size trajectory evidence that
+    the armed journal is BOUNDED (sawtooth) while the legacy one grows
+    linearly.  tests/test_endurance.py asserts the bound at 240 rounds.
+
     Returns {rounds_completed, test_acc_at_round_50 (or at `rounds`),
-    best_test_acc, epochs_monotone, wall_time_s}.
+    best_test_acc, epochs_monotone, wall_time_s[, wal]}.
     """
     from bflc_demo_tpu.client.mesh_runtime import run_federated_mesh
     from bflc_demo_tpu.data import load_occupancy, iid_shards
@@ -146,7 +157,7 @@ def endurance_config1(rounds: int = 50, ledger_backend: str = "auto",
     epochs = [e for e, _ in res.accuracy_history]
     accs = [a for _, a in res.accuracy_history]
     tail = accs[-10:] if len(accs) >= 10 else accs
-    return {
+    out = {
         "rounds_completed": res.rounds_completed,
         f"test_acc_at_round_{rounds}": round(res.final_accuracy, 4),
         # the oscillation-robust plateau estimate: a single round's acc on
@@ -159,6 +170,102 @@ def endurance_config1(rounds: int = 50, ledger_backend: str = "auto",
             all(b > a for a, b in zip(epochs, epochs[1:]))
             and len(epochs) == rounds),
         "wall_time_s": round(res.wall_time_s, 3),
+    }
+    if snapshot_interval > 0:
+        out["wal"] = _endurance_wal_leg(wal_rounds, snapshot_interval)
+    return out
+
+
+def _endurance_wal_leg(rounds: int = 240,
+                       snapshot_interval: int = 16) -> Dict:
+    """Bounded-journal evidence at endurance scale: `rounds` scripted
+    config-1-geometry rounds driven directly on a WAL-attached python
+    ledger (op application is the work both variants share; no sockets,
+    so hundreds of rounds take seconds), run twice —
+
+    - **armed**: every `snapshot_interval` epochs the writer-shaped
+      sequence runs (encode state, snapshot op, `gc_prefix` → WAL2
+      compaction, exactly `comm.ledger_service._emit_snapshot` /
+      `_maybe_finalize_snapshot` order);
+    - **legacy**: the same chain with no snapshots (the pre-PR-7
+      unbounded journal).
+
+    Samples the on-disk journal size after every round.  The armed
+    journal must sawtooth within ~one interval of ops while the legacy
+    one grows linearly with the chain.
+    """
+    import os as _os
+    import tempfile
+
+    import hashlib as _hl
+
+    from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
+    from bflc_demo_tpu.ledger.snapshot import make_snapshot_op
+
+    cfg = DEFAULT_PROTOCOL
+
+    def leg(armed: bool):
+        with tempfile.TemporaryDirectory(prefix="bflc-endur-wal-") as td:
+            path = _os.path.join(td, "run.wal")
+            led = make_ledger(cfg, backend="python")
+            addrs = [f"0x{i:040x}" for i in range(cfg.client_num)]
+            for a in addrs:
+                assert led.register_node(a) == LedgerStatus.OK
+            assert led.attach_wal(path)
+            sizes = []
+            for _ in range(rounds):
+                ep = led.epoch
+                committee = set(led.committee())
+                got = 0
+                for a in addrs:
+                    if a in committee:
+                        continue
+                    h = _hl.sha256(f"{ep}|{a}".encode()).digest()
+                    if led.upload_local_update(
+                            a, h, 10, 1.0, ep) == LedgerStatus.OK:
+                        got += 1
+                    if got >= cfg.needed_update_count:
+                        break
+                row = [0.5 + 0.01 * u
+                       for u in range(cfg.needed_update_count)]
+                for a in committee:
+                    assert led.upload_scores(a, ep,
+                                             row) == LedgerStatus.OK
+                mh = _hl.sha256(f"model|{ep}".encode()).digest()
+                assert led.commit_model(mh, ep) == LedgerStatus.OK
+                if armed and led.epoch % snapshot_interval == 0:
+                    # the writer's emission order (_emit_snapshot →
+                    # _maybe_finalize_snapshot): state BEFORE the op,
+                    # GC to the position after it
+                    state = led.encode_state()
+                    pos = led.log_size()
+                    op = make_snapshot_op(led)
+                    assert led.apply_op(op) == LedgerStatus.OK
+                    led.gc_prefix(pos + 1, state)
+                sizes.append(_os.path.getsize(path))
+            led.detach_wal()
+            # ops still HELD (journaled): chain length minus the GC'd
+            # prefix — the armed leg's bounded-state evidence
+            return sizes, led.log_size() - getattr(led, "log_base", 0)
+
+    armed_sizes, armed_ops = leg(True)
+    legacy_sizes, legacy_ops = leg(False)
+    half = len(armed_sizes) // 2
+    return {
+        "rounds": rounds, "snapshot_interval": snapshot_interval,
+        "armed_max_wal_bytes": max(armed_sizes),
+        "armed_final_wal_bytes": armed_sizes[-1],
+        # the bounded-growth claim in one number: the armed journal's
+        # ceiling over the SECOND half is no higher than over the first
+        # (a sawtooth, not a ramp)
+        "armed_first_half_max_wal_bytes": max(armed_sizes[:half]),
+        "armed_second_half_max_wal_bytes": max(armed_sizes[half:]),
+        "legacy_max_wal_bytes": max(legacy_sizes),
+        "legacy_final_wal_bytes": legacy_sizes[-1],
+        "armed_held_ops": armed_ops,
+        "legacy_held_ops": legacy_ops,
+        "bounded_ratio": round(
+            legacy_sizes[-1] / max(max(armed_sizes), 1), 2),
     }
 
 
@@ -1508,4 +1615,184 @@ def async_agg_config1(rounds: int = 6, *, buffer_k: int = 8,
                 break
         if "time_to_acc_speedup" in out:
             break
+    return out
+
+
+# ---------------------------------- on-mesh batched aggregation (meshagg)
+def mesh_agg_config1(batch_sizes=(64, 256, 1024), repeats: int = 5,
+                     score_leg: bool = True, seed: int = 0) -> Dict:
+    """Aggregate+score wall time vs stacked-delta count: the meshagg
+    engine's one-compiled-program leg against the pre-engine O(N) host
+    loop, at the geometries the scaling story cares about (a hier root
+    draining hundreds of cell partials, an async buffer at fleet scale).
+
+    Per batch size N: N admitted-shaped deltas (a many-leaf
+    transformer-like tree — 24 leaves, ~9.6k params — the shape where
+    the host loop's NxL interpreter dispatches bite) merged under
+    REDUCTION SPEC v1 by three legs: the verbatim pre-engine loop
+    (``legacy``, the host-loop baseline), the spec's FTZ host loop, and
+    the compiled mesh leg over ADMISSION-STAGED rows (exactly the
+    writer's path: rows are flattened when each upload is admitted, so
+    the aggregate pays one stack + two program dispatches).  The
+    certified canonical-bytes hashes of all three must be EQUAL — the
+    differential evidence rides the artifact.  Timed warm over
+    `repeats` runs with the compile-bearing first mesh call reported
+    separately; plus the committee-scoring axis: all N candidates
+    evaluated in one batched program vs one dispatch per candidate
+    (the reference's per-model loop shape, main.py:212-217).
+
+    The host loop's cost is Θ(N x leaves) interpreter dispatches; the
+    mesh leg's Python cost is O(1) — the claim is flat-or-sublinear
+    growth for the mesh leg against the host loop's linear ramp, not
+    absolute times (on cpu-fallback the ratios are the artifact).
+    Engine evidence (platform, device count, which leg ran, compile
+    count, self-check verdict) is embedded so a BENCH json can never
+    again claim "cpu-fallback" with no device story.
+    """
+    import hashlib as _hl
+    import statistics
+
+    import numpy as np
+
+    from bflc_demo_tpu.meshagg import spec as magg_spec
+    from bflc_demo_tpu.meshagg.engine import (ENGINE, flatten_delta,
+                                              score_candidates_batched)
+    from bflc_demo_tpu.utils.serialization import pack_entries
+
+    import jax
+    import jax.numpy as jnp
+
+    shapes = {f"/L{i:02d}": (20, 20) for i in range(24)}
+    params_per_delta = sum(int(np.prod(s)) for s in shapes.values())
+    keys = sorted(shapes)
+    rng = np.random.default_rng(seed)
+    g = {k: rng.standard_normal(s).astype(np.float32)
+         for k, s in shapes.items()}
+
+    def apply_fn(params, x):
+        h = jnp.tanh(x @ params["/L00"])
+        return h @ params["/L01"][:, :16]
+
+    x = rng.standard_normal((64, 20)).astype(np.float32)
+    y = np.eye(16, dtype=np.float32)[rng.integers(0, 16, size=64)]
+    xj, yj = jnp.asarray(x), jnp.asarray(y)
+
+    # arm the engine's one-time differential self-check so the
+    # artifact's `selfcheck` verdict is a real measurement, not
+    # "untested" (forced legs below bypass the policy that runs it)
+    ENGINE.run_selfcheck()
+    compile_before = ENGINE.compile_total
+    legs: Dict = {}
+    all_equal = True
+    for n in batch_sizes:
+        deltas = [{k: (rng.standard_normal(s) * 0.01).astype(np.float32)
+                   for k, s in shapes.items()} for _ in range(n)]
+        weights = [float(rng.integers(8, 64)) for _ in range(n)]
+        selected = list(range(n))           # a full drain/merge
+        lr = 0.05
+        # the writer stages rows at ADMISSION — off the aggregate
+        # critical path — so they are prebuilt (untimed) here
+        rows = [flatten_delta(d, keys) for d in deltas]
+
+        def run_mesh():
+            return ENGINE.aggregate_rows(g, rows, weights, selected,
+                                         lr, force_leg="mesh")
+
+        def run_host(leg):
+            return ENGINE.aggregate_flat(g, deltas, weights, selected,
+                                         lr, force_leg=leg)
+
+        # compile-bearing first mesh call, then warm medians all legs
+        t0 = time.perf_counter()
+        out_mesh = run_mesh()
+        first_mesh_s = time.perf_counter() - t0
+        mesh_t, host_t = [], []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run_mesh()
+            mesh_t.append(time.perf_counter() - t0)
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out_host = run_host("legacy")
+            host_t.append(time.perf_counter() - t0)
+        out_spec_host = run_host("host")
+        h_host = _hl.sha256(pack_entries(out_host)).hexdigest()
+        h_spec = _hl.sha256(pack_entries(out_spec_host)).hexdigest()
+        h_mesh = _hl.sha256(pack_entries(out_mesh)).hexdigest()
+        equal = h_host == h_mesh == h_spec
+        all_equal = all_equal and equal
+
+        row = {
+            "host_agg_s": round(statistics.median(host_t), 6),
+            "mesh_agg_s": round(statistics.median(mesh_t), 6),
+            "mesh_first_call_s": round(first_mesh_s, 6),
+            "agg_speedup_x": round(
+                statistics.median(host_t)
+                / max(statistics.median(mesh_t), 1e-9), 2),
+            "hashes_equal": equal,
+        }
+        if score_leg:
+            from bflc_demo_tpu.meshagg.engine import \
+                stacked_tree_from_rows
+
+            def score_once():
+                # the staged-rows fast path: one stack + one device
+                # put per LEAF + one vmapped program (timed end to end
+                # including the stacking — the committee-at-scale cost)
+                st = stacked_tree_from_rows(rows, g)
+                return np.asarray(score_candidates_batched(
+                    apply_fn, g, None, lr, xj, yj, stacked=st))
+
+            score_once()                            # warm (compile)
+            sc_t = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                score_once()
+                sc_t.append(time.perf_counter() - t0)
+            row["score_batched_s"] = round(
+                statistics.median(sc_t), 6)
+            # the reference-shaped loop: one dispatch per candidate
+            from bflc_demo_tpu.core.losses import accuracy as _acc
+
+            tmpl_deltas = [{k: jnp.asarray(d[k]) for k in shapes}
+                           for d in deltas]
+
+            @jax.jit
+            def _eval_one(params, d, x_, y_):
+                cand = {k: params[k] - lr * d[k] for k in params}
+                return _acc(apply_fn(cand, x_), y_)
+
+            _eval_one(g, tmpl_deltas[0], xj, yj)    # warm
+            t0 = time.perf_counter()
+            for d in tmpl_deltas:
+                _eval_one(g, d, xj, yj)
+            row["score_loop_s"] = round(time.perf_counter() - t0, 6)
+            row["score_speedup_x"] = round(
+                row["score_loop_s"] / max(row["score_batched_s"],
+                                          1e-9), 2)
+        legs[n] = row
+
+    n_lo, n_hi = min(batch_sizes), max(batch_sizes)
+    out = {
+        "geometry": {"leaf_shapes": {k: list(s)
+                                     for k, s in shapes.items()},
+                     "params_per_delta": params_per_delta,
+                     "batch_sizes": list(batch_sizes),
+                     "spec_version": magg_spec.SPEC_VERSION},
+        "platform": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "legs": legs,
+        "hashes_equal": all_equal,
+        "programs_compiled": ENGINE.compile_total - compile_before,
+        "engine": ENGINE.report(),
+        # growth across the measured range: 1.0 = flat, n_hi/n_lo =
+        # perfectly linear
+        "n_growth_x": round(n_hi / n_lo, 2),
+        "host_agg_growth_x": round(
+            legs[n_hi]["host_agg_s"]
+            / max(legs[n_lo]["host_agg_s"], 1e-9), 2),
+        "mesh_agg_growth_x": round(
+            legs[n_hi]["mesh_agg_s"]
+            / max(legs[n_lo]["mesh_agg_s"], 1e-9), 2),
+    }
     return out

@@ -125,7 +125,13 @@ def cell_partial(admitted: List[Tuple[str, Dict[str, np.ndarray], int,
     accumulated in SORTED SENDER ORDER with float32 ops so the result is
     a pure function of the admitted SET (float addition is not
     associative; pinning the order is what makes the canonical bytes,
-    and therefore the certified hash, arrival-order independent)."""
+    and therefore the certified hash, arrival-order independent).
+
+    The sum runs through the meshagg engine under the SAME reduction
+    spec as the root writer's merge (meshagg.spec, REDUCTION SPEC v1:
+    sorted-sender slot order here plays the ledger-slot-order role), so
+    a large cell's partial is one compiled program and the bytes are
+    identical to the pre-engine loop on every leg."""
     if not admitted:
         raise ValueError("cell_partial over an empty admitted set")
     ordered = sorted(admitted, key=lambda t: t[0])
@@ -135,17 +141,16 @@ def cell_partial(admitted: List[Tuple[str, Dict[str, np.ndarray], int,
     if np.any(w <= 0):
         raise ValueError("non-positive sample count in the admitted set")
     wsum = np.float32(w.sum())
-    out: Dict[str, np.ndarray] = {}
     keys = sorted(ordered[0][1].keys())
     for _, flat, _, _ in ordered[1:]:
         if sorted(flat.keys()) != keys:
             raise ValueError("admitted deltas disagree on entry keys")
-    for key in keys:
-        acc = np.zeros_like(np.asarray(ordered[0][1][key], np.float32))
-        for (_, flat, n, _), wi in zip(ordered, w):
-            acc = acc + np.asarray(flat[key], np.float32) \
-                * np.float32(wi / wsum)
-        out[key] = acc.astype(np.asarray(ordered[0][1][key]).dtype)
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+    accs = ENGINE.weighted_sum(keys, [flat for _, flat, _, _ in ordered],
+                               w, float(wsum))
+    out: Dict[str, np.ndarray] = {
+        key: accs[key].astype(np.asarray(ordered[0][1][key]).dtype)
+        for key in keys}
     mean_cost = float(np.float32(
         np.sum(np.asarray([c for _, _, _, c in ordered], np.float32))
         / np.float32(len(ordered))))
@@ -175,7 +180,7 @@ def check_cell_upload_op(op: bytes,
     client-count weight must not exceed that cell's registered
     membership.  (The #cellmeta cell-index <-> sender binding lives in
     the blob, so only the root writer's admission can enforce it —
-    ``ledger_service._cell_admission_error``.)"""
+    ``ledger_service._decode_cell_partial``.)"""
     if not op or op[0] != _OP_UPLOAD:
         return ""
     body = op[1:]

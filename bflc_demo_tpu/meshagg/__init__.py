@@ -1,0 +1,31 @@
+"""On-mesh batched aggregation engine (meshagg).
+
+One compiled program per round geometry for the three per-delta hot
+paths every subsystem funnels through — weighted FedAvg merges (sync
+rounds), staleness-weighted FedBuff drains (async mode), and committee
+candidate scoring — replacing the O(N) host-side Python/numpy loops
+that walked one pytree per client.
+
+The certified arithmetic is pinned by `meshagg.spec` (REDUCTION SPEC
+v1): a fixed-order, seed- and device-count-independent reduction that
+the host-loop leg and the compiled mesh leg implement byte-identically
+on the same platform, so the model hashes the writer commits (and a
+validator quorum may one day re-derive) do not depend on which leg ran.
+`BFLC_MESH_AGG_LEGACY=1` pins the host loop byte-for-byte with the
+pre-engine tree.
+"""
+
+from bflc_demo_tpu.meshagg.engine import (ENGINE, MeshAggEngine,
+                                          score_candidates_batched)
+from bflc_demo_tpu.meshagg.spec import (SPEC_VERSION, apply_step,
+                                        host_weighted_sum,
+                                        legacy_host_weighted_sum,
+                                        merge_coefficients,
+                                        merge_weight_vector)
+
+__all__ = [
+    "ENGINE", "MeshAggEngine", "score_candidates_batched",
+    "SPEC_VERSION", "apply_step", "host_weighted_sum",
+    "legacy_host_weighted_sum", "merge_coefficients",
+    "merge_weight_vector",
+]

@@ -1,0 +1,446 @@
+"""The batched aggregation/scoring engine: one compiled program per
+round geometry.
+
+`MeshAggEngine` is the one reduction surface every certified
+aggregation path calls (writer sync merge, async FedBuff drain, hier
+cell partial).  Two legs, byte-identical by construction
+(`meshagg.spec`):
+
+- **host leg** — the pre-engine numpy loop (spec.host_weighted_sum),
+  O(N x leaves) interpreter dispatches;
+- **mesh leg** — the N admitted (dequantized) deltas as ONE stacked
+  ``(N, P)`` float32 matrix (each delta's leaves raveled in sorted key
+  order — the reduction is elementwise, so packing cannot change the
+  bytes) reduced by one compiled program pair per geometry: the
+  per-slot scaling and selection masking (``t_i = selected_i ?
+  d_i * c_i : 0``) vectorize over the whole stacked ``clients`` axis in
+  a TERMS executable, then a separate SCAN executable accumulates the
+  masked terms in the spec's fixed ascending-slot order.  The split is
+  load-bearing: fused in one program, this toolchain's backend
+  contracts ``acc + d*c`` into an FMA even across an
+  optimization_barrier, which changes the low bit and would fork the
+  certified hash from the host leg (measured; spec step 3) — a
+  compiler cannot contract across executables.  Masked +0.0 terms are
+  added, never skipped, exactly as the spec's step 4 defines (the FTZ
+  ``-0`` normalization corner), and NaN/inf in an unselected slot is
+  masked out before it can poison the sum.
+
+The writer STAGES each delta's flattened row at admission
+(`flatten_delta` — it decodes every blob for schema checking anyway),
+so at aggregate time the mesh leg pays one `np.stack` plus one program
+dispatch instead of re-walking N pytrees in Python.  Programs compile
+once per ``(N, P)`` signature — independent of tree structure, so a
+transformer and an MLP at the same geometry share a program — and
+`mesh_agg_compile_total` counts the cache misses.
+
+Because the legs are bit-identical, choosing between them is pure
+performance policy: batches below ``BFLC_MESH_AGG_MIN`` (default 16)
+stay on the host loop where trace/compile overhead dominates,
+`BFLC_MESH_AGG_LEGACY=1` pins the host loop unconditionally, and any
+jax failure (or a platform whose compiler breaks the no-FMA contract —
+caught by a one-time differential SELF-CHECK at first mesh use) falls
+back to the host loop rather than ever committing divergent bytes.
+
+`score_candidates_batched` is the committee-scoring twin: it stacks the
+candidate deltas and evaluates all of them in one vmapped program
+(core.scoring), sharding the stacked candidate axis over a ``clients``
+device mesh when more than one device is present — scores are
+per-candidate independent, so sharding cannot change them.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bflc_demo_tpu.meshagg import spec
+from bflc_demo_tpu.obs import metrics as obs_metrics
+
+Pytree = Any
+
+_M_SECONDS = obs_metrics.REGISTRY.histogram(
+    "mesh_agg_seconds",
+    "batched aggregation/scoring engine wall time per call",
+    ("kernel", "leg"))
+_M_BATCH = obs_metrics.REGISTRY.histogram(
+    "mesh_agg_batch_size",
+    "stacked deltas per engine reduction call",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, float("inf")))
+_C_COMPILE = obs_metrics.REGISTRY.counter(
+    "mesh_agg_compile_total",
+    "engine programs compiled (cache misses per round geometry)",
+    ("kernel",))
+
+_CACHE_CAP = 64         # distinct (N, P) programs kept per process
+_SCAN_UNROLL = 8        # loop-overhead amortisation; order unchanged
+
+
+def _legacy() -> bool:
+    """BFLC_MESH_AGG_LEGACY=1 pins the host loop byte-for-byte."""
+    return bool(os.environ.get("BFLC_MESH_AGG_LEGACY"))
+
+
+def _min_batch() -> int:
+    """Smallest stacked-delta count routed to the compiled leg.  Pure
+    performance policy (the legs are byte-identical): below it, one
+    trace/compile costs more than N numpy dispatches save."""
+    try:
+        return int(os.environ.get("BFLC_MESH_AGG_MIN", "16"))
+    except ValueError:
+        return 16
+
+
+def flatten_delta(flat: Dict[str, np.ndarray],
+                  keys: Sequence[str]) -> np.ndarray:
+    """One delta as a contiguous ``(P,)`` float32 row: leaves raveled in
+    `keys` order.  This is the staged-at-admission representation the
+    mesh leg stacks — pure repacking, so the reduction over rows is
+    elementwise-identical to the per-leaf loops."""
+    if not keys:
+        return np.zeros(0, np.float32)
+    return np.concatenate([np.asarray(flat[k], np.float32).ravel()
+                           for k in keys])
+
+
+def _leaf_layout(keys: Sequence[str], flat: Dict[str, np.ndarray]):
+    """[(key, offset, size, shape)] describing `flatten_delta`'s row."""
+    layout, off = [], 0
+    for k in keys:
+        a = np.asarray(flat[k])
+        layout.append((k, off, int(a.size), a.shape))
+        off += int(a.size)
+    return layout, off
+
+
+class MeshAggEngine:
+    """Process-wide engine instance (module singleton ``ENGINE``)."""
+
+    def __init__(self) -> None:
+        self._programs: Dict[tuple, Any] = {}
+        self.compile_total = 0
+        self.score_geometries: Dict[tuple, bool] = {}
+        self.calls = {"mesh": 0, "host": 0}
+        self.last_leg = "unused"
+        self._selfcheck: Optional[bool] = None     # None = not yet run
+
+    # ------------------------------------------------------------ policy
+    def report(self) -> Dict[str, Any]:
+        """Evidence block for bench artifacts: which leg actually ran,
+        whether the no-FMA self-check held, and the compile count."""
+        return {
+            "spec_version": spec.SPEC_VERSION,
+            "legacy_pin": _legacy(),
+            "min_batch": _min_batch(),
+            "last_leg": self.last_leg,
+            "calls": dict(self.calls),
+            "selfcheck": ("untested" if self._selfcheck is None
+                          else "ok" if self._selfcheck else "FAILED"),
+            "compile_total": self.compile_total,
+            "cached_programs": len(self._programs),
+        }
+
+    def staging_worthwhile(self, max_batch: int) -> bool:
+        """True iff the mesh leg could ever consume a staged row at
+        this server's geometry (`max_batch` = the largest merge the
+        protocol can produce, max(needed_update_count, async_buffer)):
+        not legacy-pinned, batch ceiling reaching the min-batch policy,
+        and no already-failed self-check.  Deliberately does NOT
+        trigger the self-check — admission must stay cheap; a row
+        staged before a later self-check failure is simply popped
+        unread.  Keeps the O(P) flatten + duplicate float32 row off
+        the admission path entirely for fleets the compiled leg can
+        never serve."""
+        if _legacy() or max_batch < _min_batch():
+            return False
+        return self._selfcheck is not False
+
+    def choose_leg(self, n: int) -> str:
+        """The policy: legacy pin > min batch > self-check > mesh.
+        'legacy' is the verbatim pre-engine loop (gradual underflow);
+        'host' is the spec's FTZ host loop; 'mesh' the compiled leg —
+        'host' and 'mesh' are byte-identical everywhere, and both
+        coincide with 'legacy' on the subnormal-free domain."""
+        if _legacy():
+            return "legacy"
+        return ("mesh" if n >= _min_batch() and self._mesh_ready()
+                else "host")
+
+    def _mesh_ready(self) -> bool:
+        """True iff the compiled leg may be used: not pinned off, jax
+        importable, and the one-time differential self-check passed."""
+        if _legacy():
+            return False
+        return self.run_selfcheck()
+
+    def run_selfcheck(self) -> bool:
+        """Force the one-time differential self-check (idempotent) and
+        return its verdict — the benchmark/checker arming hook, so an
+        artifact's `selfcheck` field is a real measurement even when
+        every call below used an explicit force_leg."""
+        if self._selfcheck is None:
+            self._selfcheck = self._run_selfcheck()
+        return bool(self._selfcheck)
+
+    def _run_selfcheck(self) -> bool:
+        """One canned differential scenario (mixed shapes, a zeroed
+        weight, denormal + large magnitudes): the compiled leg must
+        reproduce the host leg's bytes exactly, or the platform's
+        compiler is contracting the spec's mul/add and the engine must
+        never touch a certified path here."""
+        try:
+            rng = np.random.default_rng(7)
+            keys = ["a", "b", "c"]
+            shapes = {"a": (9, 4), "b": (5,), "c": ()}
+            n = 19
+            flats = []
+            for _ in range(n):
+                f = {k: (rng.standard_normal(shapes[k])
+                         * 10.0 ** float(rng.integers(-8, 8))
+                         ).astype(np.float32) for k in keys}
+                flats.append(f)
+            flats[2]["a"][0, 0] = np.float32(1e-42)
+            flats[4]["a"][1, 1] = np.float32(3.1e38)
+            w = (rng.random(n).astype(np.float32) * 40.0)
+            w[3] = 0.0
+            wsum = max(float(w.sum()), 1e-12)
+            host = spec.host_weighted_sum(keys, flats, w, wsum)
+            mesh = self._mesh_weighted_sum(keys, flats, w, wsum)
+            ok = all(np.asarray(host[k]).tobytes()
+                     == np.asarray(mesh[k]).tobytes() for k in keys)
+            if not ok:
+                warnings.warn(
+                    "meshagg: compiled reduction diverged from the "
+                    "host leg on this platform (FMA contraction?) — "
+                    "falling back to the host loop for all certified "
+                    "aggregation", RuntimeWarning)
+            return ok
+        except Exception as e:                      # noqa: BLE001
+            warnings.warn(f"meshagg: self-check could not run ({e}) — "
+                          f"host loop pinned", RuntimeWarning)
+            return False
+
+    # ------------------------------------------------------- mesh leg
+    def _program(self, n: int, p: int):
+        """(terms_fn, reduce_fn) for one (N, P) geometry.  Spec step 3
+        (masked scaling) and step 4 (fixed-order accumulation) are TWO
+        separate executables on purpose: inside one program this
+        toolchain's backend contracts ``acc + d*c`` into an FMA even
+        across an optimization_barrier (measured — it forks the
+        certified hash from the host leg by one ulp), and a compiler
+        cannot contract across executable boundaries."""
+        sig = (n, p)
+        fns = self._programs.get(sig)
+        if fns is not None:
+            return fns
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def terms_fn(coeffs, gates, mat):
+            # spec step 3: masked terms — unselected rows contribute
+            # literal +0.0 (exactly the host leg's masked add), and a
+            # NaN/inf in an unselected delta is masked out here
+            return jnp.where(gates[:, None], mat * coeffs[:, None],
+                             jnp.float32(0.0))
+
+        def reduce_fn(terms):
+            # spec step 4: strict ascending-slot accumulation
+            def body(acc, t):
+                return acc + t, None
+
+            acc, _ = lax.scan(body, jnp.zeros((p,), jnp.float32),
+                              terms, unroll=_SCAN_UNROLL)
+            return acc
+
+        fns = (jax.jit(terms_fn), jax.jit(reduce_fn))
+        if len(self._programs) >= _CACHE_CAP:
+            self._programs.pop(next(iter(self._programs)))
+        self._programs[sig] = fns
+        self.compile_total += 1
+        if obs_metrics.REGISTRY.enabled:
+            _C_COMPILE.inc(kernel="reduce")
+        return fns
+
+    def _mesh_rows(self, rows: List[np.ndarray], w: np.ndarray,
+                   wsum: float) -> np.ndarray:
+        """(P,) float32 accumulator from staged rows — the compiled
+        reduction (terms program + scan program, one dispatch each)."""
+        mat = np.stack(rows)
+        coeffs = spec.merge_coefficients(w, wsum)
+        gates = np.asarray(w, np.float32) > 0.0
+        terms_fn, reduce_fn = self._program(mat.shape[0], mat.shape[1])
+        return np.asarray(reduce_fn(terms_fn(coeffs, gates, mat)))
+
+    def _mesh_weighted_sum(self, keys: Sequence[str],
+                           delta_flats: List[Dict[str, np.ndarray]],
+                           w: np.ndarray, wsum: float
+                           ) -> Dict[str, np.ndarray]:
+        rows = [flatten_delta(d, keys) for d in delta_flats]
+        layout, _ = _leaf_layout(keys, delta_flats[0])
+        acc = self._mesh_rows(rows, w, wsum)
+        return {k: acc[off:off + size].reshape(shape)
+                for k, off, size, shape in layout}
+
+    # ---------------------------------------------------- public entries
+    def weighted_sum(self, keys: Sequence[str],
+                     delta_flats: List[Dict[str, np.ndarray]],
+                     w: np.ndarray, wsum: float, *,
+                     force_leg: Optional[str] = None
+                     ) -> Dict[str, np.ndarray]:
+        """Spec steps 3-4 over the admitted set: float32 accumulators
+        per key.  ``force_leg`` ('host'/'mesh') is the benchmark /
+        differential-checker override; normal callers leave it None and
+        get the policy."""
+        n = len(delta_flats)
+        leg = force_leg if force_leg is not None else self.choose_leg(n)
+        t0 = (time.perf_counter()
+              if obs_metrics.REGISTRY.enabled else 0.0)
+        if leg == "mesh":
+            try:
+                out = self._mesh_weighted_sum(keys, delta_flats, w, wsum)
+            except Exception as e:                  # noqa: BLE001
+                if force_leg == "mesh":
+                    raise
+                warnings.warn(f"meshagg: compiled leg failed ({e}) — "
+                              f"host fallback", RuntimeWarning)
+                leg = "host"
+                out = spec.host_weighted_sum(keys, delta_flats, w, wsum)
+        elif leg == "legacy":
+            out = spec.legacy_host_weighted_sum(keys, delta_flats, w,
+                                                wsum)
+        else:
+            out = spec.host_weighted_sum(keys, delta_flats, w, wsum)
+        self._account(leg, n, t0)
+        return out
+
+    def aggregate_flat(self, global_flat: Dict[str, np.ndarray],
+                       delta_flats: List[Dict[str, np.ndarray]],
+                       weights: Sequence[float], selected: Sequence[int],
+                       lr: float, *, force_leg: Optional[str] = None
+                       ) -> Dict[str, np.ndarray]:
+        """The writer merge (spec steps 1-5): FedAvg / FedBuff-drain
+        update of ``global_flat`` by the selected deltas."""
+        w = spec.merge_weight_vector(weights, selected, len(delta_flats))
+        wsum = max(float(w.sum()), 1e-12)
+        accs = self.weighted_sum(list(global_flat.keys()), delta_flats,
+                                 w, wsum, force_leg=force_leg)
+        return spec.apply_step(global_flat, accs, lr)
+
+    def aggregate_rows(self, global_flat: Dict[str, np.ndarray],
+                       rows: List[np.ndarray],
+                       weights: Sequence[float], selected: Sequence[int],
+                       lr: float, *, force_leg: Optional[str] = None
+                       ) -> Dict[str, np.ndarray]:
+        """The writer merge over STAGED rows (`flatten_delta` images in
+        sorted-key order, built at admission): one `np.stack` + one
+        program, no per-leaf Python at aggregate time.  Falls back to
+        the host loop by unflattening the rows — the rows carry the
+        exact decode bytes, so the fallback is byte-identical too."""
+        keys = sorted(global_flat.keys())
+        n = len(rows)
+        w = spec.merge_weight_vector(weights, selected, n)
+        wsum = max(float(w.sum()), 1e-12)
+        layout, p = _leaf_layout(keys, global_flat)
+        leg = force_leg if force_leg is not None else self.choose_leg(n)
+        t0 = (time.perf_counter()
+              if obs_metrics.REGISTRY.enabled else 0.0)
+        if leg == "mesh":
+            try:
+                acc = self._mesh_rows(rows, w, wsum)
+                accs = {k: acc[off:off + size].reshape(shape)
+                        for k, off, size, shape in layout}
+            except Exception as e:                  # noqa: BLE001
+                if force_leg == "mesh":
+                    raise
+                warnings.warn(f"meshagg: compiled leg failed ({e}) — "
+                              f"host fallback", RuntimeWarning)
+                leg = "host"
+                accs = None
+        else:
+            accs = None
+        if accs is None:
+            flats = [{k: r[off:off + size].reshape(shape)
+                      for k, off, size, shape in layout} for r in rows]
+            host_fn = (spec.legacy_host_weighted_sum
+                       if leg == "legacy" else spec.host_weighted_sum)
+            accs = host_fn(keys, flats, w, wsum)
+        self._account(leg, n, t0)
+        return spec.apply_step(global_flat, accs, lr)
+
+    def _account(self, leg: str, n: int, t0: float) -> None:
+        self.calls[leg] = self.calls.get(leg, 0) + 1
+        self.last_leg = leg
+        if obs_metrics.REGISTRY.enabled:
+            _M_SECONDS.observe(time.perf_counter() - t0,
+                               kernel="reduce", leg=leg)
+            _M_BATCH.observe(n)
+
+
+ENGINE = MeshAggEngine()
+
+
+def stacked_tree_from_rows(rows: List[np.ndarray],
+                           template_flat: Dict[str, np.ndarray]
+                           ) -> Dict[str, Any]:
+    """Stacked candidate pytree (leaves shaped ``(N, ...)``) built from
+    flattened rows (`flatten_delta` images in sorted-key order of
+    `template_flat`).  One `np.stack` + one device put per LEAF instead
+    of N x L tiny transfers — the fast path for scoring a large
+    candidate set (an async buffer or hier root at fleet scale)."""
+    import jax.numpy as jnp
+
+    keys = sorted(template_flat.keys())
+    layout, _ = _leaf_layout(keys, template_flat)
+    mat = np.stack(rows)
+    return {k: jnp.asarray(
+        mat[:, off:off + size].reshape((mat.shape[0],) + tuple(shape)))
+        for k, off, size, shape in layout}
+
+
+def score_candidates_batched(apply_fn, global_params: Pytree,
+                             deltas: Optional[List[Pytree]], lr: float,
+                             x, y, *, stacked: Optional[Pytree] = None):
+    """All candidate scores in ONE program: stack the K candidate
+    deltas and run `core.scoring.score_candidates` (vmap over the
+    stacked axis).  Pass `stacked` (e.g. `stacked_tree_from_rows`) to
+    skip the per-tree stacking for large candidate sets.  With a
+    multi-device backend the stacked ``clients`` axis is sharded over a
+    1-D device mesh (scores are per-candidate independent, so placement
+    cannot change them); a non-divisible batch or a single device keeps
+    the replicated layout.  Returns a (K,) score array."""
+    import jax
+    import jax.numpy as jnp
+
+    from bflc_demo_tpu.core.scoring import score_candidates
+
+    if stacked is None:
+        stacked = jax.tree_util.tree_map(lambda *t: jnp.stack(t),
+                                         *deltas)
+    leaves = jax.tree_util.tree_leaves(stacked)
+    n = int(leaves[0].shape[0]) if leaves else 0
+    devs = jax.devices()
+    if len(devs) > 1 and n % len(devs) == 0 and not _legacy():
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(np.asarray(devs), ("clients",))
+        stacked = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(
+                mesh, PartitionSpec("clients"))), stacked)
+    # the score program is jit-cached by (apply_fn, leaf geometry) —
+    # mirror that in the compile evidence (a same-K different-shaped
+    # model IS a fresh compile, unlike the flat reduce kernel)
+    sig = (id(apply_fn), len(devs),
+           tuple((tuple(a.shape), str(a.dtype)) for a in leaves))
+    if sig not in ENGINE.score_geometries:
+        ENGINE.score_geometries[sig] = True
+        _C_COMPILE.inc(kernel="score")
+    t0 = time.perf_counter() if obs_metrics.REGISTRY.enabled else 0.0
+    out = score_candidates(apply_fn, global_params, stacked, lr, x, y)
+    if obs_metrics.REGISTRY.enabled:
+        _M_SECONDS.observe(time.perf_counter() - t0,
+                           kernel="score",
+                           leg="mesh" if len(devs) > 1 else "host")
+    return out

@@ -1,0 +1,183 @@
+"""REDUCTION SPEC v1 — the fixed-order deterministic aggregation rule.
+
+Validators re-derive the committed model hash (ROADMAP "validator-side
+FedAvg re-derivation"), so the weighted-merge arithmetic is PROTOCOL,
+not an implementation detail: every leg that computes it — the
+coordinator's host loop, the compiled mesh program, a re-deriving
+validator — must produce the same bytes from the same admitted set.
+Float addition is not associative, so "the same bytes" requires pinning
+the reduction ORDER — and, it turns out, the SUBNORMAL handling — not
+just the formula.  This module is the normative statement (and the
+host-leg implementation) of both.
+
+Inputs: N admitted deltas d_0..d_{N-1} in ledger slot order (ascending
+admission index — replicated state, identical on every replica), their
+merge weights, and the selected subset.
+
+**Arithmetic domain.**  All tensor arithmetic is IEEE float32 with
+FLUSH-TO-ZERO / DENORMALS-ARE-ZERO semantics: a subnormal operand
+reads as (signed) zero and a subnormal result flushes to (signed)
+zero.  FTZ is what the accelerator platforms the mesh leg compiles to
+actually execute (XLA:CPU pins FTZ+DAZ in its execution threads; TPU
+vector units are FTZ in hardware) and cannot be disabled there, so the
+spec adopts it rather than pretending gradual underflow is available.
+The host leg emulates it explicitly (`_daz`).  On the subnormal-free
+domain — every real model/delta exercised in this repo — FTZ float32
+is bit-identical to plain float32, which is why the historical chain's
+hashes are unchanged.  The pre-engine loop (gradual underflow, what
+`BFLC_MESH_AGG_LEGACY=1` pins byte-for-byte) coincides with the spec
+everywhere except subnormal corners.
+
+1. **Weight vector.**  ``w`` is an (N,) float32 vector: ``w[i] =
+   float32(weights[i])`` for selected slots, ``0.0`` otherwise.  On the
+   sync path ``weights[i] = n_samples_i``; on the async (FedBuff) path
+   ``weights[i] = float32(n_samples_i / sqrt(1 + staleness_i))``
+   (`ledger.base.staleness_weight` — the one definition); on the hier
+   cell tier ``weights[i] = n_samples_i`` of the cell-selected member.
+
+2. **Normalizer.**  ``wsum = max(float64(sum(w)), 1e-12)`` for the
+   writer's merge (the 1e-12 clamp keeps an empty selection inert);
+   the cell partial uses ``wsum = float32(sum(w))`` over its all-
+   positive weights.  Either way each per-slot coefficient is the IEEE
+   float32 quotient ``c[i] = w[i] / float32(wsum)`` (a float64 ``wsum``
+   that round-trips float32 exactly divides identically).
+
+3. **Terms.**  ``t_i = daz(d_i) * daz(c[i])`` flushed — one FTZ float32
+   multiply per element, NEVER fused with the accumulation (an FMA
+   contraction of ``acc + d*c`` changes the low bit; the mesh kernel
+   materialises the terms in a SEPARATE compiled program from the
+   reduction so the compiler cannot contract across them, and the host
+   leg's numpy has no FMA).  Unselected slots' terms are literal
+   ``+0.0``.
+
+4. **Fixed-order accumulation.**  ``acc`` starts at float32 zeros and
+   gains the terms STRICTLY SEQUENTIALLY in ascending slot order::
+
+       for i in 0..N-1:  acc = ftz(acc + t_i)
+
+   EVERY slot is added, unselected slots as literal ``+0.0`` — not
+   skipped: under FTZ an accumulator can reach ``-0`` (a subnormal
+   negative sum flushes to it), and ``-0 + (+0) == +0`` normalizes it
+   where a skip would not, so "add the masked term" is the normative
+   rule and both legs follow it.  A NaN/inf in an UNSELECTED delta is
+   masked out before it can poison the sum.  Spec v1
+   deliberately fixes the block count at ONE (pure sequential): it is
+   the historical chain's order, so certified hashes are unchanged
+   under the engine, and it is independent of device count — a 1-chip
+   validator re-derives a 256-chip writer's bytes.  A future spec rev
+   may introduce a fixed, protocol-agreed block structure for
+   cross-device psum-style reductions; that is a chain-visible change
+   and must ride a protocol genome field, never jax.device_count().
+
+5. **Model update** (writer merge only).  Per leaf,
+   ``new = float32(g) - float32(lr) * acc`` cast back to the leaf's
+   stored dtype — applied host-side in BOTH legs (separate IEEE mul +
+   sub, numpy, no FMA), so the tail is one shared implementation.
+
+Everything here is seed-independent and platform-deterministic: FTZ
+float32 multiply/add/divide are correctly rounded and identically
+flushed on every platform this repo targets, and the engine SELF-CHECKS
+the contract at first use (falling back to the host loop if a
+toolchain breaks it — e.g. by contracting step 3 into step 4).
+`tools/check_reduction_spec.py` is the standalone differential checker.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+SPEC_VERSION = 1
+
+# smallest normal float32 (2**-126): the FTZ/DAZ threshold
+MIN_NORMAL = np.float32(1.1754944e-38)
+
+
+def _daz(x: np.ndarray) -> np.ndarray:
+    """Flush subnormal float32 values to SIGNED zero (identity on the
+    normal range, on ±0, ±inf and NaN) — the spec's FTZ/DAZ emulation
+    for the host leg.  Multiplying by the 0/1 mask is exact and keeps
+    the sign: ``-denormal * 0.0 == -0.0``."""
+    a = np.asarray(x, np.float32)
+    return a * (np.abs(a) >= MIN_NORMAL).astype(np.float32)
+
+
+def merge_weight_vector(weights: Sequence[float], selected: Sequence[int],
+                        n: int) -> np.ndarray:
+    """(N,) float32 ``w`` per spec step 1 — byte-identical to the
+    pre-engine ``_aggregate_flat`` preamble."""
+    w = np.zeros(n, np.float32)
+    for s in selected:
+        w[s] = float(weights[s])
+    return w
+
+
+def merge_coefficients(w: np.ndarray, wsum: float) -> np.ndarray:
+    """(N,) float32 ``c`` per spec step 2.  The vectorized float32
+    divide produces the same IEEE quotients as the legacy loop's
+    per-term ``w[i] / wsum`` (numpy NEP 50: a weak python-float divisor
+    is applied at float32)."""
+    return (w / np.float32(wsum)).astype(np.float32)
+
+
+def host_weighted_sum(keys: Sequence[str],
+                      delta_flats: List[Dict[str, np.ndarray]],
+                      w: np.ndarray, wsum: float
+                      ) -> Dict[str, np.ndarray]:
+    """The HOST-LOOP leg of spec steps 3-4: FTZ float32, masked terms,
+    strict ascending-slot accumulation.  Returns float32 accumulators
+    per key.  Coincides with `legacy_host_weighted_sum` everywhere no
+    subnormal enters the reduction."""
+    coeffs = _daz(merge_coefficients(w, wsum))
+    gates = np.asarray(w, np.float32) > 0.0
+    out: Dict[str, np.ndarray] = {}
+    with np.errstate(invalid="ignore", over="ignore"):
+        for key in keys:
+            acc = None
+            for i, d in enumerate(delta_flats):
+                leaf = np.asarray(d[key], np.float32)
+                if acc is None:
+                    acc = np.zeros_like(leaf)
+                if gates[i]:
+                    acc = _daz(acc + _daz(_daz(leaf) * coeffs[i]))
+                else:
+                    # the masked +0 add (spec step 4): normalizes an
+                    # FTZ-produced -0 accumulator exactly like the
+                    # kernel's where-masked term does
+                    acc = _daz(acc + np.float32(0.0))
+            out[key] = acc if acc is not None else np.float32(0.0)
+    return out
+
+
+def legacy_host_weighted_sum(keys: Sequence[str],
+                             delta_flats: List[Dict[str, np.ndarray]],
+                             w: np.ndarray, wsum: float
+                             ) -> Dict[str, np.ndarray]:
+    """The PRE-ENGINE reduction, verbatim (gradual underflow, per-term
+    ``w[i] / wsum``): what ``BFLC_MESH_AGG_LEGACY=1`` pins byte-for-
+    byte, hoisted from the original ``_aggregate_flat`` /
+    ``hier.partial.cell_partial`` loops."""
+    out: Dict[str, np.ndarray] = {}
+    for key in keys:
+        acc = None
+        for i, d in enumerate(delta_flats):
+            leaf = np.asarray(d[key], np.float32)
+            if acc is None:
+                acc = np.zeros_like(leaf)
+            if w[i] > 0.0:
+                acc = acc + leaf * (w[i] / wsum)
+        out[key] = acc if acc is not None else np.float32(0.0)
+    return out
+
+
+def apply_step(global_flat: Dict[str, np.ndarray],
+               accs: Dict[str, np.ndarray], lr: float
+               ) -> Dict[str, np.ndarray]:
+    """Spec step 5: ``g - lr * acc`` per leaf, cast to the stored
+    dtype.  Host-side numpy in BOTH legs (separate IEEE mul + sub)."""
+    out: Dict[str, np.ndarray] = {}
+    for key, g in global_flat.items():
+        out[key] = (np.asarray(g, np.float32) - lr * accs[key]).astype(
+            np.asarray(g).dtype)
+    return out

@@ -379,6 +379,116 @@ class TestAsyncService:
         assert not r["ok"] and r["status"] == "BAD_ARG"
 
 
+class TestAsyncQuantizedDeltas:
+    """Quantized x async interaction (ISSUE 11 satellite): i8/f16
+    `--delta-dtype` uploads through the async buffer — admission
+    schema-checks the DEQUANTIZED image, the staleness-weighted drain
+    merges it, and the committed model equals the spec-side
+    recomputation from the same quantized bytes."""
+
+    @pytest.mark.parametrize("dtype", ["f16", "i8"])
+    def test_quantized_upload_staleness_drain(self, dtype):
+        import dataclasses as _dc
+
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        from bflc_demo_tpu.comm.ledger_service import (
+            CoordinatorClient, LedgerServer)
+        from bflc_demo_tpu.ledger.base import staleness_weight
+        from bflc_demo_tpu.meshagg.engine import ENGINE
+        from bflc_demo_tpu.utils.serialization import (
+            dequantize_entries, pack_entries, pack_pytree,
+            pack_quantized, unpack_pytree)
+
+        cfg = _dc.replace(ACFG, client_num=8, needed_update_count=4,
+                          async_buffer=2, max_staleness=4,
+                          delta_dtype=dtype).validate()
+        rng = np.random.default_rng(31)
+        g0 = {"W": rng.standard_normal((6, 3)).astype(np.float32),
+              "b": rng.standard_normal((3,)).astype(np.float32)}
+        blob0 = pack_pytree(g0)
+        wallets, _ = provision_wallets(8, b"async-quant-seed")
+        srv = LedgerServer(cfg, blob0)
+        srv.start()
+        cl = CoordinatorClient(srv.host, srv.port)
+        sent = {}
+        try:
+            from bflc_demo_tpu.comm.identity import _op_bytes
+
+            def sign(w, kind, epoch, payload):
+                return w.sign(_op_bytes(kind, w.address, epoch,
+                                        payload)).hex()
+
+            for w in wallets:
+                assert cl.request("register", addr=w.address,
+                                  pubkey=w.public_bytes.hex(),
+                                  tag=sign(w, "register", 0, b""))["ok"]
+            committee = set(cl.request("committee")["committee"])
+            trainers = [w for w in wallets
+                        if w.address not in committee]
+
+            def aupload(i, w, base):
+                delta = {"W": (rng.standard_normal((6, 3)) * 0.1
+                               ).astype(np.float32),
+                         "b": (rng.standard_normal((3,)) * 0.1
+                               ).astype(np.float32)}
+                blob = pack_quantized(delta, dtype)
+                d = hashlib.sha256(blob).digest()
+                sent[d] = (blob, 10 + i)
+                payload = d + struct.pack("<qd", 10 + i, 1.0)
+                return cl.request(
+                    "aupload", addr=w.address, blob=blob, hash=d.hex(),
+                    n=10 + i, cost=1.0, base_epoch=base,
+                    tag=sign(w, "aupload", base, payload))
+
+            # a delta whose quantized bytes hide a wrong-shaped leaf
+            # still dies at admission (the check runs DEQUANTIZED)
+            bad = pack_quantized({"W": np.zeros((2, 2), np.float32)},
+                                 dtype)
+            bd = hashlib.sha256(bad).digest()
+            r = cl.request("aupload", addr=trainers[0].address,
+                           blob=bad, hash=bd.hex(), n=5, cost=1.0,
+                           base_epoch=0,
+                           tag=sign(trainers[0], "aupload", 0,
+                                    bd + struct.pack("<qd", 5, 1.0)))
+            assert not r["ok"] and "mismatch" in r["error"], r
+
+            # drain 1: two fresh quantized deltas -> epoch 1
+            assert aupload(0, trainers[0], 0)["ok"]
+            r = aupload(1, trainers[1], 0)
+            assert r["ok"] and r["epoch"] == 1, r
+            # drain 2: one stale (base 0 -> s=1) + one fresh upload
+            assert aupload(2, trainers[2], 0)["ok"]
+            au = cl.request("aupdates")
+            assert au["updates"][0]["staleness"] == 1
+            r = aupload(3, trainers[3], 1)
+            assert r["ok"] and r["epoch"] == 2, r
+
+            mr = cl.request("model")
+            got = mr["hash"]
+
+            # recompute both drains from the QUANTIZED bytes through
+            # the one shared dequantizer + the reduction spec:
+            # drain 1 = uploads 0,1 (staleness 0,0); drain 2 =
+            # uploads 2,3 (staleness 1,0 — upload 2 trained on epoch 0
+            # but was admitted at epoch 1)
+            order = list(sent.values())
+            model = unpack_pytree(blob0)    # canonical key paths
+            for (a, b), stales in (((order[0], order[1]), (0, 0)),
+                                   ((order[2], order[3]), (1, 0))):
+                flats = [dequantize_entries(unpack_pytree(a[0])),
+                         dequantize_entries(unpack_pytree(b[0]))]
+                weights = [float(np.float32(
+                    n * staleness_weight(s)))
+                    for (_, n), s in zip((a, b), stales)]
+                model = ENGINE.aggregate_flat(
+                    model, flats, weights, [0, 1], cfg.learning_rate)
+            want = hashlib.sha256(pack_entries(model)).hexdigest()
+            assert got == want
+        finally:
+            cl.close()
+            srv.close()
+
+
 @pytest.mark.filterwarnings("ignore::UserWarning")
 class TestAsyncChaosDrill:
     """Tier-1 async drill: a small fleet under a straggler delay window

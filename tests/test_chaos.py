@@ -271,10 +271,21 @@ class TestReadFanoutDegradation:
         # both read-serving standbys die mid-run; the writer survives,
         # so every later read must fall back to it
         sched.events = [
-            FaultEvent(6.0, "kill", "standby-1"),
-            FaultEvent(8.0, "kill", "standby-2"),
+            FaultEvent(5.0, "kill", "standby-1"),
+            FaultEvent(6.5, "kill", "standby-2"),
         ]
-        sched.wire_windows = {}
+        # a modest persistent delay on EVERY client's writer frames
+        # (both trainers are needed each round at this 2-of-2
+        # geometry) keeps the federation running past the second
+        # kill's wall-clock offset even on an idle fast host — without
+        # it, a quick fleet finishes all 6 rounds before 6.5 s and the
+        # kill is skipped as moot (observed flake)
+        sched.wire_windows = {
+            f"client-{i}": [WireWindow(0.0, 300.0, "delay",
+                                       ("writer",), p=1.0,
+                                       delay_ms=120.0)]
+            for i in range(4)
+        }
         tdir = str(tmp_path / "telemetry")
         res = run_federated_processes(
             "make_softmax_regression", shards, test_set, cfg,

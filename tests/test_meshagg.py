@@ -1,0 +1,361 @@
+"""On-mesh batched aggregation engine (bflc_demo_tpu/meshagg; ISSUE 11).
+
+The hard property under test is DIFFERENTIAL DETERMINISM: the compiled
+mesh leg and the pre-engine host loop must produce byte-identical
+certified bytes (REDUCTION SPEC v1), pinned three ways —
+
+- golden digests captured from the PRE-ENGINE tree for the writer
+  merge and the hier cell partial (`BFLC_MESH_AGG_LEGACY=1` must stay
+  byte-identical to pre-PR forever);
+- golden COMMITTED MODEL HASHES from scripted end-to-end rounds
+  through a real LedgerServer (config-1-shaped sync round AND an async
+  FedBuff drain with a staleness mix), re-run under both legs;
+- the randomized differential checker (tools/check_reduction_spec.py)
+  invoked in-process.
+"""
+
+import hashlib
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.meshagg import spec
+from bflc_demo_tpu.meshagg.engine import (ENGINE, flatten_delta,
+                                          score_candidates_batched)
+from bflc_demo_tpu.utils.serialization import pack_entries, pack_pytree
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+# digests captured from the pre-meshagg tree (ISSUE 11): any drift in
+# the certified aggregation arithmetic — either leg — fails here
+GOLDEN_AGG = ("df85ae5b7b16077404d72e33805da33a"
+              "0d0f97509c3fdcdc91e55ed5e5747ee1")
+GOLDEN_CELL = ("3c8d67f4d02d436e58390d8a065c1f13"
+               "283a13b7e5dfdd5d629a7c56e3b24c53")
+GOLDEN_SYNC_MODEL = ("cc8d5f5257a2dc49be71fe88ce91f039"
+                     "a8779af406cd58ba187933a731bf463f")
+GOLDEN_ASYNC_MODEL = ("9b459d464fb79f6e189c9939f08c8704"
+                      "52ce805ceb909325d9c76c271e39733b")
+
+
+def _golden_scenario():
+    rng = np.random.default_rng(20260804)
+    keys = ["/W1", "/b1", "/W2", "/b2"]
+    shapes = {"/W1": (16, 8), "/b1": (8,), "/W2": (8, 3), "/b2": (3,)}
+    g = {k: rng.standard_normal(shapes[k]).astype(np.float32)
+         for k in keys}
+    deltas = [{k: rng.standard_normal(shapes[k]).astype(np.float32)
+               for k in keys} for _ in range(12)]
+    weights = [float(10 + i) * (1.0 / np.sqrt(1.0 + (i % 4)))
+               for i in range(12)]
+    selected = [0, 2, 3, 5, 7, 8, 10]
+    return rng, keys, shapes, g, deltas, weights, selected
+
+
+class TestGoldenPins:
+    def test_host_leg_pins_pre_pr_merge_bytes(self, monkeypatch):
+        monkeypatch.setenv("BFLC_MESH_AGG_LEGACY", "1")
+        _, _, _, g, deltas, weights, selected = _golden_scenario()
+        out = ENGINE.aggregate_flat(g, deltas, weights, selected, 0.05)
+        assert hashlib.sha256(
+            pack_entries(out)).hexdigest() == GOLDEN_AGG
+
+    def test_mesh_leg_reproduces_pre_pr_merge_bytes(self):
+        _, _, _, g, deltas, weights, selected = _golden_scenario()
+        out = ENGINE.aggregate_flat(g, deltas, weights, selected, 0.05,
+                                    force_leg="mesh")
+        assert hashlib.sha256(
+            pack_entries(out)).hexdigest() == GOLDEN_AGG
+
+    def test_staged_rows_leg_reproduces_pre_pr_merge_bytes(self):
+        # the writer's actual mesh path: rows staged at admission,
+        # merged via aggregate_rows
+        _, keys, _, g, deltas, weights, selected = _golden_scenario()
+        rows = [flatten_delta(d, sorted(keys)) for d in deltas]
+        out = ENGINE.aggregate_rows(g, rows, weights, selected, 0.05,
+                                    force_leg="mesh")
+        assert hashlib.sha256(
+            pack_entries(out)).hexdigest() == GOLDEN_AGG
+        # and the rows-based HOST fallback (unflatten) is identical too
+        out_h = ENGINE.aggregate_rows(g, rows, weights, selected, 0.05,
+                                      force_leg="host")
+        assert hashlib.sha256(
+            pack_entries(out_h)).hexdigest() == GOLDEN_AGG
+
+    def test_cell_partial_bytes_unchanged(self):
+        from bflc_demo_tpu.hier.partial import cell_partial
+        rng, keys, shapes, _, _, _, _ = _golden_scenario()
+        # consume the same rng stream the capture script used
+        admitted = []
+        for i in range(7):
+            flat = {k: rng.standard_normal(shapes[k]).astype(np.float32)
+                    for k in keys}
+            admitted.append((f"0x{i:040x}", flat, 10 + 3 * i,
+                             0.5 + 0.1 * i))
+        partial, n, cost = cell_partial(admitted)
+        assert hashlib.sha256(
+            pack_entries(partial)).hexdigest() == GOLDEN_CELL
+        assert n == 7 and cost == pytest.approx(0.800000011920929)
+
+
+class TestEnginePolicy:
+    def test_legacy_env_pins_host_leg(self, monkeypatch):
+        monkeypatch.setenv("BFLC_MESH_AGG_LEGACY", "1")
+        assert ENGINE.choose_leg(10_000) == "legacy"
+
+    def test_min_batch_threshold(self, monkeypatch):
+        monkeypatch.delenv("BFLC_MESH_AGG_LEGACY", raising=False)
+        monkeypatch.setenv("BFLC_MESH_AGG_MIN", "8")
+        assert ENGINE.choose_leg(7) == "host"
+        # >= threshold: mesh iff the self-check passes on this platform
+        assert ENGINE.choose_leg(8) == (
+            "mesh" if ENGINE._mesh_ready() else "host")
+
+    def test_selfcheck_passes_on_this_platform(self):
+        # the one-time no-FMA differential self-check must hold here —
+        # if this fails, the toolchain contracts the spec's mul/add and
+        # the engine (correctly) refuses the compiled leg
+        assert ENGINE._mesh_ready()
+        assert ENGINE.report()["selfcheck"] == "ok"
+
+    def test_program_cache_reuse(self):
+        before = ENGINE.compile_total
+        rng = np.random.default_rng(3)
+        deltas = [{"/x": rng.standard_normal((6, 5)).astype(np.float32)}
+                  for _ in range(21)]
+        w = spec.merge_weight_vector([1.0] * 21, list(range(21)), 21)
+        ENGINE.weighted_sum(["/x"], deltas, w, float(w.sum()),
+                            force_leg="mesh")
+        ENGINE.weighted_sum(["/x"], deltas, w, float(w.sum()),
+                            force_leg="mesh")
+        # same (N, P) geometry = same compiled program; and a same-size
+        # DIFFERENT tree structure shares it too (the kernel is flat)
+        deltas2 = [{"/a": rng.standard_normal((3, 5)).astype(np.float32),
+                    "/b": rng.standard_normal((15,)).astype(np.float32)}
+                   for _ in range(21)]
+        ENGINE.weighted_sum(["/a", "/b"], deltas2, w, float(w.sum()),
+                            force_leg="mesh")
+        assert ENGINE.compile_total <= before + 1
+
+
+class TestDifferentialChecker:
+    def test_randomized_host_vs_mesh_exact(self):
+        from check_reduction_spec import run_differential
+        out = run_differential(trials=8, seed=20260804, max_n=48)
+        assert out["mismatches"] == [], out["mismatches"]
+
+
+def _sign(w, kind, epoch, payload):
+    from bflc_demo_tpu.comm.identity import _op_bytes
+    return w.sign(_op_bytes(kind, w.address, epoch, payload)).hex()
+
+
+def _tree(rng, scale=1.0):
+    return {"W1": (rng.standard_normal((16, 8)) * scale
+                   ).astype(np.float32),
+            "b1": (rng.standard_normal((8,)) * scale
+                   ).astype(np.float32),
+            "W2": (rng.standard_normal((8, 3)) * scale
+                   ).astype(np.float32)}
+
+
+def _sync_round_model_hash():
+    """Scripted config-1 sync round through a real LedgerServer; the
+    committed model hash is the certified artifact under test."""
+    from bflc_demo_tpu.comm.identity import provision_wallets
+    from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                   LedgerServer)
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+    cfg = ProtocolConfig(client_num=20, comm_count=4, aggregate_count=6,
+                         needed_update_count=10, learning_rate=0.05,
+                         batch_size=16).validate()
+    rng = np.random.default_rng(11)
+    blob0 = pack_pytree(_tree(rng))
+    wallets, _ = provision_wallets(20, b"meshagg-parity-seed")
+    srv = LedgerServer(cfg, blob0)
+    srv.start()
+    cl = CoordinatorClient(srv.host, srv.port)
+    try:
+        for w in wallets:
+            assert cl.request("register", addr=w.address,
+                              pubkey=w.public_bytes.hex(),
+                              tag=_sign(w, "register", 0, b""))["ok"]
+        committee = set(cl.request("committee")["committee"])
+        trainers = [w for w in wallets if w.address not in committee]
+        for i, w in enumerate(trainers[:10]):
+            blob = pack_pytree(_tree(np.random.default_rng(100 + i),
+                                     0.1))
+            d = hashlib.sha256(blob).digest()
+            payload = d + struct.pack("<qd", 20 + i, 1.0 + 0.05 * i)
+            r = cl.request("upload", addr=w.address, blob=blob,
+                           hash=d.hex(), n=20 + i,
+                           cost=1.0 + 0.05 * i, epoch=0,
+                           tag=_sign(w, "upload", 0, payload))
+            assert r["ok"], r
+        for j, w in enumerate([w for w in wallets
+                               if w.address in committee]):
+            row = [0.5 + 0.01 * (j + u) for u in range(10)]
+            payload = struct.pack("<10d", *row)
+            r = cl.request("scores", addr=w.address, epoch=0,
+                           scores=row,
+                           tag=_sign(w, "scores", 0, payload))
+            assert r["ok"] or r.get("status") == "WRONG_EPOCH", r
+        assert cl.request("info")["epoch"] == 1
+        return cl.request("model")["hash"]
+    finally:
+        cl.close()
+        srv.close()
+
+
+def _async_drain_model_hash():
+    """Two scripted FedBuff drains (the second with a staleness mix)
+    through a real async-mode LedgerServer."""
+    from bflc_demo_tpu.comm.identity import _op_bytes, provision_wallets
+    from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
+                                                   LedgerServer)
+    from bflc_demo_tpu.ledger.base import ascores_sign_payload
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+    cfg = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=2,
+                         needed_update_count=4, learning_rate=0.05,
+                         batch_size=16, async_buffer=4,
+                         max_staleness=4).validate()
+    rng = np.random.default_rng(12)
+    blob0 = pack_pytree(_tree(rng))
+    wallets, _ = provision_wallets(8, b"meshagg-async-parity")
+    srv = LedgerServer(cfg, blob0)
+    srv.start()
+    cl = CoordinatorClient(srv.host, srv.port)
+    try:
+        for w in wallets:
+            assert cl.request("register", addr=w.address,
+                              pubkey=w.public_bytes.hex(),
+                              tag=_sign(w, "register", 0, b""))["ok"]
+        committee = set(cl.request("committee")["committee"])
+        trainers = [w for w in wallets if w.address not in committee]
+        comm_ws = [w for w in wallets if w.address in committee]
+
+        def aupload(i, w, base):
+            blob = pack_pytree(_tree(np.random.default_rng(200 + i),
+                                     0.1))
+            d = hashlib.sha256(blob).digest()
+            payload = d + struct.pack("<qd", 10 + i, 1.0)
+            return cl.request("aupload", addr=w.address, blob=blob,
+                              hash=d.hex(), n=10 + i, cost=1.0,
+                              base_epoch=base,
+                              tag=_sign(w, "aupload", base, payload))
+
+        for i, w in enumerate(trainers[:3]):
+            assert aupload(i, w, 0)["ok"]
+        au = cl.request("aupdates")
+        pairs = [(u["aseq"], 0.5 + 0.1 * u["aseq"])
+                 for u in au["updates"]]
+        w = comm_ws[0]
+        assert cl.request(
+            "ascores", addr=w.address,
+            pairs=[[a, s] for a, s in pairs],
+            tag=w.sign(_op_bytes(
+                "ascores", w.address, 0,
+                ascores_sign_payload(pairs))).hex())["ok"]
+        r = aupload(3, trainers[3], 0)
+        assert r["ok"] and r["epoch"] == 1, r
+        # second drain: two epoch-0 bases (staleness 1) + two fresh
+        for i, w in enumerate(trainers[:2]):
+            assert aupload(4 + i, w, 0)["ok"]
+        for i, w in enumerate(trainers[2:4]):
+            assert aupload(6 + i, w, 1)["ok"]
+        assert cl.request("info")["epoch"] == 2
+        return cl.request("model")["hash"]
+    finally:
+        cl.close()
+        srv.close()
+
+
+class TestCertifiedHashParity:
+    """Acceptance pin: mesh leg and host-loop leg produce IDENTICAL
+    certified model hashes at config-1 geometry, sync AND async — and
+    both equal the hash the pre-engine tree committed."""
+
+    def test_sync_round_hash_identical_across_legs(self, monkeypatch):
+        monkeypatch.setenv("BFLC_MESH_AGG_LEGACY", "1")
+        monkeypatch.delenv("BFLC_MESH_AGG_MIN", raising=False)
+        legacy = _sync_round_model_hash()
+        monkeypatch.delenv("BFLC_MESH_AGG_LEGACY", raising=False)
+        monkeypatch.setenv("BFLC_MESH_AGG_MIN", "1")
+        mesh = _sync_round_model_hash()
+        assert legacy == mesh == GOLDEN_SYNC_MODEL
+
+    def test_async_drain_hash_identical_across_legs(self, monkeypatch):
+        monkeypatch.setenv("BFLC_MESH_AGG_LEGACY", "1")
+        monkeypatch.delenv("BFLC_MESH_AGG_MIN", raising=False)
+        legacy = _async_drain_model_hash()
+        monkeypatch.delenv("BFLC_MESH_AGG_LEGACY", raising=False)
+        monkeypatch.setenv("BFLC_MESH_AGG_MIN", "1")
+        mesh = _async_drain_model_hash()
+        assert legacy == mesh == GOLDEN_ASYNC_MODEL
+
+
+class TestBatchedScoring:
+    def test_batched_scores_equal_direct_vmap(self):
+        import jax.numpy as jnp
+
+        from bflc_demo_tpu.core.scoring import score_candidates
+        rng = np.random.default_rng(5)
+
+        def apply_fn(params, x):
+            return x @ params["W"] + params["b"]
+
+        g = {"W": jnp.asarray(rng.standard_normal((6, 3))
+                              .astype(np.float32)),
+             "b": jnp.asarray(rng.standard_normal((3,))
+                              .astype(np.float32))}
+        deltas = [{"W": jnp.asarray((rng.standard_normal((6, 3)) * 0.1)
+                                    .astype(np.float32)),
+                   "b": jnp.asarray((rng.standard_normal((3,)) * 0.1)
+                                    .astype(np.float32))}
+                  for _ in range(5)]
+        x = jnp.asarray(rng.standard_normal((32, 6)).astype(np.float32))
+        y = jnp.asarray(np.eye(3, dtype=np.float32)[
+            rng.integers(0, 3, size=32)])
+        batched = np.asarray(score_candidates_batched(
+            apply_fn, g, deltas, 0.05, x, y))
+        import jax
+        stacked = jax.tree_util.tree_map(
+            lambda *t: jnp.stack(t), *deltas)
+        direct = np.asarray(score_candidates(apply_fn, g, stacked,
+                                             0.05, x, y))
+        assert batched.tobytes() == direct.tobytes()
+
+
+@pytest.mark.slow
+class TestMultiDevice:
+    """The spec's device-count independence, demonstrated: a forced
+    4-device CPU backend must reproduce the single-device bytes (the
+    reduction order is protocol, never jax.device_count())."""
+
+    def test_four_device_host_mesh_parity(self):
+        import subprocess
+        code = (
+            "import os, sys\n"
+            "sys.path.insert(0, 'tools')\n"
+            "from check_reduction_spec import run_differential\n"
+            "import jax\n"
+            "assert jax.device_count() == 4, jax.devices()\n"
+            "out = run_differential(trials=6, seed=1, max_n=32)\n"
+            "assert out['mismatches'] == [], out['mismatches']\n"
+            "print('MULTIDEV_OK')\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count"
+                              "=4"))
+        r = subprocess.run([sys.executable, "-c", code],
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))),
+                           capture_output=True, text=True, timeout=300,
+                           env=env)
+        assert r.returncode == 0 and "MULTIDEV_OK" in r.stdout, \
+            r.stderr[-2000:]

@@ -172,6 +172,23 @@ def _role_row(role, snap):
             cells.append(f"async buf {int(depth)}  "
                          f"staleness {n_st}x~{m_st:.1f}ep  "
                          f"aggs {aggs:.0f}")
+        # on-mesh batched aggregation (meshagg): per-leg reduction
+        # calls + latency, stacked-batch size, and programs compiled
+        # (one cache miss per round geometry)
+        n_mm, m_mm = _merged_hist(snap, "mesh_agg_seconds",
+                                  kernel="reduce", leg="mesh")
+        n_mh, m_mh = _merged_hist(snap, "mesh_agg_seconds",
+                                  kernel="reduce", leg="host")
+        n_ml, m_ml = _merged_hist(snap, "mesh_agg_seconds",
+                                  kernel="reduce", leg="legacy")
+        if n_mm or n_mh or n_ml:
+            nb, mb = _merged_hist(snap, "mesh_agg_batch_size")
+            comp = _sum_counter(snap, "mesh_agg_compile_total")
+            n_h = n_mh + n_ml
+            m_h = ((m_mh * n_mh + m_ml * n_ml) / n_h) if n_h else 0.0
+            cells.append(f"mesh-agg jit {n_mm}x{m_mm * 1e3:.1f}ms / "
+                         f"host {n_h}x{m_h * 1e3:.1f}ms  "
+                         f"batch~{mb:.0f}  compiles {comp:.0f}")
     wire_in = costs.get("wire.bytes_in", 0)
     wire_out = costs.get("wire.bytes_out", 0)
     if wire_in or wire_out:

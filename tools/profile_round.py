@@ -189,6 +189,70 @@ def profile_hier(args) -> None:
             print(_role_row(role, scrape["roles"][role]))
 
 
+def profile_mesh_agg(args) -> None:
+    """In-process meshagg microprofile: N admitted-shaped deltas merged
+    by the compiled mesh leg and the host loop (REDUCTION SPEC v1),
+    with the differential verdict and the engine telemetry row the
+    fleet tools render.  `--clients` sets N (via --mesh-agg N)."""
+    import hashlib as _hl
+    import statistics
+    import time as _time
+
+    import numpy as np
+
+    from bflc_demo_tpu.meshagg.engine import ENGINE, flatten_delta
+    from bflc_demo_tpu.obs import metrics as obs_metrics
+    from bflc_demo_tpu.utils.serialization import pack_entries
+
+    obs_metrics.REGISTRY.enabled = True
+    obs_metrics.REGISTRY.role = "profile"
+
+    n = args.mesh_agg
+    rng = np.random.default_rng(0)
+    shapes = {f"/L{i:02d}": (20, 20) for i in range(24)}
+    keys = sorted(shapes)
+    g = {k: rng.standard_normal(s).astype(np.float32)
+         for k, s in shapes.items()}
+    deltas = [{k: (rng.standard_normal(s) * 0.01).astype(np.float32)
+               for k, s in shapes.items()} for _ in range(n)]
+    rows = [flatten_delta(d, keys) for d in deltas]
+    weights = [float(rng.integers(8, 64)) for _ in range(n)]
+    selected = list(range(n))
+
+    t0 = _time.perf_counter()
+    out_mesh = ENGINE.aggregate_rows(g, rows, weights, selected, 0.05,
+                                     force_leg="mesh")
+    compile_s = _time.perf_counter() - t0
+    legs = {}
+    for leg, run in (
+            ("mesh", lambda: ENGINE.aggregate_rows(
+                g, rows, weights, selected, 0.05, force_leg="mesh")),
+            ("host", lambda: ENGINE.aggregate_flat(
+                g, deltas, weights, selected, 0.05,
+                force_leg="legacy"))):
+        ts = []
+        for _ in range(5):
+            t1 = _time.perf_counter()
+            out = run()
+            ts.append(_time.perf_counter() - t1)
+        legs[leg] = (statistics.median(ts), out)
+    h_mesh = _hl.sha256(pack_entries(out_mesh)).hexdigest()
+    h_host = _hl.sha256(pack_entries(legs["host"][1])).hexdigest()
+    rep = ENGINE.report()
+    print(f"meshagg engine: {n} stacked deltas x "
+          f"{sum(int(np.prod(s)) for s in shapes.values())} params "
+          f"(24 leaves), spec v{rep['spec_version']}")
+    print(f"mesh leg (staged rows): {legs['mesh'][0] * 1e3:8.2f} ms   "
+          f"(first call incl. compile {compile_s * 1e3:.0f} ms)")
+    print(f"host loop (pre-engine): {legs['host'][0] * 1e3:8.2f} ms   "
+          f"speedup {legs['host'][0] / max(legs['mesh'][0], 1e-9):.2f}x")
+    print(f"certified bytes identical: {h_mesh == h_host}   "
+          f"selfcheck={rep['selfcheck']}   "
+          f"programs compiled={rep['compile_total']}")
+    from fleet_top import _role_row
+    print(_role_row("profile", obs_metrics.REGISTRY.snapshot()))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
@@ -209,9 +273,19 @@ def main() -> None:
                          "async telemetry row (buffer depth, staleness "
                          "histogram, aggregations) prints off the same "
                          "scrape (0 = sync round)")
+    ap.add_argument("--mesh-agg", type=int, default=0, metavar="N",
+                    help="profile the meshagg batched-aggregation "
+                         "engine instead of a socket round: merge N "
+                         "stacked deltas through the compiled mesh "
+                         "leg AND the host loop, print per-leg "
+                         "latency, the hash-equality verdict and the "
+                         "telemetry row (0 = off)")
     args = ap.parse_args()
     if args.legacy and not os.environ.get("BFLC_CONTROL_PLANE_LEGACY"):
         _reexec_legacy()
+    if args.mesh_agg:
+        profile_mesh_agg(args)
+        return
     if args.cells:
         profile_hier(args)
         return
