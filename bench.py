@@ -219,6 +219,19 @@ def _child() -> None:
                 "round_wall_time_s_trace_off"],
             "trace": to.get("trace"),
         }
+        # model-quality health plane (obs.health): armed vs
+        # BFLC_HEALTH_LEGACY=1 round time at config-1 — the same 5%
+        # bar / alternating-leg harness as trace_overhead (the full
+        # artifact of record lives in TPU_RESULTS.md)
+        from bflc_demo_tpu.eval.benchmarks import health_overhead_config1
+        ho = health_overhead_config1(rounds=2, trials=2)
+        extra["health_overhead"] = {
+            "overhead_frac": ho.get("overhead_frac"),
+            "round_wall_time_s_health_armed": ho[
+                "round_wall_time_s_health_armed"],
+            "round_wall_time_s_health_legacy": ho[
+                "round_wall_time_s_health_legacy"],
+        }
         # data-plane axes (PR 5): coordinator egress bytes/round,
         # read-source shares, cache hit ratio, compression ratio and
         # the quantized-delta accuracy gap, vs a

@@ -44,6 +44,7 @@ from bflc_demo_tpu.comm.identity import (PublicDirectory, ReplayGuard,
 from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
                                      WireError)
 from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import health as obs_health
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.utils import tracing
@@ -354,6 +355,11 @@ class LedgerServer:
         # A missing row (promoted-standby resupply, resumed writer) is
         # re-derived from the blob at aggregate time.
         self._staged: Dict[bytes, np.ndarray] = {}
+        # model-quality health plane (obs.health): built lazily at the
+        # first committed round with the plane armed (telemetry on, no
+        # BFLC_HEALTH_LEGACY pin) — observability only, the certified
+        # bytes never depend on it
+        self._health = None
         self._model_blob = initial_model_blob
         self._model_hash = hashlib.sha256(initial_model_blob).digest()
         # {key: (shape, dtype)} of the current model — the delta admission
@@ -1643,6 +1649,11 @@ class LedgerServer:
                 self.ledger.async_selection(k)
             epoch = self.ledger.epoch
             global_flat = unpack_pytree(self._model_blob)
+            rows = delta_flats = None
+            # health capture BEFORE the drain drops the score map
+            # (obs.health — observability only)
+            health_scores = (self._async_candidate_scores(entries)
+                             if obs_health.health_armed() else None)
             from bflc_demo_tpu.meshagg.engine import ENGINE
             if ENGINE.choose_leg(len(entries)) == "mesh":
                 # meshagg drain: the FedBuff n/sqrt(1+s) weights enter
@@ -1684,6 +1695,14 @@ class LedgerServer:
                                 time.perf_counter() - t0)
         if obs_metrics.REGISTRY.enabled:
             _M_AAGG.inc()
+        if obs_health.health_armed():
+            self._health_round(
+                epoch=epoch, senders=[e.sender for e in entries],
+                rows=rows, delta_flats=delta_flats,
+                weights=weights, selected=list(selected),
+                medians=None, candidate_scores=health_scores,
+                staleness=[e.staleness for e in entries],
+                old_flat=global_flat, new_flat=new_flat, mode="async")
         obs_flight.FLIGHT.record(
             "event", "async_round_committed", epoch=epoch, drained=k,
             max_staleness=max((e.staleness for e in entries),
@@ -1834,6 +1853,63 @@ class LedgerServer:
             self._last_progress = time.monotonic()
             self._cv.notify_all()
 
+    # ----------------------------------------- model-quality health plane
+    def _sync_candidate_scores(self, k: int):
+        """Per-candidate committee score columns ([[scores of slot 0],
+        ...]) from the ledger's score rows (PyLedger
+        `committee_score_rows`, a read-only observability surface) —
+        the health plane's disagreement input.  None when the backend
+        serves no rows (the native ledger) or none are complete."""
+        rows_fn = getattr(self.ledger, "committee_score_rows", None)
+        if rows_fn is None:
+            return None
+        good = rows_fn()
+        if not good or any(len(r) != k for r in good):
+            return None
+        return [[float(r[i]) for r in good] for i in range(k)]
+
+    def _async_candidate_scores(self, entries):
+        """Async twin: committee scores per buffered entry, keyed off
+        the admission id (drained entries lose their score maps —
+        capture before the drain; PyLedger `async_score_rows`)."""
+        rows_fn = getattr(self.ledger, "async_score_rows", None)
+        if rows_fn is None:
+            return None
+        return rows_fn([e.aseq for e in entries])
+
+    def _health_round(self, *, epoch, senders, rows, delta_flats,
+                      weights, selected, medians, candidate_scores,
+                      old_flat=None, new_flat=None, staleness=None,
+                      mode="sync") -> None:
+        """Feed one COMMITTED round to the health plane (obs.health):
+        per-delta stats over the staged/decoded rows, convergence
+        telemetry, and the streaming anomaly verdict.  Observability
+        only — any failure in here is swallowed (a health bug must
+        never kill a commit), and nothing it computes feeds back into
+        admission or the certified bytes."""
+        try:
+            from bflc_demo_tpu.meshagg.engine import flatten_delta
+            keys = sorted(new_flat.keys())
+            if rows is None:
+                rows = [flatten_delta(f, keys)
+                        for f in (delta_flats or [])]
+            if self._health is None:
+                self._health = obs_health.HealthMonitor(
+                    role=obs_metrics.REGISTRY.role or "writer")
+            self._health.on_round(
+                epoch=epoch, senders=list(senders), rows=rows,
+                weights=[float(w) for w in weights],
+                selected=list(selected), medians=medians,
+                candidate_scores=candidate_scores,
+                staleness=staleness,
+                old_row=(flatten_delta(old_flat, keys)
+                         if old_flat is not None else None),
+                new_row=flatten_delta(new_flat, keys), mode=mode)
+        except Exception as e:      # noqa: BLE001 — observability only
+            if self.verbose:
+                print(f"[coordinator] health plane error: "
+                      f"{type(e).__name__}: {e}", flush=True)
+
     # ---------------------------------------------------- coordinator logic
     def _aggregate_and_commit(self) -> None:
         """On-coordinator aggregation — the reference's on-chain Aggregate
@@ -1850,6 +1926,11 @@ class LedgerServer:
         updates = self.ledger.query_all_updates()
         epoch = self.ledger.epoch
         global_flat = unpack_pytree(self._model_blob)
+        rows = delta_flats = None
+        # health capture BEFORE the commit clears the score rows
+        # (obs.health — two attribute checks when dark)
+        health_scores = (self._sync_candidate_scores(len(updates))
+                         if obs_health.health_armed() else None)
         if ENGINE.choose_leg(len(updates)) == "mesh":
             # meshagg: the admitted deltas were staged as flattened
             # rows at admission — the merge is one stack + one compiled
@@ -1904,6 +1985,15 @@ class LedgerServer:
         obs_flight.FLIGHT.record(
             "event", "round_committed", epoch=epoch,
             loss=float(self.ledger.last_global_loss))
+        if obs_health.health_armed():
+            self._health_round(
+                epoch=epoch, senders=[u.sender for u in updates],
+                rows=rows, delta_flats=delta_flats,
+                weights=[u.n_samples for u in updates],
+                selected=list(pending.selected),
+                medians=pending.medians,
+                candidate_scores=health_scores,
+                old_flat=global_flat, new_flat=new_flat, mode="sync")
         if self.verbose:
             print(f"[coordinator] epoch {epoch} aggregated: "
                   f"loss={self.ledger.last_global_loss:.5f}", flush=True)

@@ -50,6 +50,7 @@ from bflc_demo_tpu.hier.partial import (cell_evidence_digest, cell_partial,
                                         partial_blob, split_cellmeta)
 from bflc_demo_tpu.ledger import LedgerStatus
 from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import health as obs_health
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
@@ -174,6 +175,18 @@ class CellAggregatorServer(LedgerServer):
                         "tp": (obs_trace.TRACE.current_traceparent()
                                if obs_trace.TRACE.enabled else None)}
         self._partial_epoch = epoch
+        if obs_health.health_armed():
+            # member-level health at the CELL tier (obs.health): stats
+            # over every admitted member delta — including unselected
+            # ones, a flagged member need not win selection — judged
+            # against the cell's own rolling baseline.  The root sees
+            # the same plane one tier up, where each "delta" is a cell
+            # partial.  Observability only: the partial bytes above
+            # were already sealed.
+            self._cell_health_round(epoch, updates, pending,
+                                    {pending.selected[j]: admitted[j][1]
+                                     for j in range(len(admitted))},
+                                    partial)
         for u in updates:
             self._blobs.pop(u.payload_hash, None)
         self._last_progress = time.monotonic()
@@ -189,6 +202,41 @@ class CellAggregatorServer(LedgerServer):
             print(f"[cell {self.cell_index}] epoch {epoch}: partial over "
                   f"{n_clients} clients ready ({dt * 1e3:.1f} ms)",
                   flush=True)
+
+    def _cell_health_round(self, epoch, updates, pending, by_slot,
+                           partial) -> None:
+        """Member-level health plane feed (module wiring above):
+        flatten every admitted member delta (reusing the selected
+        slots' decodes), hand them to this cell's HealthMonitor with
+        the partial row as the round's aggregate direction.  Swallows
+        everything — observability must never wedge the cell round."""
+        try:
+            from bflc_demo_tpu.meshagg.engine import flatten_delta
+            keys = sorted(partial.keys())
+            rows = []
+            for i, u in enumerate(updates):
+                flat = by_slot.get(i)
+                if flat is None:
+                    flat = dequantize_entries(
+                        unpack_pytree(self._blobs[u.payload_hash]))
+                rows.append(flatten_delta(flat, keys))
+            if self._health is None:
+                self._health = obs_health.HealthMonitor(
+                    role=obs_metrics.REGISTRY.role
+                    or f"cell-{self.cell_index}")
+            self._health.on_round(
+                epoch=epoch, senders=[u.sender for u in updates],
+                rows=rows, weights=[float(u.n_samples)
+                                    for u in updates],
+                selected=list(pending.selected),
+                medians=pending.medians,
+                candidate_scores=self._sync_candidate_scores(
+                    len(updates)),
+                mode="cell")
+        except Exception as e:      # noqa: BLE001 — observability only
+            if self.verbose:
+                print(f"[cell {self.cell_index}] health plane error: "
+                      f"{type(e).__name__}: {e}", flush=True)
 
     # ------------------------------------------------------ root bridge
     def _sign(self, kind: str, epoch: int, payload: bytes) -> str:

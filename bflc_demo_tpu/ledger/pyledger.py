@@ -456,6 +456,25 @@ class PyLedger:
             return []
         return list(self._updates)
 
+    def committee_score_rows(self) -> List[List[float]]:
+        """Raw complete committee score rows for the CURRENT round, in
+        sorted sender order — a read-only OBSERVABILITY surface
+        (obs.health committee-disagreement telemetry), cleared like
+        every other round buffer at commit.  The native backend has no
+        equivalent; callers treat a missing attribute as 'no rows'."""
+        k = len(self._updates)
+        return [list(self._scores[a]) for a in sorted(self._scores)
+                if len(self._scores[a]) == k]
+
+    def async_score_rows(self, aseqs) -> List[List[float]]:
+        """Committee scores per buffered entry (by admission id), each
+        row in sorted scorer order — the async observability twin of
+        `committee_score_rows` (capture BEFORE the drain drops the
+        entries' score maps)."""
+        return [[float(v) for _, v in
+                 sorted((self._ascores.get(int(a)) or {}).items())]
+                for a in aseqs]
+
     # --- aggregation handshake ---
     def aggregate_ready(self) -> bool:
         return self._pending is not None

@@ -1,0 +1,154 @@
+"""Batched per-delta statistics kernel — the health plane's arithmetic.
+
+One pass over the round's stacked ``(N, P)`` delta matrix (the SAME
+flattened rows the aggregation engine stacks — `engine.flatten_delta`
+images in sorted-key order) produces every per-delta statistic the
+model-quality health plane (obs.health) consumes:
+
+- ``l2``        — L2 norm of the delta (nonfinite entries read as 0);
+- ``max_abs``   — largest finite magnitude;
+- ``nonfinite`` — NaN/Inf entry count (an honest f32 delta has none);
+- ``zero_frac`` — fraction of exactly-zero entries (dead/free-rider
+  deltas saturate it);
+- ``cos_ref``   — cosine against a reference row (the previous round's
+  aggregated delta direction): honest gradients correlate positively
+  round over round, a sign-flipped Byzantine delta sits near -1.
+
+Two legs, same shape as the aggregation engine: a vectorized numpy host
+leg (the default — these stats are one O(N x P) pass over data already
+in cache, microseconds at every geometry this repo runs) and an OPT-IN
+jitted leg (``BFLC_HEALTH_STATS_JIT=1``, batches >= the engine's
+``BFLC_MESH_AGG_MIN``) for accelerator-resident fleets, cached per
+``(N, P)`` geometry.  Opt-in because on a CPU host the jit dispatch
+costs more than the whole numpy pass and the first use drags the jax
+import onto the writer's commit path — measured while landing the
+health plane.  Unlike the certified reduction, NOTHING here is
+protocol: the stats are observability-only, never hashed, never
+certified — a leg divergence in the last ulp is harmless, so the jit
+leg needs no self-check and any jax failure silently falls back to
+numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_EPS = 1e-12
+
+_JIT_CACHE: Dict[tuple, Any] = {}
+_JIT_CACHE_CAP = 32
+_JIT_BROKEN = False
+
+
+def _jit_min_batch() -> int:
+    """Smallest batch routed to the jit leg — opt-in via
+    BFLC_HEALTH_STATS_JIT=1 (see module docstring), then governed by
+    the engine's min-batch policy and legacy pin."""
+    import os
+    if not os.environ.get("BFLC_HEALTH_STATS_JIT"):
+        return 1 << 62
+    from bflc_demo_tpu.meshagg.engine import _legacy, _min_batch
+    return 1 << 62 if _legacy() else _min_batch()
+
+
+def _host_stats(mat: np.ndarray,
+                ref: Optional[np.ndarray]) -> Dict[str, np.ndarray]:
+    a = np.asarray(mat, np.float32)
+    n, p = a.shape
+    finite = np.isfinite(a)
+    clean = np.where(finite, a, np.float32(0.0)).astype(np.float64)
+    l2 = np.sqrt(np.einsum("np,np->n", clean, clean))
+    max_abs = (np.abs(clean).max(axis=1) if p else np.zeros(n))
+    nonfinite = (~finite).sum(axis=1).astype(np.float64)
+    zero_frac = ((a == 0.0).sum(axis=1) / p if p
+                 else np.ones(n)).astype(np.float64)
+    if ref is None or p == 0:
+        cos = np.zeros(n)
+    else:
+        r = np.where(np.isfinite(ref), ref, 0.0).astype(np.float64)
+        rn = float(np.sqrt(r @ r))
+        denom = np.maximum(l2 * rn, _EPS)
+        cos = np.clip((clean @ r) / denom, -1.0, 1.0)
+        if rn <= _EPS:
+            cos[:] = 0.0
+    return {"l2": l2, "max_abs": max_abs, "nonfinite": nonfinite,
+            "zero_frac": zero_frac, "cos_ref": cos}
+
+
+def _jit_program(n: int, p: int):
+    fn = _JIT_CACHE.get((n, p))
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    def stats_fn(mat, ref, have_ref):
+        finite = jnp.isfinite(mat)
+        clean = jnp.where(finite, mat, jnp.float32(0.0)
+                          ).astype(jnp.float32)
+        l2 = jnp.sqrt(jnp.einsum("np,np->n", clean, clean))
+        max_abs = jnp.abs(clean).max(axis=1)
+        nonfinite = (~finite).sum(axis=1).astype(jnp.float32)
+        zero_frac = (mat == 0.0).mean(axis=1)
+        r = jnp.where(jnp.isfinite(ref), ref, jnp.float32(0.0))
+        rn = jnp.sqrt(r @ r)
+        denom = jnp.maximum(l2 * rn, jnp.float32(_EPS))
+        cos = jnp.clip((clean @ r) / denom, -1.0, 1.0)
+        cos = jnp.where(have_ref & (rn > _EPS), cos, jnp.float32(0.0))
+        return l2, max_abs, nonfinite, zero_frac, cos
+
+    fn = jax.jit(stats_fn)
+    if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
+        _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+    _JIT_CACHE[(n, p)] = fn
+    return fn
+
+
+def batch_delta_stats(mat: np.ndarray,
+                      ref: Optional[np.ndarray] = None,
+                      ) -> Dict[str, np.ndarray]:
+    """All per-delta stats for a stacked ``(N, P)`` float32 delta matrix
+    in one batched pass.  ``ref`` is the cosine reference row (``(P,)``,
+    typically last round's aggregated delta) or None (cos_ref = 0).
+    Returns ``(N,)`` float64 arrays keyed l2 / max_abs / nonfinite /
+    zero_frac / cos_ref."""
+    global _JIT_BROKEN
+    mat = np.asarray(mat, np.float32)
+    if mat.ndim != 2:
+        raise ValueError(f"expected an (N, P) matrix, got {mat.shape}")
+    n, p = mat.shape
+    if n == 0:
+        z = np.zeros(0)
+        return {k: z for k in ("l2", "max_abs", "nonfinite",
+                               "zero_frac", "cos_ref")}
+    if n >= _jit_min_batch() and p and not _JIT_BROKEN:
+        try:
+            r = (np.zeros(p, np.float32) if ref is None
+                 else np.asarray(ref, np.float32))
+            out = _jit_program(n, p)(mat, r, ref is not None)
+            keys = ("l2", "max_abs", "nonfinite", "zero_frac", "cos_ref")
+            return {k: np.asarray(v, np.float64)
+                    for k, v in zip(keys, out)}
+        except Exception:                           # noqa: BLE001 —
+            _JIT_BROKEN = True                      # observability only:
+            pass                                    # numpy is always right
+    return _host_stats(mat, ref)
+
+
+def weighted_mean_row(mat: np.ndarray, weights, selected) -> np.ndarray:
+    """The round's aggregate-direction row: the weighted mean of the
+    SELECTED rows (float64, observability-only — the certified merge
+    arithmetic lives in meshagg.spec, not here).  This is the next
+    round's ``cos_ref``."""
+    mat = np.asarray(mat, np.float64)
+    n, p = mat.shape
+    w = np.zeros(n)
+    for s in selected:
+        w[int(s)] = float(weights[int(s)])
+    tot = w.sum()
+    if tot <= 0 or p == 0:
+        return np.zeros(p)
+    row = (w / tot) @ np.where(np.isfinite(mat), mat, 0.0)
+    return row

@@ -54,6 +54,13 @@ def install_process_telemetry(role: str, out_dir: str, *,
         tracing.PROC.enabled = True
     flight.FLIGHT.install(role, out_dir, interval_s=interval_s,
                           signals=signals)
+    # model-quality health plane (obs.health): point this process's
+    # monitors at the telemetry dir for their <role>.health.jsonl
+    # records (the plane itself arms off the metrics registry +
+    # BFLC_HEALTH_LEGACY — installing the sink changes nothing when
+    # it is pinned off)
+    from bflc_demo_tpu.obs import health as _health
+    _health.install(out_dir)
     if trace_sample > 0.0:
         from bflc_demo_tpu.obs import trace as obs_trace
         obs_trace.TRACE.install(role, out_dir, sample=trace_sample,

@@ -1,0 +1,466 @@
+"""Model-quality health plane: per-delta statistics, convergence
+telemetry, streaming anomaly verdicts.
+
+The fleet can explain where round time goes (obs.trace) and what each
+role is doing (obs.metrics) but was blind to WHAT the federation is
+learning: a sign-flipped or scaled Byzantine delta that survives
+committee scoring was invisible until accuracy cratered.  This module
+is the third observability pillar (Bonawitz 2019 treats population
+analytics as a first-class subsystem of production FL — PAPERS.md):
+
+- **per-delta statistics** — L2 norm, max-abs, NaN/Inf count, zero
+  fraction, cosine against the previous round's aggregated delta
+  direction, computed in ONE batched pass over the flattened rows the
+  writer already stages at admission (meshagg.stats);
+- **per-round convergence telemetry** — global update norm, model
+  drift from the arming-time model, committee-score median/IQR/
+  disagreement, the async drain's staleness distribution, and a
+  per-client contribution ledger (admitted/selected counts, cumulative
+  merge-weight share);
+- **a streaming anomaly detector** — rolling median/MAD robust
+  z-scores of each delta's L2 norm against the fleet's recent window,
+  plus a sign-flip rule (negative cosine while the fleet's median
+  cosine is positive) and an instant nonfinite rule, escalating to a
+  WARN/CRIT round verdict emitted as metrics, flight events and one
+  ``<role>.health.jsonl`` record per round (tools/health_report.py is
+  the post-mortem renderer).
+
+**The health plane changes no trust and no bytes.**  Verdicts never
+gate admission, selection or aggregation; every statistic is computed
+from decodes the writer already performed, AFTER the certified
+arithmetic ran.  ``BFLC_HEALTH_LEGACY=1`` pins the plane off entirely;
+committed model hashes are byte-identical either way (drilled in
+tests/test_health.py), and a bug anywhere in this module is caught by
+the caller and dropped — observability must never kill a commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
+
+LEVELS = ("ok", "warn", "crit")
+
+# --- health-plane telemetry (obs.metrics; no-ops unless the registry
+# is enabled).  Round-scoped values are gauges set at verdict time (the
+# scrape that follows is always current); distributions accumulate.
+_G_VERDICT = obs_metrics.REGISTRY.gauge(
+    "health_verdict",
+    "last round's health verdict (0 ok / 1 warn / 2 crit)")
+_C_VERDICTS = obs_metrics.REGISTRY.counter(
+    "health_verdicts_total", "round health verdicts by level",
+    ("level",))
+_C_FLAGS = obs_metrics.REGISTRY.counter(
+    "health_sender_flags_total",
+    "per-delta anomaly flags by rule (sender detail rides the "
+    "health.jsonl records — sender labels would blow the cardinality "
+    "cap at fleet scale)", ("reason",))
+_G_FLAGGED = obs_metrics.REGISTRY.gauge(
+    "health_flagged_senders",
+    "senders at warn-or-worse in the last round")
+_G_UPDATE_NORM = obs_metrics.REGISTRY.gauge(
+    "global_update_norm",
+    "L2 norm of the last committed global model update")
+_G_DRIFT = obs_metrics.REGISTRY.gauge(
+    "model_drift",
+    "L2 distance of the model from the health plane's arming-time "
+    "reference")
+_G_SCORE_MED = obs_metrics.REGISTRY.gauge(
+    "committee_score_median", "median committee score, last round")
+_G_SCORE_IQR = obs_metrics.REGISTRY.gauge(
+    "committee_score_iqr",
+    "IQR of per-candidate median committee scores, last round")
+_G_SCORE_DIS = obs_metrics.REGISTRY.gauge(
+    "committee_score_disagreement",
+    "mean per-candidate spread (IQR) ACROSS committee members, last "
+    "round — high = the committee cannot agree what a good delta is")
+_M_DELTA_L2 = obs_metrics.REGISTRY.histogram(
+    "delta_l2_norm", "per-delta L2 norm at aggregation",
+    buckets=(1e-4, 1e-3, 1e-2, 0.1, 0.3, 1.0, 3.0, 10.0, 100.0,
+             float("inf")))
+_M_DELTA_COS = obs_metrics.REGISTRY.histogram(
+    "delta_cos_prev",
+    "per-delta cosine vs the previous round's aggregate direction",
+    buckets=(-0.5, -0.25, 0.0, 0.25, 0.5, 0.75, 0.9, 1.0, float("inf")))
+_M_COST = obs_metrics.REGISTRY.histogram(
+    "health_seconds", "health-plane wall cost per round verdict")
+
+#: per-process output sink (obs.install_process_telemetry arms it with
+#: the telemetry dir): monitors append their round records to
+#: <dir>/<role>.health.jsonl.  Unarmed -> metrics/flight only.
+_SINK = {"dir": ""}
+
+
+def install(out_dir: str) -> None:
+    """Point every monitor in this process at `out_dir` for its
+    ``<role>.health.jsonl`` records."""
+    _SINK["dir"] = out_dir
+
+
+def health_legacy() -> bool:
+    """BFLC_HEALTH_LEGACY=1 pins the whole health plane off (the
+    overhead benchmark's baseline switch)."""
+    return bool(os.environ.get("BFLC_HEALTH_LEGACY"))
+
+
+def health_armed() -> bool:
+    """The ONE arming decision the instrumented aggregation paths ask:
+    telemetry on and no legacy pin.  Dark fleets pay two attribute
+    checks and skip even the row flattening."""
+    return obs_metrics.REGISTRY.enabled and not health_legacy()
+
+
+def _quantile(sorted_vals: np.ndarray, q: float) -> float:
+    if len(sorted_vals) == 0:
+        return 0.0
+    return float(np.quantile(sorted_vals, q))
+
+
+class HealthMonitor:
+    """Streaming per-writer health state: rolling robust baselines,
+    per-sender escalation streaks, the contribution ledger, and the
+    round-record emitter.
+
+    Thresholds: a delta is *crit-worthy* when its L2 robust z-score
+    (|x - median| / max(1.4826 * MAD, rel_floor * median)) reaches
+    ``crit_z``, or when its cosine against the previous aggregate
+    direction is <= ``cos_flip`` while the round's median cosine is
+    positive (the sign-flip signature; the default -0.75 clears the
+    honest range — real small-batch SGD deltas measured down to -0.61
+    against the previous aggregate while a true sign-flip sits at -1);
+    *warn-worthy* at ``warn_z``.
+    CRIT requires ``crit_streak`` CONSECUTIVE crit-worthy rounds for
+    the same sender (a single outlier on a noisy fleet must not page),
+    except NaN/Inf entries which are CRIT instantly — no honest f32
+    delta contains them.  A streak survives short absences (async
+    drains admit a sender only every few rounds) but EXPIRES after
+    ``streak_gap`` monitor rounds without a trip — two isolated
+    outliers hundreds of rounds apart must not page either.  z-scores
+    only fire once the rolling window holds ``min_baseline``
+    observations, so a cold start cannot produce false verdicts.
+    """
+
+    def __init__(self, role: str = "writer", *, window: int = 128,
+                 min_baseline: int = 16, warn_z: float = 4.0,
+                 crit_z: float = 8.0, rel_floor: float = 0.05,
+                 cos_flip: float = -0.75, crit_streak: int = 2,
+                 streak_gap: int = 8,
+                 jsonl_path: Optional[str] = None,
+                 keep_records: int = 512):
+        self.role = role
+        self.window = int(window)
+        self.min_baseline = int(min_baseline)
+        self.warn_z = float(warn_z)
+        self.crit_z = float(crit_z)
+        self.rel_floor = float(rel_floor)
+        self.cos_flip = float(cos_flip)
+        self.crit_streak = int(crit_streak)
+        self.streak_gap = int(streak_gap)
+        self._jsonl_path = jsonl_path
+        self._l2_window: deque = deque(maxlen=self.window)
+        # sender -> (consecutive crit-worthy trips, monitor round of
+        # the last trip) — the round anchor expires stale streaks
+        self._streak: Dict[str, Tuple[int, int]] = {}
+        self._ref_row: Optional[np.ndarray] = None
+        self._base_row: Optional[np.ndarray] = None
+        self.contribution: Dict[str, Dict[str, float]] = {}
+        self.records: deque = deque(maxlen=keep_records)
+        self.rounds = 0
+
+    # ----------------------------------------------------------- helpers
+    def _baseline(self) -> Optional[Tuple[float, float]]:
+        """(median, robust scale) of the rolling L2 window — computed
+        ONCE per round (the window only changes between rounds), or
+        None below min_baseline (cold start never judges)."""
+        if len(self._l2_window) < self.min_baseline:
+            return None
+        arr = np.asarray(self._l2_window, np.float64)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        return med, max(1.4826 * mad, self.rel_floor * abs(med), 1e-12)
+
+    def _path(self) -> str:
+        if self._jsonl_path is not None:
+            return self._jsonl_path
+        d = _SINK["dir"]
+        return os.path.join(d, f"{self.role}.health.jsonl") if d else ""
+
+    @staticmethod
+    def _score_stats(medians, candidate_scores):
+        """(median, iqr, disagreement) of the committee outcome:
+        median/IQR over the per-candidate medians, disagreement = mean
+        per-candidate IQR ACROSS committee members.  The async path
+        passes no medians — they re-derive from the score rows."""
+        med = iqr = dis = 0.0
+        if (medians is None or not len(medians)) and candidate_scores:
+            medians = [float(np.median(np.asarray(list(r), np.float64)))
+                       if len(list(r)) else 0.0
+                       for r in candidate_scores]
+        if medians is not None and len(medians):
+            m = np.sort(np.asarray(medians, np.float64))
+            med = float(np.median(m))
+            iqr = _quantile(m, 0.75) - _quantile(m, 0.25)
+        if candidate_scores:
+            rows = [np.asarray(list(r), np.float64)
+                    for r in candidate_scores]
+            lens = {len(r) for r in rows}
+            if lens == {len(rows[0])} and len(rows[0]) >= 2:
+                # rectangular (every candidate scored by the same
+                # committee count, the common case): one vectorized
+                # quantile pass instead of a per-candidate loop
+                m = np.stack(rows)
+                q75, q25 = np.quantile(m, (0.75, 0.25), axis=1)
+                dis = float(np.mean(q75 - q25))
+            else:
+                spreads = [
+                    _quantile(np.sort(r), 0.75)
+                    - _quantile(np.sort(r), 0.25)
+                    for r in rows if len(r) >= 2]
+                if spreads:
+                    dis = float(np.mean(spreads))
+        return med, iqr, dis
+
+    # -------------------------------------------------------------- round
+    def on_round(self, *, epoch: int, senders: Sequence[str],
+                 rows: Sequence[np.ndarray], weights: Sequence[float],
+                 selected: Sequence[int],
+                 medians=None,
+                 candidate_scores: Optional[List[Sequence[float]]] = None,
+                 staleness: Optional[Sequence[int]] = None,
+                 old_row: Optional[np.ndarray] = None,
+                 new_row: Optional[np.ndarray] = None,
+                 mode: str = "sync") -> Dict[str, Any]:
+        """Ingest one committed round and return its health record.
+
+        `rows` are the admitted deltas' flattened float32 rows (engine
+        staging images) aligned with `senders`/`weights`; `selected`
+        indexes the merged subset; `old_row`/`new_row` are the global
+        model before/after (omitted at the cell tier, where the
+        "update" is the partial itself).  Never raises past numeric
+        work the caller already survived — callers still wrap it.
+        """
+        from bflc_demo_tpu.meshagg.stats import (batch_delta_stats,
+                                                 weighted_mean_row)
+        t0 = time.perf_counter()
+        self.rounds += 1
+        mat = (np.stack([np.asarray(r, np.float32) for r in rows])
+               if len(rows) else np.zeros((0, 0), np.float32))
+        ref = self._ref_row
+        if ref is not None and (mat.ndim != 2
+                                or ref.shape[0] != mat.shape[1]):
+            ref = None                      # schema changed: re-anchor
+        stats = batch_delta_stats(mat, ref)
+        agg_row = weighted_mean_row(mat, list(weights), list(selected)) \
+            if len(rows) else np.zeros(0)
+
+        # convergence telemetry
+        if old_row is not None and new_row is not None:
+            upd = (np.asarray(new_row, np.float64)
+                   - np.asarray(old_row, np.float64))
+            update_norm = float(np.sqrt(np.nansum(upd * upd)))
+            if self._base_row is None \
+                    or self._base_row.shape != np.asarray(new_row).shape:
+                self._base_row = np.asarray(old_row, np.float64).copy()
+            dv = np.asarray(new_row, np.float64) - self._base_row
+            drift = float(np.sqrt(np.nansum(dv * dv)))
+            update_nonfinite = int(
+                (~np.isfinite(np.asarray(new_row))).sum())
+        else:
+            update_norm = float(np.sqrt(np.nansum(agg_row * agg_row)))
+            drift = 0.0
+            update_nonfinite = 0
+        score_med, score_iqr, score_dis = self._score_stats(
+            medians, candidate_scores)
+
+        # streaming anomaly detection (per sender)
+        cos_med = (float(np.median(stats["cos_ref"]))
+                   if ref is not None and len(rows) else 0.0)
+        baseline = self._baseline()
+        sender_recs: List[Dict[str, Any]] = []
+        sel = {int(s) for s in selected}
+        wtot = float(sum(float(weights[i]) for i in sel)) or 1.0
+        worst = 0
+        flagged = 0
+        for i, sender in enumerate(senders):
+            l2 = float(stats["l2"][i])
+            cos = float(stats["cos_ref"][i])
+            nf = int(stats["nonfinite"][i])
+            reasons: List[str] = []
+            crit_worthy = False
+            level = 0
+            if nf > 0:
+                # instant CRIT — and crit-worthy, so it EXTENDS an
+                # in-progress streak instead of resetting it (review:
+                # an attacker interleaving NaN rounds must not get its
+                # l2_z streak erased by the clean-appearance branch)
+                reasons.append("nonfinite")
+                level = 2
+                crit_worthy = True
+            z = ((l2 - baseline[0]) / baseline[1]
+                 if baseline is not None else None)
+            if z is not None and abs(z) >= self.crit_z:
+                reasons.append("l2_z")
+                crit_worthy = True
+            elif z is not None and abs(z) >= self.warn_z:
+                reasons.append("l2_warn")
+            if ref is not None and cos <= self.cos_flip \
+                    and cos_med >= 0.1:
+                reasons.append("cos_flip")
+                crit_worthy = True
+            if crit_worthy:
+                prev, last = self._streak.get(sender, (0, -10 ** 9))
+                streak = (prev + 1 if self.rounds - last
+                          <= self.streak_gap else 1)
+                self._streak[sender] = (streak, self.rounds)
+                level = max(level, 2 if streak >= self.crit_streak
+                            else 1)
+            else:
+                self._streak.pop(sender, None)
+                if reasons and level < 1:
+                    level = 1
+            if reasons:
+                flagged += 1
+                for r in reasons:
+                    _C_FLAGS.inc(reason=r)
+            worst = max(worst, level)
+            _M_DELTA_L2.observe(l2)
+            if ref is not None:
+                _M_DELTA_COS.observe(cos)
+            c = self.contribution.setdefault(
+                sender, {"admitted": 0, "selected": 0,
+                         "weight_share": 0.0})
+            c["admitted"] += 1
+            if i in sel:
+                c["selected"] += 1
+                c["weight_share"] += float(weights[i]) / wtot
+            sender_recs.append({
+                "sender": sender, "l2": round(l2, 6),
+                "max_abs": round(float(stats["max_abs"][i]), 6),
+                "zero_frac": round(float(stats["zero_frac"][i]), 4),
+                "cos": round(cos, 4) if ref is not None else None,
+                "nonfinite": nf,
+                "z": round(z, 2) if z is not None else None,
+                "level": LEVELS[level], "reasons": reasons,
+                "selected": i in sel,
+                "w_share": (round(float(weights[i]) / wtot, 4)
+                            if i in sel else 0.0)})
+        if update_nonfinite:
+            worst = 2
+        # baselines update AFTER judging the round (a huge outlier
+        # joins the window, where the median/MAD absorb it)
+        for i in range(len(senders)):
+            self._l2_window.append(float(stats["l2"][i]))
+        self._ref_row = (np.asarray(agg_row, np.float32)
+                         if len(rows) else self._ref_row)
+
+        record: Dict[str, Any] = {
+            "type": "health_round", "t": time.time(),
+            "role": self.role, "mode": mode, "epoch": int(epoch),
+            "verdict": LEVELS[worst], "n": len(senders),
+            "n_selected": len(sel), "flagged": flagged,
+            "update_norm": round(update_norm, 6),
+            "model_drift": round(drift, 6),
+            "update_nonfinite": update_nonfinite,
+            "score_median": round(score_med, 4),
+            "score_iqr": round(score_iqr, 4),
+            "score_disagreement": round(score_dis, 4),
+            "senders": sender_recs,
+        }
+        if staleness is not None:
+            s = [int(x) for x in staleness]
+            record["staleness"] = {
+                "min": min(s, default=0), "max": max(s, default=0),
+                "mean": round(float(np.mean(s)) if s else 0.0, 2)}
+        self.records.append(record)
+
+        # emit: metrics + flight + health.jsonl
+        _G_VERDICT.set(worst)
+        _C_VERDICTS.inc(level=LEVELS[worst])
+        _G_FLAGGED.set(flagged)
+        _G_UPDATE_NORM.set(update_norm)
+        _G_DRIFT.set(drift)
+        _G_SCORE_MED.set(score_med)
+        _G_SCORE_IQR.set(score_iqr)
+        _G_SCORE_DIS.set(score_dis)
+        obs_flight.FLIGHT.record(
+            "event", "health_round", epoch=int(epoch), mode=mode,
+            verdict=LEVELS[worst], flagged=flagged,
+            update_norm=round(update_norm, 6),
+            flagged_senders=[r["sender"] for r in sender_recs
+                             if r["level"] != "ok"])
+        if worst >= 2:
+            # a CRIT verdict is exactly the moment a post-mortem wants
+            # on disk even if the process dies next — flush now
+            obs_flight.FLIGHT.flush("health_crit")
+        path = self._path()
+        if path:
+            try:
+                with open(path, "a") as fh:
+                    fh.write(json.dumps(record) + "\n")
+            except OSError:
+                pass
+        _M_COST.observe(time.perf_counter() - t0)
+        return record
+
+    # ------------------------------------------------------------- report
+    def report(self) -> Dict[str, Any]:
+        """Aggregate view over every retained round record — the same
+        shape tools/health_report.py builds offline from the jsonl."""
+        return summarize_records(list(self.records),
+                                 contribution=self.contribution)
+
+
+def summarize_records(records: List[Dict[str, Any]], *,
+                      contribution: Optional[Dict] = None
+                      ) -> Dict[str, Any]:
+    """{verdicts, flagged_senders ranking, per-round table rows} from
+    health_round records (live monitor or parsed jsonl)."""
+    verdicts = {lv: 0 for lv in LEVELS}
+    flagged: Dict[str, Dict[str, Any]] = {}
+    contrib: Dict[str, Dict[str, float]] = \
+        {k: dict(v) for k, v in (contribution or {}).items()}
+    rows = []
+    for rec in records:
+        if rec.get("type") != "health_round":
+            continue
+        verdicts[rec.get("verdict", "ok")] = \
+            verdicts.get(rec.get("verdict", "ok"), 0) + 1
+        rows.append({k: rec.get(k) for k in
+                     ("epoch", "mode", "verdict", "n", "flagged",
+                      "update_norm", "model_drift", "score_median",
+                      "score_iqr", "score_disagreement", "staleness")})
+        for s in rec.get("senders", []):
+            if contribution is None:
+                c = contrib.setdefault(
+                    s["sender"], {"admitted": 0, "selected": 0,
+                                  "weight_share": 0.0})
+                c["admitted"] += 1
+                if s.get("selected"):
+                    c["selected"] += 1
+                    c["weight_share"] += float(s.get("w_share", 0.0))
+            if s.get("level", "ok") == "ok":
+                continue
+            f = flagged.setdefault(
+                s["sender"], {"warn": 0, "crit": 0, "max_abs_z": 0.0,
+                              "reasons": []})
+            f[s["level"]] += 1
+            if s.get("z") is not None:
+                f["max_abs_z"] = max(f["max_abs_z"], abs(s["z"]))
+            for r in s.get("reasons", []):
+                if r not in f["reasons"]:
+                    f["reasons"].append(r)
+    ranking = sorted(
+        flagged.items(),
+        key=lambda kv: (-kv[1]["crit"], -kv[1]["warn"],
+                        -kv[1]["max_abs_z"]))
+    return {"rounds": len(rows), "verdicts": verdicts,
+            "flagged_senders": [{"sender": k, **v} for k, v in ranking],
+            "contribution": contrib, "round_rows": rows}

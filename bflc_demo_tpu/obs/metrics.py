@@ -260,6 +260,45 @@ class MetricsRegistry:
             self.series_dropped = 0
 
 
+def hist_quantile(sample: Dict[str, Any], q: float) -> float:
+    """Quantile estimate from one exported histogram sample (the
+    cumulative-bucket dict `Histogram.samples` / a scrape snapshot
+    carries).  Prometheus-style upper-bound estimate: the smallest
+    bucket boundary whose cumulative count reaches q * count —
+    conservative (never under-reports a tail), exact when observations
+    sit on boundaries.  Returns +inf when the quantile lands in the
+    overflow bucket and 0.0 on an empty sample.  This is the ONE
+    quantile rule every renderer shares (tools/fleet_top.py p50/p95/
+    p99) so two panels can never disagree about a tail."""
+    count = sample.get("count", 0)
+    if not count:
+        return 0.0
+    target = q * count
+    buckets = sample.get("buckets", {})
+    # buckets dicts preserve ascending boundary order as exported;
+    # still sort defensively by numeric bound for foreign snapshots
+    ordered = sorted(
+        ((float("inf") if le == "+Inf" else float(le), cum)
+         for le, cum in buckets.items()), key=lambda kv: kv[0])
+    for bound, cum in ordered:
+        if cum >= target:
+            return bound
+    return float("inf")
+
+
+def merge_hist_samples(samples: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge exported histogram samples (same metric, different label
+    sets) into one: counts/sums add, cumulative buckets add per
+    boundary.  The merged dict feeds `hist_quantile` directly."""
+    out: Dict[str, Any] = {"count": 0, "sum": 0.0, "buckets": {}}
+    for s in samples:
+        out["count"] += s.get("count", 0)
+        out["sum"] += s.get("sum", 0.0)
+        for le, cum in (s.get("buckets") or {}).items():
+            out["buckets"][le] = out["buckets"].get(le, 0) + cum
+    return out
+
+
 def _escape(v) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"')
 

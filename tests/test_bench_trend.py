@@ -1,0 +1,136 @@
+"""Satellite tooling: the BENCH_r*.json trajectory collector
+(tools/bench_trend.py) over the checked-in artifacts + its regression
+flagging, and the tier-1 budget enforcer (tools/check_tier1_budget.py)
+that makes the slow-marking policy checkable instead of manual."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+class TestBenchTrend:
+    def test_parses_checked_in_artifacts(self, capsys):
+        bt = _tool("bench_trend")
+        series = bt.load_series(REPO)
+        assert len(series) >= 5
+        assert [n for n, _ in series] == sorted(n for n, _ in series)
+        report = bt.trend(series)
+        # accuracy is the stable axis on this host: present for every
+        # artifact and never regressed across the trajectory
+        accs = dict(report["metrics"]["best_test_acc"])
+        assert len(accs) == len(series)
+        assert all(r["metric"] != "best_test_acc"
+                   for r in report["regressions"])
+        assert bt.main([REPO]) == 0          # non-strict always renders
+        out = capsys.readouterr().out
+        assert "best_test_acc" in out
+
+    def test_regression_flagged_vs_best_prior(self, tmp_path):
+        bt = _tool("bench_trend")
+        rounds = [
+            (1, {"metric": "m", "value": 1.0,
+                 "extra": {"best_test_acc": 0.90}}),
+            (2, {"metric": "m", "value": 0.5,
+                 "extra": {"best_test_acc": 0.92}}),
+            # value (lower-better) regresses 40% vs best prior (0.5);
+            # accuracy (higher-better) regresses vs best prior (0.92)
+            (3, {"metric": "m", "value": 0.7,
+                 "extra": {"best_test_acc": 0.70}}),
+        ]
+        for n, rec in rounds:
+            with open(tmp_path / f"BENCH_r{n:02d}.json", "w") as fh:
+                json.dump({"n": n, "parsed": rec}, fh)
+        report = bt.trend(bt.load_series(str(tmp_path)),
+                          threshold=0.10)
+        flagged = {(r["metric"], r["round"])
+                   for r in report["regressions"]}
+        assert ("round_time_s", 3) in flagged
+        assert ("best_test_acc", 3) in flagged
+        assert ("round_time_s", 2) not in flagged   # improvement
+        # --strict turns flags into a failing exit code
+        assert bt.main([str(tmp_path), "--strict"]) == 1
+        # both regressions (40% and ~24%) sit under a 50% threshold
+        report50 = bt.trend(bt.load_series(str(tmp_path)),
+                            threshold=0.50)
+        assert report50["regressions"] == []
+
+    def test_signed_near_zero_fracs_use_absolute_deltas(self, tmp_path):
+        """Review regression: overhead fractions hover around 0 — a
+        relative test against a near-zero best manufactures huge
+        spurious percentages from noise.  They flag on ABSOLUTE
+        change only."""
+        bt = _tool("bench_trend")
+        rounds = [
+            (1, {"metric": "m", "value": 1.0, "extra": {
+                "trace_overhead": {"overhead_frac": -0.02}}}),
+            # +5 percentage points of noise: NOT a regression at 0.10
+            (2, {"metric": "m", "value": 1.0, "extra": {
+                "trace_overhead": {"overhead_frac": 0.03}}}),
+            # +17 points over the best prior (-0.02): flagged
+            (3, {"metric": "m", "value": 1.0, "extra": {
+                "trace_overhead": {"overhead_frac": 0.15}}}),
+        ]
+        for n, rec in rounds:
+            with open(tmp_path / f"BENCH_r{n:02d}.json", "w") as fh:
+                json.dump({"n": n, "parsed": rec}, fh)
+        report = bt.trend(bt.load_series(str(tmp_path)),
+                          threshold=0.10)
+        flagged = {(r["metric"], r["round"])
+                   for r in report["regressions"]}
+        assert ("trace_overhead_frac", 2) not in flagged
+        assert ("trace_overhead_frac", 3) in flagged
+
+    def test_empty_dir_errors(self, tmp_path):
+        bt = _tool("bench_trend")
+        assert bt.main([str(tmp_path)]) == 2
+
+
+_LOG = """\
+============================= slowest durations ==============================
+25.01s call     tests/test_big.py::TestX::test_heavy
+0.50s setup    tests/test_big.py::TestX::test_heavy
+12.30s call     tests/test_small.py::test_quick
+0.01s teardown tests/test_small.py::test_quick
+=========================== 2 passed in 38.12s ===========================
+"""
+
+_LOG_OVER = _LOG.replace("25.01s", "45.01s")
+
+
+class TestCheckTier1Budget:
+    def test_durations_summed_per_nodeid(self, tmp_path):
+        cb = _tool("check_tier1_budget")
+        per_test, wall, passed = cb.parse_log(_LOG)
+        assert per_test["tests/test_big.py::TestX::test_heavy"] == \
+            25.51
+        assert per_test["tests/test_small.py::test_quick"] == 12.31
+        assert wall == 38.12 and passed == 2
+        report = cb.check(per_test, wall, budget=870.0, limit=30.0)
+        assert report["over_limit"] == []
+        assert report["budget_used_frac"] == round(38.12 / 870.0, 3)
+
+    def test_unmarked_test_over_limit_fails(self, tmp_path, capsys):
+        cb = _tool("check_tier1_budget")
+        log = tmp_path / "t1.log"
+        log.write_text(_LOG_OVER)
+        assert cb.main([str(log)]) == 1
+        out = capsys.readouterr().out
+        assert "OVER LIMIT" in out and "test_heavy" in out
+        # raising the ceiling clears it
+        assert cb.main([str(log), "--limit", "60"]) == 0
+
+    def test_no_duration_lines_is_an_error(self, tmp_path):
+        cb = _tool("check_tier1_budget")
+        log = tmp_path / "empty.log"
+        log.write_text("2 passed in 1.00s\n")
+        assert cb.main([str(log)]) == 2

@@ -357,11 +357,27 @@ class TestCellAggregatorKill:
         # aggregator (no restart — the orphaned members must re-home to
         # sibling cell-2 for the rest of the campaign)
         sched.events = [FaultEvent(12.0, "kill", "cell-1")]
-        sched.wire_windows = {}
+        # a modest delay on every member's frames UNTIL the kill keeps
+        # the federation running past its wall-clock offset even on a
+        # warm fast host — without it, a quick fleet finishes all 3
+        # rounds before 12 s and the kill is skipped as moot (the same
+        # observed flake TestReadFanoutDegradation fixed this way).
+        # The window ends with the kill so the re-home + finish phase
+        # runs at full speed (this test must stay under the tier-1
+        # per-test ceiling, tools/check_tier1_budget.py)
+        sched.wire_windows = {
+            f"client-{i}": [WireWindow(0.0, 12.5, "delay", (),
+                                       p=1.0, delay_ms=60.0)]
+            for i in range(cfg.client_num)
+        }
         tdir = str(tmp_path / "telemetry")
+        # tighter stall timeouts: after the kill every root round waits
+        # out recovery for the dead cell — the default 12 s root stall
+        # made the drill pay ~14 s per post-kill round for nothing
         res = run_federated_hier(
             "make_softmax_regression", shards, test_set, cfg,
             rounds=3, cells=3, timeout_s=300.0,
+            stall_timeout_s=3.0, root_stall_timeout_s=5.0,
             chaos_schedule=sched, chaos_dir=str(tmp_path / "chaos"),
             telemetry_dir=tdir)
         rep = res.chaos_report

@@ -1,0 +1,507 @@
+"""Model-quality health plane (bflc_demo_tpu.obs.health; ISSUE 12):
+the batched per-delta stats kernel, the streaming anomaly detector's
+verdict semantics, the end-to-end anomaly drill (a scripted sign-flip/
+scale-attack client at config-1 geometry is flagged CRIT within k
+rounds, zero false CRITs on the honest leg, committed model hashes
+byte-identical with the plane armed vs BFLC_HEALTH_LEGACY=1), and the
+health_report post-mortem tool."""
+
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.meshagg.stats import (batch_delta_stats,
+                                         weighted_mean_row)
+from bflc_demo_tpu.obs import health as obs_health
+from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs.health import HealthMonitor, summarize_records
+from bflc_demo_tpu.protocol.constants import DEFAULT_PROTOCOL
+from bflc_demo_tpu.utils.serialization import pack_pytree
+
+
+@pytest.fixture
+def enabled_registry():
+    saved_enabled = obs_metrics.REGISTRY.enabled
+    saved_role = obs_metrics.REGISTRY.role
+    obs_metrics.REGISTRY.enabled = True
+    obs_metrics.REGISTRY.role = "writer"
+    try:
+        yield obs_metrics.REGISTRY
+    finally:
+        obs_metrics.REGISTRY.enabled = saved_enabled
+        obs_metrics.REGISTRY.role = saved_role
+
+
+class TestBatchDeltaStats:
+    def test_stats_match_hand_computation(self):
+        mat = np.array([[3.0, 4.0, 0.0],
+                        [0.0, 0.0, 0.0],
+                        [1.0, np.nan, np.inf]], np.float32)
+        ref = np.array([3.0, 4.0, 0.0], np.float32)
+        s = batch_delta_stats(mat, ref)
+        assert s["l2"][0] == pytest.approx(5.0)
+        assert s["max_abs"][0] == pytest.approx(4.0)
+        assert s["zero_frac"][0] == pytest.approx(1 / 3)
+        assert s["nonfinite"][0] == 0
+        assert s["cos_ref"][0] == pytest.approx(1.0)
+        # all-zero row: zero norm, cosine pinned to 0 (not NaN)
+        assert s["l2"][1] == 0.0 and s["cos_ref"][1] == 0.0
+        assert s["zero_frac"][1] == 1.0
+        # nonfinite entries counted and excluded from the norms
+        assert s["nonfinite"][2] == 2
+        assert s["l2"][2] == pytest.approx(1.0)
+
+    def test_sign_flip_reads_negative_cosine(self):
+        rng = np.random.default_rng(3)
+        ref = rng.standard_normal(64).astype(np.float32)
+        mat = np.stack([ref, -ref])
+        s = batch_delta_stats(mat, ref)
+        assert s["cos_ref"][0] == pytest.approx(1.0)
+        assert s["cos_ref"][1] == pytest.approx(-1.0)
+
+    def test_no_ref_and_empty_edges(self):
+        s = batch_delta_stats(np.ones((2, 4), np.float32), None)
+        assert list(s["cos_ref"]) == [0.0, 0.0]
+        s0 = batch_delta_stats(np.zeros((0, 0), np.float32))
+        assert len(s0["l2"]) == 0
+
+    def test_jit_leg_matches_numpy(self, monkeypatch):
+        """The compiled stats leg is observability-only (no byte
+        contract) but must agree with numpy to float32 tolerance."""
+        from bflc_demo_tpu.meshagg import stats as mstats
+        rng = np.random.default_rng(11)
+        mat = rng.standard_normal((24, 50)).astype(np.float32)
+        mat[3, 7] = np.nan
+        mat[5, :10] = 0.0
+        ref = rng.standard_normal(50).astype(np.float32)
+        host = mstats._host_stats(mat, ref)
+        monkeypatch.setenv("BFLC_HEALTH_STATS_JIT", "1")
+        monkeypatch.setenv("BFLC_MESH_AGG_MIN", "1")
+        monkeypatch.delenv("BFLC_MESH_AGG_LEGACY", raising=False)
+        jit = batch_delta_stats(mat, ref)
+        if mstats._JIT_BROKEN:      # platform without jax: numpy ran
+            pytest.skip("jit stats leg unavailable on this platform")
+        for k in host:
+            np.testing.assert_allclose(jit[k], host[k], rtol=2e-5,
+                                       atol=2e-5, err_msg=k)
+
+    def test_weighted_mean_row_is_selected_weighted_mean(self):
+        mat = np.array([[1.0, 0.0], [0.0, 1.0], [10.0, 10.0]],
+                       np.float32)
+        row = weighted_mean_row(mat, [1.0, 3.0, 99.0], [0, 1])
+        np.testing.assert_allclose(row, [0.25, 0.75])
+
+
+def _honest_round(rng, base, n=10, dim=16):
+    return [(base + 0.3 * rng.standard_normal(dim)).astype(np.float32)
+            for _ in range(n)]
+
+
+class TestHealthMonitorDetector:
+    def test_honest_fleet_never_flags(self):
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal(16).astype(np.float32)
+        hm = HealthMonitor(jsonl_path="")
+        for ep in range(10):
+            rec = hm.on_round(
+                epoch=ep, senders=[f"c{i}" for i in range(10)],
+                rows=_honest_round(rng, base),
+                weights=[10.0] * 10, selected=list(range(6)))
+            assert rec["verdict"] == "ok", rec
+        assert hm.report()["flagged_senders"] == []
+
+    def test_scale_attack_crit_within_two_rounds(self):
+        rng = np.random.default_rng(1)
+        base = rng.standard_normal(16).astype(np.float32)
+        hm = HealthMonitor(jsonl_path="")
+        verdicts = {}
+        for ep in range(7):
+            rows = _honest_round(rng, base)
+            if ep >= 3:
+                rows[4] = rows[4] * np.float32(40.0)
+            rec = hm.on_round(
+                epoch=ep, senders=[f"c{i}" for i in range(10)],
+                rows=rows, weights=[10.0] * 10,
+                selected=list(range(6)))
+            verdicts[ep] = {s["sender"]: s["level"]
+                            for s in rec["senders"]}
+        # crit within crit_streak=2 rounds of attack start; only c4
+        assert verdicts[4]["c4"] == "crit"
+        assert all(lv == "ok" for ep in verdicts
+                   for s, lv in verdicts[ep].items() if s != "c4")
+
+    def test_sign_flip_crit_and_nonfinite_instant(self):
+        rng = np.random.default_rng(2)
+        base = rng.standard_normal(16).astype(np.float32)
+        hm = HealthMonitor(jsonl_path="")
+        for ep in range(5):
+            rows = _honest_round(rng, base)
+            if ep >= 2:
+                rows[7] = -rows[7]
+            rec = hm.on_round(
+                epoch=ep, senders=[f"c{i}" for i in range(10)],
+                rows=rows, weights=[10.0] * 10,
+                selected=list(range(6)))
+        by = {s["sender"]: s for s in rec["senders"]}
+        assert by["c7"]["level"] == "crit"
+        assert "cos_flip" in by["c7"]["reasons"]
+        # NaN is CRIT on sight — no streak, no baseline needed
+        hm2 = HealthMonitor(jsonl_path="")
+        rows = _honest_round(rng, base, n=4)
+        rows[1][0] = np.nan
+        rec = hm2.on_round(epoch=0, senders=list("abcd"), rows=rows,
+                           weights=[1.0] * 4, selected=[0, 1])
+        assert rec["verdict"] == "crit"
+        assert rec["senders"][1]["reasons"] == ["nonfinite"]
+
+    def test_stale_streak_expires_two_isolated_outliers_never_crit(self):
+        """Review regression: the crit streak must EXPIRE after
+        streak_gap rounds without a trip — two isolated one-round
+        outliers far apart are two WARNs, never a CRIT page."""
+        rng = np.random.default_rng(5)
+        base = rng.standard_normal(16).astype(np.float32)
+        hm = HealthMonitor(jsonl_path="", streak_gap=8)
+        verdicts = []
+        for ep in range(25):
+            rows = _honest_round(rng, base)
+            if ep in (4, 20):           # isolated glitches, 16 apart
+                rows[2] = rows[2] * np.float32(40.0)
+            rec = hm.on_round(
+                epoch=ep, senders=[f"c{i}" for i in range(10)],
+                rows=rows, weights=[10.0] * 10,
+                selected=list(range(6)))
+            verdicts.append(rec["verdict"])
+        assert verdicts.count("warn") == 2
+        assert "crit" not in verdicts
+        # ...while trips WITHIN the gap still escalate across an
+        # ABSENCE (async cadence: a sender is only admitted every few
+        # drains — a clean appearance resets, an absence must not)
+        hm2 = HealthMonitor(jsonl_path="", streak_gap=8)
+        got_crit = False
+        for ep in range(12):
+            senders = [f"c{i}" for i in range(10)]
+            rows = _honest_round(rng, base)
+            if ep >= 4 and ep % 2 == 0:
+                rows[2] = rows[2] * np.float32(40.0)     # trip
+            elif ep >= 4:
+                del senders[2], rows[2]                  # absent
+            rec = hm2.on_round(
+                epoch=ep, senders=senders, rows=rows,
+                weights=[10.0] * len(senders),
+                selected=list(range(6)))
+            got_crit = got_crit or rec["verdict"] == "crit"
+        assert got_crit
+
+    def test_nonfinite_round_extends_a_streak(self):
+        """Review regression: a NaN-bearing round is instant CRIT and
+        must also COUNT toward the streak — an attacker interleaving
+        NaN rounds must not get its l2_z escalation reset."""
+        rng = np.random.default_rng(6)
+        base = rng.standard_normal(16).astype(np.float32)
+        hm = HealthMonitor(jsonl_path="")
+        verdicts = []
+        for ep in range(6):
+            rows = _honest_round(rng, base)
+            if ep == 3:
+                rows[2] = rows[2] * np.float32(40.0)    # l2_z trip
+            elif ep == 4:
+                rows[2][0] = np.nan                     # NaN round
+            elif ep == 5:
+                rows[2] = rows[2] * np.float32(40.0)    # l2_z again
+            rec = hm.on_round(
+                epoch=ep, senders=[f"c{i}" for i in range(10)],
+                rows=rows, weights=[10.0] * 10,
+                selected=list(range(6)))
+            verdicts.append(
+                {s["sender"]: s["level"] for s in rec["senders"]})
+        assert verdicts[4]["c2"] == "crit"      # NaN: instant
+        # the ep-5 l2_z trip rides the unbroken streak -> still CRIT
+        assert verdicts[5]["c2"] == "crit"
+
+    def test_cold_start_z_needs_baseline(self):
+        """A huge first-round delta must not CRIT before the rolling
+        window holds min_baseline observations."""
+        hm = HealthMonitor(jsonl_path="", min_baseline=16)
+        rows = [np.full(8, 1e3 * (i + 1), np.float32)
+                for i in range(4)]
+        rec = hm.on_round(epoch=0, senders=list("abcd"), rows=rows,
+                          weights=[1.0] * 4, selected=[0])
+        assert rec["verdict"] == "ok"
+        assert all(s["z"] is None for s in rec["senders"])
+
+    def test_round_record_convergence_fields_and_jsonl(self, tmp_path):
+        path = str(tmp_path / "w.health.jsonl")
+        hm = HealthMonitor(jsonl_path=path)
+        old = np.zeros(8)
+        new = np.full(8, 0.1)
+        rec = hm.on_round(
+            epoch=5, senders=["a", "b"],
+            rows=[np.ones(8, np.float32), np.ones(8, np.float32)],
+            weights=[1.0, 3.0], selected=[0, 1],
+            medians=[0.6, 0.4],
+            candidate_scores=[[0.5, 0.7], [0.3, 0.5]],
+            staleness=[0, 3], old_row=old, new_row=new, mode="async")
+        assert rec["update_norm"] == pytest.approx(
+            float(np.sqrt(8 * 0.01)), abs=1e-5)
+        assert rec["score_median"] == pytest.approx(0.5)
+        # per-candidate IQR of a 2-member row is half its range (0.1)
+        assert rec["score_disagreement"] == pytest.approx(0.1)
+        assert rec["staleness"] == {"min": 0, "max": 3, "mean": 1.5}
+        # contribution ledger: weight shares sum to 1 over selected
+        assert hm.contribution["b"]["weight_share"] == pytest.approx(
+            0.75)
+        # the jsonl record parses and summarizes
+        lines = [json.loads(ln) for ln in open(path)]
+        assert lines[0]["type"] == "health_round"
+        summ = summarize_records(lines)
+        assert summ["rounds"] == 1
+        assert summ["verdicts"]["ok"] == 1
+
+    def test_legacy_pin_disarms(self, monkeypatch, enabled_registry):
+        monkeypatch.setenv("BFLC_HEALTH_LEGACY", "1")
+        assert not obs_health.health_armed()
+        monkeypatch.delenv("BFLC_HEALTH_LEGACY")
+        assert obs_health.health_armed()
+
+
+# ---------------------------------------------------------------- drill
+def _delta_for(client: int, epoch: int, base: np.ndarray,
+               dim: int) -> np.ndarray:
+    """Deterministic per-(client, epoch) honest delta — both drill
+    legs regenerate byte-identical uploads."""
+    rng = np.random.default_rng([client, epoch, 1234])
+    return (base + 0.3 * rng.standard_normal(dim)).astype(np.float32)
+
+
+def _run_drill(rounds: int, attacker: str, attack_from: int):
+    """Scripted config-1-geometry federation against a real
+    LedgerServer dispatch surface (auth off — the drill scripts every
+    role): 10 trainer uploads + 4 committee score rows per round, the
+    attacker's delta sign-flipped AND scaled from `attack_from` on.
+    Returns (per-round committed model hashes, server) — the caller
+    closes it."""
+    from bflc_demo_tpu.comm.ledger_service import LedgerServer
+
+    cfg = DEFAULT_PROTOCOL        # 20 clients / comm 4 / top-6 / 10
+    dim = 12
+    rng = np.random.default_rng(99)
+    base = rng.standard_normal(dim).astype(np.float32)
+    blob0 = pack_pytree({"W": np.zeros(dim, np.float32)})
+    server = LedgerServer(cfg, blob0, require_auth=False,
+                          stall_timeout_s=3600.0)
+    addrs = [f"c{i:02d}" for i in range(cfg.client_num)]
+    for a in addrs:
+        assert server._dispatch("register", {"addr": a})["ok"]
+    hashes = []
+    for _ in range(rounds):
+        ep = server.ledger.epoch
+        committee = server._dispatch("committee", {})["committee"]
+        trainers = sorted(a for a in addrs if a not in committee)
+        # attacker uploads LAST (slot 9) so the scripted scores below
+        # keep it out of the rotating committee; 9 honest trainers
+        # fill the other slots
+        uploaders = [a for a in trainers
+                     if a != attacker][:cfg.needed_update_count - 1]
+        uploaders.append(attacker)
+        for a in uploaders:
+            d = _delta_for(addrs.index(a), ep, base, dim)
+            if a == attacker and ep >= attack_from:
+                d = (-20.0 * d).astype(np.float32)
+            blob = pack_pytree({"W": d})
+            r = server._dispatch("upload", {
+                "addr": a, "blob": blob,
+                "hash": hashlib.sha256(blob).hexdigest(),
+                "n": 10, "cost": 1.0, "epoch": ep})
+            assert r["ok"], (a, r)
+        # deterministic committee outcome: earlier slots score higher,
+        # so selection and the next committee are slot-ordered and the
+        # attacker (slot 9) never seats
+        row = [1.0 - 0.05 * j for j in range(cfg.needed_update_count)]
+        for a in committee:
+            r = server._dispatch("scores", {"addr": a, "epoch": ep,
+                                            "scores": row})
+            assert r["ok"], (a, r)
+        assert server.ledger.epoch == ep + 1, "round did not commit"
+        hashes.append(server._model_hash)
+    return hashes, server
+
+
+class TestAnomalyDrill:
+    """The acceptance drill: config-1 geometry, scripted sign-flip +
+    scale attacker, flagged CRIT within k rounds, zero false CRITs on
+    the honest leg, certified model hashes byte-identical armed vs
+    pinned off."""
+
+    ROUNDS = 7
+    ATTACK_FROM = 3
+    K = 3                   # flag budget (rounds after attack start)
+
+    def test_attacker_flagged_crit_within_k_no_false_crits(
+            self, tmp_path, enabled_registry, monkeypatch):
+        monkeypatch.delenv("BFLC_HEALTH_LEGACY", raising=False)
+        obs_health.install(str(tmp_path))
+        try:
+            hashes, server = _run_drill(self.ROUNDS, "c19",
+                                        self.ATTACK_FROM)
+            assert server._health is not None
+            records = list(server._health.records)
+            server.close()
+            assert len(records) == self.ROUNDS
+            by_epoch = {r["epoch"]: r for r in records}
+            # flagged CRIT within K rounds of the attack starting...
+            crit_epochs = [
+                e for e, r in by_epoch.items()
+                if any(s["sender"] == "c19" and s["level"] == "crit"
+                       for s in r["senders"])]
+            assert crit_epochs, "attacker never went CRIT"
+            assert min(crit_epochs) <= self.ATTACK_FROM + self.K
+            # ...for the right reasons (sign-flip and/or magnitude)
+            reasons = {r for e in crit_epochs for s in
+                       by_epoch[e]["senders"] if s["sender"] == "c19"
+                       for r in s["reasons"]}
+            assert reasons & {"cos_flip", "l2_z"}
+            # no honest sender ever CRITs in the attack leg either
+            for r in records:
+                for s in r["senders"]:
+                    if s["sender"] != "c19":
+                        assert s["level"] != "crit", (r["epoch"], s)
+            # pre-attack rounds are green
+            for e in range(self.ATTACK_FROM):
+                assert by_epoch[e]["verdict"] == "ok"
+            # the committee-score capture path worked end to end (the
+            # ledger's read-only committee_score_rows surface): real
+            # medians, zero disagreement (the drill's committee rows
+            # are identical by construction)
+            assert all(r["score_median"] > 0 for r in records)
+            assert all(r["score_disagreement"] == 0.0
+                       for r in records)
+            # the verdict surfaced as metrics on the scrape plane
+            snap = obs_metrics.REGISTRY.snapshot()["metrics"]
+            crit_total = sum(
+                s["value"] for s in
+                snap["health_verdicts_total"]["samples"]
+                if s["labels"].get("level") == "crit")
+            assert crit_total >= 1
+        finally:
+            obs_health.install("")
+
+    def test_honest_leg_zero_false_crits(self, enabled_registry,
+                                         monkeypatch):
+        monkeypatch.delenv("BFLC_HEALTH_LEGACY", raising=False)
+        hashes, server = _run_drill(self.ROUNDS, attacker="c19",
+                                    attack_from=10 ** 9)
+        records = list(server._health.records)
+        server.close()
+        assert len(records) == self.ROUNDS
+        assert all(r["verdict"] != "crit" for r in records)
+        assert all(s["level"] != "crit"
+                   for r in records for s in r["senders"])
+
+    def test_model_hashes_byte_identical_armed_vs_legacy(
+            self, enabled_registry, monkeypatch):
+        """Health plane armed vs BFLC_HEALTH_LEGACY=1 over the SAME
+        scripted attack: every committed model hash equal — the plane
+        observes, it never touches the certified bytes."""
+        monkeypatch.delenv("BFLC_HEALTH_LEGACY", raising=False)
+        armed, s1 = _run_drill(self.ROUNDS, "c19", self.ATTACK_FROM)
+        assert s1._health is not None and s1._health.rounds > 0
+        s1.close()
+        monkeypatch.setenv("BFLC_HEALTH_LEGACY", "1")
+        legacy, s2 = _run_drill(self.ROUNDS, "c19", self.ATTACK_FROM)
+        assert s2._health is None       # plane never armed
+        s2.close()
+        assert armed == legacy
+        assert len(set(armed)) == self.ROUNDS   # model really moved
+
+
+class TestCellTierHealth:
+    def test_member_level_stats_at_the_cell(self, enabled_registry,
+                                            monkeypatch):
+        """The cell aggregator feeds its MEMBERS' deltas to its own
+        monitor (mode='cell') when it seals a partial — member-level
+        anomalies are caught one tier below the root."""
+        monkeypatch.delenv("BFLC_HEALTH_LEGACY", raising=False)
+        from bflc_demo_tpu.comm.identity import provision_wallets
+        from bflc_demo_tpu.hier.aggregator import CellAggregatorServer
+        from bflc_demo_tpu.protocol.constants import ProtocolConfig
+        cfg = ProtocolConfig(client_num=6, comm_count=2,
+                             aggregate_count=2, needed_update_count=3,
+                             learning_rate=0.05,
+                             batch_size=16).validate()
+        wallets, _ = provision_wallets(1, b"cell-health-test-seed")
+        blob0 = pack_pytree({"W": np.zeros(8, np.float32)})
+        srv = CellAggregatorServer(
+            cfg, blob0, 0, wallets[0], [("127.0.0.1", 1)],
+            require_auth=False, stall_timeout_s=3600.0)
+        try:
+            addrs = [f"m{i}" for i in range(6)]
+            for a in addrs:
+                assert srv._dispatch("register", {"addr": a})["ok"]
+            ep = srv.ledger.epoch
+            committee = srv._dispatch("committee", {})["committee"]
+            trainers = sorted(a for a in addrs
+                              if a not in committee)[:3]
+            rng = np.random.default_rng(0)
+            for a in trainers:
+                blob = pack_pytree(
+                    {"W": rng.standard_normal(8).astype(np.float32)})
+                r = srv._dispatch("upload", {
+                    "addr": a, "blob": blob,
+                    "hash": hashlib.sha256(blob).hexdigest(),
+                    "n": 5, "cost": 1.0, "epoch": ep})
+                assert r["ok"], r
+            for a in committee:
+                assert srv._dispatch(
+                    "scores", {"addr": a, "epoch": ep,
+                               "scores": [0.9, 0.8, 0.7]})["ok"]
+            assert srv._outbox is not None      # partial sealed
+            assert srv._health is not None
+            rec = srv._health.records[-1]
+            assert rec["mode"] == "cell" and rec["n"] == 3
+            assert {s["sender"] for s in rec["senders"]} == \
+                set(trainers)
+        finally:
+            srv.close()
+
+
+class TestHealthReportTool:
+    def _tool(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        try:
+            import health_report
+        finally:
+            sys.path.pop(0)
+        return health_report
+
+    def test_report_over_drill_artifacts(self, tmp_path,
+                                         enabled_registry,
+                                         monkeypatch, capsys):
+        monkeypatch.delenv("BFLC_HEALTH_LEGACY", raising=False)
+        obs_health.install(str(tmp_path))
+        try:
+            _, server = _run_drill(5, "c19", 2)
+            server.close()
+        finally:
+            obs_health.install("")
+        tool = self._tool()
+        records = tool.load_health_records(str(tmp_path))
+        assert records and all(r["type"] == "health_round"
+                               for r in records)
+        out_json = str(tmp_path / "health_report_drill.json")
+        assert tool.main([str(tmp_path), "--out", out_json]) == 0
+        md = capsys.readouterr().out
+        assert "Per-round verdicts" in md
+        assert "c19" in md                     # flagged ranking names it
+        summary = json.load(open(out_json))
+        ranked = summary["flagged_senders"]
+        assert ranked and ranked[0]["sender"] == "c19"
+        # contribution ledger rebuilt offline from the records
+        assert summary["contribution"]["c19"]["admitted"] == 5
+
+    def test_empty_dir_is_a_clean_error(self, tmp_path):
+        assert self._tool().main([str(tmp_path)]) == 2

@@ -409,6 +409,262 @@ class TestTraceZeroOverheadWhenOff:
                 v.close()
 
 
+class TestHistogramQuantiles:
+    """Satellite: p50/p95/p99 from the exported cumulative buckets —
+    the ONE quantile rule (obs.metrics.hist_quantile) fleet_top's
+    straggler/staleness panels render instead of means."""
+
+    def test_quantiles_from_exported_sample(self):
+        from bflc_demo_tpu.obs.metrics import hist_quantile
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("lat", "", buckets=(0.01, 0.1, 1.0, 10.0))
+        for _ in range(90):
+            h.observe(0.05)
+        for _ in range(9):
+            h.observe(0.5)
+        h.observe(5.0)
+        s = reg.snapshot()["metrics"]["lat"]["samples"][0]
+        # upper-bucket-bound estimates: conservative, never under-read
+        assert hist_quantile(s, 0.5) == 0.1
+        assert hist_quantile(s, 0.95) == 1.0
+        assert hist_quantile(s, 0.999) == 10.0
+        assert hist_quantile({"count": 0}, 0.5) == 0.0
+
+    def test_overflow_bucket_reads_inf(self):
+        from bflc_demo_tpu.obs.metrics import hist_quantile
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("x", "", buckets=(1.0,))
+        h.observe(100.0)
+        s = reg.snapshot()["metrics"]["x"]["samples"][0]
+        assert hist_quantile(s, 0.5) == float("inf")
+
+    def test_merge_across_label_sets(self):
+        from bflc_demo_tpu.obs.metrics import (hist_quantile,
+                                               merge_hist_samples)
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("m", "", ("k",), buckets=(1.0, 2.0))
+        for _ in range(3):
+            h.observe(0.5, k="a")
+        h.observe(1.5, k="b")
+        merged = merge_hist_samples(
+            reg.snapshot()["metrics"]["m"]["samples"])
+        assert merged["count"] == 4
+        assert hist_quantile(merged, 0.5) == 1.0
+        assert hist_quantile(merged, 0.99) == 2.0
+
+    def test_fleet_top_renders_tails_not_means(self):
+        """The straggler panel (upload_lag_seconds) and the async
+        staleness panel surface p50/p95/p99 (rendered off a LOCAL
+        registry snapshot — _role_row takes any snapshot dict)."""
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        try:
+            import fleet_top
+        finally:
+            sys.path.pop(0)
+        reg = MetricsRegistry(enabled=True, role="writer")
+        lag = reg.histogram("upload_lag_seconds", "")
+        for v in (0.01, 0.02, 0.03, 2.0):
+            lag.observe(v)
+        st = reg.histogram(
+            "async_admitted_staleness", "",
+            buckets=(0, 1, 2, 3, 5, 8, 13, 21, float("inf")))
+        for v in (0, 0, 1, 8):
+            st.observe(v)
+        reg.counter("async_aggregations_total", "").inc()
+        row = fleet_top._role_row("writer", reg.snapshot())
+        assert "lag p50/95/99" in row
+        assert "staleness p50/95/99" in row
+
+    def test_fleet_top_renders_cell_tier_health(self):
+        """Review regression: member-level health verdicts live at the
+        CELL aggregator — its fleet_top row (and the timeline digest)
+        must render them, or a cell-tier CRIT is invisible live."""
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        try:
+            import fleet_top
+        finally:
+            sys.path.pop(0)
+        reg = MetricsRegistry(enabled=True, role="cell-1")
+        reg.gauge("cell_admitted", "").set(3)
+        reg.gauge("health_verdict", "").set(2)
+        reg.gauge("health_flagged_senders", "").set(1)
+        reg.counter("health_verdicts_total", "", ("level",)).inc(
+            level="crit")
+        snap = reg.snapshot()
+        row = fleet_top._role_row("cell-1", snap)
+        assert "health CRIT" in row and "flagged 1" in row
+        digest = fleet_top._scrape_digest(
+            {"roles": {"cell-1": snap}})
+        assert "cell-1: health=CRIT" in digest
+
+
+def _async_cfg():
+    import dataclasses
+    return dataclasses.replace(
+        ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                       needed_update_count=3, learning_rate=0.05,
+                       batch_size=16, async_buffer=3,
+                       max_staleness=4)).validate()
+
+
+def _async_aupload(server, addr, i, base_epoch):
+    import hashlib as _hl
+
+    from bflc_demo_tpu.utils.serialization import pack_pytree
+    blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
+                                     np.float32)})
+    d = _hl.sha256(blob).digest()
+    return server._dispatch("aupload", {
+        "addr": addr, "blob": blob, "hash": d.hex(), "n": 10 + i,
+        "cost": 1.0, "base_epoch": base_epoch})
+
+
+class TestAsyncTelemetryScrape:
+    """Satellite: async-mode scrape coverage — the fault-degradation
+    tests only covered sync roles; `async_buffer_depth` and
+    `async_admitted_staleness` must ride the metrics.jsonl timeline."""
+
+    def test_async_gauges_ride_the_timeline(self, tmp_path,
+                                            enabled_registry):
+        from bflc_demo_tpu.comm.ledger_service import LedgerServer
+        from bflc_demo_tpu.utils.serialization import pack_pytree
+        cfg = _async_cfg()
+        server = LedgerServer(
+            cfg, pack_pytree({"W": np.zeros((5, 2), np.float32)}),
+            require_auth=False, stall_timeout_s=3600.0)
+        try:
+            addrs = [f"a{i}" for i in range(cfg.client_num)]
+            for a in addrs:
+                assert server._dispatch("register", {"addr": a})["ok"]
+            committee = set(
+                server._dispatch("committee", {})["committee"])
+            trainers = [a for a in addrs if a not in committee]
+            # process-global registry: assert deltas, not absolutes
+            def _stale_count():
+                m = obs_metrics.REGISTRY.snapshot()["metrics"].get(
+                    "async_admitted_staleness") or {}
+                return sum(s["count"] for s in m.get("samples", []))
+
+            def _aggs():
+                m = obs_metrics.REGISTRY.snapshot()["metrics"].get(
+                    "async_aggregations_total") or {}
+                return sum(s["value"] for s in m.get("samples", []))
+
+            stale0, aggs0 = _stale_count(), _aggs()
+            # two admissions: buffer below K, depth visible at scrape
+            for i, a in enumerate(trainers[:2]):
+                assert _async_aupload(server, a, i, 0)["ok"]
+            jsonl = str(tmp_path / "metrics.jsonl")
+            coll = FleetCollector(
+                {"writer": (server.host, server.port)},
+                jsonl_path=jsonl)
+            server.start()
+            rec = coll.scrape(tag="mid-buffer")
+            w = rec["roles"]["writer"]["metrics"]
+            depth = w["async_buffer_depth"]["samples"][0]["value"]
+            assert depth == 2
+            assert _stale_count() == stale0 + 2
+            # the K-th admission drains inside the ack; next scrape
+            # shows the aggregation counter and an empty buffer
+            assert _async_aupload(server, trainers[2], 2, 0)["ok"]
+            rec2 = coll.scrape(tag="post-drain")
+            w2 = rec2["roles"]["writer"]["metrics"]
+            assert _aggs() == aggs0 + 1
+            assert "async_aggregations_total" in w2
+            assert w2["async_buffer_depth"]["samples"][0]["value"] == 0
+            # both scrapes landed on the jsonl timeline with the async
+            # series present
+            tl = load_timeline(jsonl)
+            tags = [r["tag"] for r in tl if r["type"] == "scrape"]
+            assert tags == ["mid-buffer", "post-drain"]
+            for r in tl:
+                assert "async_buffer_depth" in \
+                    r["roles"]["writer"]["metrics"]
+        finally:
+            server.close()
+
+    def test_flight_dump_parses_after_mid_drain_kill(self, tmp_path):
+        """SIGKILL an async writer that is continuously admitting and
+        draining; its flight dump and metrics snapshot must still
+        parse and carry the async evidence (the flight recorder's
+        durability contract, extended to async mode)."""
+        code = textwrap.dedent(f"""
+            import numpy as np
+            from bflc_demo_tpu import obs
+            from bflc_demo_tpu.comm.ledger_service import LedgerServer
+            from bflc_demo_tpu.protocol.constants import ProtocolConfig
+            from bflc_demo_tpu.utils.serialization import pack_pytree
+            import hashlib
+            obs.install_process_telemetry(
+                "asyncwriter", {str(tmp_path)!r}, interval_s=0.1)
+            cfg = ProtocolConfig(
+                client_num=6, comm_count=2, aggregate_count=2,
+                needed_update_count=3, learning_rate=0.05,
+                batch_size=16, async_buffer=3,
+                max_staleness=4).validate()
+            srv = LedgerServer(
+                cfg, pack_pytree({{"W": np.zeros((5, 2), np.float32)}}),
+                require_auth=False, stall_timeout_s=3600.0)
+            addrs = [f"a{{i}}" for i in range(6)]
+            for a in addrs:
+                srv._dispatch("register", {{"addr": a}})
+            committee = set(srv._dispatch("committee", {{}})["committee"])
+            trainers = [a for a in addrs if a not in committee]
+            j = 0
+            while True:             # admit/drain forever, until killed
+                for a in trainers[:3]:
+                    ep = srv.ledger.epoch
+                    blob = pack_pytree(
+                        {{"W": np.full((5, 2), 0.01 * (j % 7),
+                                       np.float32)}})
+                    d = hashlib.sha256(blob).digest()
+                    srv._dispatch("aupload", {{
+                        "addr": a, "blob": blob, "hash": d.hex(),
+                        "n": 10, "cost": 1.0, "base_epoch": ep}})
+                    j += 1
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("BFLC_HEALTH_LEGACY", None)
+        p = subprocess.Popen([sys.executable, "-c", code], env=env,
+                             cwd=os.path.dirname(os.path.dirname(
+                                 os.path.abspath(__file__))))
+        fpath = tmp_path / "asyncwriter.flight.jsonl"
+        deadline = time.monotonic() + 60.0
+        drained = False
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    dump = load_flight(str(fpath))
+                    if any(e.get("name") == "async_round_committed"
+                           for e in dump["events"]):
+                        drained = True
+                        break
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.1)
+            assert drained, "writer never drained a buffer"
+        finally:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
+        dump = load_flight(str(fpath))      # parses after SIGKILL
+        assert dump["header"]["role"] == "asyncwriter"
+        assert any(e.get("name") == "async_round_committed"
+                   for e in dump["events"])
+        snap = read_snapshot_file(
+            str(tmp_path / "asyncwriter.metrics.json"))
+        assert snap is not None
+        aggs = snap["metrics"]["async_aggregations_total"]["samples"]
+        assert aggs and aggs[0]["value"] >= 1
+        # the health plane rode along: verdict metrics + health.jsonl
+        assert "health_verdict" in snap["metrics"]
+        hpath = tmp_path / "asyncwriter.health.jsonl"
+        recs = [json.loads(ln) for ln in open(hpath)]
+        assert recs and all(r["mode"] == "async" for r in recs)
+        assert all("staleness" in r for r in recs)
+
+
 class TestObserveFaultTimestamps:
     def test_schedule_relative_t_cannot_clobber_wall_clock(self,
                                                            tmp_path):

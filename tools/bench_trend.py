@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""bench_trend: the BENCH_r*.json trajectory as a per-metric trend
+table, with regression flags.
+
+Every PR snapshots `bench.py`'s JSON line into a `BENCH_r<round>.json`
+artifact (wrapped by the capture harness as {"n": round, "parsed":
+{metric, value, extra...}}), but the trajectory was never collected —
+nothing would catch a perf regression between PRs.  This tool parses
+the whole series, extracts the headline axes plus the `extra.*`
+numbers each PR added, and flags any round whose value regressed more
+than --threshold (default 10%) against the BEST prior round on that
+metric.
+
+Caveat the artifacts themselves document: round TIMES on the
+cpu-fallback host have CV > 1 (BENCH notes / VERDICT r5), so time-axis
+flags on this host are a prompt to look, not a verdict — accuracy and
+byte-count axes are the stable ones.
+
+Usage:
+    python tools/bench_trend.py [repo_dir] [--json] [--threshold 0.1]
+        [--strict]
+
+--strict exits 1 when any regression is flagged (CI hook); default
+exit is 0 with flags printed.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# (label, dotted path under the parsed record, direction, mode) —
+# direction "higher" = bigger is better, "lower" = smaller is better;
+# mode "rel" flags a RELATIVE change vs the best prior round, "abs" an
+# ABSOLUTE one (for signed near-zero metrics like the overhead
+# fractions, where dividing noise around 0 by 0 manufactures huge
+# spurious percentages).  Missing paths are skipped per round (axes
+# appear as PRs add them).
+METRICS: List[Tuple[str, str, str, str]] = [
+    ("best_test_acc", "extra.best_test_acc", "higher", "rel"),
+    ("round_time_s", "value", "lower", "rel"),
+    ("warm_median_round_time_s",
+     "extra.batched_warm_median_round_time_s", "lower", "rel"),
+    ("samples_per_sec_per_chip",
+     "extra.train_samples_per_sec_per_chip", "higher", "rel"),
+    ("federation_round_wall_s",
+     "extra.federation.fast.round_wall_time_s", "lower", "rel"),
+    ("ops_certified_per_sec",
+     "extra.federation.fast.ops_certified_per_sec", "higher", "rel"),
+    ("egress_bytes_per_round",
+     "extra.data_plane.egress_bytes_per_round", "lower", "rel"),
+    ("trace_overhead_frac", "extra.trace_overhead.overhead_frac",
+     "lower", "abs"),
+    ("health_overhead_frac", "extra.health_overhead.overhead_frac",
+     "lower", "abs"),
+    ("async_throughput_speedup",
+     "extra.async_agg.round_throughput_speedup", "higher", "rel"),
+]
+
+
+def _dig(rec: Dict[str, Any], path: str) -> Optional[float]:
+    cur: Any = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def load_series(repo_dir: str) -> List[Tuple[int, Dict[str, Any]]]:
+    """[(round_n, parsed record)] sorted by round, from BENCH_r*.json.
+    The capture wrapper ({"n", "parsed"}) and a bare bench.py line are
+    both accepted."""
+    out = []
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        try:
+            with open(path) as fh:
+                raw = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec = raw.get("parsed") if isinstance(raw.get("parsed"), dict) \
+            else raw
+        if not isinstance(rec, dict) or rec.get("metric") is None:
+            continue
+        n = raw.get("n")
+        if n is None:
+            m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+            n = int(m.group(1)) if m else 0
+        out.append((int(n), rec))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def trend(series: List[Tuple[int, Dict[str, Any]]],
+          threshold: float = 0.10) -> Dict[str, Any]:
+    """{metrics: {label: [(round, value)]}, regressions: [...]}.
+    A regression at round r: worse than the best PRIOR round by more
+    than `threshold` (relative)."""
+    metrics: Dict[str, List[Tuple[int, float]]] = {}
+    regressions: List[Dict[str, Any]] = []
+    for label, path, direction, mode in METRICS:
+        pts = [(n, _dig(rec, path)) for n, rec in series]
+        pts = [(n, v) for n, v in pts if v is not None]
+        if not pts:
+            continue
+        metrics[label] = pts
+        best: Optional[float] = None
+        for n, v in pts:
+            if best is not None and (mode == "abs" or best != 0):
+                delta = (v - best if direction == "lower"
+                         else best - v)
+                worse = delta if mode == "abs" else delta / abs(best)
+                if worse > threshold:
+                    regressions.append({
+                        "metric": label, "round": n, "value": v,
+                        "best_prior": best, "mode": mode,
+                        "worse_frac": round(worse, 4),
+                        "direction": direction})
+            best = (v if best is None
+                    else (min(best, v) if direction == "lower"
+                          else max(best, v)))
+    return {"rounds": [n for n, _ in series], "threshold": threshold,
+            "metrics": metrics, "regressions": regressions}
+
+
+def render_table(report: Dict[str, Any]) -> str:
+    rounds = report["rounds"]
+    lines = ["bench trajectory (rounds: "
+             + ", ".join(str(n) for n in rounds) + ")", ""]
+    head = f"{'metric':<28}" + "".join(f"{('r' + str(n)):>12}"
+                                       for n in rounds)
+    lines += [head, "-" * len(head)]
+    flagged = {(r["metric"], r["round"])
+               for r in report["regressions"]}
+    for label, pts in report["metrics"].items():
+        by_round = dict(pts)
+        cells = []
+        for n in rounds:
+            v = by_round.get(n)
+            if v is None:
+                cells.append(f"{'-':>12}")
+            else:
+                mark = "!" if (label, n) in flagged else ""
+                cells.append(f"{v:>11.4g}{mark or ' '}")
+        lines.append(f"{label:<28}" + "".join(cells))
+    lines.append("")
+    if report["regressions"]:
+        lines.append(f"{len(report['regressions'])} regression(s) "
+                     f"> {report['threshold']:.0%} vs best prior "
+                     f"round ('!' above):")
+        for r in report["regressions"]:
+            lines.append(
+                f"  {r['metric']} @ r{r['round']}: {r['value']:.4g} "
+                f"vs best {r['best_prior']:.4g} "
+                f"({r['worse_frac']:+.1%} worse)")
+    else:
+        lines.append("no regressions vs best prior round")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("repo_dir", nargs="?",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding BENCH_r*.json "
+                         "(default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression flag bar (default 0.10)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any regression is flagged")
+    args = ap.parse_args(argv)
+
+    series = load_series(args.repo_dir)
+    if not series:
+        print(f"no BENCH_r*.json under {args.repo_dir}",
+              file=sys.stderr)
+        return 2
+    report = trend(series, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_table(report))
+    return 1 if (args.strict and report["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

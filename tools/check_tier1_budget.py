@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""check_tier1_budget: enforce the tier-1 suite's 870 s budget and the
+slow-marking policy from a pytest log.
+
+The tier-1 suite runs `-m 'not slow'` (ROADMAP.md), so EVERY test in
+its log is by construction unmarked — and the suite has crept past
+700 s twice, each time fixed by manually hunting the offender and
+demoting it to `slow`.  This tool makes the policy enforceable: feed
+it a pytest log produced with ``--durations=0`` and it
+
+- reports the per-test duration table (call + setup + teardown summed
+  per nodeid, slowest first);
+- totals them against the tier-1 budget (default 870 s) with the
+  headroom fraction;
+- FAILS (exit 1) when any test exceeds --limit seconds (default 30) —
+  the signal that it must either get faster or take the `slow` mark
+  (with a docstring rationale, per the established policy).
+
+Usage:
+    pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee t1.log
+    python tools/check_tier1_budget.py t1.log [--limit 30]
+        [--budget 870] [--top 15]
+"""
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Tuple
+
+# `--durations` lines: "  12.34s call     tests/test_x.py::TestY::test_z"
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
+# the summary wall line: "549 passed, 2 skipped in 389.12s"
+_SUMMARY_RE = re.compile(
+    r"(\d+) passed.*?in (\d+(?:\.\d+)?)s")
+
+
+def parse_log(text: str) -> Tuple[Dict[str, float], float, int]:
+    """({nodeid: summed seconds}, summary wall seconds or 0, passed)."""
+    per_test: Dict[str, float] = {}
+    wall, passed = 0.0, 0
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            dur, _phase, nodeid = m.groups()
+            per_test[nodeid] = per_test.get(nodeid, 0.0) + float(dur)
+            continue
+        s = _SUMMARY_RE.search(line)
+        if s:
+            passed, wall = int(s.group(1)), float(s.group(2))
+    return per_test, wall, passed
+
+
+def check(per_test: Dict[str, float], wall: float, *,
+          budget: float = 870.0, limit: float = 30.0) -> dict:
+    ranked: List[Tuple[str, float]] = sorted(
+        per_test.items(), key=lambda kv: -kv[1])
+    total = sum(per_test.values())
+    over = [{"test": t, "seconds": round(s, 2)}
+            for t, s in ranked if s > limit]
+    return {
+        "tests": len(per_test),
+        "sum_durations_s": round(total, 2),
+        "summary_wall_s": wall,
+        "budget_s": budget,
+        "budget_used_frac": round((wall or total) / budget, 3)
+        if budget else None,
+        "limit_s": limit,
+        "over_limit": over,
+        "ranked": [{"test": t, "seconds": round(s, 2)}
+                   for t, s in ranked],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("log", help="pytest log with --durations=0 output")
+    ap.add_argument("--budget", type=float, default=870.0,
+                    help="tier-1 wall budget in seconds (default 870)")
+    ap.add_argument("--limit", type=float, default=30.0,
+                    help="per-unmarked-test ceiling in seconds "
+                         "(default 30)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="slowest tests to print (default 15)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.log) as fh:
+            text = fh.read()
+    except OSError as e:
+        print(f"cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+    per_test, wall, passed = parse_log(text)
+    if not per_test:
+        print(f"{args.log}: no --durations lines found — run pytest "
+              f"with --durations=0", file=sys.stderr)
+        return 2
+    report = check(per_test, wall, budget=args.budget,
+                   limit=args.limit)
+    if args.json:
+        report["ranked"] = report["ranked"][: args.top]
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"{report['tests']} tests, "
+              f"sum {report['sum_durations_s']:.1f}s"
+              + (f", suite wall {wall:.1f}s" if wall else "")
+              + f" — {report['budget_used_frac']:.0%} of the "
+                f"{args.budget:.0f}s tier-1 budget")
+        print(f"\nslowest {min(args.top, len(report['ranked']))}:")
+        for row in report["ranked"][: args.top]:
+            flag = "  << OVER LIMIT" if row["seconds"] > args.limit \
+                else ""
+            print(f"  {row['seconds']:8.2f}s  {row['test']}{flag}")
+        if report["over_limit"]:
+            print(f"\nFAIL: {len(report['over_limit'])} unmarked "
+                  f"test(s) exceed the {args.limit:.0f}s ceiling — "
+                  f"speed them up or demote to @pytest.mark.slow "
+                  f"with a docstring rationale:")
+            for row in report["over_limit"]:
+                print(f"  {row['seconds']:8.2f}s  {row['test']}")
+        else:
+            print(f"\nOK: no unmarked test over {args.limit:.0f}s")
+    return 1 if report["over_limit"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

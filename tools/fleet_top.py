@@ -26,21 +26,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from bflc_demo_tpu.obs.collector import load_timeline  # noqa: E402
+from bflc_demo_tpu.obs.metrics import (hist_quantile,  # noqa: E402
+                                       merge_hist_samples)
 
 
 def _hist_stats(sample):
-    """(count, mean, p50-ish) from one cumulative-bucket hist sample."""
+    """(count, mean, p50) from one cumulative-bucket hist sample."""
     count = sample.get("count", 0)
     if not count:
         return 0, 0.0, 0.0
-    mean = sample.get("sum", 0.0) / count
-    p50 = 0.0
-    half = count / 2.0
-    for le, cum in sample.get("buckets", {}).items():
-        if cum >= half:
-            p50 = float("inf") if le == "+Inf" else float(le)
-            break
-    return count, mean, p50
+    return count, sample.get("sum", 0.0) / count, \
+        hist_quantile(sample, 0.5)
 
 
 def _metric(snapshot, name):
@@ -70,6 +66,43 @@ def _merged_hist(snapshot, name, **want):
             count += s.get("count", 0)
             tot += s.get("sum", 0.0)
     return count, (tot / count if count else 0.0)
+
+
+def _fmt_q(v, scale=1.0, unit=""):
+    return "inf" if v == float("inf") else f"{v * scale:.0f}{unit}"
+
+
+def _merged_tail(snapshot, name, scale=1.0, unit="", **want):
+    """'p50/p95/p99' string from the merged histogram, or None when
+    empty — tails, not means, for the straggler/staleness panels
+    (upper-bucket-bound estimates, obs.metrics.hist_quantile)."""
+    samples = [s for s in _metric(snapshot, name)
+               if all(s.get("labels", {}).get(k) == v
+                      for k, v in want.items())]
+    merged = merge_hist_samples(samples)
+    if not merged["count"]:
+        return None
+    return "/".join(_fmt_q(hist_quantile(merged, q), scale, unit)
+                    for q in (0.5, 0.95, 0.99))
+
+
+def _health_cell(snap):
+    """The model-quality health panel (obs.health) for any role that
+    runs a monitor — the root writer AND every cell aggregator
+    (member-level verdicts live at the cell; the root only sees the
+    merged partial).  None until a verdict exists."""
+    hv = _gauge_value(snap, "health_verdict")
+    if hv is None:
+        return None
+    crit = _sum_counter(snap, "health_verdicts_total", level="crit")
+    warn = _sum_counter(snap, "health_verdicts_total", level="warn")
+    upd = _gauge_value(snap, "global_update_norm", 0.0)
+    dis = _gauge_value(snap, "committee_score_disagreement", 0.0)
+    word = ("OK", "WARN", "CRIT")[min(int(hv), 2)]
+    flagged = int(_gauge_value(snap, "health_flagged_senders", 0))
+    return (f"health {word}  flagged {flagged}  "
+            f"upd {upd:.3g}  disagree {dis:.3f}  "
+            f"w/c {warn:.0f}/{crit:.0f}")
 
 
 def _role_row(role, snap):
@@ -126,6 +159,10 @@ def _role_row(role, snap):
         cells.append(f"round {int(rnd):>3}  admitted {int(adm):>3}  "
                      f"partial {n_p}x{m_p * 1e3:5.1f}ms  "
                      f"root-certify {n_a}x{m_a * 1e3:6.1f}ms")
+        # member-level health verdicts live HERE, not at the root
+        hc = _health_cell(snap)
+        if hc is not None:
+            cells.append(hc)
     elif role.startswith("standby"):
         applied = _gauge_value(snap, "standby_applied_ops", 0)
         lag = _gauge_value(snap, "standby_ack_lag_ops", 0)
@@ -150,9 +187,12 @@ def _role_row(role, snap):
         backlog = _gauge_value(snap, "uncertified_backlog", 0)
         n_c, m_c = _merged_hist(snap, "certify_latency_seconds")
         n_bt, m_bt = _merged_hist(snap, "cert_batch_size")
+        ct = _merged_tail(snap, "certify_latency_seconds", scale=1e3,
+                          unit="ms")
         cells.append(f"round {int(rnd):>3}  backlog {int(backlog):>3}  "
-                     f"certify {n_c}x{m_c * 1e3:6.1f}ms  "
-                     f"batch-mean {m_bt:4.1f}")
+                     f"certify {n_c}x{m_c * 1e3:6.1f}ms"
+                     + (f" (p50/95/99 {ct})" if n_c else "")
+                     + f"  batch-mean {m_bt:4.1f}")
         # certified snapshots + compaction (PR 7): checkpoint freshness
         # and the bounded-log evidence (GC'd prefix depth + reclaimed ops)
         age = _gauge_value(snap, "snapshot_age_rounds")
@@ -163,15 +203,26 @@ def _role_row(role, snap):
             cells.append(f"snap age {int(age)}r/"
                          f"{sbytes / 1e6:.2f}MB  base {int(base)}  "
                          f"gc {gc:.0f}ops")
+        # straggler panel: admission lag behind each round's first
+        # upload — the TAIL is the story (p50/p95/p99, not a mean)
+        lag = _merged_tail(snap, "upload_lag_seconds", scale=1e3,
+                           unit="ms")
+        if lag is not None:
+            cells.append(f"lag p50/95/99 {lag}")
         # async buffered aggregation (--async-buffer K): buffer
-        # occupancy, admitted-staleness distribution, aggregations
+        # occupancy, admitted-staleness tail, aggregations
         aggs = _sum_counter(snap, "async_aggregations_total")
-        n_st, m_st = _merged_hist(snap, "async_admitted_staleness")
-        if aggs or n_st:
+        st = _merged_tail(snap, "async_admitted_staleness", unit="ep")
+        if aggs or st is not None:
             depth = _gauge_value(snap, "async_buffer_depth", 0)
             cells.append(f"async buf {int(depth)}  "
-                         f"staleness {n_st}x~{m_st:.1f}ep  "
+                         f"staleness p50/95/99 {st or '-'}  "
                          f"aggs {aggs:.0f}")
+        # model-quality health plane (obs.health): last round's
+        # verdict, flagged senders, update norm, committee disagreement
+        hc = _health_cell(snap)
+        if hc is not None:
+            cells.append(hc)
         # on-mesh batched aggregation (meshagg): per-leg reduction
         # calls + latency, stacked-batch size, and programs compiled
         # (one cache miss per round geometry)
@@ -245,6 +296,17 @@ def _scrape_digest(rec) -> str:
                 f"async-buf={int(_gauge_value(w, 'async_buffer_depth', 0))} "
                 f"aggs={aggs:.0f}")
     for role in sorted(roles):
+        # WARN/CRIT health rounds surface on the timeline (quiet when
+        # OK) — from ANY monitored role: the writer or a cell
+        # aggregator (member-level verdicts never reach the root)
+        if role == "writer" or role.startswith("cell"):
+            hv = _gauge_value(roles[role], "health_verdict")
+            if hv:
+                bits.append(
+                    f"{role}: health="
+                    f"{('OK', 'WARN', 'CRIT')[min(int(hv), 2)]} "
+                    f"flagged="
+                    f"{int(_gauge_value(roles[role], 'health_flagged_senders', 0))}")
         if role.startswith("cell"):
             adm = _gauge_value(roles[role], "cell_admitted", 0)
             n_a, m_a = _merged_hist(roles[role],

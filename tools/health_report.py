@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""health_report: post-mortem renderer for the model-quality health
+plane (bflc_demo_tpu.obs.health).
+
+Input is a telemetry dir (or a single file) holding one or more
+``<role>.health.jsonl`` record streams — one JSON object per committed
+round, written by the writer / cell aggregators of a run with the
+health plane armed.  Output:
+
+- a per-round **verdict table** (epoch, tier, verdict, update norm,
+  model drift, committee score median/IQR/disagreement, staleness);
+- a **flagged-sender ranking** (crit/warn counts, worst |z|, rules
+  tripped) — the "who attacked us" view;
+- the **contribution ledger** (per-sender admitted/selected counts and
+  cumulative merge-weight share).
+
+Usage:
+    python tools/health_report.py <telemetry_dir | health.jsonl> \
+        [--json] [--out health_report_<tag>.json]
+
+Markdown to stdout by default; --json prints the machine-readable
+summary instead; --out additionally writes it to a file.  Verdicts are
+observability only — this tool renders what the fleet saw, it gates
+nothing (PARITY.md: the health plane changes no trust).
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bflc_demo_tpu.obs.health import summarize_records  # noqa: E402
+
+
+def load_health_records(path: str) -> List[dict]:
+    """Every parseable health_round record under `path` (a dir is
+    globbed for *.health.jsonl; torn trailing lines are skipped — the
+    stream is append-only and a kill can tear the last line)."""
+    files = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".health.jsonl"):
+                files.append(os.path.join(path, name))
+    else:
+        files = [path]
+    records: List[dict] = []
+    for fp in files:
+        try:
+            with open(fp) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue            # torn tail line
+                    if rec.get("type") == "health_round":
+                        rec.setdefault("role",
+                                       os.path.basename(fp).split(
+                                           ".health.jsonl")[0])
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: (r.get("t", 0.0), r.get("epoch", 0)))
+    return records
+
+
+def render_markdown(summary: Dict, records: List[dict]) -> str:
+    lines = ["# Model-quality health report", ""]
+    v = summary["verdicts"]
+    lines.append(f"{summary['rounds']} rounds — "
+                 f"ok {v.get('ok', 0)} / warn {v.get('warn', 0)} / "
+                 f"crit {v.get('crit', 0)}")
+    lines += ["", "## Per-round verdicts", "",
+              "| epoch | role | mode | verdict | flagged | upd norm | "
+              "drift | score med | IQR | disagree | staleness |",
+              "|---|---|---|---|---|---|---|---|---|---|---|"]
+    for rec in records:
+        st = rec.get("staleness")
+        st_s = (f"{st['min']}-{st['max']} (~{st['mean']})"
+                if st else "-")
+        lines.append(
+            f"| {rec.get('epoch')} | {rec.get('role', '?')} "
+            f"| {rec.get('mode')} "
+            f"| {rec.get('verdict', 'ok').upper()} "
+            f"| {rec.get('flagged', 0)}/{rec.get('n', 0)} "
+            f"| {rec.get('update_norm', 0):.4g} "
+            f"| {rec.get('model_drift', 0):.4g} "
+            f"| {rec.get('score_median', 0):.3f} "
+            f"| {rec.get('score_iqr', 0):.3f} "
+            f"| {rec.get('score_disagreement', 0):.3f} "
+            f"| {st_s} |")
+    lines += ["", "## Flagged senders", ""]
+    if not summary["flagged_senders"]:
+        lines.append("(none — every delta inside the fleet baseline)")
+    else:
+        lines += ["| sender | crit | warn | worst \\|z\\| | rules |",
+                  "|---|---|---|---|---|"]
+        for f in summary["flagged_senders"]:
+            lines.append(f"| {f['sender']} | {f['crit']} | {f['warn']} "
+                         f"| {f['max_abs_z']:.1f} "
+                         f"| {', '.join(f['reasons'])} |")
+    contrib = summary.get("contribution") or {}
+    if contrib:
+        lines += ["", "## Contribution ledger", "",
+                  "| sender | admitted | selected | weight share |",
+                  "|---|---|---|---|"]
+        ranked = sorted(contrib.items(),
+                        key=lambda kv: -kv[1].get("weight_share", 0.0))
+        for sender, c in ranked:
+            lines.append(
+                f"| {sender} | {c.get('admitted', 0)} "
+                f"| {c.get('selected', 0)} "
+                f"| {c.get('weight_share', 0.0):.3f} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path",
+                    help="telemetry dir (globs *.health.jsonl) or one "
+                         "health.jsonl file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON summary instead of markdown")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON summary to this file")
+    args = ap.parse_args(argv)
+
+    records = load_health_records(args.path)
+    if not records:
+        print(f"no health records under {args.path} "
+              f"(health plane unarmed, or BFLC_HEALTH_LEGACY=1 run)",
+              file=sys.stderr)
+        return 2
+    summary = summarize_records(records)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(summary, fh, indent=2)
+            fh.write("\n")
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_markdown(summary, records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
