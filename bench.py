@@ -53,6 +53,11 @@ hash-equality verdict, and `extra.platform_detail` records the jax
 backend, device count/kind and whether the meshagg engine ran jitted —
 device evidence every artifact now carries (eval.benchmarks.
 mesh_agg_config1; full curve in TPU_RESULTS.md round 15).
+`extra.sparse` (ISSUE 13) is the sparse-upload-delta axis: writer
+egress/round dense vs the sparsest top-k leg (f32 and i8), the QSGD
+composition ratio sparse x i8 vs i8 alone, the accuracy gaps and the
+encode/decode wall shares (eval.benchmarks.sparse_config1; the full
+density x dtype grid is TPU_RESULTS.md round 17).
 BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
@@ -253,6 +258,36 @@ def _child() -> None:
             "quantized_acc_gap": dp.get("quantized_acc_gap"),
             "quantized_delta_dtype": dp.get("quantized_leg", {}).get(
                 "delta_dtype"),
+        }
+        # sparse upload deltas (ISSUE 13): density-sweep egress at the
+        # config-1 fleet — this is the bench-budget twin (dense vs the
+        # sparsest leg, f32 and i8; the full {1.0,0.1,0.01} x {f32,i8}
+        # grid lives in TPU_RESULTS.md round 17), with the QSGD
+        # composition ratio (sparse x i8 vs i8 alone) and the
+        # encode/decode wall shares that bound the CPU cost of the win
+        from bflc_demo_tpu.eval.benchmarks import sparse_config1
+        sp = sparse_config1(rounds=2, densities=(1.0, 0.01),
+                            dtypes=("f32", "i8"))
+        sp_sparsest = sp["legs"].get("d0.01_f32", {})
+        extra["sparse"] = {
+            # ratio vs the PR-5 LEGACY dense-f32 baseline (fan-out/
+            # cache/compression off) — the headline; the fast-plane
+            # internal ratio rides separately so the two wins are
+            # never conflated
+            "egress_vs_legacy_dense_f32_x": sp.get(
+                "egress_vs_legacy_dense_f32_x", {}).get("d0.01_f32"),
+            "egress_vs_fast_dense_f32_x": sp.get(
+                "egress_vs_dense_f32_x", {}).get("d0.01_f32"),
+            "sparse_i8_vs_i8_x": sp.get("sparse_i8_vs_i8_x"),
+            "sparsest_egress_bytes_per_round": sp_sparsest.get(
+                "writer_egress_bytes_per_round"),
+            "dense_egress_bytes_per_round": sp["legs"].get(
+                "d1_f32", {}).get("writer_egress_bytes_per_round"),
+            "acc_gap_vs_dense_f32": sp.get("acc_gap_vs_dense_f32"),
+            "encode_share_of_round_d001": sp_sparsest.get(
+                "encode_share_of_round"),
+            "decode_share_of_round_d001": sp_sparsest.get(
+                "decode_share_of_round"),
         }
         # hierarchical-federation axes (PR 6): root-coordinator cost vs
         # simulated thin-client count at fixed cell count — the headline

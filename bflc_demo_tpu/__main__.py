@@ -157,6 +157,17 @@ def main(argv=None) -> int:
               "the processes runtime; --attest-scores to mesh/executor",
               file=sys.stderr)
         return 2
+    if cfg is not None and opts.runtime != "processes":
+        # sparse upload deltas are a wire-protocol mode like
+        # --async-buffer: only the processes runtime packs/decodes
+        # blobs, so fail with guidance instead of the configs-layer
+        # ValueError traceback
+        from bflc_demo_tpu.utils.serialization import sparse_enabled
+        if sparse_enabled(cfg):
+            print("--delta-density < 1 applies to --runtime processes "
+                  "(in-memory runtimes move no upload blobs)",
+                  file=sys.stderr)
+            return 2
     if opts.secure:
         if opts.config != "config4":
             print("--secure is the config4 secure-aggregation variant",

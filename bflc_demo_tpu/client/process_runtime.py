@@ -49,6 +49,36 @@ _M_PHASE = obs_metrics.REGISTRY.histogram(
     "client_phase_seconds", "client round phase wall time", ("phase",))
 _M_ACTIONS = obs_metrics.REGISTRY.counter(
     "client_actions_total", "completed client actions", ("action",))
+_M_SPARSE_ENCODE = obs_metrics.REGISTRY.histogram(
+    "sparse_encode_seconds",
+    "client-side sparse delta encode (top-k select + pack) per upload")
+
+
+def _encode_delta(delta, cfg) -> bytes:
+    """The ONE client-side delta encoder: sparse top-k when the genome
+    arms it (--delta-density < 1; certified hash over the sparse
+    canonical bytes), else the unchanged quantized/dense pipeline —
+    sync loop, async loop and any future uploader share this so the
+    encodings can never drift apart (utils.serialization)."""
+    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+                                                   pack_quantized,
+                                                   pack_sparse,
+                                                   sparse_enabled)
+    if sparse_enabled(cfg):
+        if obs_metrics.REGISTRY.enabled:
+            # materialize the (possibly still-dispatching) jax leaves
+            # BEFORE the timer: the encode metric must charge the
+            # top-k + pack, not the tail of the async train compute
+            import jax
+            delta = jax.tree_util.tree_map(np.asarray, delta)
+            t0 = time.perf_counter()
+            blob = pack_sparse(delta, cfg.delta_density,
+                               cfg.delta_dtype)
+            _M_SPARSE_ENCODE.observe(time.perf_counter() - t0)
+            return blob
+        return pack_sparse(delta, cfg.delta_density, cfg.delta_dtype)
+    return (pack_pytree(delta) if cfg.delta_dtype == "f32"
+            else pack_quantized(delta, cfg.delta_dtype))
 
 
 def _force_cpu_jax() -> None:
@@ -202,9 +232,8 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
     from bflc_demo_tpu.core.local_train import local_train
     from bflc_demo_tpu.comm.identity import _op_bytes
     from bflc_demo_tpu.ledger.base import ascores_sign_payload
-    from bflc_demo_tpu.utils.serialization import (dequantize_entries,
-                                                   pack_pytree,
-                                                   pack_quantized,
+    from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                   dequantize_entries,
                                                    unpack_pytree,
                                                    restore_pytree)
 
@@ -247,8 +276,7 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
                     model.apply, params, xj, yj, lr=cfg.learning_rate,
                     batch_size=cfg.batch_size,
                     local_epochs=cfg.local_epochs)
-            blob = (pack_pytree(delta) if cfg.delta_dtype == "f32"
-                    else pack_quantized(delta, cfg.delta_dtype))
+            blob = _encode_delta(delta, cfg)
             digest = hashlib.sha256(blob).digest()
             router.cache.put(digest.hex(), blob)
             n = int(x.shape[0])
@@ -295,9 +323,9 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
                         continue
                     deltas = [restore_pytree(
                                   template,
-                                  dequantize_entries(
+                                  densify_entries(dequantize_entries(
                                       unpack_pytree(
-                                          fetched[u["hash"]])))
+                                          fetched[u["hash"]]))))
                               for u in ups]
                     mr = router.fetch_model()
                 if not mr.get("ok"):
@@ -384,9 +412,8 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
     from bflc_demo_tpu.comm.failover import FailoverClient
     from bflc_demo_tpu.comm.identity import Wallet
     from bflc_demo_tpu.core.local_train import local_train
-    from bflc_demo_tpu.utils.serialization import (dequantize_entries,
-                                                   pack_pytree,
-                                                   pack_quantized,
+    from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                   dequantize_entries,
                                                    unpack_pytree,
                                                    restore_pytree)
 
@@ -472,11 +499,10 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                     model.apply, params, xj, yj, lr=cfg.learning_rate,
                     batch_size=cfg.batch_size,
                     local_epochs=cfg.local_epochs)
-            # opt-in quantized upload (utils.serialization): the blob —
-            # and therefore the hash this client SIGNS and the quorum
-            # certifies — is the quantized canonical bytes
-            blob = (pack_pytree(delta) if cfg.delta_dtype == "f32"
-                    else pack_quantized(delta, cfg.delta_dtype))
+            # opt-in sparse/quantized upload (utils.serialization): the
+            # blob — and therefore the hash this client SIGNS and the
+            # quorum certifies — is the sparse/quantized canonical bytes
+            blob = _encode_delta(delta, cfg)
             digest = hashlib.sha256(blob).digest()
             router.cache.put(digest.hex(), blob)
             n = int(x.shape[0])
@@ -529,10 +555,13 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                 with obs_trace.TRACE.span("fetch"):
                     fetched = router.fetch_blobs(
                         [u["hash"] for u in ups])
+                    # densify ∘ dequantize is the one shared decode
+                    # chain — an identity on dense f32 blobs, so the
+                    # pre-sparse path is byte-unchanged
                     deltas = [restore_pytree(
                                   template,
-                                  dequantize_entries(
-                                      unpack_pytree(fetched[u["hash"]])))
+                                  densify_entries(dequantize_entries(
+                                      unpack_pytree(fetched[u["hash"]]))))
                               for u in ups]
                     mr = router.fetch_model()
                 if not mr.get("ok"):

@@ -408,6 +408,50 @@ def check_op_auth(op: bytes, auth: Optional[dict],
         return f"undecodable op/auth: {type(e).__name__}: {e}"
 
 
+def check_sparse_upload_op(op: bytes, auth: Optional[dict]) -> str:
+    """'' when a sparse-mode upload/aupload op's payload blob decodes
+    through the ONE densify inverse; a reason string otherwise.
+
+    The validator half of sparse admission re-execution (the writer
+    half is `ledger_service._decode_delta`): with the fleet density-
+    armed, upload auth evidence must carry the (small — that is the
+    point of sparsification) blob whose sha256 equals the op's payload
+    hash, and `densify_entries(dequantize_entries(...))` must accept
+    it — so a colluding writer can no more certify a malformed `#topk`
+    blob than it can forge a client tag.  Validators hold no model
+    schema (that stays writer-side admission); what they pin is the
+    content binding plus the structural sparse contract: in-bounds,
+    strictly ascending, count-consistent indices.  Only call in sparse
+    mode — dense fleets carry no blob evidence and must not start."""
+    if not op or op[0] not in (_OP_UPLOAD, _OP_AUPLOAD):
+        return ""
+    body = op[1:]
+    try:
+        (slen,) = struct.unpack_from("<q", body, 0)
+        if slen < 0 or 8 + slen + 32 > len(body):
+            return "sparse: malformed upload body"
+        payload_hash = body[8 + slen:8 + slen + 32]
+    except struct.error as e:
+        return f"sparse: undecodable op ({e})"
+    if not isinstance(auth, dict) or "blob" not in auth:
+        return ("sparse: upload op without blob evidence (density-"
+                "armed quorum requires it)")
+    try:
+        blob = bytes.fromhex(auth["blob"])
+    except (TypeError, ValueError):
+        return "sparse: unparseable blob evidence"
+    if hashlib.sha256(blob).digest() != payload_hash:
+        return "sparse: blob evidence does not match the op's payload hash"
+    from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                   dequantize_entries,
+                                                   unpack_pytree)
+    try:
+        densify_entries(dequantize_entries(unpack_pytree(blob)))
+    except (ValueError, TypeError, struct.error) as e:
+        return f"sparse: blob refused by densify ({e})"
+    return ""
+
+
 # ------------------------------------------------- repair (liveness) layer
 _ABANDON_MAGIC = b"BFLCABDN1"
 
@@ -576,6 +620,13 @@ class ValidatorNode:
         # derived from configuration, like the validator keys)
         self._cell_registry: Optional[Dict[str, Tuple[int, int]]] = (
             dict(cell_registry) if cell_registry is not None else None)
+        # sparse upload deltas (--delta-density, utils.serialization):
+        # on a density-armed quorum every upload/aupload op must carry
+        # its blob as auth evidence and survive the densify inverse —
+        # the validator re-execution of sparse admission, so a
+        # colluding writer cannot certify a malformed #topk blob
+        from bflc_demo_tpu.utils.serialization import sparse_enabled
+        self._sparse = sparse_enabled(cfg)
         self._lock = threading.Lock()
         # index -> (attempt, op digest) of our current vote there
         self._voted: Dict[int, Tuple[int, bytes]] = {}
@@ -773,13 +824,21 @@ class ValidatorNode:
         self._heads.append(self.ledger.log_head())
         return self._sign_position(i, op, attempt)
 
-    def _vote_locked(self, i: int, op: bytes, auth, attempt: int) -> dict:
+    def _vote_locked(self, i: int, op: bytes, auth, attempt: int,
+                     sparse_err: str = "") -> dict:
         """The evidence-free voting core (lock held): idempotent re-sign
         of an op we already hold, strict ordering, abandon promises, auth
         check, apply + sign.  Anything needing QUORUM EVIDENCE (a peer
         certificate or a repair proof) refuses here — `_validate` layers
         that handling on top; the batch fast path refuses outright and
-        lets the writer fall back to the single-op method."""
+        lets the writer fall back to the single-op method.
+
+        `sparse_err` is the PRECOMPUTED `check_sparse_upload_op` verdict
+        ('' = fine): the full blob decode is a pure function of
+        (op, auth) and must run OUTSIDE this lock — on a density-armed
+        quorum it materializes the whole dense model per upload, and
+        serializing that behind the validator's one lock would put
+        N x decode latency on the BFT critical path per round."""
         op_hash = hashlib.sha256(op).digest()
         size = self.ledger.log_size()
         promised = self._promised.get(i, 0)
@@ -813,6 +872,8 @@ class ValidatorNode:
             err = check_cell_upload_op(op, self._cell_registry)
             if err:
                 return self._refuse("CELL", err)
+        if self._sparse and sparse_err:
+            return self._refuse("SPARSE", sparse_err)
         if self.require_auth:
             err = check_op_auth(op, auth, self.directory)
             if err:
@@ -844,10 +905,16 @@ class ValidatorNode:
 
     def _validate_inner(self, i: int, op: bytes, op_hash: bytes,
                         attempt: int, msg: dict) -> dict:
+        # the sparse blob re-execution is a pure function of (op, auth):
+        # run it before taking the lock (see _vote_locked docstring)
+        sparse_err = (check_sparse_upload_op(op, msg.get("auth"))
+                      if self._sparse else "")
         with self._lock:
-            r = self._vote_locked(i, op, msg.get("auth"), attempt)
+            r = self._vote_locked(i, op, msg.get("auth"), attempt,
+                                  sparse_err=sparse_err)
             status = r.get("status")
-            if r.get("ok") or status not in ("CONFLICT", "AUTH"):
+            if r.get("ok") or status not in ("CONFLICT", "AUTH",
+                                             "SPARSE"):
                 return r
             if status == "CONFLICT":
                 # a DIFFERENT op at a bound position: only quorum evidence
@@ -882,15 +949,23 @@ class ValidatorNode:
                                         self.directory)
                     if err:
                         return self._refuse("AUTH", err)
+                if cert is None and self._sparse and sparse_err:
+                    # ... and never a sparse bypass either: a
+                    # re-proposed upload still needs its blob evidence
+                    return self._refuse("SPARSE", sparse_err)
                 self._enroll_register_pubkey(op, msg.get("auth"))
                 _M_REPAIR.inc(kind=("cert_resync" if cert is not None
                                     else "re_proposal"))
                 self._rollback_to(i)
                 t = max(attempt, cert.attempt if cert else 0)
                 return self._apply_and_sign(i, op, op_hash, t)
-            # AUTH refusal at the fresh tip: certified backlog — the
-            # quorum already re-verified the client tag once; admit on
-            # the certificate
+            # AUTH/SPARSE refusal at the fresh tip: certified backlog —
+            # the quorum already re-verified the client tag (and, on a
+            # density-armed quorum, the sparse blob) once; admit on the
+            # certificate.  This keeps validator REJOIN live on sparse
+            # fleets: ops certified before a promotion lose their
+            # writer-process-local auth evidence (blob included), and
+            # refusing them here would wedge resync forever.
             if self._peer_certificate(msg, i, op) is None:
                 return r
             self._enroll_register_pubkey(op, msg.get("auth"))
@@ -976,12 +1051,19 @@ class ValidatorNode:
         stopped = None
         t0 = time.perf_counter() if (
             tracing.PROC.enabled or obs_metrics.REGISTRY.enabled) else 0.0
+        # sparse blob re-execution per op, OUTSIDE the lock (pure
+        # function of (op, auth); see _vote_locked docstring) — other
+        # vote/abandon traffic proceeds while this batch decodes
+        sparse_errs = ([check_sparse_upload_op(op, auths[k])
+                        for k, op in enumerate(ops)]
+                       if self._sparse else [""] * len(ops))
         # causal span linked to EVERY op in the batch (obs.trace): one
         # vote round-trip serves several clients' traces at once
         with obs_trace.server_span(msg, "vote_batch", links_key="tps",
                                    i=start, n_ops=len(ops)), self._lock:
             for k, op in enumerate(ops):
-                r = self._vote_locked(start + k, op, auths[k], attempt)
+                r = self._vote_locked(start + k, op, auths[k], attempt,
+                                      sparse_err=sparse_errs[k])
                 if not r.get("ok"):
                     stopped = r
                     break

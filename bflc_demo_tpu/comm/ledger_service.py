@@ -51,8 +51,10 @@ from bflc_demo_tpu.utils import tracing
 from bflc_demo_tpu.ledger import (async_enabled, make_ledger,
                                   LedgerStatus)
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
-from bflc_demo_tpu.utils.serialization import (dequantize_entries,
-                                               pack_entries, unpack_pytree)
+from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                               dequantize_entries,
+                                               pack_entries, sparse_enabled,
+                                               unpack_pytree)
 
 
 # --- admission-control gas (reference: CommitteePrecompiled.cpp:143,151,
@@ -125,6 +127,18 @@ _M_ASTALENESS = obs_metrics.REGISTRY.histogram(
 _M_AAGG = obs_metrics.REGISTRY.counter(
     "async_aggregations_total",
     "buffered aggregations committed (async mode)")
+# --- sparse upload deltas (--delta-density; utils.serialization): the
+# protocol density this writer admits (1.0 = dense) and the writer-side
+# decode cost of the densify inverse at admission — the operator's
+# evidence that sparse decode stays off the round critical path
+# (tools/fleet_top.py renders both; clients time the encode half).
+_G_DENSITY = obs_metrics.REGISTRY.gauge(
+    "delta_density",
+    "protocol upload-delta density this writer admits (1.0 = dense)")
+_M_SPARSE_DECODE = obs_metrics.REGISTRY.histogram(
+    "sparse_decode_seconds",
+    "writer-side sparse delta decode (dequantize + densify) per "
+    "admitted blob")
 
 _PROMO_MAGIC = b"BFLCPROM1"
 
@@ -478,6 +492,16 @@ class LedgerServer:
         # construction.  False (K=0 or BFLC_ASYNC_LEGACY=1) pins the
         # synchronous round barrier byte-for-byte.
         self._async = async_enabled(cfg)
+        # sparse upload deltas (--delta-density < 1, utils.serialization):
+        # admission decodes through the ONE densify inverse (a malformed
+        # #topk record is a schema error at the door), the decoded DENSE
+        # image is what gets staged — so meshagg reduction bytes and every
+        # golden hash pin are untouched by construction — and upload ops'
+        # auth evidence carries the (small) sparse blob so BFT validators
+        # re-execute the same decode before co-signing.  Dense fleets
+        # (density 1.0 or BFLC_SPARSE_LEGACY=1) reject #topk entries as
+        # the schema garbage they then are.
+        self._sparse = sparse_enabled(cfg)
         if bft_validators:
             from bflc_demo_tpu.comm.bft import CertificateAssembler
             from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
@@ -1325,10 +1349,18 @@ class LedgerServer:
                     # so a validator with a directory hole — rejoined
                     # through a mid-registration promotion — heals on
                     # this op instead of refusing the client forever
-                    self._op_auth[self.ledger.log_size() - 1] = {
-                        "tag": m.get("tag", ""), "n": int(m["n"]),
-                        "cost": float(m["cost"]),
-                        "pubkey": self._sender_pubkey_hex(addr)}
+                    auth = {"tag": m.get("tag", ""), "n": int(m["n"]),
+                            "cost": float(m["cost"]),
+                            "pubkey": self._sender_pubkey_hex(addr)}
+                    if self._sparse:
+                        # sparse mode: the (small — that's the point)
+                        # blob rides the auth evidence so validators
+                        # re-execute the densify admission check
+                        # before co-signing (comm.bft
+                        # check_sparse_upload_op) — a colluding writer
+                        # cannot certify a malformed #topk blob
+                        auth["blob"] = blob.hex()
+                    self._op_auth[self.ledger.log_size() - 1] = auth
                 elif st == LedgerStatus.DUPLICATE:
                     # an honest retry (e.g. across a writer failover) whose
                     # original reply was lost: the record is in the ledger —
@@ -1478,6 +1510,8 @@ class LedgerServer:
                                       else self.ledger.log_size()))
                     _G_SUBS.set(len(self._sub_acked))
                     _G_LOG_BASE.set(getattr(self.ledger, "log_base", 0))
+                    _G_DENSITY.set(self.cfg.delta_density
+                                   if self._sparse else 1.0)
                     if self._async:
                         _G_ABUF_DEPTH.set(
                             self.ledger.async_buffer_depth)
@@ -1560,10 +1594,15 @@ class LedgerServer:
                 self._replay.consume(
                     self.ledger.epoch - self.cfg.max_staleness,
                     base_epoch, bytes.fromhex(m.get("tag", "")))
-            self._op_auth[self.ledger.log_size() - 1] = {
-                "tag": m.get("tag", ""), "n": int(m["n"]),
-                "cost": float(m["cost"]),
-                "pubkey": self._sender_pubkey_hex(addr)}
+            auth = {"tag": m.get("tag", ""), "n": int(m["n"]),
+                    "cost": float(m["cost"]),
+                    "pubkey": self._sender_pubkey_hex(addr)}
+            if self._sparse:
+                # async opcode-10 carries sparse blobs through the
+                # FedBuff drain too: same validator re-execution
+                # evidence as the sync path
+                auth["blob"] = blob.hex()
+            self._op_auth[self.ledger.log_size() - 1] = auth
             if obs_metrics.REGISTRY.enabled:
                 _M_ASTALENESS.observe(
                     self.ledger.epoch - base_epoch)
@@ -1669,6 +1708,9 @@ class LedgerServer:
                                    unpack_pytree(
                                        self._blobs[e.payload_hash]))
                                for e in entries]
+                if self._sparse:
+                    delta_flats = [densify_entries(f)
+                                   for f in delta_flats]
                 new_flat = _aggregate_flat(global_flat, delta_flats,
                                            weights, list(selected),
                                            self.cfg.learning_rate)
@@ -1745,14 +1787,26 @@ class LedgerServer:
         deterministic decode scorers and the aggregator apply — so the
         admitted structure is exactly what aggregation will walk; with
         quantization off the strict check is unchanged (reduced-
-        precision blobs are rejected at the door).  The decoded image
-        is returned so admission can STAGE it for the meshagg
-        aggregate instead of throwing the work away and re-decoding at
-        commit."""
+        precision blobs are rejected at the door).  With sparse deltas
+        armed (cfg.delta_density < 1) the image additionally runs
+        through the ONE `densify_entries` inverse — a malformed #topk
+        record (out-of-bounds/duplicate/unsorted indices) raises
+        ValueError here and dies as a schema error, never a crash;
+        with density 1.0 a #topk entry is rejected by the strict key
+        check.  The decoded image is returned so admission can STAGE
+        it for the meshagg aggregate instead of throwing the work away
+        and re-decoding at commit."""
         try:
+            t0 = (time.perf_counter()
+                  if self._sparse and obs_metrics.REGISTRY.enabled
+                  else 0.0)
             delta = unpack_pytree(blob)
             if self.cfg.delta_dtype != "f32":
                 delta = dequantize_entries(delta)
+            if self._sparse:
+                delta = densify_entries(delta)
+                if t0:
+                    _M_SPARSE_DECODE.observe(time.perf_counter() - t0)
         except (ValueError, TypeError, struct.error) as e:
             return f"undecodable delta blob: {e}", None
         err = self._schema_error(delta)
@@ -1783,6 +1837,8 @@ class LedgerServer:
         from bflc_demo_tpu.hier.partial import split_cellmeta
         from bflc_demo_tpu.meshagg.engine import flatten_delta
         flat = dequantize_entries(unpack_pytree(self._blobs[digest]))
+        if self._sparse:
+            flat = densify_entries(flat)
         if self._cell_registry is not None:
             flat = split_cellmeta(flat)[0]
         return flatten_delta(flat, sorted(flat.keys()))
@@ -1801,7 +1857,10 @@ class LedgerServer:
         entries mirror the model schema.  The #cellmeta-stripped
         partial is returned so root admission can stage it for the
         meshagg aggregate (the evidence entry rode the certified hash
-        but is not a model tensor)."""
+        but is not a model tensor).  With sparse deltas armed the cell
+        aggregator RE-SPARSIFIES its partial for the bridge hop
+        (hier.partial.partial_blob): the same densify inverse decodes
+        it here, before the #cellmeta split."""
         from bflc_demo_tpu.hier.partial import split_cellmeta
         ent = self._cell_registry.get(addr)
         if ent is None:
@@ -1810,6 +1869,8 @@ class LedgerServer:
         reg_index, cap = ent
         try:
             flat = unpack_pytree(blob)
+            if self._sparse:
+                flat = densify_entries(flat)
             partial, meta = split_cellmeta(flat)
         except (ValueError, TypeError, struct.error) as e:
             return f"undecodable cell partial: {e}", None
@@ -1894,8 +1955,18 @@ class LedgerServer:
                 rows = [flatten_delta(f, keys)
                         for f in (delta_flats or [])]
             if self._health is None:
+                # the protocol density feeds the monitor: honest
+                # sparse deltas legitimately drive zero_frac to
+                # ~1-density and must not trip the free-rider rule.
+                # density 1.0 (rule off) when quantization composes:
+                # i8 can zero an honest survivor set outright
+                # (HealthMonitor docstring)
                 self._health = obs_health.HealthMonitor(
-                    role=obs_metrics.REGISTRY.role or "writer")
+                    role=obs_metrics.REGISTRY.role or "writer",
+                    density=(self.cfg.delta_density
+                             if self._sparse
+                             and self.cfg.delta_dtype == "f32"
+                             else 1.0))
             self._health.on_round(
                 epoch=epoch, senders=list(senders), rows=rows,
                 weights=[float(w) for w in weights],
@@ -1941,14 +2012,16 @@ class LedgerServer:
                 global_flat, rows, [u.n_samples for u in updates],
                 list(pending.selected), self.cfg.learning_rate)
         else:
-            # host loop: dequantize is the ONE shared decode
-            # (utils.serialization): an identity on plain f32 blobs,
-            # the deterministic inverse for opt-in f16/i8 uploads —
-            # scorer, aggregator and re-validators therefore agree on
-            # every delta's numeric meaning
+            # host loop: densify ∘ dequantize is the ONE shared decode
+            # chain (utils.serialization): an identity on plain f32
+            # blobs, the deterministic inverse for opt-in f16/i8 and
+            # sparse uploads — scorer, aggregator and re-validators
+            # therefore agree on every delta's numeric meaning
             delta_flats = [dequantize_entries(
                                unpack_pytree(self._blobs[u.payload_hash]))
                            for u in updates]
+            if self._sparse:
+                delta_flats = [densify_entries(f) for f in delta_flats]
             if self._cell_registry is not None:
                 # hier root: each "delta" is a cell partial whose
                 # reserved #cellmeta evidence entry rode the certified

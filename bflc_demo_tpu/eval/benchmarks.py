@@ -774,6 +774,158 @@ def data_plane_config1(rounds: int = 3, *, standbys: int = 2,
     return out
 
 
+def sparse_config1(rounds: int = 3, *, standbys: int = 2,
+                   validators: int = 4, quorum: int = 1,
+                   model_hidden: int = 4096,
+                   densities=(1.0, 0.1, 0.01),
+                   dtypes=("f32", "i8"),
+                   timeout_s: float = 420.0) -> Dict:
+    """Sparse-upload benchmark: the PR-5 egress methodology swept over
+    the density x dtype grid at the config-1 BFT fleet geometry
+    (20 clients + 2 standbys + 4 validators + quorum-1 + WAL, the same
+    fat MLP as data_plane_config1 so blob movement dominates the wire).
+
+    One child fleet per (density, dtype) leg on the fast data plane,
+    PLUS the PR-5 baseline: a dense-f32 fleet with
+    BFLC_DATA_PLANE_LEGACY=1 (no fan-out, no cache, no compression) —
+    the `legacy_d1_f32` leg every ratio in `egress_vs_legacy_x` is
+    taken against, exactly the data_plane_config1 methodology with the
+    encoding axes swept on top.  Per leg: writer egress bytes/round
+    (steady-state scrape slope), best accuracy vs the fast dense-f32
+    leg, and the encode/decode round shares (client-side top-k
+    `sparse_encode_seconds` as a fraction of one round's wall — the
+    latency a client's upload gains; writer-side densify
+    `sparse_decode_seconds` summed per round against the same wall —
+    both must stay noise or the egress win is an illusion).  The
+    headline claims: density 0.01 x f32 beats the legacy dense-f32
+    egress by >= 20x at an accuracy gap <= 0.01, and density x i8
+    beats i8 alone (sparsification and quantization compose
+    multiplicatively, QSGD).  Certified-history integrity per leg: the
+    replica replay inside run_federated_processes raises on head
+    divergence."""
+    import dataclasses
+
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+    from bflc_demo_tpu.obs.collector import load_timeline
+
+    cfg = DEFAULT_PROTOCOL
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    factory_kw = {"input_shape": (5,), "hidden": int(model_hidden),
+                  "num_classes": 2}
+
+    def _leg(density: float, dtype: str, legacy: bool = False) -> Dict:
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        run_cfg = dataclasses.replace(cfg, delta_dtype=dtype,
+                                      delta_density=float(density))
+        saved = {k: os.environ.get(k)
+                 for k in ("BFLC_PROC_TRACE", "BFLC_DATA_PLANE_LEGACY")}
+        os.environ["BFLC_PROC_TRACE"] = "1"
+        if legacy:
+            os.environ["BFLC_DATA_PLANE_LEGACY"] = "1"
+        else:
+            os.environ.pop("BFLC_DATA_PLANE_LEGACY", None)
+        try:
+            with tempfile.TemporaryDirectory(
+                    prefix="bflc-sparse-bench-") as td:
+                res = run_federated_processes(
+                    "make_mlp", shards, (xte, yte), run_cfg,
+                    rounds=rounds, factory_kw=factory_kw,
+                    standbys=standbys, quorum=quorum,
+                    bft_validators=validators,
+                    wal_path=os.path.join(td, "writer.wal"),
+                    telemetry_dir=os.path.join(td, "telemetry"),
+                    timeout_s=timeout_s)
+                timeline = load_timeline(res.telemetry_report["jsonl"]) \
+                    if res.telemetry_report else []
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        info = res.final_info or {}
+        costs = (info.get("perf") or {}).get("costs", {})
+        bytes_out = float(costs.get("wire.bytes_out", 0.0))
+        rounds_done = max(res.rounds_completed, 1)
+        ts = [t for _, t in res.epoch_times]
+        round_wall = ((ts[-1] - ts[0]) / (len(ts) - 1)
+                      if len(ts) >= 2 else res.wall_time_s / rounds_done)
+        n_enc, mean_enc = _scrape_hist(timeline, "client-",
+                                       "sparse_encode_seconds")
+        n_dec, mean_dec = _scrape_hist(timeline, "writer",
+                                       "sparse_decode_seconds")
+        return {
+            "density": float(density), "delta_dtype": dtype,
+            "data_plane": "legacy" if legacy else "fast",
+            "rounds": res.rounds_completed,
+            "best_acc": round(res.best_accuracy(), 4),
+            "round_wall_time_s": round(round_wall, 4),
+            "writer_egress_bytes_per_round": int(_writer_egress_per_round(
+                timeline, bytes_out, rounds_done)),
+            "encode_calls": n_enc,
+            "encode_mean_s": round(mean_enc, 6),
+            # latency one client's upload gains per round
+            "encode_share_of_round": round(
+                mean_enc / max(round_wall, 1e-9), 5),
+            "decode_calls": n_dec,
+            "decode_mean_s": round(mean_dec, 6),
+            # writer-side densify is serial on admission: whole-round sum
+            "decode_share_of_round": round(
+                (n_dec / rounds_done) * mean_dec
+                / max(round_wall, 1e-9), 5),
+            "log_head": info.get("log_head"),
+            "replica_verified": res.replica_report is not None,
+        }
+
+    legs: Dict[str, Dict] = {
+        # the PR-5 baseline every headline ratio is against: dense f32
+        # on the LEGACY data plane (no fan-out / cache / compression)
+        "legacy_d1_f32": _leg(1.0, "f32", legacy=True),
+    }
+    for dt in dtypes:
+        for d in densities:
+            legs[f"d{d:g}_{dt}"] = _leg(d, dt)
+    out: Dict = {
+        "geometry": {"clients": cfg.client_num, "standbys": standbys,
+                     "validators": validators, "quorum": quorum,
+                     "rounds": rounds, "model": "mlp",
+                     "model_hidden": int(model_hidden),
+                     "densities": [float(d) for d in densities],
+                     "dtypes": list(dtypes)},
+        "legs": legs,
+    }
+    legacy = legs["legacy_d1_f32"]
+    if legacy["writer_egress_bytes_per_round"]:
+        b = legacy["writer_egress_bytes_per_round"]
+        out["egress_vs_legacy_dense_f32_x"] = {
+            k: round(b / leg["writer_egress_bytes_per_round"], 2)
+            for k, leg in legs.items()
+            if k != "legacy_d1_f32"
+            and leg["writer_egress_bytes_per_round"]}
+    base = legs.get("d1_f32")
+    if base:
+        if base["writer_egress_bytes_per_round"]:
+            b = base["writer_egress_bytes_per_round"]
+            out["egress_vs_dense_f32_x"] = {
+                k: round(b / leg["writer_egress_bytes_per_round"], 2)
+                for k, leg in legs.items()
+                if leg["writer_egress_bytes_per_round"]}
+        out["acc_gap_vs_dense_f32"] = {
+            k: round(base["best_acc"] - leg["best_acc"], 4)
+            for k, leg in legs.items()}
+    # the QSGD composition claim: sparse x i8 beats i8 alone
+    i8 = legs.get("d1_i8")
+    sparsest = min((float(d) for d in densities), default=1.0)
+    si8 = legs.get(f"d{sparsest:g}_i8")
+    if i8 and si8 and si8["writer_egress_bytes_per_round"]:
+        out["sparse_i8_vs_i8_x"] = round(
+            i8["writer_egress_bytes_per_round"]
+            / si8["writer_egress_bytes_per_round"], 2)
+    return out
+
+
 # ------------------------------------------- hierarchical federation (PR 6)
 def _flat_entries(template):
     """[(keystr, leaf_index)] of a pytree template — the canonical entry

@@ -73,6 +73,14 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
         if async_enabled(cfg):
             inapplicable += [("async_buffer (protocol)",
                               cfg.async_buffer)]
+        # sparse upload deltas are likewise a wire-protocol mode: only
+        # the processes runtimes pack/decode blobs, so an in-memory
+        # runtime would silently train dense under a density the
+        # operator asked for
+        from bflc_demo_tpu.utils.serialization import sparse_enabled
+        if sparse_enabled(cfg):
+            inapplicable += [("delta_density (protocol)",
+                              cfg.delta_density)]
         inapplicable += [("standbys", standbys), ("quorum", quorum),
                          ("bft_validators", bft_validators),
                          ("chaos_seed", chaos_seed is not None),

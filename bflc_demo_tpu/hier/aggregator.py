@@ -54,7 +54,8 @@ from bflc_demo_tpu.obs import health as obs_health
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.protocol.constants import ProtocolConfig
-from bflc_demo_tpu.utils.serialization import (dequantize_entries,
+from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                               dequantize_entries,
                                                restore_pytree,
                                                unpack_pytree)
 
@@ -154,6 +155,11 @@ class CellAggregatorServer(LedgerServer):
                 u = updates[s]
                 flat = dequantize_entries(
                     unpack_pytree(self._blobs[u.payload_hash]))
+                if self._sparse:
+                    # members uploaded sparse (admission already
+                    # densified for the schema check; the stored blob
+                    # is still the certified sparse bytes)
+                    flat = densify_entries(flat)
                 admitted.append((u.sender, flat, u.n_samples,
                                  u.avg_cost))
             partial, n_clients, mean_cost = cell_partial(admitted)
@@ -163,8 +169,12 @@ class CellAggregatorServer(LedgerServer):
                  for u in updates],
                 [float(m) for m in pending.medians],
                 list(pending.selected))
+            # sparse mode: re-sparsify the dense partial for the
+            # cell->root bridge hop (hier.partial.partial_blob)
             blob = partial_blob(partial, self.cell_index, n_clients,
-                                evidence)
+                                evidence,
+                                density=(self.cfg.delta_density
+                                         if self._sparse else 1.0))
         # the member's trace context (ambient here: the partial computes
         # inside the triggering member's scores dispatch) rides the
         # outbox so the BRIDGE upload to the root continues the same
@@ -219,11 +229,20 @@ class CellAggregatorServer(LedgerServer):
                 if flat is None:
                     flat = dequantize_entries(
                         unpack_pytree(self._blobs[u.payload_hash]))
+                    if self._sparse:
+                        flat = densify_entries(flat)
                 rows.append(flatten_delta(flat, keys))
             if self._health is None:
+                # density 1.0 (zero_frac rule off) when quantization
+                # composes — same wiring rule as the root writer
+                # (HealthMonitor docstring)
                 self._health = obs_health.HealthMonitor(
                     role=obs_metrics.REGISTRY.role
-                    or f"cell-{self.cell_index}")
+                    or f"cell-{self.cell_index}",
+                    density=(self.cfg.delta_density
+                             if self._sparse
+                             and self.cfg.delta_dtype == "f32"
+                             else 1.0))
             self._health.on_round(
                 epoch=epoch, senders=[u.sender for u in updates],
                 rows=rows, weights=[float(u.n_samples)
@@ -292,9 +311,13 @@ class CellAggregatorServer(LedgerServer):
             blobs = router.fetch_blobs([u["hash"] for u in ups])
         except (LookupError, ConnectionError):
             return None
+        # candidate partials are sparse on the bridge when the fleet is
+        # density-armed: densify (identity on dense) before the
+        # #cellmeta split, the same decode chain the root writer runs
         deltas = [restore_pytree(self._template,
-                                 split_cellmeta(unpack_pytree(
-                                     blobs[u["hash"]]))[0])
+                                 split_cellmeta(densify_entries(
+                                     unpack_pytree(
+                                         blobs[u["hash"]])))[0])
                   for u in ups]
         stacked = jax.tree_util.tree_map(lambda *t: jnp.stack(t), *deltas)
         xv, yv = self._val

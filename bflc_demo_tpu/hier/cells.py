@@ -114,7 +114,10 @@ def root_protocol(cfg: ProtocolConfig, n_cells: int) -> ProtocolConfig:
     n_cells - comm cell partials merge.  Partials are always plain f32
     (the aggregator dequantizes member deltas before summing), so the
     root genome pins delta_dtype='f32' regardless of the cell tier's
-    upload encoding."""
+    upload encoding.  delta_density is NOT pinned: a density-armed
+    fleet re-sparsifies each cell partial for the bridge hop
+    (hier.partial.partial_blob), and the root admits it through the
+    same densify inverse as any upload."""
     if n_cells < 2:
         raise ValueError(f"the root tier needs >= 2 cells, got {n_cells}")
     comm = max(1, min(cfg.comm_count, n_cells // 2, n_cells - 1))

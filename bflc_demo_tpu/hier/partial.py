@@ -30,7 +30,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from bflc_demo_tpu.utils.serialization import pack_entries
+from bflc_demo_tpu.utils.serialization import (pack_entries,
+                                               sparsify_entries)
 
 # reserved canonical-entry key: '#' cannot appear in a model pytree's
 # keystr paths (utils.serialization.QSCALE_SUFFIX uses the same property)
@@ -158,13 +159,24 @@ def cell_partial(admitted: List[Tuple[str, Dict[str, np.ndarray], int,
 
 
 def partial_blob(partial: Dict[str, np.ndarray], cell_index: int,
-                 n_clients: int, evidence: bytes) -> bytes:
+                 n_clients: int, evidence: bytes,
+                 density: float = 1.0) -> bytes:
     """Canonical bytes of (partial entries + #cellmeta) — what the cell
     aggregator hashes, SIGNS, and uploads; the certified payload hash is
-    sha256 of exactly these bytes."""
+    sha256 of exactly these bytes.
+
+    With sparse upload deltas armed (density < 1) the partial is
+    RE-SPARSIFIED for the bridge hop: members already uploaded sparse
+    into the cell, the cell summed them dense, and the one certified op
+    per cell per round gets the same egress win on the cell->root edge.
+    Sparsify runs BEFORE the #cellmeta entry joins (the evidence is a
+    uint8 vector sparsify passes through untouched either way), and the
+    root decodes through the same `densify_entries` inverse as any
+    upload — density 1.0 keeps the pre-sparse bytes byte-for-byte."""
     if CELLMETA_KEY in partial:
         raise ValueError("partial already carries a #cellmeta entry")
-    entries = dict(partial)
+    entries = (sparsify_entries(dict(partial), density)
+               if density < 1.0 else dict(partial))
     entries[CELLMETA_KEY] = pack_cellmeta(cell_index, n_clients, evidence)
     return pack_entries(entries)
 

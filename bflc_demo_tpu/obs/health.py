@@ -146,16 +146,38 @@ class HealthMonitor:
     outliers hundreds of rounds apart must not page either.  z-scores
     only fire once the rolling window holds ``min_baseline``
     observations, so a cold start cannot produce false verdicts.
+
+    ``density`` is the protocol's upload-delta density (1.0 = dense):
+    sparse mode legitimately drives every honest delta's ``zero_frac``
+    to ~``1 - density``, so the free-rider rule below warns past
+    ``max(1 - density/2, 0.98)`` — strictly above what an honest
+    top-k encoder can produce (k = ceil(density * size) nonzeros means
+    zero_frac <= 1 - density < the ceiling), while an all-zero /
+    dead-sender delta still trips.  The rule is ACTIVE ONLY in sparse
+    mode (density < 1): dense fleets keep their pre-sparse behavior —
+    no zero_frac judgement — because other encodings also produce
+    exact zeros legitimately (i8 quantization zeroes every entry below
+    half a scale step; ReLU models have structurally dead gradients)
+    and a density-blind ceiling would cry wolf on honest fleets.  For
+    the same reason, CALLERS feed density=1.0 (rule off) when
+    quantization composes with sparsification (delta_dtype != 'f32'):
+    an honest outlier-dominated sparse x i8 delta can dequantize its
+    whole survivor set to exact zeros (every |v| < scale/2), which the
+    f32-only ``zero_frac <= 1 - density`` bound does not cover — the
+    writer wiring (comm.ledger_service / hier.aggregator) does this.
+    Warn-worthy only (never crit on its own).
     """
 
     def __init__(self, role: str = "writer", *, window: int = 128,
                  min_baseline: int = 16, warn_z: float = 4.0,
                  crit_z: float = 8.0, rel_floor: float = 0.05,
                  cos_flip: float = -0.75, crit_streak: int = 2,
-                 streak_gap: int = 8,
+                 streak_gap: int = 8, density: float = 1.0,
                  jsonl_path: Optional[str] = None,
                  keep_records: int = 512):
         self.role = role
+        self.density = float(density)
+        self._zf_ceiling = max(1.0 - self.density / 2.0, 0.98)
         self.window = int(window)
         self.min_baseline = int(min_baseline)
         self.warn_z = float(warn_z)
@@ -315,6 +337,13 @@ class HealthMonitor:
                     and cos_med >= 0.1:
                 reasons.append("cos_flip")
                 crit_worthy = True
+            if self.density < 1.0 and \
+                    float(stats["zero_frac"][i]) > self._zf_ceiling:
+                # free-rider / dead delta: more zeros than an honest
+                # top-k encoder at this protocol density can produce
+                # (class docstring; sparse mode only) — warn-worthy,
+                # never crit alone
+                reasons.append("zero_frac")
             if crit_worthy:
                 prev, last = self._streak.get(sender, (0, -10 ** 9))
                 streak = (prev + 1 if self.rounds - last

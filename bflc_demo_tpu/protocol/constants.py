@@ -59,6 +59,17 @@ class ProtocolConfig:
     # quantized canonical bytes (utils.serialization).
     delta_dtype: str = "f32"
 
+    # data plane: opt-in deterministic top-k sparsified upload deltas
+    # (1.0 = dense, off).  Part of the protocol genome like delta_dtype:
+    # clients keep each float leaf's ceil(density * size) largest-|value|
+    # entries (ties by ascending flat index — every honest encoder
+    # byte-identical), the certified hash is over the sparse canonical
+    # bytes, and every consumer decodes through the ONE shared
+    # `densify_entries` inverse; composes multiplicatively with
+    # delta_dtype (utils.serialization).  BFLC_SPARSE_LEGACY=1 pins the
+    # dense protocol byte-for-byte regardless of this knob.
+    delta_density: float = 1.0
+
     # asynchronous buffered aggregation (FedBuff, Nguyen et al. 2022 —
     # PAPERS.md): with async_buffer = K > 0 the round barrier falls.
     # Clients train continuously against whatever model they last
@@ -94,6 +105,10 @@ class ProtocolConfig:
             raise ValueError(
                 f"delta_dtype must be one of ('f32', 'f16', 'i8'), got "
                 f"{self.delta_dtype!r}")
+        if not 0.0 < self.delta_density <= 1.0:
+            raise ValueError(
+                f"delta_density must be in (0, 1], got "
+                f"{self.delta_density}")
         if self.async_buffer < 0 or self.max_staleness < 0:
             raise ValueError(
                 f"async_buffer and max_staleness must be >= 0, got "

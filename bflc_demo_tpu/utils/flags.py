@@ -117,6 +117,18 @@ def build_parser() -> argparse.ArgumentParser:
                                 "quantize client uploads, certified "
                                 "hash over the quantized bytes)")
             continue
+        if name == "delta_density":
+            # opt-in sparsified upload deltas (utils.serialization);
+            # composes with --delta-dtype.  Validated by
+            # ProtocolConfig.validate (must be in (0, 1])
+            p.add_argument("--delta-density", type=float, default=None,
+                           help="protocol: deterministic top-k upload "
+                                "sparsification — keep this fraction "
+                                "of each float leaf's largest-|value| "
+                                "entries (default 1.0 = dense; "
+                                "certified hash over the sparse "
+                                "bytes, composes with --delta-dtype)")
+            continue
         p.add_argument("--" + name.replace("_", "-"),
                        type=type(default), default=None,
                        help=f"protocol: {name} (default {default})")

@@ -30,11 +30,36 @@ single numeric meaning everywhere:
   scale (max|x|/127) riding in a reserved `<key>#qscale` 0-d entry;
   decode is exactly `int8.astype(f32) * scale` — pure IEEE float32 ops,
   bit-identical on every host.
+
+Sparse upload deltas (Konečný et al. 2016's OTHER remedy; composes
+multiplicatively with quantization per QSGD): an upload delta may
+additionally opt into deterministic per-leaf top-k sparsification
+(`--delta-density`, part of the protocol genome).  Each float leaf
+keeps only its k = ceil(density * size) largest-|value| entries, ties
+broken by ASCENDING FLAT INDEX so every honest encoder produces
+byte-identical output; the surviving values ride the EXISTING value
+pipeline (a plain f32 vector, or f16/i8 through `quantize_entries` —
+so `--delta-dtype i8 --delta-density 0.01` composes) and the sorted
+u32 indices pack into a reserved `<key>#topk` entry together with the
+leaf's original shape.  Sparsification happens ONCE, client-side,
+BEFORE quantization, and the certified content hash is over the
+sparse canonical bytes — what was signed is exactly what every
+consumer hashes.  `densify_entries` is the ONE deterministic inverse
+(an identity on dense blobs): admission schema checks, committee
+scorers, the aggregator and BFT validator re-execution all decode
+through it, so sparsification changes no trust (PARITY.md).  A
+malformed `#topk` entry (out-of-bounds / duplicate / unsorted
+indices, wrong dtype, value-count mismatch) raises ValueError and is
+refused at admission as a schema error, never applied.  Density 1.0
+(the default) and `BFLC_SPARSE_LEGACY=1` pin the dense protocol
+byte-for-byte: sparsify is the identity and no `#topk` entry ever
+exists.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import struct
 from typing import Any, Dict, List, Tuple
 
@@ -52,6 +77,47 @@ DELTA_DTYPES = ("f32", "f16", "i8")
 # cannot appear in a jax.tree_util.keystr path component the models
 # produce, so an honest tree can never collide with a scale entry.
 QSCALE_SUFFIX = "#qscale"
+
+# reserved key suffix carrying a sparsified leaf's index/shape record
+# (same '#' collision argument): uint32 [ndim, *shape, *ascending idx]
+TOPK_SUFFIX = "#topk"
+
+# densify refuses a #topk record claiming more dimensions than any
+# model here could honestly produce — a bound, not a format feature
+_TOPK_MAX_NDIM = 8
+
+# ... and records whose claimed dense sizes TOTAL past 64M elements
+# (256 MB of f32) per blob: the allocations happen BEFORE any schema
+# check, so untrusted records must never size them — and no honest
+# model can be bigger, because its dense form has to fit the 256 MiB
+# wire frame cap everywhere else in the system (comm.wire)
+_TOPK_MAX_ELEMS = 1 << 26
+
+
+def sparse_legacy() -> bool:
+    """BFLC_SPARSE_LEGACY=1 pins the dense protocol byte-for-byte (the
+    benchmark's baseline switch): encoders never sparsify and decoders
+    treat `#topk` entries as the schema garbage they then are."""
+    return bool(os.environ.get("BFLC_SPARSE_LEGACY"))
+
+
+def sparse_enabled(cfg) -> bool:
+    """The ONE arming decision every sparse-aware layer asks: the
+    protocol genome opted in (delta_density < 1) and no legacy pin."""
+    return float(getattr(cfg, "delta_density", 1.0)) < 1.0 \
+        and not sparse_legacy()
+
+
+def topk_count(size: int, density: float) -> int:
+    """Deterministic per-leaf k: ceil(density * size), clamped to
+    [0, size].  Every honest encoder computes the same k from the same
+    (size, density) pair — f64 multiply + ceil are IEEE-pinned."""
+    if size <= 0 or density <= 0.0:
+        return 0
+    if density >= 1.0:
+        return int(size)
+    return int(min(size, int(np.ceil(np.float64(density)
+                                     * np.float64(size)))))
 
 
 def _leaf_entries(tree: Pytree) -> List[Tuple[str, np.ndarray]]:
@@ -243,4 +309,128 @@ def pack_quantized(tree: Pytree, dtype: str) -> bytes:
     these quantized canonical bytes, so quantization changes no trust
     semantics; module docstring)."""
     entries = dict(_leaf_entries(tree))
+    return pack_entries(quantize_entries(entries, dtype))
+
+
+# ------------------------------------------------------ sparse encodings
+def sparsify_entries(flat: Dict[str, np.ndarray],
+                     density: float) -> Dict[str, np.ndarray]:
+    """Deterministic per-leaf top-k image of flat {path: array} entries.
+
+    Each float leaf keeps its k = `topk_count(size, density)` entries of
+    largest |value|, TIES BROKEN BY ASCENDING FLAT INDEX (a stable sort
+    on -|v| — two honest encoders can never disagree on the survivor
+    set), emitted as a (k,) float32 vector in ascending-index order plus
+    a reserved `<key>#topk` uint32 record ``[ndim, *shape, *indices]``.
+    A leaf whose k reaches its full size stays DENSE (the sparse form
+    would only be bigger); density >= 1 is therefore the identity and
+    produces no `#topk` entry anywhere — the byte-for-byte dense pin.
+    Non-float leaves always pass through untouched.  Apply BEFORE
+    `quantize_entries`: the k-vector rides the existing f32/f16/i8
+    value pipeline, so sparsification and quantization compose."""
+    if density >= 1.0:
+        return dict(flat)
+    if density < 0.0:
+        raise ValueError(f"density must be in [0, 1], got {density}")
+    out: Dict[str, np.ndarray] = {}
+    for key, arr in flat.items():
+        a = np.asarray(arr)
+        if not np.issubdtype(a.dtype, np.floating):
+            out[key] = a
+            continue
+        size = int(a.size)
+        k = topk_count(size, density)
+        if k >= size:
+            out[key] = a
+            continue
+        vals = a.astype(np.float32, copy=False).ravel()
+        # stable argsort on -|v|: equal magnitudes keep ascending flat
+        # index — the documented deterministic tie-break
+        order = np.argsort(-np.abs(vals), kind="stable")
+        idx = np.sort(order[:k]).astype(np.uint32)
+        out[key] = vals[idx].astype(np.float32)
+        out[key + TOPK_SUFFIX] = np.concatenate([
+            np.asarray([a.ndim] + list(a.shape), np.uint32), idx])
+    return out
+
+
+def densify_entries(flat: Dict[str, np.ndarray]
+                    ) -> Dict[str, np.ndarray]:
+    """The ONE deterministic inverse of `sparsify_entries`, shared by
+    admission schema checks, committee scorers, the aggregator and BFT
+    validator re-execution (module docstring).
+
+    An identity on dense entries (no `#topk` keys).  For each `#topk`
+    record the paired (k,) float vector scatters into a float32 zeros
+    tensor of the recorded shape.  Raises ValueError on ANY malformed
+    record — wrong dtype, impossible ndim, value-count mismatch,
+    out-of-bounds / duplicate / unsorted indices, or an orphan record
+    without its values leaf — so a hostile blob dies at admission as a
+    schema error instead of corrupting an aggregate.  Run AFTER
+    `dequantize_entries` (f16/i8 k-vectors decode to float32 first)."""
+    topks = {k: v for k, v in flat.items() if k.endswith(TOPK_SUFFIX)}
+    if not topks:
+        return dict(flat)
+    out: Dict[str, np.ndarray] = {}
+    seen = set()
+    claimed_total = 0
+    for tkey, rec in topks.items():
+        base = tkey[:-len(TOPK_SUFFIX)]
+        seen.add(base)
+        rec = np.asarray(rec)
+        if rec.dtype != np.uint32 or rec.ndim != 1 or rec.size < 1:
+            raise ValueError(f"{tkey}: malformed record (want a 1-D "
+                             f"uint32 vector)")
+        ndim = int(rec[0])
+        if ndim > _TOPK_MAX_NDIM or rec.size < 1 + ndim:
+            raise ValueError(f"{tkey}: impossible ndim {ndim}")
+        shape = tuple(int(d) for d in rec[1:1 + ndim])
+        size = 1
+        for d in shape:
+            size *= d
+        claimed_total += size
+        if claimed_total > _TOPK_MAX_ELEMS:
+            # refuse BEFORE the np.zeros below, and CUMULATIVELY — a
+            # blob of thousands of tiny records each claiming a large
+            # (individually legal) shape must not be able to request
+            # terabytes of allocations one leaf at a time
+            raise ValueError(f"{tkey}: claimed dense sizes total "
+                             f"{claimed_total}, exceeding "
+                             f"{_TOPK_MAX_ELEMS} elements")
+        idx = rec[1 + ndim:].astype(np.int64)
+        if base not in flat:
+            raise ValueError(f"{tkey}: record without its values leaf")
+        vals = np.asarray(flat[base])
+        if not np.issubdtype(vals.dtype, np.floating) or vals.ndim != 1:
+            raise ValueError(f"{base}: sparse values must be a 1-D "
+                             f"float vector, got {vals.dtype} "
+                             f"rank {vals.ndim}")
+        if len(idx) != vals.size:
+            raise ValueError(f"{tkey}: {len(idx)} indices for "
+                             f"{vals.size} values")
+        if len(idx) > size or (len(idx) and
+                               (int(idx[-1]) >= size or int(idx[0]) < 0)):
+            raise ValueError(f"{tkey}: index out of bounds for a "
+                             f"{size}-element leaf")
+        if len(idx) > 1 and not np.all(np.diff(idx) > 0):
+            raise ValueError(f"{tkey}: indices must be strictly "
+                             f"ascending (no duplicates)")
+        dense = np.zeros(size, np.float32)
+        dense[idx] = vals.astype(np.float32, copy=False)
+        out[base] = dense.reshape(shape)
+    for key, arr in flat.items():
+        if key.endswith(TOPK_SUFFIX) or key in seen:
+            continue
+        out[key] = np.asarray(arr)
+    return out
+
+
+def pack_sparse(tree: Pytree, density: float,
+                dtype: str = "f32") -> bytes:
+    """Canonical bytes of `tree`'s sparsified (then quantized) entries —
+    what a density-armed client uploads, hashes and SIGNS.  Sparsify
+    runs first so the surviving k-vectors ride the existing value
+    pipeline; at density >= 1 and dtype 'f32' this is byte-identical to
+    `pack_pytree` (the dense pin holds by construction)."""
+    entries = sparsify_entries(dict(_leaf_entries(tree)), density)
     return pack_entries(quantize_entries(entries, dtype))

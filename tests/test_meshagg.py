@@ -149,6 +149,32 @@ class TestDifferentialChecker:
         out = run_differential(trials=8, seed=20260804, max_n=48)
         assert out["mismatches"] == [], out["mismatches"]
 
+    def test_sparse_decode_images_host_vs_mesh_exact(self):
+        """Sparse and sparse x i8/f16 decode images (ISSUE 13) reduce
+        byte-identically on both legs — forced coverage of every
+        (dtype, density) cell the randomized stream samples."""
+        from check_reduction_spec import _random_flat
+        rng = np.random.default_rng(20260804)
+        shapes = {"/W": (24, 16), "/b": (16,)}
+        keys = sorted(shapes)
+        for quant in ("f32", "f16", "i8"):
+            for density in (0.1, 0.01):
+                deltas = [_random_flat(rng, shapes, quant, density)
+                          for _ in range(20)]
+                w = spec.merge_weight_vector(
+                    [float(10 + i) for i in range(20)],
+                    list(range(20)), 20)
+                wsum = max(float(w.sum()), 1e-12)
+                with np.errstate(over="ignore", invalid="ignore"):
+                    host = ENGINE.weighted_sum(keys, deltas, w, wsum,
+                                               force_leg="host")
+                    mesh = ENGINE.weighted_sum(keys, deltas, w, wsum,
+                                               force_leg="mesh")
+                for k in keys:
+                    assert np.asarray(host[k]).tobytes() == \
+                        np.asarray(mesh[k]).tobytes(), (quant, density,
+                                                        k)
+
 
 def _sign(w, kind, epoch, payload):
     from bflc_demo_tpu.comm.identity import _op_bytes

@@ -10,10 +10,15 @@ from bflc_demo_tpu.comm import UpdateStore
 from bflc_demo_tpu.utils import (canonical_bytes, hash_pytree, pack_pytree,
                                  unpack_pytree)
 from bflc_demo_tpu.utils.serialization import (QSCALE_SUFFIX,
+                                               TOPK_SUFFIX,
+                                               densify_entries,
                                                dequantize_entries,
                                                pack_entries,
                                                pack_quantized,
-                                               quantize_entries)
+                                               pack_sparse,
+                                               quantize_entries,
+                                               sparsify_entries,
+                                               topk_count)
 
 
 def tree():
@@ -174,6 +179,175 @@ class TestQuantizedEncodings:
     def test_unknown_dtype_rejected(self):
         with pytest.raises(ValueError, match="delta dtype"):
             quantize_entries({}, "f8")
+
+
+class TestSparseEncodings:
+    """Deterministic top-k sparsification (utils.serialization
+    sparsify_entries / densify_entries / pack_sparse): round-trip,
+    tie determinism, k edges, non-float passthrough, quantization
+    composition, and malformed-#topk rejection."""
+
+    def _flat(self, shape=(40, 25), seed=42):
+        rng = np.random.default_rng(seed)
+        return {"['W']": rng.standard_normal(shape).astype(np.float32)}
+
+    def test_topk_roundtrip_keeps_exactly_the_topk(self):
+        flat = self._flat()
+        s = sparsify_entries(flat, 0.01)
+        d = densify_entries(s)
+        W = flat["['W']"].ravel()
+        k = topk_count(W.size, 0.01)
+        order = np.argsort(-np.abs(W), kind="stable")
+        idx = np.sort(order[:k])
+        got = d["['W']"]
+        assert got.shape == flat["['W']"].shape
+        assert got.dtype == np.float32
+        np.testing.assert_array_equal(got.ravel()[idx], W[idx])
+        assert np.all(np.delete(got.ravel(), idx) == 0.0)
+
+    def test_tie_determinism_ascending_index(self):
+        # duplicated magnitudes: the survivor set must be the EARLIEST
+        # flat indices, and two encoders produce identical bytes
+        flat = {"['t']": np.asarray([1.0, -1.0, 0.5, 1.0, -1.0],
+                                    np.float32)}
+        s = sparsify_entries(flat, 0.4)       # k = ceil(2) = 2
+        rec = s["['t']" + TOPK_SUFFIX]
+        assert list(rec[:2]) == [1, 5]        # ndim, shape
+        assert list(rec[2:]) == [0, 1]        # ties -> lowest indices
+        assert pack_entries(sparsify_entries(dict(flat), 0.4)) == \
+            pack_entries(s)
+
+    def test_k_zero_edge(self):
+        s = sparsify_entries({"['x']": np.ones((6,), np.float32)}, 0.0)
+        assert s["['x']"].size == 0
+        d = densify_entries(s)
+        assert d["['x']"].shape == (6,) and np.all(d["['x']"] == 0)
+
+    def test_k_full_edges_stay_dense(self):
+        flat = self._flat(shape=(3,))
+        # density 1.0 is the identity; k >= size keeps the leaf dense
+        assert sparsify_entries(flat, 1.0) == flat
+        s = sparsify_entries(flat, 0.9)       # ceil(2.7) = 3 = size
+        assert TOPK_SUFFIX not in "".join(s)
+        np.testing.assert_array_equal(s["['W']"], flat["['W']"])
+        # a 0-d leaf can never sparsify below one entry
+        s0 = sparsify_entries({"['s']": np.float32(2.5)}, 0.01)
+        assert "['s']" + TOPK_SUFFIX not in s0
+
+    def test_non_float_leaf_passthrough(self):
+        flat = {"['n']": np.arange(9, dtype=np.int32)}
+        s = sparsify_entries(flat, 0.1)
+        assert "['n']" + TOPK_SUFFIX not in s
+        np.testing.assert_array_equal(
+            densify_entries(s)["['n']"], flat["['n']"])
+
+    def test_densify_identity_on_dense(self):
+        flat = self._flat()
+        out = densify_entries(flat)
+        np.testing.assert_array_equal(out["['W']"], flat["['W']"])
+
+    def test_pack_sparse_dense_pin_and_determinism(self):
+        t = {"W": self._flat()["['W']"], "b": np.ones(4, np.float32)}
+        from bflc_demo_tpu.utils.serialization import pack_pytree
+        assert pack_sparse(t, 1.0) == pack_pytree(t)
+        b1, b2 = pack_sparse(t, 0.05), pack_sparse(t, 0.05)
+        assert b1 == b2
+        assert pack_entries(unpack_pytree(b1)) == b1
+
+    def test_quantization_composes(self):
+        t = {"W": self._flat()["['W']"]}
+        blob = pack_sparse(t, 0.05, "i8")
+        flat = unpack_pytree(blob)
+        assert flat["['W']"].dtype == np.int8
+        assert ("['W']" + QSCALE_SUFFIX) in flat
+        assert ("['W']" + TOPK_SUFFIX) in flat
+        d = densify_entries(dequantize_entries(flat))
+        assert d["['W']"].shape == (40, 25)
+        assert d["['W']"].dtype == np.float32
+        # the sparse x i8 blob is smaller than i8 alone
+        assert len(blob) < len(pack_quantized(t, "i8"))
+
+    def _sparse(self):
+        return sparsify_entries(self._flat(), 0.05)
+
+    def _with_rec(self, mutate):
+        s = dict(self._sparse())
+        key = "['W']" + TOPK_SUFFIX
+        rec = s[key].copy()
+        s[key] = mutate(rec)
+        return s
+
+    def test_malformed_out_of_bounds_rejected(self):
+        def oob(rec):
+            rec[-1] = 10 ** 6
+            return rec
+        with pytest.raises(ValueError, match="out of bounds"):
+            densify_entries(self._with_rec(oob))
+
+    def test_malformed_duplicate_and_unsorted_rejected(self):
+        def dup(rec):
+            rec[4] = rec[3]
+            return rec
+        with pytest.raises(ValueError, match="ascending"):
+            densify_entries(self._with_rec(dup))
+
+        def swap(rec):
+            rec[3], rec[4] = rec[4].copy(), rec[3].copy()
+            return rec
+        with pytest.raises(ValueError, match="ascending"):
+            densify_entries(self._with_rec(swap))
+
+    def test_malformed_oversized_count_rejected(self):
+        # more claimed values+indices than the leaf holds
+        s = dict(self._sparse())
+        key = "['W']" + TOPK_SUFFIX
+        rec = s[key]
+        ndim = int(rec[0])
+        big = np.arange(2000, dtype=np.uint32)
+        s[key] = np.concatenate([rec[:1 + ndim].copy(), big])
+        s["['W']"] = np.zeros(2000, np.float32)
+        with pytest.raises(ValueError, match="out of bounds"):
+            densify_entries(s)
+
+    def test_malformed_dtype_and_orphan_rejected(self):
+        s = dict(self._sparse())
+        key = "['W']" + TOPK_SUFFIX
+        s[key] = s[key].astype(np.int64)
+        with pytest.raises(ValueError, match="uint32"):
+            densify_entries(s)
+        s2 = {key: self._sparse()[key]}       # record, no values leaf
+        with pytest.raises(ValueError, match="values leaf"):
+            densify_entries(s2)
+
+    def test_malformed_count_mismatch_rejected(self):
+        s = dict(self._sparse())
+        s["['W']"] = np.append(s["['W']"], np.float32(1.0))
+        with pytest.raises(ValueError, match="indices for"):
+            densify_entries(s)
+
+    def test_giant_claimed_shape_rejected_before_allocation(self):
+        # a ~100-byte hostile record must not be able to size a
+        # multi-GB np.zeros: the claimed dense size is refused first
+        s = dict(self._sparse())
+        key = "['W']" + TOPK_SUFFIX
+        rec = s[key].copy()
+        rec[1] = rec[2] = np.uint32(2 ** 31 - 1)    # shape (2^31, 2^31)
+        s[key] = rec
+        with pytest.raises(ValueError, match="claimed dense size"):
+            densify_entries(s)
+
+    def test_many_records_cannot_sum_past_the_allocation_cap(self):
+        # per-record caps alone are defeatable: thousands of tiny
+        # records each claiming an individually-legal large shape must
+        # refuse CUMULATIVELY, not allocate leaf by leaf
+        s = {}
+        for i in range(8):
+            k = f"['L{i}']"
+            s[k] = np.zeros(0, np.float32)
+            s[k + TOPK_SUFFIX] = np.asarray(
+                [2, 8192, 8192], np.uint32)     # 64M elems each, legal
+        with pytest.raises(ValueError, match="claimed dense size"):
+            densify_entries(s)
 
 
 def test_store_integrity():

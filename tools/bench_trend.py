@@ -58,6 +58,14 @@ METRICS: List[Tuple[str, str, str, str]] = [
      "lower", "abs"),
     ("async_throughput_speedup",
      "extra.async_agg.round_throughput_speedup", "higher", "rel"),
+    # sparse upload deltas (eval.benchmarks.sparse_config1, bench.py
+    # extra.sparse): the density-sweep headline — writer egress/round
+    # at the sparsest leg and its multiple vs the dense-f32 leg — so a
+    # >10% regression in either direction of the sweep flags
+    ("sparse_egress_bytes_per_round",
+     "extra.sparse.sparsest_egress_bytes_per_round", "lower", "rel"),
+    ("sparse_egress_vs_legacy_x",
+     "extra.sparse.egress_vs_legacy_dense_f32_x", "higher", "rel"),
 ]
 
 

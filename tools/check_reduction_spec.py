@@ -8,10 +8,13 @@ ran.  This tool is the standing proof obligation: randomized trees
 (mixed leaf ranks, 0-d leaves, denormal and near-overflow magnitudes),
 randomized weights (integer n_samples and FedBuff ``n/sqrt(1+s)``
 staleness discounts), randomized selections (including empty and
-full), and every delta-dtype decode image the data plane admits (plain
-f32, f16-decoded, i8-decoded) — each scenario reduced by BOTH legs and
-compared with exact byte equality, plus the full ``aggregate_flat``
-writer merge against the certified canonical-bytes hash.
+full), and every decode image the data plane admits — delta dtypes
+(plain f32, f16-decoded, i8-decoded) CROSSED with upload densities
+(dense, top-k sparsified at 0.1 / 0.01 through the one
+sparsify -> quantize -> dequantize -> densify chain) — each scenario
+reduced by BOTH legs and compared with exact byte equality, plus the
+full ``aggregate_flat`` writer merge against the certified
+canonical-bytes hash.
 
 Runnable standalone (CI / a new platform's smoke test):
 
@@ -34,19 +37,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import numpy as np  # noqa: E402
 
 
-def _random_flat(rng, shapes, quant):
+def _random_flat(rng, shapes, quant, density=1.0):
     """One delta in a randomly chosen admitted decode image."""
-    from bflc_demo_tpu.utils.serialization import (dequantize_entries,
-                                                   quantize_entries)
+    from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                   dequantize_entries,
+                                                   quantize_entries,
+                                                   sparsify_entries)
     flat = {}
     for k, shp in shapes.items():
         scale = 10.0 ** float(rng.integers(-8, 8))
         flat[k] = (rng.standard_normal(shp) * scale).astype(np.float32)
-    if quant == "f32":
+    if quant == "f32" and density >= 1.0:
         return flat
-    # what admission/scoring/aggregation actually see for a quantized
-    # upload: the ONE deterministic decode of the quantized bytes
-    return dequantize_entries(quantize_entries(flat, quant))
+    # what admission/scoring/aggregation actually see for a sparse
+    # and/or quantized upload: the ONE deterministic decode chain of
+    # the exact bytes the client signed (sparsify runs BEFORE
+    # quantize, densify AFTER dequantize — the wire order)
+    return densify_entries(dequantize_entries(
+        quantize_entries(sparsify_entries(flat, density), quant)))
 
 
 def _scenario(rng, max_n):
@@ -59,7 +67,9 @@ def _scenario(rng, max_n):
         shapes[f"/leaf{j}"] = tuple(
             int(d) for d in rng.integers(1, 9, size=rank))
     quant = ("f32", "f16", "i8")[int(rng.integers(0, 3))]
-    deltas = [_random_flat(rng, shapes, quant) for _ in range(n)]
+    density = (1.0, 0.1, 0.01)[int(rng.integers(0, 3))]
+    deltas = [_random_flat(rng, shapes, quant, density)
+              for _ in range(n)]
     if deltas and "/leaf0" in deltas[0] and deltas[0]["/leaf0"].size:
         deltas[0]["/leaf0"].flat[0] = np.float32(1e-42)      # denormal
     # sync n_samples or async staleness-discounted weights
@@ -76,7 +86,7 @@ def _scenario(rng, max_n):
     lr = float(rng.random()) * 0.5
     g = {k: rng.standard_normal(shp).astype(np.float32)
          for k, shp in shapes.items()}
-    return g, deltas, weights, selected, lr, quant
+    return g, deltas, weights, selected, lr, quant, density
 
 
 def run_differential(trials: int = 20, seed: int = 0,
@@ -98,7 +108,7 @@ def run_differential(trials: int = 20, seed: int = 0,
     # legs must agree on those bytes too, so the warnings are noise
     with np.errstate(over="ignore", invalid="ignore"):
         for t in range(trials):
-            g, deltas, weights, selected, lr, quant = \
+            g, deltas, weights, selected, lr, quant, density = \
                 _scenario(rng, max_n)
             keys = sorted(g.keys())
             w = spec.merge_weight_vector(weights, selected, len(deltas))
@@ -120,6 +130,7 @@ def run_differential(trials: int = 20, seed: int = 0,
             if bad:
                 mismatches.append({
                     "trial": t, "n": len(deltas), "quant": quant,
+                    "density": density,
                     "selected": len(selected), "leaves": bad})
     return {"trials": trials, "seed": seed, "max_n": max_n,
             "mismatches": mismatches,

@@ -105,6 +105,18 @@ def _health_cell(snap):
             f"w/c {warn:.0f}/{crit:.0f}")
 
 
+def _sparse_cell(snap):
+    """The sparse-upload panel (--delta-density) for any admitting role
+    — the writer and every cell aggregator: protocol density plus the
+    per-blob densify decode cost.  None on a dense fleet."""
+    dens = _gauge_value(snap, "delta_density")
+    if dens is None or dens >= 1.0:
+        return None
+    n_sd, m_sd = _merged_hist(snap, "sparse_decode_seconds")
+    return (f"sparse d={dens:g}"
+            + (f"  decode {n_sd}x{m_sd * 1e3:.1f}ms" if n_sd else ""))
+
+
 def _role_row(role, snap):
     """One table row: the per-role-class key numbers."""
     costs = snap.get("trace_costs") or {}
@@ -136,6 +148,11 @@ def _role_row(role, snap):
                 + (f"  hit {hits / (hits + misses):.0%}"
                    if hits + misses else "")
                 + (f"  fb {fb:.0f}" if fb else ""))
+        # sparse upload deltas (--delta-density): client-side top-k
+        # encode cost per upload
+        n_se, m_se = _merged_hist(snap, "sparse_encode_seconds")
+        if n_se:
+            cells.append(f"sparse-enc {n_se}x{m_se * 1e3:.1f}ms")
     elif role.startswith("validator"):
         n_b, m_b = _merged_hist(snap, "vote_latency_seconds",
                                 kind="batch")
@@ -159,6 +176,11 @@ def _role_row(role, snap):
         cells.append(f"round {int(rnd):>3}  admitted {int(adm):>3}  "
                      f"partial {n_p}x{m_p * 1e3:5.1f}ms  "
                      f"root-certify {n_a}x{m_a * 1e3:6.1f}ms")
+        # sparse bridge (--delta-density): member-blob decode cost and
+        # the density this cell re-sparsifies its partial at
+        sp = _sparse_cell(snap)
+        if sp is not None:
+            cells.append(sp)
         # member-level health verdicts live HERE, not at the root
         hc = _health_cell(snap)
         if hc is not None:
@@ -218,6 +240,11 @@ def _role_row(role, snap):
             cells.append(f"async buf {int(depth)}  "
                          f"staleness p50/95/99 {st or '-'}  "
                          f"aggs {aggs:.0f}")
+        # sparse upload deltas (--delta-density): protocol density +
+        # writer-side densify decode cost per admitted blob
+        sp = _sparse_cell(snap)
+        if sp is not None:
+            cells.append(sp)
         # model-quality health plane (obs.health): last round's
         # verdict, flagged senders, update norm, committee disagreement
         hc = _health_cell(snap)

@@ -280,6 +280,11 @@ def main() -> None:
                          "leg AND the host loop, print per-leg "
                          "latency, the hash-equality verdict and the "
                          "telemetry row (0 = off)")
+    ap.add_argument("--delta-density", type=float, default=1.0,
+                    help="run the round with sparse top-k uploads at "
+                         "this density (utils.serialization "
+                         "pack_sparse; 1.0 = dense) and print the "
+                         "sparse encode/decode telemetry row")
     args = ap.parse_args()
     if args.legacy and not os.environ.get("BFLC_CONTROL_PLANE_LEGACY"):
         _reexec_legacy()
@@ -301,14 +306,22 @@ def main() -> None:
     from bflc_demo_tpu.obs.collector import FleetCollector
     from bflc_demo_tpu.protocol.constants import ProtocolConfig
     from bflc_demo_tpu.utils import tracing
-    from bflc_demo_tpu.utils.serialization import pack_pytree
+    from bflc_demo_tpu.utils.serialization import pack_pytree, pack_sparse
 
     n = args.clients
+    density = float(args.delta_density)
     cfg = ProtocolConfig(client_num=n, comm_count=max(2, n // 4),
                          aggregate_count=2,
                          needed_update_count=max(3, n // 2),
                          learning_rate=0.05, batch_size=16,
+                         delta_density=density,
                          async_buffer=max(args.async_buffer, 0)).validate()
+
+    def pack_delta(tree):
+        # the scripted uploads use the same encode policy a real
+        # client would (sparse when the density arms it)
+        return (pack_sparse(tree, density) if density < 1.0
+                else pack_pytree(tree))
     wallets, _ = provision_wallets(n, b"profile-round-seed")
     vwallets, vkeys = provision_validators(args.validators,
                                            b"profile-round-validators")
@@ -353,9 +366,9 @@ def main() -> None:
         from bflc_demo_tpu.ledger.base import ascores_sign_payload
 
         def aupload(i, w):
-            blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
-                                             np.float32),
-                                "b": np.zeros((2,), np.float32)})
+            blob = pack_delta({"W": np.full((5, 2), 0.1 * (i + 1),
+                                            np.float32),
+                               "b": np.zeros((2,), np.float32)})
             digest = hashlib.sha256(blob).digest()
             payload = digest + struct.pack("<qd", 10 + i, 1.0)
             return client.request(
@@ -382,9 +395,9 @@ def main() -> None:
         assert r["ok"] and r["epoch"] == 1, r
     else:
         for i, w in enumerate(trainers[: cfg.needed_update_count]):
-            blob = pack_pytree({"W": np.full((5, 2), 0.1 * (i + 1),
-                                             np.float32),
-                                "b": np.zeros((2,), np.float32)})
+            blob = pack_delta({"W": np.full((5, 2), 0.1 * (i + 1),
+                                            np.float32),
+                               "b": np.zeros((2,), np.float32)})
             digest = hashlib.sha256(blob).digest()
             payload = digest + struct.pack("<qd", 10 + i, 1.0)
             r = client.request("upload", addr=w.address, blob=blob,
@@ -502,6 +515,16 @@ def main() -> None:
     # fleet_top renders — buffer depth, staleness distribution of the
     # admitted deltas, aggregations committed
     from fleet_top import _merged_hist as _mh
+
+    # sparse upload deltas (--delta-density): protocol density plus the
+    # writer-side densify decode cost per admitted blob — the same
+    # panel fleet_top renders
+    dens = _gv(writer_snap, "delta_density")
+    if dens is not None and dens < 1.0:
+        n_sd, m_sd = _mh(writer_snap, "sparse_decode_seconds")
+        print(f"sparse: density {dens:g}   decode {n_sd} blobs "
+              f"(mean {m_sd * 1e3:.2f} ms)   "
+              f"decode share {n_sd * m_sd / wall:.2%} of round wall")
 
     aggs = _csum(writer_snap, "async_aggregations_total")
     n_st, m_st = _mh(writer_snap, "async_admitted_staleness")
