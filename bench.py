@@ -237,6 +237,19 @@ def _child() -> None:
             "round_wall_time_s_health_legacy": ho[
                 "round_wall_time_s_health_legacy"],
         }
+        # SLO/forensics plane (obs.timeline + obs.slo): armed vs
+        # BFLC_SLO_LEGACY=1 round time at config-1 — the same 5% bar /
+        # alternating-leg harness; the plane is driver-side, so this
+        # charges the joiner + burn-rate judge per scrape tick
+        from bflc_demo_tpu.eval.benchmarks import slo_overhead_config1
+        so = slo_overhead_config1(rounds=2, trials=2)
+        extra["slo_overhead"] = {
+            "overhead_frac": so.get("overhead_frac"),
+            "round_wall_time_s_slo_armed": so[
+                "round_wall_time_s_slo_armed"],
+            "round_wall_time_s_slo_legacy": so[
+                "round_wall_time_s_slo_legacy"],
+        }
         # data-plane axes (PR 5): coordinator egress bytes/round,
         # read-source shares, cache hit ratio, compression ratio and
         # the quantized-delta accuracy gap, vs a

@@ -1010,6 +1010,7 @@ def run_federated_processes(
     # roles, published file snapshots for clients/standbys — onto one
     # metrics.jsonl timeline; chaos fault events land on the same file.
     collector = None
+    forensics = None
     if telemetry_dir:
         from bflc_demo_tpu.obs.collector import FleetCollector
         rpc_roles = {"writer": (host, port)}
@@ -1028,6 +1029,16 @@ def run_federated_processes(
             tls=_client_tls(tls_dir), tls_roles=("writer",))
         if campaign is not None:
             campaign.on_fault = collector.observe_fault
+        # round forensics + SLO plane (obs.timeline / obs.slo): the
+        # joiner and burn-rate engine ride the collector's own record
+        # stream — every scrape tick both correlates the round and
+        # judges it, alerts landing in <telemetry_dir>/alerts.jsonl
+        # with the joined round context embedded.  BFLC_SLO_LEGACY=1
+        # pins the whole plane off (scrapes continue unchanged).
+        from bflc_demo_tpu.obs.timeline import arm_forensics
+        forensics = arm_forensics(collector, telemetry_dir,
+                                  timeout_s=timeout_s,
+                                  max_staleness=cfg.max_staleness)
         collector.note("fleet_up", clients=len(shards),
                        standbys=standbys, validators=bft_validators,
                        quorum=quorum)
@@ -1127,6 +1138,12 @@ def run_federated_processes(
                                     for n in os.listdir(telemetry_dir)
                                     if n.endswith(".spans.jsonl")),
                                 **collector.coverage_report()}
+            if forensics is not None:
+                # SLO/forensics plane report (obs.slo): per-objective
+                # breach/alert counts + the alerts artifact path
+                telemetry_report["slo"] = forensics.report()
+                telemetry_report["alerts_jsonl"] = os.path.join(
+                    telemetry_dir, "alerts.jsonl")
         final_ep = sponsor.current_endpoint
         replica_report = None
         if replicas > 0:

@@ -1518,8 +1518,15 @@ class LedgerServer:
                     snap = self._snapshot_offer()
                     _G_SNAP_AGE.set(self.ledger.epoch - snap["epoch"]
                                     if snap is not None else -1)
+                # `epoch` stamps the writer's authoritative round
+                # position into every scrape record (obs.collector):
+                # health/flight records already carry their epoch but
+                # periodic scrapes were wall-clock-only, forcing the
+                # forensics joiner (obs.timeline) to infer round
+                # membership from timestamps
                 return {"ok": True,
                         "role": obs_metrics.REGISTRY.role or "writer",
+                        "epoch": self.ledger.epoch,
                         "snapshot": obs_metrics.REGISTRY.snapshot()}
             if method == "wait":
                 # event-driven poll: block until the log grows past the
@@ -1949,11 +1956,16 @@ class LedgerServer:
         never kill a commit), and nothing it computes feeds back into
         admission or the certified bytes."""
         try:
-            from bflc_demo_tpu.meshagg.engine import flatten_delta
+            from bflc_demo_tpu.meshagg.engine import (_leaf_layout,
+                                                      flatten_delta)
             keys = sorted(new_flat.keys())
             if rows is None:
                 rows = [flatten_delta(f, keys)
                         for f in (delta_flats or [])]
+            # row leaf map for the opt-in per-leaf WHERE refinement
+            # (BFLC_HEALTH_PER_LEAF=1): metadata only, built per round
+            # so a schema change never feeds a stale layout
+            layout, _ = _leaf_layout(keys, new_flat)
             if self._health is None:
                 # the protocol density feeds the monitor: honest
                 # sparse deltas legitimately drive zero_frac to
@@ -1975,7 +1987,8 @@ class LedgerServer:
                 staleness=staleness,
                 old_row=(flatten_delta(old_flat, keys)
                          if old_flat is not None else None),
-                new_row=flatten_delta(new_flat, keys), mode=mode)
+                new_row=flatten_delta(new_flat, keys),
+                leaf_layout=layout, mode=mode)
         except Exception as e:      # noqa: BLE001 — observability only
             if self.verbose:
                 print(f"[coordinator] health plane error: "

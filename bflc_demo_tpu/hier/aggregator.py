@@ -221,7 +221,8 @@ class CellAggregatorServer(LedgerServer):
         the partial row as the round's aggregate direction.  Swallows
         everything — observability must never wedge the cell round."""
         try:
-            from bflc_demo_tpu.meshagg.engine import flatten_delta
+            from bflc_demo_tpu.meshagg.engine import (_leaf_layout,
+                                                      flatten_delta)
             keys = sorted(partial.keys())
             rows = []
             for i, u in enumerate(updates):
@@ -251,6 +252,10 @@ class CellAggregatorServer(LedgerServer):
                 medians=pending.medians,
                 candidate_scores=self._sync_candidate_scores(
                     len(updates)),
+                # per-leaf WHERE refinement at the member tier too —
+                # a CRIT at the cell names the member's offending
+                # leaves (BFLC_HEALTH_PER_LEAF=1)
+                leaf_layout=_leaf_layout(keys, partial)[0],
                 mode="cell")
         except Exception as e:      # noqa: BLE001 — observability only
             if self.verbose:

@@ -379,6 +379,7 @@ def run_federated_hier(
                               (lambda i=i: _spawn_client(i)), p)
 
     collector = None
+    forensics = None
     if telemetry_dir:
         from bflc_demo_tpu.obs.collector import FleetCollector
         rpc_roles = {"writer": (host, root_port)}
@@ -396,6 +397,14 @@ def run_federated_hier(
             jsonl_path=os.path.join(telemetry_dir, "metrics.jsonl"))
         if campaign is not None:
             campaign.on_fault = collector.observe_fault
+        # round forensics + SLO plane (obs.timeline / obs.slo), the
+        # same one-call wiring as the flat runtime: the root's
+        # telemetry replies epoch-stamp each scrape and the
+        # joiner/engine ride the tick
+        from bflc_demo_tpu.obs.timeline import arm_forensics
+        forensics = arm_forensics(collector, telemetry_dir,
+                                  timeout_s=timeout_s,
+                                  max_staleness=cfg.max_staleness)
         collector.note("fleet_up", clients=len(shards),
                        cells=plan.n_cells, validators=bft_validators)
         collector.scrape(tag="fleet_up")
@@ -493,6 +502,12 @@ def run_federated_hier(
                                     for n in os.listdir(telemetry_dir)
                                     if n.endswith(".spans.jsonl")),
                                 **collector.coverage_report()}
+            if forensics is not None:
+                # SLO/forensics report, same keys as the flat runtime
+                # so flat-vs-hier soak artifacts compare directly
+                telemetry_report["slo"] = forensics.report()
+                telemetry_report["alerts_jsonl"] = os.path.join(
+                    telemetry_dir, "alerts.jsonl")
     finally:
         sponsor_router.close()
         sponsor.close()

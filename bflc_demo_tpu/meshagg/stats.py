@@ -14,6 +14,10 @@ model-quality health plane (obs.health) consumes:
   aggregated delta direction): honest gradients correlate positively
   round over round, a sign-flipped Byzantine delta sits near -1.
 
+``per_leaf_stats`` is the opt-in WHERE refinement: the same L2/cosine
+per (delta, leaf) over the row layout, so a CRIT can name the
+worst-offending leaves (obs.health ``BFLC_HEALTH_PER_LEAF=1``).
+
 Two legs, same shape as the aggregation engine: a vectorized numpy host
 leg (the default — these stats are one O(N x P) pass over data already
 in cache, microseconds at every geometry this repo runs) and an OPT-IN
@@ -135,6 +139,44 @@ def batch_delta_stats(mat: np.ndarray,
             _JIT_BROKEN = True                      # observability only:
             pass                                    # numpy is always right
     return _host_stats(mat, ref)
+
+
+def per_leaf_stats(mat: np.ndarray, layout,
+                   ref: Optional[np.ndarray] = None
+                   ) -> Dict[str, Dict[str, np.ndarray]]:
+    """Per-(delta, LEAF) L2 and cosine-vs-reference — the WHERE half of
+    the health plane (obs.health per-leaf mode): a flagged sender's
+    record then names the worst-offending leaves instead of one
+    flattened number.
+
+    ``layout`` is engine._leaf_layout's ``[(key, offset, size, ...)]``
+    describing how `flatten_delta` packed the ``(N, P)`` rows; ``ref``
+    is the same cosine reference row batch_delta_stats uses.  Returns
+    ``{key: {"l2": (N,), "cos": (N,)}}``.  Observability-only numpy
+    (like everything here) — computed lazily, only for rounds that
+    actually flagged a sender."""
+    a = np.asarray(mat, np.float32)
+    n = a.shape[0]
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for entry in layout:
+        key, off, size = entry[0], int(entry[1]), int(entry[2])
+        seg = a[:, off:off + size]
+        clean = np.where(np.isfinite(seg), seg,
+                         np.float32(0.0)).astype(np.float64)
+        l2 = (np.sqrt(np.einsum("np,np->n", clean, clean))
+              if size else np.zeros(n))
+        if ref is None or size == 0:
+            cos = np.zeros(n)
+        else:
+            r = np.asarray(ref[off:off + size], np.float64)
+            r = np.where(np.isfinite(r), r, 0.0)
+            rn = float(np.sqrt(r @ r))
+            denom = np.maximum(l2 * rn, _EPS)
+            cos = np.clip((clean @ r) / denom, -1.0, 1.0)
+            if rn <= _EPS:
+                cos = np.zeros(n)
+        out[key] = {"l2": l2, "cos": cos}
+    return out
 
 
 def weighted_mean_row(mat: np.ndarray, weights, selected) -> np.ndarray:

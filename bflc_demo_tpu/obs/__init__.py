@@ -16,6 +16,11 @@ can afford them):
   rest) onto one metrics.jsonl timeline interleaved with chaos fault
   events; Prometheus text dumps.
 
+Later PRs grew the plane to four layers on the same scrape spine:
+`obs.trace` (causal spans), `obs.health` (model-quality verdicts), and
+`obs.timeline` + `obs.slo` (the round-forensics joiner and burn-rate
+SLO engine riding the FleetCollector's record stream).
+
 `install_process_telemetry` is the one-call arming point every child
 process entry uses (client/process_runtime), mirroring how chaos
 injectors install.

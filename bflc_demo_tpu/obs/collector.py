@@ -108,12 +108,27 @@ class FleetCollector:
         self.answered_total = 0
         self.expected_total = 0
         self.last_scrape: Optional[dict] = None
+        # record observers (obs.timeline.RoundForensics subscribes):
+        # every scrape/fault/note record is handed to each observer as
+        # it is appended — the live feed of the round-forensics joiner
+        self.observers: List = []
         if jsonl_path:
             os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)),
                         exist_ok=True)
 
+    def add_observer(self, fn) -> None:
+        """Subscribe `fn(record)` to the collector's record stream (the
+        same records metrics.jsonl receives).  Observer errors are
+        swallowed — a forensics bug must never break the scrape loop."""
+        self.observers.append(fn)
+
     # ------------------------------------------------------------- write
     def _append(self, rec: dict) -> None:
+        for fn in self.observers:
+            try:
+                fn(rec)
+            except Exception:   # noqa: BLE001 — observability only
+                pass
         if not self.jsonl_path:
             return
         try:
@@ -140,31 +155,45 @@ class FleetCollector:
                       "source": source, **ev})
 
     # ------------------------------------------------------------ scrape
-    def _scrape_rpc(self, role: str,
-                    ep: Tuple[str, int]) -> Optional[dict]:
+    def _scrape_rpc(self, role: str, ep: Tuple[str, int]
+                    ) -> Tuple[Optional[dict], Optional[int]]:
+        """(snapshot, reported ledger epoch).  The epoch rides the
+        `telemetry` reply itself (comm.ledger_service) — the writer's
+        authoritative round position at scrape time, stamped into the
+        scrape record so the forensics joiner never has to infer it
+        from wall clocks (obs.timeline.round_of_scrape)."""
         from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
         try:
             c = CoordinatorClient(ep[0], ep[1], timeout_s=self.timeout_s,
                                   tls=(self.tls if role in self.tls_roles
                                        else None))
         except (ConnectionError, OSError):
-            return None
+            return None, None
         try:
             r = c.request("telemetry")
             snap = r.get("snapshot")
-            return snap if r.get("ok") and isinstance(snap, dict) \
-                else None
+            rep_ep = r.get("epoch")
+            return (snap if r.get("ok") and isinstance(snap, dict)
+                    else None,
+                    rep_ep if isinstance(rep_ep, int) else None)
         except (ConnectionError, OSError, ValueError):
-            return None
+            return None, None
         finally:
             c.close()
 
     def scrape(self, tag: Any = None) -> dict:
         """One fleet-wide scrape; appends the record to metrics.jsonl
-        and returns it.  Partial coverage is normal under faults."""
+        and returns it.  Partial coverage is normal under faults.  The
+        record carries `epoch` — the writer-reported ledger epoch —
+        whenever the writer answered (fault-darkened writers leave it
+        absent; the joiner falls back to the tag)."""
         roles: Dict[str, Optional[dict]] = {}
+        epoch: Optional[int] = None
         for role, ep in self.rpc_roles.items():
-            roles[role] = self._scrape_rpc(role, ep)
+            snap, rep_ep = self._scrape_rpc(role, ep)
+            roles[role] = snap
+            if role == "writer" and rep_ep is not None:
+                epoch = rep_ep
         for role, path in self.file_roles.items():
             roles[role] = read_snapshot_file(path)
         answered = sorted(r for r, s in roles.items() if s is not None)
@@ -175,6 +204,8 @@ class FleetCollector:
                "coverage": {"answered": len(answered),
                             "expected": len(roles),
                             "missing": missing}}
+        if epoch is not None:
+            rec["epoch"] = epoch
         self.scrapes += 1
         self.answered_total += len(answered)
         self.expected_total += len(roles)

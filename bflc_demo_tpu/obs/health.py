@@ -173,9 +173,19 @@ class HealthMonitor:
                  crit_z: float = 8.0, rel_floor: float = 0.05,
                  cos_flip: float = -0.75, crit_streak: int = 2,
                  streak_gap: int = 8, density: float = 1.0,
+                 per_leaf: Optional[bool] = None,
+                 leaf_top: int = 3,
                  jsonl_path: Optional[str] = None,
                  keep_records: int = 512):
         self.role = role
+        # per-leaf WHERE refinement (meshagg.stats.per_leaf_stats):
+        # opt-in (BFLC_HEALTH_PER_LEAF=1 or per_leaf=True) because the
+        # extra O(N x P) pass only pays off when someone is triaging —
+        # and computed ONLY on rounds that flagged a sender, so even
+        # armed it costs nothing on a healthy fleet
+        self.per_leaf = (bool(os.environ.get("BFLC_HEALTH_PER_LEAF"))
+                         if per_leaf is None else bool(per_leaf))
+        self.leaf_top = int(leaf_top)
         self.density = float(density)
         self._zf_ceiling = max(1.0 - self.density / 2.0, 0.98)
         self.window = int(window)
@@ -259,6 +269,7 @@ class HealthMonitor:
                  staleness: Optional[Sequence[int]] = None,
                  old_row: Optional[np.ndarray] = None,
                  new_row: Optional[np.ndarray] = None,
+                 leaf_layout=None,
                  mode: str = "sync") -> Dict[str, Any]:
         """Ingest one committed round and return its health record.
 
@@ -266,9 +277,13 @@ class HealthMonitor:
         staging images) aligned with `senders`/`weights`; `selected`
         indexes the merged subset; `old_row`/`new_row` are the global
         model before/after (omitted at the cell tier, where the
-        "update" is the partial itself).  Never raises past numeric
-        work the caller already survived — callers still wrap it.
-        """
+        "update" is the partial itself); `leaf_layout` is the row's
+        ``[(key, offset, size, ...)]`` leaf map (engine._leaf_layout) —
+        with the per-leaf mode armed, any FLAGGED sender's record then
+        carries its ``leaf_top`` worst-offending leaves (the ROADMAP
+        "WHERE a model diverges" refinement).  Never raises past
+        numeric work the caller already survived — callers still wrap
+        it."""
         from bflc_demo_tpu.meshagg.stats import (batch_delta_stats,
                                                  weighted_mean_row)
         t0 = time.perf_counter()
@@ -383,6 +398,35 @@ class HealthMonitor:
                             if i in sel else 0.0)})
         if update_nonfinite:
             worst = 2
+        if self.per_leaf and leaf_layout is not None and len(rows) \
+                and any(r["reasons"] for r in sender_recs):
+            # the WHERE refinement, lazily: one per-leaf pass only on
+            # rounds that flagged someone.  Leaves ranked by the
+            # sender's leaf L2 over the round's MEDIAN for that leaf —
+            # a scaled or flipped layer stands out against its own
+            # fleet baseline, not against other layers' magnitudes.
+            try:
+                from bflc_demo_tpu.meshagg.stats import per_leaf_stats
+                leaf = per_leaf_stats(mat, leaf_layout, ref)
+                med = {k: float(np.median(v["l2"]))
+                       for k, v in leaf.items()}
+                for i, srec in enumerate(sender_recs):
+                    if not srec["reasons"]:
+                        continue
+                    ranked = sorted(
+                        ((k, float(v["l2"][i]), med[k],
+                          float(v["cos"][i]))
+                         for k, v in leaf.items()),
+                        key=lambda e: -(e[1] / (e[2] + 1e-12)))
+                    srec["leaves"] = [
+                        {"key": k, "l2": round(l2, 6),
+                         "l2_med": round(m, 6),
+                         "ratio": round(l2 / (m + 1e-12), 2),
+                         "cos": (round(c, 4) if ref is not None
+                                 else None)}
+                        for k, l2, m, c in ranked[:self.leaf_top]]
+            except Exception:   # noqa: BLE001 — observability only:
+                pass            # the flat verdict already stands
         # baselines update AFTER judging the round (a huge outlier
         # joins the window, where the median/MAD absorb it)
         for i in range(len(senders)):
@@ -445,6 +489,43 @@ class HealthMonitor:
         shape tools/health_report.py builds offline from the jsonl."""
         return summarize_records(list(self.records),
                                  contribution=self.contribution)
+
+
+def load_health_records(path: str) -> List[Dict[str, Any]]:
+    """Every parseable health_round record under `path` (a dir is
+    globbed for *.health.jsonl; torn trailing lines are skipped — the
+    stream is append-only and a kill can tear the last line).  The ONE
+    loader tools/health_report.py, tools/chaos_soak.py's --fail-on-crit
+    gate and the forensics joiner's tests share."""
+    files = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".health.jsonl"):
+                files.append(os.path.join(path, name))
+    else:
+        files = [path]
+    records: List[Dict[str, Any]] = []
+    for fp in files:
+        try:
+            with open(fp) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue            # torn tail line
+                    if isinstance(rec, dict) \
+                            and rec.get("type") == "health_round":
+                        rec.setdefault("role",
+                                       os.path.basename(fp).split(
+                                           ".health.jsonl")[0])
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: (r.get("t", 0.0), r.get("epoch", 0)))
+    return records
 
 
 def summarize_records(records: List[Dict[str, Any]], *,

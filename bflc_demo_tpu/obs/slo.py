@@ -1,0 +1,318 @@
+"""Declarative SLO engine: objectives as data, streaming multi-window
+burn-rate alerts that carry their own evidence.
+
+The alerting half of the fourth observability layer (obs.timeline is
+the forensics half).  An operator states objectives as ``SLOSpec``
+records — *round latency p95 under X*, *certify latency under Y*,
+*async staleness bounded*, *scrape coverage over Z*, *a CRIT-verdict
+budget*, *accuracy must not regress* — and the engine judges each
+committed round's joined signal summary (obs.timeline.slo_summary)
+against every objective, streaming:
+
+- **breach** — one round outside its objective (counted, never paged
+  alone: noise budget is the whole point of an SLO);
+- **burn rate** — breach fraction over a rolling window divided by the
+  objective's budget (burn 1.0 = exactly spending the allowance);
+- **alert** — Google-SRE-style multi-window rule: page only when BOTH
+  the fast window (default 5 rounds, catches onset quickly) and the
+  slow window (default 25 rounds, confirms it is sustained) burn over
+  their thresholds.  One alert per excursion: the alert latches until
+  the fast window cools below burn 1.0, so a sustained breach pages
+  once, not every round.
+
+Every alert is emitted three ways so the page carries its own evidence:
+a metric (``slo_alerts_total{slo=...}``), a flight event (flushed
+immediately — the alert survives a SIGKILL), and one record in
+``alerts.jsonl`` embedding the correlated round context (the joined
+round record: wall, health verdict, faults, critical path when traced).
+``alerts.jsonl`` is rewritten tmp-then-rename on every alert — like the
+flight recorder, a kill mid-write can never tear it (drilled in
+tests/test_forensics.py).
+
+**The SLO plane changes no trust and no bytes** (PARITY.md): it runs
+driver-side off scrape artifacts, gates nothing in the protocol, and
+``BFLC_SLO_LEGACY=1`` pins it off entirely — committed model hashes are
+byte-identical either way.  Operator tooling (tools/chaos_soak.py
+``--fail-on-slo`` / ``--fail-on-crit``) turns verdicts into exit codes
+OUTSIDE the protocol, which is exactly where enforcement belongs until
+validators re-derive the signals themselves (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
+
+_C_BREACH = obs_metrics.REGISTRY.counter(
+    "slo_breaches_total", "rounds outside an SLO's objective", ("slo",))
+_C_ALERTS = obs_metrics.REGISTRY.counter(
+    "slo_alerts_total", "multi-window burn-rate pages", ("slo",))
+_G_BURN_FAST = obs_metrics.REGISTRY.gauge(
+    "slo_burn_rate_fast", "fast-window burn rate, last judged round",
+    ("slo",))
+_G_BURN_SLOW = obs_metrics.REGISTRY.gauge(
+    "slo_burn_rate_slow", "slow-window burn rate, last judged round",
+    ("slo",))
+_G_ALERT_ACTIVE = obs_metrics.REGISTRY.gauge(
+    "slo_alert_active", "1 while an alert excursion is latched",
+    ("slo",))
+
+
+def slo_legacy() -> bool:
+    """BFLC_SLO_LEGACY=1 pins the whole SLO/forensics plane off (the
+    overhead benchmark's baseline switch)."""
+    return bool(os.environ.get("BFLC_SLO_LEGACY"))
+
+
+def slo_armed() -> bool:
+    """The one arming decision the driver wiring asks: telemetry on and
+    no legacy pin (same shape as obs.health.health_armed)."""
+    return obs_metrics.REGISTRY.enabled and not slo_legacy()
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One objective as data.
+
+    ``signal`` names a key in the joined round summary
+    (obs.timeline.RoundTimeline.slo_summary); ``op`` states the GOOD
+    condition (``"<="``: value <= bound is healthy, ``">="``: value >=
+    bound is healthy); ``budget`` is the tolerated breach fraction
+    (0.1 = one round in ten may breach before burn reaches 1.0).
+    A round whose signal is None is SKIPPED — absence of data is a
+    coverage problem (its own SLO), never a breach of this one."""
+    name: str
+    signal: str
+    bound: float
+    op: str = "<="                      # "<=" or ">="
+    budget: float = 0.1
+    fast_window: int = 5
+    slow_window: int = 25
+    # page when fast >= burn_fast AND slow >= burn_slow.  Windows
+    # younger than their configured length are PADDED with healthy
+    # history (the denominator is the configured window), so the
+    # absolute breach count needed to page is uniform across a run —
+    # round 2 is judged exactly like round 200.  At the default budget
+    # 0.1 one isolated breach never pages (1/5 / 0.1 = burn 2 < 3)
+    # while two consecutive breaches do (2/5 / 0.1 = 4 >= 3, slow
+    # window confirming at 2/25 / 0.1 = 0.8 >= 0.6) — "within 2
+    # rounds of onset" by design.
+    burn_fast: float = 3.0
+    burn_slow: float = 0.6
+    description: str = ""
+
+    def healthy(self, value: float) -> bool:
+        return (value <= self.bound if self.op == "<="
+                else value >= self.bound)
+
+
+def burn_rate(breaches: int, window: int, budget: float) -> float:
+    """The ONE burn-rate rule every window shares: breach fraction over
+    the window divided by the budget.  The engine passes the CONFIGURED
+    window length even while the observed history is shorter (young
+    windows are padded with healthy rounds), so onset sensitivity is
+    uniform across a run — a lone breach in round 2 must not page just
+    because the denominator was small."""
+    if window <= 0 or budget <= 0:
+        return 0.0
+    return (breaches / window) / budget
+
+
+@dataclass
+class _SLOState:
+    spec: SLOSpec
+    fast: Deque[int] = field(default_factory=deque)
+    slow: Deque[int] = field(default_factory=deque)
+    breaches: int = 0
+    judged: int = 0
+    alerts: int = 0
+    active: bool = False
+    last_fast_burn: float = 0.0
+    last_slow_burn: float = 0.0
+
+
+def default_slos(*, round_latency_s: float = 30.0,
+                 certify_latency_s: float = 5.0,
+                 max_staleness: float = 8.0,
+                 scrape_coverage: float = 0.9,
+                 acc_regression: float = 0.05) -> List[SLOSpec]:
+    """The standing fleet objectives.  Bounds are deployment knobs —
+    the process runtime scales round_latency off its own timeout and
+    staleness off the protocol genome; these defaults suit config-1
+    geometry on a shared host."""
+    return [
+        SLOSpec("round_latency", "round_wall_s", round_latency_s,
+                description="commit-to-commit round wall time"),
+        SLOSpec("certify_latency", "certify_p95_s", certify_latency_s,
+                description="per-round p95 BFT certification latency "
+                            "(cumulative-histogram delta)"),
+        SLOSpec("async_staleness", "staleness_p95", max_staleness,
+                description="per-round p95 admitted async staleness "
+                            "(epochs); only fires on async fleets"),
+        SLOSpec("scrape_coverage", "scrape_coverage", scrape_coverage,
+                op=">=",
+                description="fraction of roles answering the round's "
+                            "fleet scrape"),
+        SLOSpec("health_budget", "health_verdict", 1.0, budget=0.05,
+                description="model-quality verdict budget: CRIT rounds "
+                            "are the breach (obs.health)"),
+        SLOSpec("accuracy_progress", "acc_drop_from_best",
+                acc_regression,
+                description="committed accuracy must stay within "
+                            "acc_regression of the best seen"),
+    ]
+
+
+class SLOEngine:
+    """Streaming evaluator: feed each round's signal summary, collect
+    alerts.  ``jsonl_path`` arms the durable alerts.jsonl artifact
+    (rewritten atomically per alert)."""
+
+    def __init__(self, slos: Optional[List[SLOSpec]] = None, *,
+                 jsonl_path: str = "", keep_alerts: int = 256):
+        self.slos = list(slos if slos is not None else default_slos())
+        self.jsonl_path = jsonl_path
+        self._state = {s.name: _SLOState(s) for s in self.slos}
+        self.alerts: List[dict] = []
+        self.keep_alerts = int(keep_alerts)
+        self.rounds = 0
+
+    # ------------------------------------------------------------- judge
+    def observe_round(self, summary: Dict[str, Any],
+                      context: Optional[Dict[str, Any]] = None
+                      ) -> List[dict]:
+        """Judge one round's joined summary against every objective;
+        returns the alerts this round raised (usually none).  `context`
+        is the full joined round record embedded into each alert so the
+        page carries its own evidence."""
+        self.rounds += 1
+        epoch = summary.get("epoch")
+        raised: List[dict] = []
+        for st in self._state.values():
+            spec = st.spec
+            value = summary.get(spec.signal)
+            if value is None:
+                continue                    # no data != breach
+            breached = not spec.healthy(float(value))
+            st.judged += 1
+            st.fast.append(1 if breached else 0)
+            st.slow.append(1 if breached else 0)
+            while len(st.fast) > spec.fast_window:
+                st.fast.popleft()
+            while len(st.slow) > spec.slow_window:
+                st.slow.popleft()
+            fast = burn_rate(sum(st.fast),
+                             max(len(st.fast), spec.fast_window),
+                             spec.budget)
+            slow = burn_rate(sum(st.slow),
+                             max(len(st.slow), spec.slow_window),
+                             spec.budget)
+            st.last_fast_burn, st.last_slow_burn = fast, slow
+            _G_BURN_FAST.set(fast, slo=spec.name)
+            _G_BURN_SLOW.set(slow, slo=spec.name)
+            if breached:
+                st.breaches += 1
+                _C_BREACH.inc(slo=spec.name)
+            if st.active and fast < 1.0:
+                st.active = False           # excursion over: un-latch
+                _G_ALERT_ACTIVE.set(0, slo=spec.name)
+            if not st.active and fast >= spec.burn_fast \
+                    and slow >= spec.burn_slow:
+                st.active = True
+                st.alerts += 1
+                raised.append(self._raise(spec, epoch, float(value),
+                                          fast, slow, summary, context))
+        return raised
+
+    def _raise(self, spec: SLOSpec, epoch, value: float, fast: float,
+               slow: float, summary: Dict[str, Any],
+               context: Optional[Dict[str, Any]]) -> dict:
+        st = self._state[spec.name]
+        alert = {
+            "type": "slo_alert", "t": time.time(), "slo": spec.name,
+            "epoch": epoch, "signal": spec.signal,
+            "value": round(value, 6), "bound": spec.bound,
+            "op": spec.op, "budget": spec.budget,
+            "burn_fast": round(fast, 3), "burn_slow": round(slow, 3),
+            "windows": {"fast": list(st.fast), "slow_breaches":
+                        sum(st.slow), "slow_len": len(st.slow)},
+            "summary": dict(summary),
+        }
+        if context is not None:
+            alert["context"] = context
+        self.alerts.append(alert)
+        if len(self.alerts) > self.keep_alerts:
+            del self.alerts[0]
+        _C_ALERTS.inc(slo=spec.name)
+        _G_ALERT_ACTIVE.set(1, slo=spec.name)
+        # the page is exactly the moment a post-mortem wants the ring on
+        # disk even if the driver dies next — record AND flush
+        obs_flight.FLIGHT.record(
+            "event", "slo_alert", slo=spec.name, epoch=epoch,
+            value=round(value, 6), bound=spec.bound,
+            burn_fast=round(fast, 3), burn_slow=round(slow, 3))
+        obs_flight.FLIGHT.flush("slo_alert")
+        self._write_alerts()
+        return alert
+
+    def _write_alerts(self) -> None:
+        """Persist every retained alert atomically (tmp-then-rename,
+        the flight recorder's durability rule: a SIGKILL mid-write
+        leaves the previous complete file, never a torn one)."""
+        if not self.jsonl_path:
+            return
+        tmp = f"{self.jsonl_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                for a in self.alerts:
+                    fh.write(json.dumps(a) + "\n")
+            os.replace(tmp, self.jsonl_path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ report
+    def report(self) -> Dict[str, Any]:
+        return {
+            "rounds_judged": self.rounds,
+            "alerts": len(self.alerts),
+            "slos": {
+                name: {"judged": st.judged, "breaches": st.breaches,
+                       "alerts": st.alerts, "active": st.active,
+                       "burn_fast": round(st.last_fast_burn, 3),
+                       "burn_slow": round(st.last_slow_burn, 3)}
+                for name, st in self._state.items()},
+        }
+
+
+def load_alerts(path: str) -> List[dict]:
+    """Parse an alerts.jsonl (or glob one from a telemetry dir) —
+    tolerant like every other artifact loader."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "alerts.jsonl")
+    out: List[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) \
+                        and rec.get("type") == "slo_alert":
+                    out.append(rec)
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,540 @@
+"""Unified round timeline: one correlated record per federated round.
+
+The fleet emits five observability artifact streams — periodic metrics
+scrapes (``metrics.jsonl``, PR 4), causal spans (``*.spans.jsonl``,
+PR 8), model-quality health verdicts (``*.health.jsonl``, PR 11),
+flight-recorder events (``*.flight.jsonl``) and chaos fault records —
+but until this module nothing joined them: answering "why did round 41
+breach latency while health went WARN" meant hand-correlating five file
+formats.  This is the forensics half of the fourth observability layer
+(obs.slo is the alerting half): a **canonical event model** and a
+**streaming joiner** that keys every event onto its round and produces
+ONE queryable per-round record:
+
+    {epoch, t0, t1, wall_s, commit {acc, ...}, health {role: verdict
+     record}, faults in window, scrape stats (coverage, per-round
+     certify/staleness tails from cumulative-histogram deltas),
+     critical-path segments + straggler ranking (when spans exist),
+     alerts}
+
+**Round keying.**  The canonical round key is the pre-commit ledger
+epoch ``r`` — what health records, round_commit notes and trace roots
+already carry.  Periodic scrapes are post-commit observations: the
+writer's `telemetry` RPC stamps its CURRENT epoch ``E`` into each
+scrape record (PR 13 — previously scrapes were wall-clock-only and the
+joiner had to infer), so a scrape stamped ``E`` describes the fleet
+just after round ``E - 1`` committed.  Mixed-version artifacts degrade
+gracefully: an unstamped scrape falls back to parsing its ``round-N``
+tag, an untagged one joins by wall-clock window, and unknown record
+types are skipped — shuffled, truncated or torn streams never raise
+(property-tested in tests/test_forensics.py).
+
+Two feeding modes, same joiner:
+
+- **live** — ``RoundForensics`` subscribes to the FleetCollector's
+  record stream (collector.add_observer) and evaluates the SLO engine
+  as each round's post-commit scrape lands;
+- **offline** — ``load_round_timeline(telemetry_dir)`` rebuilds the
+  identical state from the artifact directory (tools/obs_query.py,
+  tools/incident_bundle.py).
+
+Observability only: nothing here feeds back into admission, selection
+or the certified bytes — ``BFLC_SLO_LEGACY=1`` pins the whole plane off
+and committed model hashes are byte-identical either way (drilled in
+tests/test_forensics.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+#: artifact schema revision stamped into joined records (bump when the
+#: round-record shape changes; the joiner itself stays tolerant of
+#: records from any earlier revision)
+SCHEMA_VERSION = 1
+
+
+def _round_of_tag(tag) -> Optional[int]:
+    """'round-41' -> 41 (the pre-epoch-stamp scrape convention)."""
+    if isinstance(tag, str) and tag.startswith("round-"):
+        try:
+            return int(tag[len("round-"):])
+        except ValueError:
+            return None
+    return None
+
+
+def round_of_scrape(rec: dict) -> Optional[int]:
+    """The round a scrape record DESCRIBES (None when undeterminable).
+
+    A stamped scrape carries the writer's post-commit ledger epoch
+    ``E`` — it observes the fleet after round ``E - 1`` committed, so
+    it describes round ``E - 1``.  Unstamped records (pre-PR-13
+    artifacts) fall back to the driver's ``round-N`` tag, which names
+    the round directly."""
+    ep = rec.get("epoch")
+    if isinstance(ep, int):
+        return ep - 1 if ep >= 1 else None
+    return _round_of_tag(rec.get("tag"))
+
+
+def _merge_hist(snapshot: dict, name: str) -> Dict[str, Any]:
+    """Merged cumulative-histogram sample for `name` across its label
+    sets, from one role snapshot ({} when absent)."""
+    from bflc_demo_tpu.obs.metrics import merge_hist_samples
+    samples = ((snapshot.get("metrics") or {}).get(name) or {}).get(
+        "samples") or []
+    return merge_hist_samples(samples) if samples else {}
+
+
+def hist_delta(cur: Dict[str, Any],
+               prev: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-interval histogram: cur - prev on count/sum/cumulative
+    buckets.  Exported histograms are cumulative since process start, so
+    two consecutive scrapes bracket one round — the delta is the ROUND's
+    distribution, which is what an SLO on per-round tail latency must
+    judge (a cumulative p95 would average the breach away).  A counter
+    reset (role restart: cur < prev) falls back to cur."""
+    if not cur:
+        return {}
+    if not prev:
+        return dict(cur)
+    if cur.get("count", 0) < prev.get("count", 0):
+        return dict(cur)                    # restarted role: fresh epoch
+    out = {"count": cur.get("count", 0) - prev.get("count", 0),
+           "sum": cur.get("sum", 0.0) - prev.get("sum", 0.0),
+           "buckets": {}}
+    pb = prev.get("buckets") or {}
+    for le, cum in (cur.get("buckets") or {}).items():
+        out["buckets"][le] = cum - pb.get(le, 0)
+    return out
+
+
+def _gauge(snapshot: dict, name: str, default=None):
+    s = ((snapshot.get("metrics") or {}).get(name) or {}).get(
+        "samples") or []
+    return s[0].get("value", default) if s else default
+
+
+class RoundTimeline:
+    """The streaming joiner (module docstring).  Feed it canonical
+    records via ``observe*``; query joined rounds via
+    ``round_record`` / ``slo_summary``.  Bounded: only the newest
+    ``keep_rounds`` rounds retain full detail."""
+
+    def __init__(self, keep_rounds: int = 1024):
+        self.keep_rounds = int(keep_rounds)
+        # round r -> commit evidence {t, acc?, loss?}
+        self.commits: Dict[int, dict] = {}
+        # round r -> [scrape digests] (post-commit observations of r)
+        self.scrapes: Dict[int, List[dict]] = {}
+        # (role, round) -> health_round record
+        self.health: Dict[tuple, dict] = {}
+        # wall-clock-only events awaiting window assignment
+        self.faults: List[dict] = []
+        self.notes: List[dict] = []
+        self.alerts: List[dict] = []
+        self.spans: List[dict] = []
+        self._prev_scrape_roles: Optional[dict] = None
+        self._span_reports: Optional[Dict[int, dict]] = None
+
+    # ------------------------------------------------------------ ingest
+    def observe(self, rec: dict) -> None:
+        """One record off the FleetCollector stream (scrape / note /
+        fault) or any other canonical dict — unknown types are skipped,
+        never raised on (mixed-version tolerance)."""
+        if not isinstance(rec, dict):
+            return
+        t = rec.get("type")
+        if t == "scrape":
+            self._observe_scrape(rec)
+        elif t == "note":
+            self._observe_note(rec)
+        elif t == "fault":
+            self.faults.append(rec)
+        elif t == "health_round":
+            self.observe_health(rec)
+        elif t == "slo_alert":
+            self.observe_alert(rec)
+        # anything else: a future stream this revision doesn't know
+
+    def _observe_note(self, rec: dict) -> None:
+        self.notes.append(rec)
+        if rec.get("name") == "round_commit" \
+                and isinstance(rec.get("epoch"), int):
+            c = self.commits.setdefault(rec["epoch"], {})
+            c["t"] = rec.get("t", c.get("t"))
+            if "acc" in rec:
+                c["acc"] = rec["acc"]
+            self._gc()
+
+    def _observe_scrape(self, rec: dict) -> None:
+        r = round_of_scrape(rec)
+        roles = rec.get("roles") or {}
+        # None = writer darkened this scrape (chaos kill / partition):
+        # it must NOT clobber the previous answered snapshot, or the
+        # next answered scrape's "per-round" histogram deltas would
+        # silently fall back to whole-run cumulatives exactly under
+        # the faults this plane exists to diagnose
+        writer_answered = roles.get("writer")
+        writer = writer_answered or {}
+        digest = {
+            "t": rec.get("t", 0.0),
+            "epoch": rec.get("epoch"),
+            "epoch_stamped": isinstance(rec.get("epoch"), int),
+            "coverage": dict(rec.get("coverage") or {}),
+            "health_verdict": _gauge(writer, "health_verdict"),
+            "health_flagged": _gauge(writer, "health_flagged_senders"),
+            "round_gauge": _gauge(writer, "round"),
+            "backlog": _gauge(writer, "uncertified_backlog"),
+            "async_depth": _gauge(writer, "async_buffer_depth"),
+            # per-round tails: delta of the writer's cumulative
+            # histograms against the PREVIOUS scrape (module docstring)
+            "certify_hist": hist_delta(
+                _merge_hist(writer, "certify_latency_seconds"),
+                _merge_hist(self._prev_scrape_roles,
+                            "certify_latency_seconds")
+                if self._prev_scrape_roles is not None else None),
+            "staleness_hist": hist_delta(
+                _merge_hist(writer, "async_admitted_staleness"),
+                _merge_hist(self._prev_scrape_roles,
+                            "async_admitted_staleness")
+                if self._prev_scrape_roles is not None else None),
+            "upload_lag_hist": hist_delta(
+                _merge_hist(writer, "upload_lag_seconds"),
+                _merge_hist(self._prev_scrape_roles,
+                            "upload_lag_seconds")
+                if self._prev_scrape_roles is not None else None),
+        }
+        if writer_answered is not None:
+            self._prev_scrape_roles = writer_answered
+        if r is not None and r >= 0:
+            self.scrapes.setdefault(r, []).append(digest)
+            self._gc()
+        else:
+            # window-assigned later (fleet_up / pre-stamp artifacts)
+            self.notes.append({"type": "scrape_unkeyed", **digest})
+
+    def observe_health(self, rec: dict) -> None:
+        if rec.get("type") != "health_round":
+            return
+        ep = rec.get("epoch")
+        if isinstance(ep, int):
+            self.health[(rec.get("role", "writer"), ep)] = rec
+            self._gc()
+
+    def observe_alert(self, rec: dict) -> None:
+        if rec.get("type") == "slo_alert":
+            self.alerts.append(rec)
+
+    def observe_spans(self, spans: List[dict]) -> None:
+        """Offline feed: spans as obs.trace.load_spans returns them
+        (wall-anchored t0/t1).  Invalidates the cached reports."""
+        self.spans.extend(s for s in spans
+                          if isinstance(s, dict) and "t0" in s)
+        self._span_reports = None
+
+    def observe_flight(self, events: List[dict], role: str = "") -> None:
+        """Offline feed: a role's flight-recorder events.  The writer's
+        ``round_committed`` / ``async_round_committed`` events anchor
+        commits when the driver's metrics.jsonl is missing or torn (a
+        SIGKILLed driver takes its notes with it — the flight dump is
+        exactly the out-of-band copy)."""
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            self.notes.append({**ev, "flight_role": role})
+            if ev.get("name") in ("round_committed",
+                                  "async_round_committed") \
+                    and isinstance(ev.get("epoch"), int):
+                c = self.commits.setdefault(ev["epoch"], {})
+                c.setdefault("t", ev.get("t"))
+                if "loss" in ev:
+                    c.setdefault("loss", ev["loss"])
+
+    def _gc(self) -> None:
+        """Bound every retained stream to the newest keep_rounds
+        rounds: epoch-keyed stores trim by epoch floor, wall-clock
+        streams (notes/faults) by the floor round's commit time, and
+        alerts by count.  Spans are fed offline only (one load per
+        query session) and are not trimmed here."""
+        if len(self.alerts) > self.keep_rounds:
+            del self.alerts[:len(self.alerts) - self.keep_rounds]
+        if len(self.commits) <= self.keep_rounds:
+            return
+        floor = sorted(self.commits)[-self.keep_rounds]
+        floor_t = (self.commits.get(floor) or {}).get("t")
+        for d in (self.commits, self.scrapes):
+            for k in [k for k in d if k < floor]:
+                del d[k]
+        for k in [k for k in self.health if k[1] < floor]:
+            del self.health[k]
+        if floor_t is not None:
+            self.faults = [f for f in self.faults
+                           if not isinstance(f.get("t"), (int, float))
+                           or f["t"] >= floor_t]
+            self.notes = [n for n in self.notes
+                          if not isinstance(n.get("t"), (int, float))
+                          or n["t"] >= floor_t]
+
+    # ------------------------------------------------------------- query
+    def rounds(self) -> List[int]:
+        """Every round any stream mentioned, ascending."""
+        rs = set(self.commits) | set(self.scrapes)
+        rs.update(ep for _role, ep in self.health)
+        return sorted(rs)
+
+    def round_bounds(self, r: int):
+        """(t0, t1) wall window of round r: previous commit -> this
+        commit.  Falls back to health-record / scrape timestamps when a
+        commit note is missing (killed driver), and to (None, None)
+        when nothing anchors the round in wall time."""
+        t1 = (self.commits.get(r) or {}).get("t")
+        if t1 is None:
+            hs = [h.get("t") for (role, ep), h in self.health.items()
+                  if ep == r and h.get("t")]
+            t1 = max(hs) if hs else None
+        if t1 is None:
+            ss = [s["t"] for s in self.scrapes.get(r, ())]
+            t1 = min(ss) if ss else None
+        prev = [c.get("t") for ep, c in self.commits.items()
+                if ep < r and c.get("t") is not None]
+        t0 = max(prev) if prev else None
+        if t0 is None and t1 is not None:
+            hs = [h.get("t") for (role, ep), h in self.health.items()
+                  if ep == r - 1 and h.get("t")]
+            t0 = max(hs) if hs else None
+        return t0, t1
+
+    def _reports_by_epoch(self) -> Dict[int, dict]:
+        """Trace round reports keyed by epoch (cached; obs.trace does
+        the heavy lifting — segments partition round wall time)."""
+        if self._span_reports is None:
+            if self.spans:
+                from bflc_demo_tpu.obs import trace as obs_trace
+                reps = obs_trace.round_reports(self.spans,
+                                               faults=self.faults)
+                self._span_reports = {rep["epoch"]: rep for rep in reps}
+            else:
+                self._span_reports = {}
+        return self._span_reports
+
+    def faults_in_round(self, r: int) -> List[dict]:
+        t0, t1 = self.round_bounds(r)
+        if t1 is None:
+            return []
+        lo = t0 if t0 is not None else t1 - 3600.0
+        return [f for f in self.faults
+                if isinstance(f.get("t"), (int, float))
+                and lo < f["t"] <= t1]
+
+    def round_record(self, r: int) -> Dict[str, Any]:
+        """The joined per-round forensic record — every pillar's view of
+        round r on one dict (module docstring shape)."""
+        t0, t1 = self.round_bounds(r)
+        commit = dict(self.commits.get(r) or {})
+        scrapes = self.scrapes.get(r, [])
+        health = {role: rec for (role, ep), rec in self.health.items()
+                  if ep == r}
+        verdicts = [h.get("verdict", "ok") for h in health.values()]
+        worst = ("crit" if "crit" in verdicts
+                 else "warn" if "warn" in verdicts
+                 else "ok" if verdicts else None)
+        cov = [s["coverage"] for s in scrapes if s.get("coverage")]
+        rec: Dict[str, Any] = {
+            "schema": SCHEMA_VERSION,
+            "epoch": r, "t0": t0, "t1": t1,
+            "wall_s": (t1 - t0 if t0 is not None and t1 is not None
+                       else None),
+            "commit": commit,
+            "health_verdict": worst,
+            "health": health,
+            "faults": self.faults_in_round(r),
+            "scrapes": len(scrapes),
+            "scrape_coverage": (min(
+                (c.get("answered", 0) / c["expected"])
+                for c in cov if c.get("expected")) if cov else None),
+            "epoch_stamped": any(s.get("epoch_stamped")
+                                 for s in scrapes) or None,
+            "alerts": [a for a in self.alerts if a.get("epoch") == r],
+        }
+        rep = self._reports_by_epoch().get(r)
+        if rep is not None:
+            rec["trace"] = {
+                "wall_s": rep["wall_s"],
+                "segments": rep["segments"],
+                "covered_frac": rep["covered_frac"],
+                "stragglers": rep["stragglers"],
+                "fault_segments": rep["faults"],
+            }
+        return rec
+
+    def slo_summary(self, r: int) -> Dict[str, Any]:
+        """The flat signal dict the SLO engine judges for round r — one
+        key per objective signal, None = no data this round (an SLO
+        skips, it never breaches on absence).  Uses the round's LAST
+        post-commit scrape (the freshest observation of r)."""
+        from bflc_demo_tpu.obs.metrics import hist_quantile
+        t0, t1 = self.round_bounds(r)
+        commit = self.commits.get(r) or {}
+        scrapes = self.scrapes.get(r, [])
+        last = scrapes[-1] if scrapes else {}
+        health = [rec for (role, ep), rec in self.health.items()
+                  if ep == r]
+        verdict = None
+        if health:
+            verdict = max({"ok": 0, "warn": 1, "crit": 2}.get(
+                h.get("verdict", "ok"), 0) for h in health)
+        elif last.get("health_verdict") is not None:
+            verdict = int(last["health_verdict"])
+        acc = commit.get("acc")
+        # regression is judged against the best accuracy STRICTLY
+        # BEFORE round r, never the global best: a catch-up pass over
+        # an async burst or dark-writer gap judges earlier rounds
+        # after later (better) commits are already known, and a
+        # look-ahead baseline would page a healthily improving run
+        best_prior = max(
+            (float(c["acc"]) for ep, c in self.commits.items()
+             if ep < r and c.get("acc") is not None), default=None)
+        cert = last.get("certify_hist") or {}
+        stal = last.get("staleness_hist") or {}
+        cov = last.get("coverage") or {}
+        return {
+            "epoch": r,
+            # round 0's "wall" spans fleet spawn + registration — not a
+            # latency signal (None = the SLO skips it)
+            "round_wall_s": (t1 - t0 if r > 0 and t0 is not None
+                             and t1 is not None else None),
+            "certify_p95_s": (hist_quantile(cert, 0.95)
+                              if cert.get("count") else None),
+            "staleness_p95": (hist_quantile(stal, 0.95)
+                              if stal.get("count") else None),
+            "scrape_coverage": ((cov.get("answered", 0)
+                                 / cov["expected"])
+                                if cov.get("expected") else None),
+            "health_verdict": verdict,
+            "accuracy": acc,
+            "acc_drop_from_best": (
+                round(best_prior - float(acc), 6)
+                if acc is not None and best_prior is not None
+                else None),
+        }
+
+
+class RoundForensics:
+    """The live wiring glue: one RoundTimeline + one SLO engine fed off
+    the FleetCollector record stream (collector.add_observer(f.observe)).
+
+    Each round is SLO-judged exactly once, when its post-commit scrape
+    lands (by then the round's wall, health gauges, coverage and
+    histogram deltas are all observable).  Every failure in here is
+    swallowed — forensics must never take down the driver loop."""
+
+    def __init__(self, engine=None, keep_rounds: int = 1024):
+        self.timeline = RoundTimeline(keep_rounds=keep_rounds)
+        self.engine = engine
+        self._judged: set = set()
+
+    def observe(self, rec: dict) -> None:
+        try:
+            self.timeline.observe(rec)
+            if self.engine is None or rec.get("type") != "scrape":
+                return
+            r = round_of_scrape(rec)
+            if r is None or r < 0:
+                return
+            # judge every committed-but-unjudged round up to r, in
+            # order — a fault-darkened writer or an async burst can
+            # commit rounds between scrapes, and skipping them would
+            # silently shrink the burn windows
+            for rr in sorted(ep for ep in self.timeline.commits
+                             if ep <= r and ep not in self._judged):
+                self._judged.add(rr)
+                for alert in self.engine.observe_round(
+                        self.timeline.slo_summary(rr),
+                        context=self.timeline.round_record(rr)):
+                    self.timeline.observe_alert(alert)
+        except Exception:       # noqa: BLE001 — observability only:
+            pass                # a forensics bug must not kill the run
+
+    def report(self) -> Dict[str, Any]:
+        rep: Dict[str, Any] = {"rounds_joined": len(
+            self.timeline.rounds())}
+        if self.engine is not None:
+            rep.update(self.engine.report())
+        return rep
+
+
+def arm_forensics(collector, telemetry_dir: str, *,
+                  timeout_s: float = 600.0,
+                  max_staleness=None) -> Optional[RoundForensics]:
+    """The ONE driver-side arming point (flat process runtime AND the
+    hier runtime): build the SLO engine over the standing objectives —
+    round-latency bound scaled off the run's own timeout (a round that
+    eats a whole fault-recovery window is the breach worth paging on),
+    staleness off the protocol genome — subscribe a RoundForensics to
+    the collector's record stream, and return it so the caller can
+    embed its report in telemetry_report.  None when BFLC_SLO_LEGACY=1
+    pins the plane off.  The arming signal is the collector itself,
+    NOT this process's metrics registry: drivers never install process
+    telemetry (only spawned children do), so a registry check would
+    leave the plane dark on every real fleet."""
+    from bflc_demo_tpu.obs import slo as obs_slo
+    if obs_slo.slo_legacy():
+        return None
+    kw = {"round_latency_s": max(30.0, timeout_s / 20.0)}
+    if max_staleness is not None:
+        kw["max_staleness"] = float(max(max_staleness, 1))
+    engine = obs_slo.SLOEngine(
+        obs_slo.default_slos(**kw),
+        jsonl_path=os.path.join(telemetry_dir, "alerts.jsonl"))
+    forensics = RoundForensics(engine)
+    collector.add_observer(forensics.observe)
+    return forensics
+
+
+# ------------------------------------------------------------- offline
+def load_round_timeline(telemetry_dir: str,
+                        keep_rounds: int = 4096) -> RoundTimeline:
+    """Rebuild the joined timeline from a telemetry artifact directory:
+    metrics.jsonl (scrapes/faults/notes), every *.health.jsonl,
+    *.spans.jsonl, *.flight.jsonl, and alerts.jsonl when present.  Every
+    stream is optional and torn/garbled lines are skipped — a post-
+    mortem must parse whatever a dead fleet left behind."""
+    from bflc_demo_tpu.obs.collector import load_timeline as _load_jsonl
+    tl = RoundTimeline(keep_rounds=keep_rounds)
+    mpath = os.path.join(telemetry_dir, "metrics.jsonl")
+    for rec in _load_jsonl(mpath):
+        tl.observe(rec)
+    try:
+        names = sorted(os.listdir(telemetry_dir))
+    except OSError:
+        names = []
+    for name in names:
+        path = os.path.join(telemetry_dir, name)
+        if name.endswith(".health.jsonl"):
+            role = name[:-len(".health.jsonl")]
+            for rec in _load_jsonl(path):
+                rec.setdefault("role", role)
+                tl.observe_health(rec)
+        elif name.endswith(".spans.jsonl"):
+            from bflc_demo_tpu.obs import trace as obs_trace
+            tl.observe_spans(obs_trace.load_spans(path))
+        elif name.endswith(".flight.jsonl"):
+            role = name[:-len(".flight.jsonl")]
+            tl.observe_flight(_load_flight_events(path), role)
+    for rec in _load_jsonl(os.path.join(telemetry_dir, "alerts.jsonl")):
+        tl.observe_alert(rec)
+    return tl
+
+
+def _load_flight_events(path: str) -> List[dict]:
+    """Flight events, empty on any malformedness (the joiner is the
+    tolerant consumer; obs.flight.load_flight stays strict for the
+    durability tests)."""
+    try:
+        from bflc_demo_tpu.obs.flight import load_flight
+        return load_flight(path).get("events", [])
+    except (OSError, ValueError):
+        return []

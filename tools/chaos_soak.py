@@ -9,7 +9,12 @@ artifact carrying everything needed to replay or triage a failure:
     {seed, profile, schedule, faults executed/skipped, invariant
      verdicts + violations, rounds, final/best accuracy, wall time}
 
-Exit code 0 iff every invariant held AND the accuracy bar was met.
+Exit code 0 iff every invariant held AND the accuracy bar was met AND
+no armed operator gate tripped: --fail-on-crit turns any CRIT verdict
+from the model-quality health plane (obs.health) into a failing run,
+--fail-on-slo does the same for SLO burn-rate alerts (obs.slo) — the
+verdict-driven operator tooling the observability planes themselves
+deliberately never do (they observe; THIS gates).
 
 The headline campaign (TPU_RESULTS.md / tests/test_chaos.py slow soak):
 
@@ -66,6 +71,15 @@ def main(argv=None) -> int:
                         "tools/fleet_top.py --timeline")
     p.add_argument("--no-telemetry", action="store_true",
                    help="disable the telemetry plane")
+    p.add_argument("--fail-on-crit", action="store_true",
+                   help="exit nonzero when the model-quality health "
+                        "plane issued any CRIT round verdict "
+                        "(obs.health) — the verdict-driven operator "
+                        "gate; requires telemetry")
+    p.add_argument("--fail-on-slo", action="store_true",
+                   help="exit nonzero when the SLO engine raised any "
+                        "burn-rate alert (obs.slo alerts.jsonl); "
+                        "requires telemetry")
     p.add_argument("--verbose", action="store_true", default=True)
     p.add_argument("--quiet", dest="verbose", action="store_false")
     args = p.parse_args(argv)
@@ -124,6 +138,9 @@ def main(argv=None) -> int:
     report = dict(res.chaos_report or {}) if res is not None else {}
     violations = report.get("violations", [])
     final_acc = res.final_accuracy if res is not None else 0.0
+    gates = operator_gates(telemetry_dir,
+                           fail_on_crit=args.fail_on_crit,
+                           fail_on_slo=args.fail_on_slo)
     artifact = {
         "seed": args.seed,
         "profile": args.profile,
@@ -141,8 +158,10 @@ def main(argv=None) -> int:
         "chaos": report,
         "telemetry": (res.telemetry_report
                       if res is not None else None),
+        "gates": gates,
     }
-    ok = (not failure and not violations and final_acc >= args.min_acc)
+    ok = (not failure and not violations and final_acc >= args.min_acc
+          and not gates["failures"])
     artifact["verdict"] = "PASS" if ok else "FAIL"
 
     with open(out, "w") as fh:
@@ -155,7 +174,51 @@ def main(argv=None) -> int:
               f"tools/fleet_top.py {telemetry_dir} --timeline)")
     if violations:
         print("INVARIANT VIOLATIONS:", *violations, sep="\n  ")
+    for g in gates["failures"]:
+        print(f"OPERATOR GATE FAILED: {g}")
     return 0 if ok else 1
+
+
+def operator_gates(telemetry_dir: str, *, fail_on_crit: bool = False,
+                   fail_on_slo: bool = False) -> dict:
+    """Verdict-gated operations (the ROADMAP 'verdict-driven operator
+    tooling' item): turn the run's health verdicts (obs.health) and SLO
+    burn-rate alerts (obs.slo) into exit-code evidence.  Enforcement
+    lives HERE, outside the protocol — the observability planes
+    themselves gate nothing (PARITY.md).  Returns {crit_rounds,
+    slo_alerts, failures}; `failures` is non-empty iff an armed gate
+    tripped.  Drilled in tier-1 with a scripted attacker
+    (tests/test_forensics.py)."""
+    gates: dict = {"crit_rounds": [], "slo_alerts": [], "failures": []}
+    if not telemetry_dir or not os.path.isdir(telemetry_dir):
+        if fail_on_crit or fail_on_slo:
+            gates["failures"].append(
+                "gating requested but no telemetry dir — run without "
+                "--no-telemetry")
+        return gates
+    from bflc_demo_tpu.obs.health import load_health_records
+    from bflc_demo_tpu.obs.slo import load_alerts
+    gates["crit_rounds"] = [
+        {"epoch": r.get("epoch"), "role": r.get("role"),
+         "flagged": [s["sender"] for s in r.get("senders", [])
+                     if s.get("level") == "crit"]}
+        for r in load_health_records(telemetry_dir)
+        if r.get("verdict") == "crit"]
+    gates["slo_alerts"] = [
+        {"slo": a.get("slo"), "epoch": a.get("epoch"),
+         "value": a.get("value"), "bound": a.get("bound")}
+        for a in load_alerts(telemetry_dir)]
+    if fail_on_crit and gates["crit_rounds"]:
+        gates["failures"].append(
+            f"--fail-on-crit: {len(gates['crit_rounds'])} CRIT health "
+            f"round(s), first at epoch "
+            f"{gates['crit_rounds'][0]['epoch']}")
+    if fail_on_slo and gates["slo_alerts"]:
+        gates["failures"].append(
+            f"--fail-on-slo: {len(gates['slo_alerts'])} SLO alert(s), "
+            f"first {gates['slo_alerts'][0]['slo']} at epoch "
+            f"{gates['slo_alerts'][0]['epoch']}")
+    return gates
 
 
 if __name__ == "__main__":

@@ -287,7 +287,33 @@ def _role_row(role, snap):
     return "  ".join(cells)
 
 
-def render_once(timeline) -> str:
+def _slo_panel(art_dir: str) -> list:
+    """SLO plane rows (obs.slo): per-objective burn state off the
+    newest scrape's writer gauges is not available here (the engine
+    runs driver-side), so the panel renders the durable artifact —
+    alerts.jsonl — which is exactly what an operator pages on.  Empty
+    when the plane is unarmed or quiet."""
+    if not art_dir:
+        return []
+    path = os.path.join(art_dir, "alerts.jsonl")
+    if not os.path.exists(path):
+        return []
+    from bflc_demo_tpu.obs.slo import load_alerts
+    alerts = load_alerts(path)
+    if not alerts:
+        return []
+    lines = [f"SLO alerts ({len(alerts)}; tools/obs_query.py --slo "
+             f"<name> for context):"]
+    for a in alerts[-8:]:
+        lines.append(
+            f"  round {a.get('epoch')}: {a.get('slo')} "
+            f"{a.get('signal')}={a.get('value')} vs {a.get('op')} "
+            f"{a.get('bound')} (burn {a.get('burn_fast')}/"
+            f"{a.get('burn_slow')})")
+    return lines
+
+
+def render_once(timeline, art_dir: str = "") -> str:
     scrapes = [r for r in timeline if r.get("type") == "scrape"]
     if not scrapes:
         return "no scrapes in timeline (telemetry disabled or empty run)"
@@ -295,10 +321,12 @@ def render_once(timeline) -> str:
     cov = last.get("coverage", {})
     lines = [f"scrape tag={last.get('tag')}  "
              f"coverage {cov.get('answered')}/{cov.get('expected')}"
+             + (f"  epoch={last['epoch']}" if "epoch" in last else "")
              + (f"  missing: {', '.join(cov['missing'])}"
                 if cov.get("missing") else "")]
     for role in sorted(last.get("roles", {})):
         lines.append(_role_row(role, last["roles"][role]))
+    lines.extend(_slo_panel(art_dir))
     return "\n".join(lines)
 
 
@@ -364,6 +392,11 @@ def _scrape_digest(rec) -> str:
 def render_timeline(timeline, spans_dir: str = "") -> str:
     recs = [r for r in timeline
             if r.get("type") in ("scrape", "fault", "note")]
+    if spans_dir:
+        # SLO burn-rate pages (obs.slo) interleave on the same stream:
+        # the alert is read next to the fault/scrape that caused it
+        from bflc_demo_tpu.obs.slo import load_alerts
+        recs.extend(load_alerts(spans_dir))
     if not recs:
         return "empty timeline"
     t0 = min(r.get("t", 0.0) for r in recs)
@@ -374,6 +407,12 @@ def render_timeline(timeline, spans_dir: str = "") -> str:
             what = (f"{r.get('kind', '?')} {r.get('target', '')}"
                     f"{'' if r.get('executed', True) else ' (skipped)'}")
             lines.append(f"+{dt:7.1f}s  FAULT   {what.strip()}")
+        elif r["type"] == "slo_alert":
+            lines.append(
+                f"+{dt:7.1f}s  ALERT   {r.get('slo')} round "
+                f"{r.get('epoch')}: {r.get('signal')}={r.get('value')} "
+                f"vs {r.get('op')} {r.get('bound')} "
+                f"(burn {r.get('burn_fast')}/{r.get('burn_slow')})")
         elif r["type"] == "note":
             extras = {k: v for k, v in r.items()
                       if k not in ("type", "t", "name")}
@@ -438,12 +477,13 @@ def main(argv=None) -> int:
                               spans_dir=os.path.dirname(
                                   os.path.abspath(path))))
         return 0
+    art_dir = os.path.dirname(os.path.abspath(path))
     if args.once:
-        print(render_once(load_timeline(path)))
+        print(render_once(load_timeline(path), art_dir=art_dir))
         return 0
     try:
         while True:
-            out = render_once(load_timeline(path))
+            out = render_once(load_timeline(path), art_dir=art_dir)
             sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
                              else "")
             print(time.strftime("%H:%M:%S"), "—", path)

@@ -33,41 +33,11 @@ from typing import Dict, List
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from bflc_demo_tpu.obs.health import summarize_records  # noqa: E402
-
-
-def load_health_records(path: str) -> List[dict]:
-    """Every parseable health_round record under `path` (a dir is
-    globbed for *.health.jsonl; torn trailing lines are skipped — the
-    stream is append-only and a kill can tear the last line)."""
-    files = []
-    if os.path.isdir(path):
-        for name in sorted(os.listdir(path)):
-            if name.endswith(".health.jsonl"):
-                files.append(os.path.join(path, name))
-    else:
-        files = [path]
-    records: List[dict] = []
-    for fp in files:
-        try:
-            with open(fp) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue            # torn tail line
-                    if rec.get("type") == "health_round":
-                        rec.setdefault("role",
-                                       os.path.basename(fp).split(
-                                           ".health.jsonl")[0])
-                        records.append(rec)
-        except OSError:
-            continue
-    records.sort(key=lambda r: (r.get("t", 0.0), r.get("epoch", 0)))
-    return records
+# the loader moved into the package (obs.health) so the chaos_soak
+# --fail-on-crit gate and the forensics joiner share it; re-exported
+# here because this tool is its historical home
+from bflc_demo_tpu.obs.health import (  # noqa: E402,F401
+    load_health_records, summarize_records)
 
 
 def render_markdown(summary: Dict, records: List[dict]) -> str:

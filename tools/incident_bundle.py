@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""incident_bundle: export a self-contained forensic tar around an alert.
+
+The page hand-off artifact: given a telemetry dir and an SLO alert (or
+an explicit round), slice EVERY artifact stream to a ±K-round window
+around it — metrics.jsonl scrapes/faults/notes, per-role health
+records, causal spans, flight-recorder events, the alerts file — and
+pack one ``incident_<slo>_r<epoch>.tar`` whose ``narrative.md``
+reconstructs the cross-pillar story (what paged, the round's critical
+path, the health verdict and flagged senders, the faults in window), so
+the person paged at 3am gets evidence, not a directory of five file
+formats.
+
+    python tools/incident_bundle.py <telemetry_dir>            # newest
+        # alert, ±3 rounds
+    python tools/incident_bundle.py <dir> --slo health_budget  # newest
+        # alert of that objective
+    python tools/incident_bundle.py <dir> --round 41 --k 5     # window
+        # around a round with no alert (manual forensics)
+
+Slices stay in their native formats — every bundled stream re-parses
+with the same loaders (obs.timeline.load_round_timeline works on an
+extracted bundle).
+"""
+
+import argparse
+import io
+import json
+import os
+import sys
+import tarfile
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bflc_demo_tpu.obs import slo as obs_slo            # noqa: E402
+from bflc_demo_tpu.obs import trace as obs_trace        # noqa: E402
+from bflc_demo_tpu.obs.collector import load_timeline   # noqa: E402
+from bflc_demo_tpu.obs.timeline import (                # noqa: E402
+    load_round_timeline, round_of_scrape)
+
+
+def pick_alert(alerts: List[dict], slo: str = "",
+               index: Optional[int] = None) -> Optional[dict]:
+    """The alert to bundle: --alert index wins, else the NEWEST alert
+    (optionally of a named objective) — pages triage newest-first."""
+    if slo:
+        alerts = [a for a in alerts if a.get("slo") == slo]
+    if not alerts:
+        return None
+    if index is not None:
+        return alerts[index] if 0 <= index < len(alerts) else None
+    return alerts[-1]
+
+
+def _slice_jsonl_records(records: List[dict], keep) -> bytes:
+    buf = io.StringIO()
+    for rec in records:
+        if keep(rec):
+            buf.write(json.dumps(rec) + "\n")
+    return buf.getvalue().encode()
+
+
+def _add_bytes(tar: tarfile.TarFile, name: str, data: bytes) -> None:
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(data))
+
+
+def build_bundle(telemetry_dir: str, out_path: str, *,
+                 slo: str = "", alert_index: Optional[int] = None,
+                 around_round: Optional[int] = None,
+                 k: int = 3) -> dict:
+    """Write the tar; returns the manifest (raises ValueError when
+    nothing anchors a window)."""
+    tl = load_round_timeline(telemetry_dir)
+    alerts = obs_slo.load_alerts(telemetry_dir) or list(tl.alerts)
+    alert = None
+    if around_round is None:
+        alert = pick_alert(alerts, slo=slo, index=alert_index)
+        if alert is None:
+            raise ValueError(
+                "no matching alert in alerts.jsonl — pass --round to "
+                "bundle a window without one")
+        center = int(alert.get("epoch") or 0)
+    else:
+        center = int(around_round)
+    lo_r, hi_r = center - k, center + k
+    bounds = [tl.round_bounds(r) for r in range(lo_r, hi_r + 1)]
+    t_los = [b[0] for b in bounds if b[0] is not None]
+    t_his = [b[1] for b in bounds if b[1] is not None]
+    t_lo = min(t_los) if t_los else None
+    t_hi = max(t_his) if t_his else None
+
+    def _in_wall(t) -> bool:
+        if not isinstance(t, (int, float)):
+            return False
+        return ((t_lo is None or t >= t_lo - 1.0)
+                and (t_hi is None or t <= t_hi + 1.0))
+
+    def _keep_metrics(rec) -> bool:
+        if rec.get("type") == "scrape":
+            r = round_of_scrape(rec)
+            if r is not None:
+                return lo_r <= r <= hi_r
+        ep = rec.get("epoch")
+        if isinstance(ep, int) and rec.get("type") == "note":
+            return lo_r <= ep <= hi_r
+        return _in_wall(rec.get("t"))
+
+    files: List[str] = []
+    with tarfile.open(out_path, "w") as tar:
+        mpath = os.path.join(telemetry_dir, "metrics.jsonl")
+        if os.path.exists(mpath):
+            data = _slice_jsonl_records(load_timeline(mpath),
+                                        _keep_metrics)
+            _add_bytes(tar, "metrics.slice.jsonl", data)
+            files.append("metrics.slice.jsonl")
+        try:
+            names = sorted(os.listdir(telemetry_dir))
+        except OSError:
+            names = []
+        for name in names:
+            path = os.path.join(telemetry_dir, name)
+            if name.endswith(".health.jsonl"):
+                data = _slice_jsonl_records(
+                    load_timeline(path),
+                    lambda rec: isinstance(rec.get("epoch"), int)
+                    and lo_r <= rec["epoch"] <= hi_r)
+            elif name.endswith(".spans.jsonl"):
+                # wall-anchored re-serialization (load_spans applied
+                # the header offset; headerless slices re-load with
+                # offset 0, i.e. unchanged)
+                spans = obs_trace.load_spans(path)
+                data = _slice_jsonl_records(
+                    spans, lambda s: _in_wall(s.get("t0"))
+                    or _in_wall(s.get("t1")))
+            elif name.endswith(".flight.jsonl"):
+                # keep the header line so load_flight still parses
+                try:
+                    with open(path) as fh:
+                        lines = fh.read().splitlines()
+                except OSError:
+                    continue
+                kept = lines[:1] + [
+                    ln for ln in lines[1:]
+                    if _keep_flight_line(ln, _in_wall)]
+                data = ("\n".join(kept) + "\n").encode() \
+                    if kept else b""
+            elif name == "alerts.jsonl":
+                try:
+                    with open(path, "rb") as fh:
+                        data = fh.read()
+                except OSError:
+                    continue
+            else:
+                continue
+            if data:
+                _add_bytes(tar, f"slices/{name}", data)
+                files.append(f"slices/{name}")
+        narrative = _narrative(tl, alert, center, lo_r, hi_r)
+        _add_bytes(tar, "narrative.md", narrative.encode())
+        files.append("narrative.md")
+        manifest = {
+            "type": "incident_bundle", "t": time.time(),
+            "telemetry_dir": os.path.abspath(telemetry_dir),
+            "alert": alert, "round": center,
+            "window_rounds": [lo_r, hi_r],
+            "window_wall": [t_lo, t_hi],
+            "files": files,
+        }
+        _add_bytes(tar, "manifest.json",
+                   (json.dumps(manifest, indent=2) + "\n").encode())
+    return manifest
+
+
+def _keep_flight_line(line: str, in_wall) -> bool:
+    line = line.strip()
+    if not line:
+        return False
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return False
+    return in_wall(rec.get("t"))
+
+
+def _narrative(tl, alert: Optional[dict], center: int,
+               lo_r: int, hi_r: int) -> str:
+    """The reconstructed cross-pillar story (markdown) — obs_query's
+    round renderer over the window, led by the page itself."""
+    import obs_query
+    lines = ["# Incident bundle narrative", ""]
+    if alert is not None:
+        lines.append(
+            f"**Paged:** SLO `{alert['slo']}` at round "
+            f"{alert.get('epoch')} — {alert['signal']}="
+            f"{alert.get('value')} vs {alert['op']} {alert['bound']} "
+            f"(burn fast/slow {alert.get('burn_fast')}/"
+            f"{alert.get('burn_slow')}, budget {alert.get('budget')})")
+    else:
+        lines.append(f"**Manual forensics window** around round "
+                     f"{center} (no alert)")
+    lines.append(f"Window: rounds {lo_r}..{hi_r}")
+    present = [r for r in tl.rounds() if lo_r <= r <= hi_r]
+    lines += ["", obs_query.render_summary(
+        tl, [tl.round_record(r) for r in present])]
+    for r in present:
+        lines += ["", "---", "", obs_query.render_round(
+            tl.round_record(r))]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="telemetry dir")
+    ap.add_argument("--slo", default="",
+                    help="bundle the newest alert of this objective")
+    ap.add_argument("--alert", type=int, default=None,
+                    help="alerts.jsonl index to bundle (default: "
+                         "newest)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="bundle around this round instead of an alert")
+    ap.add_argument("--k", type=int, default=3,
+                    help="window half-width in rounds (default 3)")
+    ap.add_argument("--out", default="",
+                    help="tar path (default incident_<slo>_r<N>.tar)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.path):
+        print(f"no such telemetry dir: {args.path}", file=sys.stderr)
+        return 2
+    try:
+        alerts = obs_slo.load_alerts(args.path)
+        alert = (pick_alert(alerts, slo=args.slo, index=args.alert)
+                 if args.round is None else None)
+        tag = (alert["slo"] if alert else "manual")
+        center = (int(alert.get("epoch") or 0) if alert
+                  else (args.round or 0))
+        out = args.out or f"incident_{tag}_r{center}.tar"
+        manifest = build_bundle(
+            args.path, out, slo=args.slo, alert_index=args.alert,
+            around_round=args.round, k=args.k)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(f"bundle -> {out}")
+    print(f"  round window {manifest['window_rounds'][0]}.."
+          f"{manifest['window_rounds'][1]}, "
+          f"{len(manifest['files'])} member(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

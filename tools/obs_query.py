@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""obs_query: query the unified round timeline of a telemetry dir.
+
+The one-stop forensics view (bflc_demo_tpu.obs.timeline): joins every
+artifact stream a run left behind — metrics.jsonl scrapes/faults/notes,
+*.health.jsonl verdicts, *.spans.jsonl causal traces, *.flight.jsonl
+dumps, alerts.jsonl SLO pages — and answers round questions without
+hand-correlating five file formats:
+
+    python tools/obs_query.py <telemetry_dir>              # all rounds
+    python tools/obs_query.py <dir> --round 41             # one round,
+        # full detail: wall, critical-path partition, health verdict +
+        # flagged senders (+ worst leaves), faults in window, alerts
+    python tools/obs_query.py <dir> --since 30             # tail rounds
+    python tools/obs_query.py <dir> --slo round_latency    # the SLO's
+        # alerts with their embedded round context
+    python tools/obs_query.py <dir> --role cell-1          # one role's
+        # health stream only
+
+Markdown to stdout by default; --json prints machine-readable records;
+--out additionally writes the JSON to a file.  Read-only over the
+artifacts — this tool renders what the fleet recorded, it gates nothing
+(tools/chaos_soak.py --fail-on-crit/--fail-on-slo is the gating half).
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bflc_demo_tpu.obs.timeline import (  # noqa: E402
+    RoundTimeline, load_round_timeline)
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def round_rows(tl: RoundTimeline, rounds: List[int]) -> List[dict]:
+    return [tl.round_record(r) for r in rounds]
+
+
+def render_summary(tl: RoundTimeline, recs: List[dict]) -> str:
+    lines = ["# Round forensics timeline", ""]
+    verdicts = {"ok": 0, "warn": 0, "crit": 0}
+    for rec in recs:
+        v = rec.get("health_verdict")
+        if v in verdicts:
+            verdicts[v] += 1
+    lines.append(f"{len(recs)} rounds joined — health ok "
+                 f"{verdicts['ok']} / warn {verdicts['warn']} / crit "
+                 f"{verdicts['crit']}; {len(tl.alerts)} SLO alert(s); "
+                 f"{len(tl.faults)} fault record(s)")
+    lines += ["", "| round | wall | health | flagged | faults | "
+                  "coverage | acc | alerts |",
+              "|---|---|---|---|---|---|---|---|"]
+    for rec in recs:
+        flagged = sum(h.get("flagged", 0)
+                      for h in rec["health"].values())
+        acc = (rec["commit"] or {}).get("acc")
+        cov = rec.get("scrape_coverage")
+        alerts = ", ".join(a["slo"] for a in rec["alerts"]) or "-"
+        lines.append(
+            f"| {rec['epoch']} | {_fmt_s(rec.get('wall_s'))} "
+            f"| {(rec.get('health_verdict') or '-').upper()} "
+            f"| {flagged} | {len(rec['faults'])} "
+            f"| {f'{cov:.0%}' if cov is not None else '-'} "
+            f"| {f'{acc:.4f}' if acc is not None else '-'} "
+            f"| {alerts} |")
+    return "\n".join(lines)
+
+
+def render_round(rec: dict) -> str:
+    r = rec["epoch"]
+    lines = [f"# Round {r} forensics", ""]
+    lines.append(f"wall {_fmt_s(rec.get('wall_s'))}  "
+                 f"health {(rec.get('health_verdict') or '?').upper()}  "
+                 f"scrapes {rec.get('scrapes')}"
+                 + ("  (epoch-stamped)" if rec.get("epoch_stamped")
+                    else ""))
+    commit = rec.get("commit") or {}
+    if commit:
+        lines.append("commit: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(commit.items())))
+    tr = rec.get("trace")
+    if tr:
+        lines += ["", "## Critical path (partition of round wall)", ""]
+        wall = tr["wall_s"]
+        lines.append(f"trace wall {wall:.3f}s, attributed "
+                     f"{tr['covered_frac']:.0%}")
+        for label, dur in tr["segments"]:
+            lines.append(f"- {label}: {dur:.3f}s "
+                         f"({dur / wall:.0%})" if wall else
+                         f"- {label}: {dur:.3f}s")
+        if tr.get("stragglers"):
+            worst = ", ".join(f"{role} +{lag:.3f}s"
+                              for role, lag in tr["stragglers"][:5])
+            lines.append(f"stragglers: {worst}")
+        for f in tr.get("fault_segments", []):
+            lines.append(f"fault {f.get('kind')} {f.get('target')} "
+                         f"-> landed in {f.get('landed_in')}")
+    if rec.get("faults"):
+        lines += ["", "## Faults in window", ""]
+        for f in rec["faults"]:
+            lines.append(f"- {f.get('kind', '?')} "
+                         f"{f.get('target', '')} "
+                         f"(t={f.get('t', 0):.3f})")
+    for role, h in sorted(rec.get("health", {}).items()):
+        lines += ["", f"## Health — {role}: "
+                      f"{h.get('verdict', 'ok').upper()}", ""]
+        lines.append(f"update_norm {h.get('update_norm')}  drift "
+                     f"{h.get('model_drift')}  score med/IQR/disagree "
+                     f"{h.get('score_median')}/{h.get('score_iqr')}/"
+                     f"{h.get('score_disagreement')}")
+        for s in h.get("senders", []):
+            if s.get("level", "ok") == "ok":
+                continue
+            line = (f"- {s['sender']}: {s['level'].upper()} "
+                    f"({', '.join(s.get('reasons', []))}) "
+                    f"l2={s.get('l2')} cos={s.get('cos')} "
+                    f"z={s.get('z')}")
+            lines.append(line)
+            for leaf in s.get("leaves", []) or ():
+                lines.append(
+                    f"    worst leaf {leaf['key']}: "
+                    f"l2 {leaf['l2']} vs med {leaf['l2_med']} "
+                    f"({leaf['ratio']}x)"
+                    + (f" cos {leaf['cos']}"
+                       if leaf.get("cos") is not None else ""))
+    if rec.get("alerts"):
+        lines += ["", "## SLO alerts", ""]
+        for a in rec["alerts"]:
+            lines.append(
+                f"- {a['slo']}: {a['signal']}={a.get('value')} vs "
+                f"{a['op']} {a['bound']} (burn fast/slow "
+                f"{a.get('burn_fast')}/{a.get('burn_slow')})")
+    return "\n".join(lines)
+
+
+def render_slo(tl: RoundTimeline, name: str) -> str:
+    alerts = [a for a in tl.alerts if a.get("slo") == name]
+    lines = [f"# SLO alerts — {name}", ""]
+    if not alerts:
+        lines.append("(no alerts for this objective)")
+        return "\n".join(lines)
+    for a in alerts:
+        lines.append(f"## round {a.get('epoch')}: "
+                     f"{a['signal']}={a.get('value')} vs {a['op']} "
+                     f"{a['bound']} (burn {a.get('burn_fast')}/"
+                     f"{a.get('burn_slow')}, budget {a.get('budget')})")
+        ctx = a.get("context") or {}
+        if ctx:
+            lines.append(f"   wall {_fmt_s(ctx.get('wall_s'))}  health "
+                         f"{(ctx.get('health_verdict') or '-').upper()}"
+                         f"  faults {len(ctx.get('faults', []))}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="telemetry dir (the FleetCollector's "
+                                 "artifact directory)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="full forensic detail for one round")
+    ap.add_argument("--role", default="",
+                    help="restrict health streams to one role")
+    ap.add_argument("--slo", default="",
+                    help="show a named objective's alerts")
+    ap.add_argument("--since", type=int, default=None,
+                    help="only rounds >= this epoch")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable records instead of markdown")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON records to this file")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.path):
+        print(f"no such telemetry dir: {args.path}", file=sys.stderr)
+        return 2
+    tl = load_round_timeline(args.path)
+    rounds = tl.rounds()
+    if args.since is not None:
+        rounds = [r for r in rounds if r >= args.since]
+    if args.round is not None:
+        rounds = [r for r in rounds if r == args.round]
+        if not rounds:
+            print(f"round {args.round} not present in any stream "
+                  f"under {args.path}", file=sys.stderr)
+            return 2
+    if not rounds and not args.slo:
+        print(f"no joinable rounds under {args.path} (telemetry "
+              f"disabled, or empty run)", file=sys.stderr)
+        return 2
+    recs = round_rows(tl, rounds)
+    if args.role:
+        for rec in recs:
+            rec["health"] = {role: h
+                             for role, h in rec["health"].items()
+                             if role == args.role}
+    payload = {"dir": args.path, "rounds": recs,
+               "alerts": ([a for a in tl.alerts
+                           if a.get("slo") == args.slo]
+                          if args.slo else tl.alerts)}
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    elif args.slo:
+        print(render_slo(tl, args.slo))
+    elif args.round is not None:
+        print(render_round(recs[0]))
+    else:
+        print(render_summary(tl, recs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
