@@ -57,7 +57,10 @@ mesh_agg_config1; full curve in TPU_RESULTS.md round 15).
 egress/round dense vs the sparsest top-k leg (f32 and i8), the QSGD
 composition ratio sparse x i8 vs i8 alone, the accuracy gaps and the
 encode/decode wall shares (eval.benchmarks.sparse_config1; the full
-density x dtype grid is TPU_RESULTS.md round 17).
+density x dtype grid is TPU_RESULTS.md round 17).  `extra.rederive`
+(ISSUE 15) is the validator re-derivation plane axis: off/shard/full
+round-wall overhead, per-validator re-derivation cost, and the
+lying-writer refusal drill (eval.benchmarks.rederive_config1).
 BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
@@ -373,6 +376,23 @@ def _child() -> None:
             "chaos_violations": (aa["sync"]["chaos_violations"] or [])
             + (aa["async"]["chaos_violations"] or []),
             "geometry": aa["geometry"],
+        }
+        # validator re-derivation plane (bflc_demo_tpu.rederive):
+        # off/shard/full round-wall overhead + per-validator cost over
+        # one scripted fleet, and the refusal drill — a writer
+        # committing a corrupted model hash under shard mode must fail
+        # certification (eval.benchmarks.rederive_config1)
+        from bflc_demo_tpu.eval.benchmarks import rederive_config1
+        rd = rederive_config1(rounds=3, validators=4)
+        extra["rederive"] = {
+            "round_wall_overhead_shard_x":
+                rd["round_wall_overhead_shard_x"],
+            "round_wall_overhead_full_x":
+                rd["round_wall_overhead_full_x"],
+            "rederive_s_per_validator_round": {
+                m: rd["legs"][m]["rederive_s_per_validator_round"]
+                for m in ("shard", "full")},
+            "refusal_drill": rd["refusal_drill"],
         }
     if os.environ.get("BFLC_BENCH_ENDURANCE"):
         # the declared metric axis (BASELINE.json: "test-acc @ round 50"),

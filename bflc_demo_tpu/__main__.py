@@ -67,6 +67,14 @@ def main(argv=None) -> int:
                       f"gives f=0 (no Byzantine tolerance); the "
                       f"reference geometry is 4", file=sys.stderr)
             kw["bft_validators"] = opts.bft_validators
+        if opts.rederive != "off":
+            # validator re-derivation plane (bflc_demo_tpu.rederive):
+            # only meaningful with a commit quorum to refuse from
+            if not opts.bft_validators:
+                print("--rederive needs --bft-validators N (validators "
+                      "are who re-derive and refuse)", file=sys.stderr)
+                return 2
+            kw["rederive"] = opts.rederive
         if opts.chaos_seed >= 0:
             # the seeded fault campaign (bflc_demo_tpu.chaos): randomized
             # kills/partitions/delays with invariant monitors; replay any
@@ -128,11 +136,11 @@ def main(argv=None) -> int:
         if opts.standbys or opts.quorum or opts.bft_validators \
                 or opts.chaos_seed >= 0 or opts.snapshot_interval \
                 or opts.snapshot_dir or opts.telemetry_dir \
-                or opts.trace_sample:
+                or opts.trace_sample or opts.rederive != "off":
             print("--standbys/--quorum/--bft-validators/--chaos-seed/"
                   "--snapshot-interval/--snapshot-dir/--telemetry-dir/"
-                  "--trace-sample apply to --runtime processes",
-                  file=sys.stderr)
+                  "--trace-sample/--rederive apply to --runtime "
+                  "processes", file=sys.stderr)
             return 2
     elif opts.runtime == "mesh" and opts.attest_scores is not None \
             and not (opts.standbys or opts.tls_dir or opts.quorum
@@ -150,12 +158,13 @@ def main(argv=None) -> int:
             or opts.attest_scores is not None or opts.bft_validators \
             or opts.chaos_seed >= 0 or opts.cells or opts.cell_size \
             or opts.snapshot_interval or opts.snapshot_dir \
-            or opts.telemetry_dir or opts.trace_sample:
+            or opts.telemetry_dir or opts.trace_sample \
+            or opts.rederive != "off":
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
               "--chaos-seed/--cells/--cell-size/--snapshot-interval/"
-              "--snapshot-dir/--telemetry-dir/--trace-sample apply to "
-              "the processes runtime; --attest-scores to mesh/executor",
-              file=sys.stderr)
+              "--snapshot-dir/--telemetry-dir/--trace-sample/--rederive "
+              "apply to the processes runtime; --attest-scores to "
+              "mesh/executor", file=sys.stderr)
         return 2
     if cfg is not None and opts.runtime != "processes":
         # sparse upload deltas are a wire-protocol mode like

@@ -161,10 +161,15 @@ def _server_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                  verbose: bool, chaos_spec: Optional[dict] = None,
                  telemetry_spec: Optional[dict] = None,
                  snapshot_interval: int = 0,
-                 snapshot_dir: str = "") -> None:
+                 snapshot_dir: str = "",
+                 rederive: str = "") -> None:
     _force_cpu_jax()
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
+    if rederive:
+        # the writer attaches commit evidence + retains round blobs
+        # for validator re-derivation fetches (bflc_demo_tpu.rederive)
+        os.environ["BFLC_REDERIVE"] = rederive
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
     tls = _server_tls(tls_dir)
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
@@ -186,17 +191,25 @@ def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
                     port: int = 0,
                     chaos_spec: Optional[dict] = None,
                     telemetry_spec: Optional[dict] = None,
-                    cell_registry: Optional[dict] = None) -> None:
+                    cell_registry: Optional[dict] = None,
+                    rederive: str = "",
+                    initial_blob: bytes = b"") -> None:
     """One BFT commit-quorum member (comm.bft.ValidatorNode): an
     independent replica + wallet that re-executes every op and co-signs
     commit certificates — the reference analogue of one PBFT chain node.
     Peer keys let it admit certified backlog when rejoining mid-run; a
     fixed `port` makes the role restartable under chaos (the writer's
-    endpoint list survives the restart).  No jax import: the validator
-    path is pure ledger + crypto, and a lean child restarts fast."""
+    endpoint list survives the restart).  No jax import unless the
+    re-derivation plane is armed (`rederive` in {shard, full} — the
+    validator then re-derives every commit's model hash through the
+    serialization/meshagg decode chain, with `initial_blob` as the
+    provisioned genesis model); unarmed, the validator path stays pure
+    ledger + crypto and a lean child restarts fast."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # in case a dep imports jax
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
+    if rederive:
+        os.environ["BFLC_REDERIVE"] = rederive
     from bflc_demo_tpu.comm.bft import ValidatorNode
     from bflc_demo_tpu.comm.identity import Wallet
     node = ValidatorNode(ProtocolConfig(**cfg_kw),
@@ -204,6 +217,7 @@ def _validator_proc(cfg_kw: dict, wallet_seed: bytes, index: int,
                          port=port,
                          validator_keys=validator_keys,
                          cell_registry=cell_registry,
+                         initial_model_blob=initial_blob or None,
                          verbose=verbose)
     port_q.put(node.port)
     node.serve_forever()
@@ -629,7 +643,8 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
                   chaos_spec: Optional[dict] = None,
                   telemetry_spec: Optional[dict] = None,
                   snapshot_interval: int = 0,
-                  snapshot_dir: str = "") -> None:
+                  snapshot_dir: str = "",
+                  rederive: str = "") -> None:
     """Hot standby: follow the writer's op stream, promote on its death
     (comm.failover.Standby).  Reports its serving port, then blocks.  A
     fixed `port` makes the role restartable under chaos (clients keep
@@ -641,6 +656,11 @@ def _standby_proc(cfg_kw: dict, endpoints: List[Tuple[str, int]],
     _force_cpu_jax()
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
+    if rederive:
+        # a PROMOTED standby's LedgerServer must keep attaching commit
+        # evidence, or the fleet's validators degrade to counted skips
+        # for the rest of the run
+        os.environ["BFLC_REDERIVE"] = rederive
     from bflc_demo_tpu.comm.failover import Standby
     from bflc_demo_tpu.comm.identity import Wallet
     tls_c, tls_s = _client_tls(tls_dir), _server_tls(tls_dir)
@@ -727,6 +747,7 @@ def run_federated_processes(
         trace_sample: float = 0.0,
         snapshot_interval: int = 0,
         snapshot_dir: str = "",
+        rederive: str = "off",
         verbose: bool = False) -> ProcessFederationResult:
     """Run a full federation as (1 coordinator + N clients [+ standbys]
     [+ 1 replica]) OS processes.  Parent = sponsor.
@@ -792,6 +813,17 @@ def run_federated_processes(
     BFLC_SNAPSHOT_LEGACY=1) pins the replay-from-genesis behavior.
     snapshot_dir: persist snapshot artifacts under per-role subdirs
     (writer/, standby-N/) — tmp-then-rename, newest two retained.
+    rederive: validator re-derivation plane mode (bflc_demo_tpu.rederive,
+    'off'|'shard'|'full'; requires bft_validators > 0 to do anything) —
+    validators fetch the round's admitted deltas through the read
+    fan-out, re-run the deterministic decode + REDUCTION SPEC v1
+    FedAvg, and refuse to co-sign a commit whose model hash they cannot
+    reproduce; the writer attaches commit evidence and retains the
+    round's blobs one round for their fetches.  'shard' re-derives a
+    deterministic leaf subset per validator (min(n, max(2, 2f+1))-way coverage,
+    escalating to full on any per-leaf disagreement); 'off' (default,
+    or BFLC_REDERIVE_LEGACY=1) pins today's guard-check posture with
+    certified bytes unchanged.
 
     Async buffered aggregation rides the PROTOCOL genome, not a driver
     flag: cfg.async_buffer = K > 0 (CLI --async-buffer) switches every
@@ -812,6 +844,10 @@ def run_federated_processes(
             f"quorum={quorum} requires standbys >= {quorum + 1}: a "
             f"promoted writer must retain {quorum} followers to keep "
             f"acknowledging mutations after a failover")
+    from bflc_demo_tpu.rederive import REDERIVE_MODES
+    if rederive not in REDERIVE_MODES:
+        raise ValueError(f"rederive must be one of {REDERIVE_MODES}, "
+                         f"got {rederive!r}")
     crash_at = crash_at or {}
     factory_kw = factory_kw or {}
     t_start = time.monotonic()
@@ -906,7 +942,9 @@ def run_federated_processes(
             args=(cfg_kw, master_seed + b"|bft-validator|"
                   + struct.pack("<q", v), v, q, bft_keys, verbose,
                   vport, _wire(f"validator-{v}"),
-                  _tspec(f"validator-{v}")),
+                  _tspec(f"validator-{v}"), None,
+                  rederive if rederive != "off" else "",
+                  initial_blob if rederive != "off" else b""),
             daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -923,7 +961,8 @@ def run_federated_processes(
                               standby_keys, quorum,
                               bft_endpoints, bft_keys, verbose,
                               _wire("writer"), _tspec("writer"),
-                              snapshot_interval, _snap_dir("writer")),
+                              snapshot_interval, _snap_dir("writer"),
+                              rederive if rederive != "off" else ""),
                         daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -938,7 +977,8 @@ def run_federated_processes(
                               quorum, bft_endpoints, bft_keys,
                               verbose, sbport, _wire(f"standby-{s}"),
                               _tspec(f"standby-{s}"),
-                              snapshot_interval, _snap_dir(f"standby-{s}")),
+                              snapshot_interval, _snap_dir(f"standby-{s}"),
+                              rederive if rederive != "off" else ""),
                         daemon=True)
         with _cpu_spawn_env():
             p.start()

@@ -49,17 +49,25 @@ equivocating writer).  Two repair paths restore progress:
   `CertificateAssembler.superseded_op` — a stale writer self-demotes, a
   racing standby re-follows the winner).
 
-Deliberate non-goals, documented rather than implied (PARITY.md): the
-commit op's MODEL HASH is re-executed as a guard check but not re-derived
-(validators hold no payload blobs, so a writer lying about the FedAvg
-output hash is caught by committee score attestation + any-holder
-re-verification, not here); reads are not certified; client-originated
-ops still require auth evidence (or an existing certificate) on the
-repair path — a repair proof authorizes the ROLLBACK, never an auth
-bypass; and the repair mandate's f+1 threshold protects any
-possibly-certified op against f lying validators OR an arbitrarily
-equivocating writer, but not both colluding at once (the same compound
-fault PBFT needs its second phase for — documented in PARITY.md).
+The last writer-trust axis — the commit op's MODEL HASH, historically
+taken on writer authority — is closed by the opt-in re-derivation plane
+(bflc_demo_tpu.rederive, `--rederive {shard,full}`): an armed validator
+fetches the round's admitted deltas through the data-plane read path
+(hash-verified against upload ops it already co-signed), re-runs the
+deterministic decode + REDUCTION SPEC v1 merge on its own replica's
+selection, and REFUSES (status ``REDERIVE``) a commit whose hash it
+cannot reproduce — with unavailability degrading to the historical
+guard-check as a counted, WARNed skip (never a wedge), and
+certified-backlog/rejoin ops admitting on their certificate.
+
+Deliberate non-goals, documented rather than implied (PARITY.md):
+reads are not certified; client-originated ops still require auth
+evidence (or an existing certificate) on the repair path — a repair
+proof authorizes the ROLLBACK, never an auth bypass; and the repair
+mandate's f+1 threshold protects any possibly-certified op against f
+lying validators OR an arbitrarily equivocating writer, but not both
+colluding at once (the same compound fault PBFT needs its second phase
+for — documented in PARITY.md).
 
 Deployment note: validator ports belong on the coordinator-side network
 segment (like standby subscriptions).  The drill in tests/test_bft.py is
@@ -82,6 +90,7 @@ import numpy as np
 from bflc_demo_tpu.comm.identity import (PublicDirectory, _op_bytes,
                                          address_of, verify_signature,
                                          verify_signatures_batch)
+from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.obs import trace as obs_trace
 from bflc_demo_tpu.utils import tracing
@@ -111,9 +120,11 @@ _CERT_MAGIC = b"BFLCCERT1"
 _EMPTY_HEAD = b"\0" * 32        # head digest of the empty chain (log_head())
 
 # ledger op codec (must match pyledger/ledger.cpp opcode table);
-# 10/11 are the async buffered-aggregation client ops (ledger.base)
+# 10/11 are the async buffered-aggregation client ops (ledger.base),
+# 4/12 the sync/async COMMIT ops the re-derivation plane judges
 _OP_REGISTER, _OP_UPLOAD, _OP_SCORES = 1, 2, 3
 _OP_AUPLOAD, _OP_ASCORES = 10, 11
+_OP_COMMIT, _OP_ACOMMIT = 4, 12
 
 # --- validator-side telemetry (obs.metrics; no-ops unless the process
 # registry is enabled): vote latency by transport shape, refusals by
@@ -132,6 +143,10 @@ _M_ABANDON = obs_metrics.REGISTRY.counter(
     "abandon_events_total", "signed abandon statements issued")
 _G_VLOG = obs_metrics.REGISTRY.gauge(
     "validator_log_size", "replica chain length at last scrape")
+_M_RL_XCHECK = obs_metrics.REGISTRY.counter(
+    "rederive_crosscheck_total",
+    "assembler-side per-leaf digest cross-checks over the commit "
+    "votes forming a certificate (rederive plane)", ("result",))
 
 
 def cert_payload_digest(index: int, prev_head: bytes, op_digest: bytes,
@@ -586,6 +601,8 @@ class ValidatorNode:
                  validator_keys: Optional[Dict[int, bytes]] = None,
                  quorum: Optional[int] = None,
                  cell_registry: Optional[Dict[str, Tuple[int, int]]] = None,
+                 rederive: Optional[str] = None,
+                 initial_model_blob: Optional[bytes] = None,
                  verbose: bool = False):
         cfg.validate()
         self.cfg = cfg
@@ -627,6 +644,29 @@ class ValidatorNode:
         # colluding writer cannot certify a malformed #topk blob
         from bflc_demo_tpu.utils.serialization import sparse_enabled
         self._sparse = sparse_enabled(cfg)
+        # validator re-derivation plane (bflc_demo_tpu.rederive): with a
+        # mode armed — explicit `rederive` or BFLC_REDERIVE, legacy pin
+        # wins — this validator re-derives every commit op's model hash
+        # from the admitted deltas (fetched through the data-plane read
+        # path, hash-verified against upload ops it already co-signed)
+        # and REFUSES to co-sign one it cannot reproduce.  Python
+        # backend only: the re-derivation reads the replica's pending
+        # selection / async buffer surfaces.
+        from bflc_demo_tpu.rederive import (REDERIVE_MODES,
+                                            rederive_legacy,
+                                            rederive_mode)
+        if rederive is None:
+            mode = rederive_mode()
+        else:
+            mode = (rederive if rederive in REDERIVE_MODES
+                    and not rederive_legacy() else "off")
+        self._rederiver = None
+        if mode != "off" and ledger_backend == "python":
+            from bflc_demo_tpu.rederive.core import Rederiver
+            self._rederiver = Rederiver(
+                mode, index, len(self.validator_keys) or 1, cfg,
+                initial_model_blob=initial_model_blob,
+                cell_registry=self._cell_registry)
         self._lock = threading.Lock()
         # index -> (attempt, op digest) of our current vote there
         self._voted: Dict[int, Tuple[int, bytes]] = {}
@@ -656,6 +696,8 @@ class ValidatorNode:
 
     def close(self) -> None:
         self._stop.set()
+        if self._rederiver is not None:
+            self._rederiver.close()
         try:
             self._sock.close()
         except OSError:
@@ -825,20 +867,28 @@ class ValidatorNode:
         return self._sign_position(i, op, attempt)
 
     def _vote_locked(self, i: int, op: bytes, auth, attempt: int,
-                     sparse_err: str = "") -> dict:
+                     sparse_err: str = "", cell_err: str = "") -> dict:
         """The evidence-free voting core (lock held): idempotent re-sign
         of an op we already hold, strict ordering, abandon promises, auth
-        check, apply + sign.  Anything needing QUORUM EVIDENCE (a peer
-        certificate or a repair proof) refuses here — `_validate` layers
-        that handling on top; the batch fast path refuses outright and
-        lets the writer fall back to the single-op method.
+        check, re-derivation, apply + sign.  Anything needing QUORUM
+        EVIDENCE (a peer certificate or a repair proof) refuses here —
+        `_validate` layers that handling on top; the batch fast path
+        refuses outright and lets the writer fall back to the single-op
+        method.
 
         `sparse_err` is the PRECOMPUTED `check_sparse_upload_op` verdict
         ('' = fine): the full blob decode is a pure function of
         (op, auth) and must run OUTSIDE this lock — on a density-armed
         quorum it materializes the whole dense model per upload, and
         serializing that behind the validator's one lock would put
-        N x decode latency on the BFT critical path per round."""
+        N x decode latency on the BFT critical path per round.
+        `cell_err` is the precomputed `Rederiver.check_cell` verdict for
+        root-tier cell uploads — pure function of (op, auth) + the
+        cell's read surface, likewise computed outside the lock.  The
+        COMMIT re-derivation itself runs here: it reads this replica's
+        pending/async state (only valid under the lock) and commits are
+        one or two ops a round, so the bounded fetch sits where the
+        round's certification round-trip already does."""
         op_hash = hashlib.sha256(op).digest()
         size = self.ledger.log_size()
         promised = self._promised.get(i, 0)
@@ -878,7 +928,24 @@ class ValidatorNode:
             err = check_op_auth(op, auth, self.directory)
             if err:
                 return self._refuse("AUTH", err)
-        return self._apply_and_sign(i, op, op_hash, attempt)
+        rl = None
+        if self._rederiver is not None:
+            if cell_err:
+                # root-tier cell partial that is not the FedAvg of its
+                # member-signed deltas (precomputed outside the lock)
+                return self._refuse("REDERIVE", cell_err)
+            if op[0] in (_OP_COMMIT, _OP_ACOMMIT):
+                err, rl = self._rederiver.check(self.ledger, op, auth)
+                if err:
+                    return self._refuse("REDERIVE", err)
+        r = self._apply_and_sign(i, op, op_hash, attempt)
+        if r.get("ok") and rl is not None:
+            # per-leaf digest vector of the successful re-derivation:
+            # vote metadata the assembler cross-checks across
+            # overlapping shards (rederive.core.crosscheck_rl)
+            r["rl"] = rl["leaves"]
+            r["rmode"] = rl["mode"]
+        return r
 
     def _validate(self, msg: dict) -> dict:
         try:
@@ -906,15 +973,19 @@ class ValidatorNode:
     def _validate_inner(self, i: int, op: bytes, op_hash: bytes,
                         attempt: int, msg: dict) -> dict:
         # the sparse blob re-execution is a pure function of (op, auth):
-        # run it before taking the lock (see _vote_locked docstring)
+        # run it before taking the lock (see _vote_locked docstring) —
+        # the cell-partial re-derivation likewise (op + evidence + the
+        # cell's read surface, no replica state)
         sparse_err = (check_sparse_upload_op(op, msg.get("auth"))
                       if self._sparse else "")
+        cell_err = self._cell_rederive_err(op, msg.get("auth"))
         with self._lock:
             r = self._vote_locked(i, op, msg.get("auth"), attempt,
-                                  sparse_err=sparse_err)
+                                  sparse_err=sparse_err,
+                                  cell_err=cell_err)
             status = r.get("status")
             if r.get("ok") or status not in ("CONFLICT", "AUTH",
-                                             "SPARSE"):
+                                             "SPARSE", "REDERIVE"):
                 return r
             if status == "CONFLICT":
                 # a DIFFERENT op at a bound position: only quorum evidence
@@ -953,23 +1024,52 @@ class ValidatorNode:
                     # ... and never a sparse bypass either: a
                     # re-proposed upload still needs its blob evidence
                     return self._refuse("SPARSE", sparse_err)
+                if cert is None and cell_err:
+                    # ... nor a cell re-derivation bypass
+                    return self._refuse("REDERIVE", cell_err)
                 self._enroll_register_pubkey(op, msg.get("auth"))
                 _M_REPAIR.inc(kind=("cert_resync" if cert is not None
                                     else "re_proposal"))
                 self._rollback_to(i)
+                rl = None
+                if cert is None and self._rederiver is not None \
+                        and op and op[0] in (_OP_COMMIT, _OP_ACOMMIT):
+                    # re-proposed commit without a certificate: the
+                    # rollback restored the pre-commit state, so the
+                    # re-derivation judges it like a fresh vote
+                    err, rl = self._rederiver.check(self.ledger, op,
+                                                    msg.get("auth"))
+                    if err:
+                        return self._refuse("REDERIVE", err)
                 t = max(attempt, cert.attempt if cert else 0)
-                return self._apply_and_sign(i, op, op_hash, t)
-            # AUTH/SPARSE refusal at the fresh tip: certified backlog —
-            # the quorum already re-verified the client tag (and, on a
-            # density-armed quorum, the sparse blob) once; admit on the
-            # certificate.  This keeps validator REJOIN live on sparse
-            # fleets: ops certified before a promotion lose their
+                r2 = self._apply_and_sign(i, op, op_hash, t)
+                if r2.get("ok") and rl is not None:
+                    # the contested re-proposal is exactly where the
+                    # forensic cross-check wants digest vectors most
+                    r2["rl"] = rl["leaves"]
+                    r2["rmode"] = rl["mode"]
+                return r2
+            # AUTH/SPARSE/REDERIVE refusal at the fresh tip: certified
+            # backlog — the quorum already re-verified the client tag
+            # (and the sparse blob / the commit re-derivation) once;
+            # admit on the certificate.  This keeps validator REJOIN
+            # live: ops certified before a promotion lose their
             # writer-process-local auth evidence (blob included), and
             # refusing them here would wedge resync forever.
             if self._peer_certificate(msg, i, op) is None:
                 return r
             self._enroll_register_pubkey(op, msg.get("auth"))
             return self._apply_and_sign(i, op, op_hash, attempt)
+
+    def _cell_rederive_err(self, op: bytes, auth) -> str:
+        """Precomputed root-tier cell-partial re-derivation verdict
+        ('' = fine / not applicable) — pure function of (op, auth) +
+        the cell's read surface, run OUTSIDE the validator lock (see
+        _vote_locked docstring)."""
+        if self._rederiver is None or self._cell_registry is None \
+                or not op or op[0] != _OP_UPLOAD:
+            return ""
+        return self._rederiver.check_cell(op, auth)
 
     def _snapshot_install(self, msg: dict) -> dict:
         """State-sync a REJOINING replica that lags below the writer's
@@ -1057,13 +1157,16 @@ class ValidatorNode:
         sparse_errs = ([check_sparse_upload_op(op, auths[k])
                         for k, op in enumerate(ops)]
                        if self._sparse else [""] * len(ops))
+        cell_errs = [self._cell_rederive_err(op, auths[k])
+                     for k, op in enumerate(ops)]
         # causal span linked to EVERY op in the batch (obs.trace): one
         # vote round-trip serves several clients' traces at once
         with obs_trace.server_span(msg, "vote_batch", links_key="tps",
                                    i=start, n_ops=len(ops)), self._lock:
             for k, op in enumerate(ops):
                 r = self._vote_locked(start + k, op, auths[k], attempt,
-                                      sparse_err=sparse_errs[k])
+                                      sparse_err=sparse_errs[k],
+                                      cell_err=cell_errs[k])
                 if not r.get("ok"):
                     stopped = r
                     break
@@ -1377,6 +1480,9 @@ class CertificateAssembler:
             heads.append(h)
         # position -> attempt -> {validator: sig}; raw first, verify bulk
         raw: List[List[Tuple[int, int, bytes]]] = [[] for _ in range(n)]
+        # position -> {validator: per-leaf digest vector} — rederive
+        # vote metadata, cross-checked after the certificates mint
+        rl_by_pos: List[Dict[int, dict]] = [{} for _ in range(n)]
         lock = threading.Lock()
         # one causal span per vote ROUND-TRIP, linked to every op in the
         # batch (obs.trace): the ambient context is captured here — the
@@ -1405,6 +1511,8 @@ class CertificateAssembler:
                 if 0 <= k < n and vidx in self.keys:
                     with lock:
                         raw[k].append((vidx, vt, sig))
+                        if isinstance(v.get("rl"), dict):
+                            rl_by_pos[k][vidx] = v["rl"]
 
         threads = [threading.Thread(target=ask, args=(c, ci),
                                     daemon=True)
@@ -1462,7 +1570,28 @@ class CertificateAssembler:
             if got is None:
                 break
         certs += [None] * (n - len(certs))
+        for k, rls in enumerate(rl_by_pos):
+            if len(rls) >= 2:
+                self._crosscheck(start + k, rls)
         return certs
+
+    @staticmethod
+    def _crosscheck(position: int, rls: Dict[int, dict]) -> None:
+        """Cross-check the per-leaf digest vectors that rode a commit
+        op's votes (rederive plane).  Honest vectors can never disagree
+        — each digests leaves that matched the one claimed blob — so a
+        disagreement fingerprints a lying or buggy validator for the
+        forensic record (safety rests on the shard-coverage refusal
+        arithmetic, not on this check)."""
+        from bflc_demo_tpu.rederive.core import crosscheck_rl
+        bad = crosscheck_rl(rls)
+        _M_RL_XCHECK.inc(result="disagree" if bad else "ok")
+        if bad:
+            obs_flight.FLIGHT.record(
+                "event", "rederive_crosscheck_disagreement",
+                position=position, leaves=bad[:8],
+                validators=sorted(rls))
+            obs_flight.FLIGHT.flush("rederive_crosscheck")
 
     def _gather_votes(self, i: int, op: bytes, auth: Optional[dict],
                       prev_head: bytes, attempt: int,
@@ -1479,6 +1608,7 @@ class CertificateAssembler:
         votes: Dict[int, Dict[int, bytes]] = {}
         refusals: List[dict] = []
         diverged: List[ValidatorClient] = []
+        rls: Dict[int, dict] = {}
         lock = threading.Lock()
 
         amb = (obs_trace.TRACE.current_traceparent()
@@ -1512,6 +1642,8 @@ class CertificateAssembler:
             with lock:
                 if verify_signature(pub, payload, sig):
                     votes.setdefault(vt, {})[vidx] = sig
+                    if isinstance(r.get("rl"), dict):
+                        rls[vidx] = r["rl"]
                 else:
                     diverged.append(client)
 
@@ -1521,6 +1653,8 @@ class CertificateAssembler:
             t.start()
         for t in threads:
             t.join(timeout=self.timeout_s + 5.0)
+        if len(rls) >= 2:
+            self._crosscheck(i, rls)
         return votes, refusals, diverged
 
     def _resync_diverged(self, client: ValidatorClient, i: int) -> bool:

@@ -502,6 +502,20 @@ class LedgerServer:
         # (density 1.0 or BFLC_SPARSE_LEGACY=1) reject #topk entries as
         # the schema garbage they then are.
         self._sparse = sparse_enabled(cfg)
+        # validator re-derivation plane (bflc_demo_tpu.rederive): when
+        # armed, every commit/acommit op's auth evidence carries the
+        # claimed NEW model blob (hash-bound to the op) plus the current
+        # read set + this writer's endpoint, and the round's consumed
+        # blobs — the admitted deltas and the previous model — are
+        # RETAINED one round in _rederive_blobs so a validator's
+        # coordinator-fallback fetch can still be served after the
+        # commit popped them from the working set.  Off (default): no
+        # evidence, no retention, bytes unchanged.
+        from bflc_demo_tpu.rederive import rederive_armed
+        self._rederive = rederive_armed()
+        self._rederive_blobs: Dict[bytes, bytes] = {}
+        self._rederive_commit_pos: Optional[int] = None
+        self._rederive_cell_auth: List[int] = []
         if bft_validators:
             from bflc_demo_tpu.comm.bft import CertificateAssembler
             from bflc_demo_tpu.protocol.constants import bft_quorum as _bq
@@ -1224,13 +1238,61 @@ class LedgerServer:
         with self._cv:
             return sorted(set(self._sub_read_ep.values()))
 
+    def _blob_lookup(self, digest: bytes) -> Optional[bytes]:
+        """The read-serving blob lookup: the working set, then the
+        rederive plane's one-round retention (validators fetching the
+        just-committed round's inputs after the commit popped them)."""
+        blob = self._blobs.get(digest)
+        if blob is None and self._rederive_blobs:
+            blob = self._rederive_blobs.get(digest)
+        return blob
+
+    def _stash_rederive(self, new_blob: bytes,
+                        round_blobs: Dict[bytes, bytes]) -> None:
+        """Arm the just-appended commit op for validator re-derivation
+        (caller holds the lock, BEFORE the model/blob swap): evidence on
+        the op's auth record + one round of blob retention.  The
+        previous model rides under its own hash — a validator that
+        missed the last round's verification fetches it content-
+        addressed like any delta.  The PREVIOUS commit's fat `mblob`
+        evidence is dropped here (endpoints kept): retaining every
+        round's full model hex would grow writer memory ~2x model size
+        per round forever, and a validator replaying old certified
+        commits admits them on their certificate (or degrades to the
+        counted skip) — the evidence is only load-bearing until its own
+        certification."""
+        prev = self._rederive_commit_pos
+        if prev is not None and prev in self._op_auth:
+            self._op_auth[prev].pop("mblob", None)
+        # ... and the same rule for the round's CELL evidence (hier
+        # root): the cell uploads certified at their own acks, before
+        # this commit — their fat partial blobs + member listings are
+        # no longer load-bearing (backlog resync admits on the
+        # certificate).  The sparse-mode "blob" evidence pre-dates the
+        # plane and keeps its historical retention.
+        for p in self._rederive_cell_auth:
+            a = self._op_auth.get(p)
+            if a is not None:
+                a.pop("cell", None)
+                if not self._sparse:
+                    a.pop("blob", None)
+        self._rederive_cell_auth = []
+        pos = self.ledger.log_size() - 1
+        round_blobs[self._model_hash] = self._model_blob
+        self._rederive_blobs = round_blobs
+        self._rederive_commit_pos = pos
+        self._op_auth[pos] = {
+            "mblob": new_blob.hex(),
+            "rs": [list(ep) for ep in self._read_set()],
+            "co": [self.host, self.port]}
+
     def _dispatch_inner(self, method: str, m: dict) -> dict:
         with self._lock:
             # blob / blobs / model ride the ONE shared read dispatch
             # (comm.dataplane.handle_read) — the same hash-addressed
             # protocol standby read replicas and the mesh executor serve
             read = handle_read(
-                method, m, blob_lookup=self._blobs.get,
+                method, m, blob_lookup=self._blob_lookup,
                 model_state=lambda: (self.ledger.epoch, self._model_hash,
                                      self._model_blob),
                 read_set=self._read_set)
@@ -1360,6 +1422,20 @@ class LedgerServer:
                         # check_sparse_upload_op) — a colluding writer
                         # cannot certify a malformed #topk blob
                         auth["blob"] = blob.hex()
+                    if self._cell_registry is not None \
+                            and self._rederive \
+                            and isinstance(m.get("cell_ev"), dict):
+                        # hier root + rederive plane: the cell's
+                        # member-signed admission listing + the partial
+                        # blob ride the evidence so every validator can
+                        # re-derive the cell partial from member blobs
+                        # (rederive.core.check_cell); the fat parts
+                        # are dropped again at the round's commit
+                        # (_stash_rederive) once the op certified
+                        auth["cell"] = m["cell_ev"]
+                        auth.setdefault("blob", blob.hex())
+                        self._rederive_cell_auth.append(
+                            self.ledger.log_size() - 1)
                     self._op_auth[self.ledger.log_size() - 1] = auth
                 elif st == LedgerStatus.DUPLICATE:
                     # an honest retry (e.g. across a writer failover) whose
@@ -1726,6 +1802,11 @@ class LedgerServer:
             st = self.ledger.async_commit(digest, epoch, k)
             if st != LedgerStatus.OK:
                 raise RuntimeError(f"async commit rejected: {st.name}")
+            if self._rederive:
+                self._stash_rederive(
+                    blob, {e.payload_hash: self._blobs[e.payload_hash]
+                           for e in entries
+                           if e.payload_hash in self._blobs})
             for e in entries:
                 self._blobs.pop(e.payload_hash, None)
                 self._staged.pop(e.payload_hash, None)
@@ -2053,6 +2134,10 @@ class LedgerServer:
         st = self.ledger.commit_model(digest, epoch)
         if st != LedgerStatus.OK:
             raise RuntimeError(f"commit rejected: {st.name}")
+        if self._rederive:
+            self._stash_rederive(
+                blob, {u.payload_hash: self._blobs[u.payload_hash]
+                       for u in updates if u.payload_hash in self._blobs})
         for u in updates:
             self._blobs.pop(u.payload_hash, None)
             self._staged.pop(u.payload_hash, None)

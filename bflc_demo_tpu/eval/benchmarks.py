@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import subprocess
 import sys
 import tempfile
@@ -345,6 +346,161 @@ def _cert_throughput_inproc(n_ops: int = 24, validators: int = 4,
         out["batched_vs_sequential"] = round(
             out["batched_ops_per_sec"] / out["sequential_ops_per_sec"], 2)
     return out
+
+
+def _rederive_scripted_rounds(mode: str, rounds: int, validators: int,
+                              lie: bool = False) -> Dict:
+    """One in-process fleet (thread-served writer + validator quorum)
+    driving `rounds` scripted config-1-shaped rounds with the rederive
+    plane at `mode` — the benchmark's measurement core and the
+    refusal-drill harness (`lie=True` corrupts the writer's committed
+    model bytes).  Returns wall/round, per-validator rederive cost,
+    the committed epoch and the validator stats."""
+    import hashlib as _hl
+    from unittest import mock
+
+    import numpy as np
+
+    import bflc_demo_tpu.comm.ledger_service as _ls
+    from bflc_demo_tpu.comm.bft import ValidatorNode, provision_validators
+    from bflc_demo_tpu.comm.identity import _op_bytes, provision_wallets
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+    from bflc_demo_tpu.utils.serialization import pack_entries, pack_pytree
+
+    cfg = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=3,
+                         needed_update_count=4, learning_rate=0.05,
+                         batch_size=16)
+    init = pack_pytree({"W": np.zeros((64, 8), np.float32),
+                        "b": np.zeros((8,), np.float32)})
+    saved = os.environ.get("BFLC_REDERIVE")
+    os.environ["BFLC_REDERIVE"] = mode
+    nodes, srv = [], None
+    try:
+        vwallets, vkeys = provision_validators(
+            validators, b"rederive-bench|" + mode.encode())
+        nodes = [ValidatorNode(cfg, w, i, validator_keys=vkeys,
+                               initial_model_blob=init)
+                 for i, w in enumerate(vwallets)]
+        for v in nodes:
+            v.start()
+        srv = _ls.LedgerServer(
+            cfg, init, bft_validators=[(v.host, v.port) for v in nodes],
+            bft_keys=vkeys, bft_timeout_s=2.0)
+        srv.start()
+        cl = _ls.CoordinatorClient(srv.host, srv.port)
+        wallets, _ = provision_wallets(cfg.client_num,
+                                       b"rederive-bench-clients")
+
+        def sign(w, kind, ep, payload):
+            return w.sign(_op_bytes(kind, w.address, ep, payload)).hex()
+
+        for w in wallets:
+            cl.request("register", addr=w.address,
+                       pubkey=w.public_bytes.hex(),
+                       tag=sign(w, "register", 0, b""))
+
+        def corrupting_pack(entries):
+            e = dict(entries)
+            k = sorted(e)[0]
+            a = np.array(e[k], np.float32).copy()
+            a.flat[0] += np.float32(0.25)
+            return pack_entries(dict(e, **{k: a}))
+
+        ctx = (mock.patch.object(_ls, "pack_entries", corrupting_pack)
+               if lie else _null_ctx())
+        walls = []
+        last = {}
+        with ctx:
+            for ep in range(rounds):
+                t0 = time.perf_counter()
+                committee = set(cl.request("committee")["committee"])
+                trainers = [w for w in wallets
+                            if w.address not in committee]
+                for i, w in enumerate(
+                        trainers[:cfg.needed_update_count]):
+                    blob = pack_pytree(
+                        {"W": np.full((64, 8), 0.01 * (i + 1 + ep),
+                                      np.float32),
+                         "b": np.full((8,), 0.001 * (i + 1),
+                                      np.float32)})
+                    d = _hl.sha256(blob).digest()
+                    payload = d + struct.pack("<qd", 10 + i, 1.0)
+                    cl.request("upload", addr=w.address, blob=blob,
+                               hash=d.hex(), n=10 + i, cost=1.0,
+                               epoch=ep,
+                               tag=sign(w, "upload", ep, payload))
+                nu = cfg.needed_update_count
+                for j, w in enumerate([w for w in wallets
+                                       if w.address in committee]):
+                    row = [0.5 + 0.01 * (j + u) for u in range(nu)]
+                    payload = struct.pack(f"<{nu}d", *row)
+                    last = cl.request("scores", addr=w.address,
+                                      epoch=ep, scores=row,
+                                      tag=sign(w, "scores", ep,
+                                               payload))
+                walls.append(time.perf_counter() - t0)
+                if lie:
+                    break           # one refused round is the drill
+        info = cl.request("info")
+        stats = [dict(v._rederiver.stats) if v._rederiver is not None
+                 else None for v in nodes]
+        per_validator_s = [s["seconds"] for s in stats if s]
+        return {
+            "mode": mode, "rounds_driven": len(walls),
+            "committed_epoch": info["epoch"],
+            "last_status": last.get("status"),
+            "wall_per_round_s": round(
+                sum(walls) / max(len(walls), 1), 4),
+            "rederive_s_per_validator_round": round(
+                sum(per_validator_s)
+                / max(len(per_validator_s) * len(walls), 1), 5)
+            if per_validator_s else 0.0,
+            "refusals": sum(s["refused"] for s in stats if s),
+            "skips": sum(s["skipped"] for s in stats if s),
+            "oks": sum(s["ok"] for s in stats if s),
+        }
+    finally:
+        if srv is not None:
+            srv.close()
+        for v in nodes:
+            v.close()
+        if saved is None:
+            os.environ.pop("BFLC_REDERIVE", None)
+        else:
+            os.environ["BFLC_REDERIVE"] = saved
+
+
+def _null_ctx():
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def rederive_config1(rounds: int = 3, validators: int = 4) -> Dict:
+    """The validator re-derivation plane's cost + enforcement axis
+    (bflc_demo_tpu.rederive): off / shard / full legs over the same
+    scripted fleet geometry — round wall overhead vs the off leg and
+    the per-validator re-derivation cost (shard must be cheaper than
+    full) — plus the refusal drill: a writer committing a corrupted
+    (self-consistent) model under shard mode must FAIL certification
+    with the committed epoch unmoved.  Rides bench.py `extra.rederive`."""
+    legs = {m: _rederive_scripted_rounds(m, rounds, validators)
+            for m in ("off", "shard", "full")}
+    drill = _rederive_scripted_rounds("shard", 1, validators, lie=True)
+    off_wall = max(legs["off"]["wall_per_round_s"], 1e-9)
+    return {
+        "rounds": rounds, "validators": validators,
+        "legs": legs,
+        "round_wall_overhead_shard_x": round(
+            legs["shard"]["wall_per_round_s"] / off_wall, 3),
+        "round_wall_overhead_full_x": round(
+            legs["full"]["wall_per_round_s"] / off_wall, 3),
+        "refusal_drill": {
+            "certified": drill["last_status"] not in ("CERT_TIMEOUT",),
+            "last_status": drill["last_status"],
+            "refusals": drill["refusals"],
+            "committed_epoch": drill["committed_epoch"],
+        },
+    }
 
 
 def certification_throughput(n_ops: int = 24, validators: int = 4,

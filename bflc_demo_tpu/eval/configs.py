@@ -46,6 +46,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                      cells: int = 0, cell_size: int = 0,
                      snapshot_interval: int = 0, snapshot_dir: str = "",
                      telemetry_dir: str = "", trace_sample: float = 0.0,
+                     rederive: str = "off",
                      **mesh_kw) -> SimulationResult:
     """Dispatch a federated run to the chosen runtime.
 
@@ -88,7 +89,8 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                          ("snapshot_interval", snapshot_interval),
                          ("snapshot_dir", snapshot_dir),
                          ("telemetry_dir", telemetry_dir),
-                         ("trace_sample", trace_sample)]
+                         ("trace_sample", trace_sample),
+                         ("rederive", rederive != "off" and rederive)]
     if runtime not in ("executor", "mesh"):
         # attestation exists on both mesh-family runtimes (default-on
         # where wallets exist); elsewhere an explicit request must error
@@ -151,7 +153,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
                 factory_kw=factory_kw or {},
                 bft_validators=bft_validators,
                 telemetry_dir=telemetry_dir, trace_sample=trace_sample,
-                verbose=verbose)
+                rederive=rederive, verbose=verbose)
         from bflc_demo_tpu.client.process_runtime import \
             run_federated_processes
         return run_federated_processes(
@@ -163,7 +165,7 @@ def run_with_runtime(model, shards, test_set, cfg, *, runtime: str = "mesh",
             snapshot_interval=snapshot_interval,
             snapshot_dir=snapshot_dir,
             telemetry_dir=telemetry_dir, trace_sample=trace_sample,
-            verbose=verbose)
+            rederive=rederive, verbose=verbose)
     if runtime == "executor":
         if not process_factory:
             raise ValueError("this preset does not support the 'executor' "

@@ -184,6 +184,26 @@ class CellAggregatorServer(LedgerServer):
                         "hash": hashlib.sha256(blob).digest(),
                         "tp": (obs_trace.TRACE.current_traceparent()
                                if obs_trace.TRACE.enabled else None)}
+        if self._rederive:
+            # validator re-derivation of CELL PARTIALS (rederive plane,
+            # one tier down): ship the cell-local evidence — the
+            # admitted member records WITH each member's own upload tag
+            # and self-authenticating pubkey, the committee medians and
+            # the selection — so a root validator can re-verify the
+            # #cellmeta digest binding, the member signatures, and
+            # re-run the deterministic partial from member blobs
+            # fetched off this aggregator's own read surface.  Member
+            # blobs are retained one round for exactly those fetches.
+            rows = self._member_evidence(epoch, updates)
+            self._outbox["cell_ev"] = ({
+                "epoch": epoch, "updates": rows,
+                "medians": [float(m) for m in pending.medians],
+                "selected": [int(s) for s in pending.selected],
+                "read_ep": [self.host, self.port]}
+                if rows is not None else None)
+            self._rederive_blobs = {
+                u.payload_hash: self._blobs[u.payload_hash]
+                for u in updates if u.payload_hash in self._blobs}
         self._partial_epoch = epoch
         if obs_health.health_armed():
             # member-level health at the CELL tier (obs.health): stats
@@ -212,6 +232,48 @@ class CellAggregatorServer(LedgerServer):
             print(f"[cell {self.cell_index}] epoch {epoch}: partial over "
                   f"{n_clients} clients ready ({dt * 1e3:.1f} ms)",
                   flush=True)
+
+    def _member_evidence(self, epoch: int, updates):
+        """[[sender, hash hex, n, cost, tag hex, pubkey hex], ...] in
+        ledger slot order — the member-signed admission listing a root
+        validator re-verifies (rederive.core.check_cell).  None when
+        any member's auth evidence is gone (a promoted cell aggregator
+        holds the chain but not the process-local tags): the bridge
+        then ships no evidence and validators degrade to the counted
+        skip instead of refusing an honest cell."""
+        from bflc_demo_tpu.ledger.tool import decode_op
+        want = {(u.sender, u.payload_hash): i
+                for i, u in enumerate(updates)}
+        rows = [None] * len(updates)
+        found = 0
+        base = getattr(self.ledger, "log_base", 0)
+        for pos in sorted(self._op_auth, reverse=True):
+            if found == len(updates):
+                break
+            if pos < base:
+                continue
+            try:
+                d = decode_op(self.ledger.log_op(pos))
+            except (ValueError, IndexError, struct.error):
+                continue
+            if d.get("op") != "upload" or d.get("epoch") != epoch:
+                continue
+            try:
+                key = (d["sender"], bytes.fromhex(d["payload_hash"]))
+            except (KeyError, ValueError):
+                continue
+            i = want.get(key)
+            if i is None or rows[i] is not None:
+                continue
+            a = self._op_auth[pos]
+            if not a.get("tag") or not a.get("pubkey"):
+                continue
+            u = updates[i]
+            rows[i] = [u.sender, u.payload_hash.hex(),
+                       int(u.n_samples), float(u.avg_cost),
+                       a["tag"], a["pubkey"]]
+            found += 1
+        return rows if found == len(updates) else None
 
     def _cell_health_round(self, epoch, updates, pending, by_slot,
                            partial) -> None:
@@ -420,7 +482,8 @@ class CellAggregatorServer(LedgerServer):
                                 cost=float(outbox["cost"]),
                                 epoch=repoch,
                                 tag=self._sign("upload", repoch,
-                                               payload))
+                                               payload),
+                                cell_ev=outbox.get("cell_ev"))
                         if obs_metrics.REGISTRY.enabled:
                             _M_ROOT_ACK.observe(
                                 time.perf_counter() - t0)

@@ -50,12 +50,15 @@ def _root_proc(cfg_kw: dict, initial_blob: bytes, port_q,
                stall_timeout_s: float, wal_path: str,
                cell_registry: dict, bft_endpoints: list, bft_keys: dict,
                verbose: bool, chaos_spec: Optional[dict] = None,
-               telemetry_spec: Optional[dict] = None) -> None:
+               telemetry_spec: Optional[dict] = None,
+               rederive: str = "") -> None:
     """The root coordinator: a plain LedgerServer whose clients are the
     cell aggregators (cell_registry arms the hier admission contract)."""
     _force_cpu_jax()
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
+    if rederive:
+        os.environ["BFLC_REDERIVE"] = rederive
     from bflc_demo_tpu.comm.ledger_service import LedgerServer
     server = LedgerServer(ProtocolConfig(**cfg_kw), initial_blob,
                           stall_timeout_s=stall_timeout_s,
@@ -75,13 +78,18 @@ def _cell_proc(cell_cfg_kw: dict, initial_blob: bytes, cell_index: int,
                val_x, val_y, root_bft_keys: dict, port: int, port_q,
                stall_timeout_s: float, verbose: bool,
                chaos_spec: Optional[dict] = None,
-               telemetry_spec: Optional[dict] = None) -> None:
+               telemetry_spec: Optional[dict] = None,
+               rederive: str = "") -> None:
     """One cell aggregator process (hier.aggregator): coordinator for its
     members on `port` (fixed, so members survive an aggregator restart),
     bridge client of the root."""
     _force_cpu_jax()
     _install_chaos(chaos_spec)
     _install_telemetry(telemetry_spec)
+    if rederive:
+        # the cell attaches member-signed evidence + retains member
+        # blobs so ROOT validators can re-derive its partial
+        os.environ["BFLC_REDERIVE"] = rederive
     from bflc_demo_tpu.comm.identity import Wallet
     from bflc_demo_tpu.hier.aggregator import CellAggregatorServer
     val = None
@@ -153,6 +161,7 @@ def run_federated_hier(
         chaos_dir: str = "",
         telemetry_dir: str = "",
         trace_sample: float = 0.0,
+        rederive: str = "off",
         verbose: bool = False) -> ProcessFederationResult:
     """Run a two-tier federation as OS processes.  Parent = sponsor.
 
@@ -178,6 +187,11 @@ def run_federated_hier(
     requires telemetry_dir) — a traced member op's context crosses the
     cell aggregator's bridge into the root tier, so one trace covers
     member -> cell -> root -> validators.
+    rederive: validator re-derivation plane mode (bflc_demo_tpu.rederive,
+    'off'|'shard'|'full') — ROOT validators re-derive every committed
+    model hash from the admitted cell partials AND every cell partial
+    from its member-signed deltas before co-signing; cells attach the
+    member-signed evidence.  'off' (default) pins today's posture.
     """
     import multiprocessing as mp
 
@@ -187,6 +201,10 @@ def run_federated_hier(
     if trace_sample and not telemetry_dir:
         raise ValueError("trace_sample > 0 needs telemetry_dir (the "
                          "spans land beside the telemetry artifacts)")
+    from bflc_demo_tpu.rederive import REDERIVE_MODES
+    if rederive not in REDERIVE_MODES:
+        raise ValueError(f"rederive must be one of {REDERIVE_MODES}, "
+                         f"got {rederive!r}")
     plan = plan_cells(len(shards), cells, cell_size)
     factory_kw = factory_kw or {}
     kill_cell_at_epoch = dict(kill_cell_at_epoch or {})
@@ -267,7 +285,9 @@ def run_federated_hier(
             args=(root_cfg_kw, master_seed + b"|bft-validator|"
                   + struct.pack("<q", v), v, q, bft_keys, verbose,
                   vport, _wire(f"validator-{v}"),
-                  _tspec(f"validator-{v}"), cell_registry),
+                  _tspec(f"validator-{v}"), cell_registry,
+                  rederive if rederive != "off" else "",
+                  initial_blob if rederive != "off" else b""),
             daemon=True)
         with _cpu_spawn_env():
             p.start()
@@ -292,7 +312,8 @@ def run_federated_hier(
                               or max(stall_timeout_s * 2, 8.0)),
                              wal_path, cell_registry, bft_endpoints,
                              bft_keys, verbose, _wire("writer"),
-                             _tspec("writer")),
+                             _tspec("writer"),
+                             rederive if rederive != "off" else ""),
                        daemon=True)
     with _cpu_spawn_env():
         root.start()
@@ -313,7 +334,8 @@ def run_federated_hier(
             args=(cc_kw, initial_blob, c, agg_seeds[c],
                   root_endpoints, model_factory, factory_kw,
                   vx, vy, bft_keys, cport, cq, stall_timeout_s,
-                  verbose, _wire(f"cell-{c}"), _tspec(f"cell-{c}")),
+                  verbose, _wire(f"cell-{c}"), _tspec(f"cell-{c}"),
+                  rederive if rederive != "off" else ""),
             daemon=True)
         with _cpu_spawn_env():
             p.start()

@@ -63,6 +63,10 @@ _G_BURN_SLOW = obs_metrics.REGISTRY.gauge(
 _G_ALERT_ACTIVE = obs_metrics.REGISTRY.gauge(
     "slo_alert_active", "1 while an alert excursion is latched",
     ("slo",))
+_C_NOTIFY = obs_metrics.REGISTRY.counter(
+    "slo_notify_total",
+    "operator notify commands spawned per alert, by outcome",
+    ("result",))
 
 
 def slo_legacy() -> bool:
@@ -176,13 +180,24 @@ class SLOEngine:
     (rewritten atomically per alert)."""
 
     def __init__(self, slos: Optional[List[SLOSpec]] = None, *,
-                 jsonl_path: str = "", keep_alerts: int = 256):
+                 jsonl_path: str = "", keep_alerts: int = 256,
+                 notify_cmd: Optional[str] = None):
         self.slos = list(slos if slos is not None else default_slos())
         self.jsonl_path = jsonl_path
         self._state = {s.name: _SLOState(s) for s in self.slos}
         self.alerts: List[dict] = []
         self.keep_alerts = int(keep_alerts)
         self.rounds = 0
+        # alert routing beyond file/exit-code (--notify-cmd /
+        # BFLC_SLO_NOTIFY_CMD): one operator command spawned PER ALERT
+        # with the alerts.jsonl record on stdin — the hook a pager /
+        # webhook bridge hangs off.  Failure-isolated: a broken command
+        # is counted (`slo_notify_total{result=...}`), never raised —
+        # alerting must not be able to kill the judge.
+        self.notify_cmd = (notify_cmd if notify_cmd is not None
+                          else os.environ.get("BFLC_SLO_NOTIFY_CMD", ""))
+        self.notified = 0
+        self.notify_failures = 0
 
     # ------------------------------------------------------------- judge
     def observe_round(self, summary: Dict[str, Any],
@@ -260,7 +275,52 @@ class SLOEngine:
             burn_fast=round(fast, 3), burn_slow=round(slow, 3))
         obs_flight.FLIGHT.flush("slo_alert")
         self._write_alerts()
+        self._notify(alert)
         return alert
+
+    def _notify(self, alert: dict) -> None:
+        """Spawn the operator's notify command with the alert record on
+        stdin (one JSON line — the exact alerts.jsonl shape).  The
+        child runs detached through a shell so operators can write
+        `--notify-cmd 'curl -s -d @- https://pager/...'` one-liners;
+        feeding stdin happens on a reaper thread so a slow or wedged
+        pager can never block the judging path."""
+        if not self.notify_cmd:
+            return
+        import subprocess
+        import threading
+        try:
+            proc = subprocess.Popen(
+                self.notify_cmd, shell=True,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except (OSError, ValueError):
+            self.notify_failures += 1
+            _C_NOTIFY.inc(result="spawn_error")
+            return
+        payload = (json.dumps(alert) + "\n").encode()
+
+        def _feed():
+            ok = False
+            try:
+                proc.communicate(payload, timeout=30.0)
+                ok = proc.returncode == 0
+            except Exception:       # noqa: BLE001 — failure-isolated
+                try:
+                    proc.kill()
+                    # reap the killed child, or an alert storm against
+                    # a hung pager accumulates one zombie per page
+                    proc.communicate()
+                except (OSError, ValueError):
+                    pass
+            if ok:
+                _C_NOTIFY.inc(result="ok")
+            else:
+                self.notify_failures += 1
+                _C_NOTIFY.inc(result="failed")
+
+        self.notified += 1
+        threading.Thread(target=_feed, daemon=True).start()
 
     def _write_alerts(self) -> None:
         """Persist every retained alert atomically (tmp-then-rename,
@@ -285,6 +345,8 @@ class SLOEngine:
         return {
             "rounds_judged": self.rounds,
             "alerts": len(self.alerts),
+            "notified": self.notified,
+            "notify_failures": self.notify_failures,
             "slos": {
                 name: {"judged": st.judged, "breaches": st.breaches,
                        "alerts": st.alerts, "active": st.active,

@@ -1,0 +1,84 @@
+"""Validator re-derivation plane: close the last writer-trust axis.
+
+Every other writer claim is re-executed by the BFT quorum before it
+binds (comm.bft: admission guards, client tags, staleness stamps, cell
+registry bounds, sparse blob decodes, snapshot digests) — but the
+commit op's model HASH has always been taken on writer authority:
+validators hold no payload blobs, so `validate_op` can only check the
+epoch, not the arithmetic (PARITY.md trust divergence 2 and the
+divergence-5 async note).  This package closes that axis: validators
+join the data-plane read fan-out as CONSUMERS (comm.dataplane
+ReadRouter + BlobCache against standby replicas with coordinator
+fallback, every blob hash-verified against upload ops the quorum
+already co-signed), re-run the ONE deterministic decode chain
+(`densify_entries` ∘ `dequantize_entries`) plus REDUCTION SPEC v1
+weighted FedAvg (meshagg.spec — the normative merge arithmetic, byte-
+deterministic across legs by construction), and REFUSE to co-sign a
+commit whose model hash they cannot reproduce.
+
+Three validator-local modes (`BFLC_REDERIVE` / `--rederive`):
+
+- ``off`` (default) — today's guard-check posture, bytes unchanged;
+- ``shard`` — each validator re-derives a deterministic LEAF SUBSET
+  (rederive.shards): shards are a pure function of (leaf count,
+  validator count, epoch), the validator set's union covers every leaf
+  with >= min(n, max(2, 2f+1))-way overlap, and any per-leaf
+  disagreement
+  escalates that validator to FULL re-derivation before voting.  The
+  2f+1 coverage is what makes f colluding validators powerless: any
+  wrong leaf is covered by >= f+1 HONEST validators, whose refusals
+  alone push the signer count below the 2f+1 quorum
+  (n - (f+1) < 2f+1 at the PBFT geometry n = 3f+1).  Per-validator
+  compute is coverage/n of the model — sublinear in model size as the
+  validator set grows at fixed f;
+- ``full`` — every validator re-derives every leaf (the maximal
+  posture; shard is the recommended production mode).
+
+Liveness is non-negotiable: blob unavailability (every serving
+replica dead, a pre-plane writer sending no evidence) degrades to the
+historical guard-check with a counted `rederive_skipped_total` plus a
+flight-recorder WARN — never a wedge; certified-backlog and rejoin
+ops admit on their certificate exactly like the sparse-evidence path;
+and `BFLC_REDERIVE_LEGACY=1` (or mode ``off``) pins the plane off with
+certified bytes unchanged.  The residual axis is stated honestly in
+PARITY.md: a writer that WITHHOLDS the bytes converts a silent lie
+into a counted, alarmed degrade — the operator pages on the skip
+counter instead of trusting silence.
+
+The plane also carries the health-enforcement half (ROADMAP PR-11
+follow-on): validators re-derive nonfinite/L2 statistics from the same
+fetched rows and refuse certification outright on a NaN/Inf aggregate
+— a poisoned-delta writer that previously certified garbage is now
+refused by every honest armed validator.
+"""
+
+from __future__ import annotations
+
+import os
+
+REDERIVE_MODES = ("off", "shard", "full")
+
+
+def rederive_legacy() -> bool:
+    """BFLC_REDERIVE_LEGACY=1 pins the plane off regardless of mode —
+    the benchmark/golden-pin baseline switch, same shape as every other
+    legacy pin in this repo."""
+    return bool(os.environ.get("BFLC_REDERIVE_LEGACY"))
+
+
+def rederive_mode() -> str:
+    """The ONE mode-resolution point: BFLC_REDERIVE in {off, shard,
+    full}, 'off' on anything unknown (a typo must degrade to today's
+    posture, never crash a validator), and the legacy pin wins."""
+    if rederive_legacy():
+        return "off"
+    mode = os.environ.get("BFLC_REDERIVE", "off").strip().lower()
+    return mode if mode in REDERIVE_MODES else "off"
+
+
+def rederive_armed() -> bool:
+    """True when this process participates in the plane: validators
+    re-derive before voting, writers attach commit evidence (the
+    claimed model blob + read set) and retain the round's blobs for
+    validator fetches."""
+    return rederive_mode() != "off"

@@ -1,0 +1,686 @@
+"""The validator-side re-derivation engine (see package docstring).
+
+`Rederiver` is owned by a `comm.bft.ValidatorNode`.  For every commit
+op (sync opcode 4, async opcode 12) it:
+
+1. pins the CLAIMED new-model blob — the vote request's ``mblob``
+   evidence hash-bound to the op's embedded model hash, or a
+   content-addressed fetch of that hash (a writer cannot substitute
+   bytes: the hash IS the claim);
+2. reconstructs the merge inputs from the validator's OWN replica —
+   the admitted update set, the committee selection, the weights
+   (sync: n_samples; async: ``n/sqrt(1+s)`` re-derived from the
+   CERTIFIED staleness stamps via `ledger.async_selection`, never
+   trusted from the writer) and the previous model (the blob verified
+   last round, the provisioned genesis blob, or a hash-verified fetch);
+3. fetches the selected deltas' payload blobs through the data-plane
+   read path (`comm.dataplane.ReadRouter` + `BlobCache` over the
+   advertised read set, coordinator fallback) — every blob verified
+   against the payload hash of an upload op this validator already
+   co-signed;
+4. decodes through the ONE chain the writer used
+   (``densify_entries ∘ dequantize_entries``, `split_cellmeta` on a
+   hier root) and re-runs REDUCTION SPEC v1 via the same
+   `meshagg.ENGINE` — byte-identical across legs by construction — for
+   its leaf shard (`rederive.shards`) or the full model;
+5. refuses (status ``REDERIVE``) on any byte mismatch — a shard
+   mismatch first ESCALATES to full re-derivation so the refusal names
+   every diverging leaf — and on a NaN/Inf aggregate (the
+   health-enforcement half: a poisoned delta that certifies garbage
+   today is refused here even though its bytes "match").
+
+Unselected slots never need their blobs: REDUCTION SPEC v1 adds them
+as masked +0.0 terms, so a zeros row of the right shape is
+byte-equivalent — the validator fetches only `aggregate_count` blobs
+per round, not `needed_update_count`.
+
+Degrade contract: anything UNAVAILABLE (no evidence from a pre-plane
+writer, every serving replica dead, a fetch miss) counts
+`rederive_skipped_total`, records a flight WARN, and signs on the
+historical guard-check — liveness over enforcement, but never
+silently.  Anything PRESENT-BUT-WRONG refuses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs import trace as obs_trace
+from bflc_demo_tpu.rederive.shards import leaf_shard, shard_coverage
+from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                               dequantize_entries,
+                                               sparse_enabled,
+                                               unpack_pytree)
+
+Endpoint = Tuple[str, int]
+
+_OP_COMMIT, _OP_ACOMMIT = 4, 12
+_ZERO_HASH = b"\0" * 32
+
+_M_SECONDS = obs_metrics.REGISTRY.histogram(
+    "rederive_seconds",
+    "validator-side commit re-derivation wall time (fetch + decode + "
+    "spec merge + compare)", ("mode",))
+_C_BYTES = obs_metrics.REGISTRY.counter(
+    "rederive_bytes_total",
+    "blob bytes consumed by the validator re-derivation fetch path")
+_C_REFUSE = obs_metrics.REGISTRY.counter(
+    "rederive_refusals_total",
+    "commit votes refused by re-derivation", ("reason",))
+_C_SKIP = obs_metrics.REGISTRY.counter(
+    "rederive_skipped_total",
+    "commits signed on guard-check only because re-derivation inputs "
+    "were unavailable (the counted, alarmed degrade)", ("reason",))
+_G_COVERAGE = obs_metrics.REGISTRY.gauge(
+    "rederive_shard_coverage",
+    "validators re-deriving each leaf at this quorum geometry")
+
+
+def crosscheck_rl(rls: Dict[int, Dict[str, str]]) -> List[str]:
+    """Leaf keys whose per-leaf digests DISAGREE across validators'
+    vote metadata — the certificate-side cross-check.  Honest votes can
+    never disagree (each digest is of leaves that matched the one
+    claimed blob), so a non-empty result fingerprints a lying or buggy
+    validator for the forensic record; safety never rests on it (the
+    coverage arithmetic in rederive.shards does that)."""
+    seen: Dict[str, str] = {}
+    bad: List[str] = []
+    for _v, rl in sorted(rls.items()):
+        if not isinstance(rl, dict):
+            continue
+        for key, dig in rl.items():
+            if key in seen:
+                if seen[key] != dig and key not in bad:
+                    bad.append(key)
+            else:
+                seen[key] = str(dig)
+    return bad
+
+
+class BlobFetcher:
+    """Content-addressed fetches for a validator: one `ReadRouter` per
+    CONTROL endpoint (the coordinator, or a cell's read surface on a
+    hier root — kept in a small bounded map so alternating cell/commit
+    fetches don't thrash connections), shared `BlobCache`, every byte
+    hash-verified by the router.  The evidence on each vote names the
+    CURRENT endpoints, so the fetch path follows the fleet with no
+    validator-side configuration.
+
+    One lock serializes the whole fetch: the cell-partial checks run
+    OUTSIDE the validator's main lock on per-connection threads while a
+    commit check holds it, and ReadRouter's connection state is not
+    thread-safe — a torn router mid-fetch would masquerade as an
+    unavailability skip (silently disabling enforcement).  The decode +
+    spec-merge compute stays parallel; only the wire part serializes."""
+
+    _MAX_ROUTERS = 8
+
+    def __init__(self, timeout_s: float = 8.0,
+                 cache_bytes: int = 64 << 20):
+        import collections
+        import threading
+        from bflc_demo_tpu.comm.dataplane import BlobCache
+        self.cache = BlobCache(cache_bytes)
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._routers: "collections.OrderedDict[Endpoint, object]" = \
+            collections.OrderedDict()
+
+    def _close_router(self, router) -> None:
+        try:
+            router.close()
+            router.control.close()
+        except Exception:       # noqa: BLE001 — teardown best-effort
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            for router in self._routers.values():
+                self._close_router(router)
+            self._routers.clear()
+
+    def _router_for(self, read_set: Sequence[Endpoint],
+                    coordinator: Optional[Endpoint]):
+        """Caller holds self._lock."""
+        from bflc_demo_tpu.comm.dataplane import ReadRouter
+        from bflc_demo_tpu.comm.ledger_service import CoordinatorClient
+        control = coordinator or (read_set[0] if read_set else None)
+        if control is None:
+            return None
+        control = (str(control[0]), int(control[1]))
+        router = self._routers.get(control)
+        if router is None:
+            router = ReadRouter(
+                CoordinatorClient(control[0], control[1],
+                                  timeout_s=self.timeout_s),
+                cache=self.cache, timeout_s=self.timeout_s)
+            self._routers[control] = router
+            while len(self._routers) > self._MAX_ROUTERS:
+                _, old = self._routers.popitem(last=False)
+                self._close_router(old)
+        else:
+            self._routers.move_to_end(control)
+        router.note_read_set(
+            {"read_set": [list(ep) for ep in read_set]})
+        return router
+
+    def fetch(self, hashes: Sequence[str], read_set: Sequence[Endpoint],
+              coordinator: Optional[Endpoint]
+              ) -> Optional[Dict[str, bytes]]:
+        """{hex hash: verified bytes} for every hash, or None when any
+        remained unavailable (the caller degrades, counted)."""
+        if not hashes:
+            return {}
+        with self._lock:
+            router = self._router_for(read_set, coordinator)
+            if router is None:
+                return None
+            try:
+                out = router.fetch_blobs(list(hashes))
+            except (LookupError, ConnectionError, OSError):
+                return None
+        if obs_metrics.REGISTRY.enabled:
+            _C_BYTES.inc(sum(len(b) for b in out.values()))
+        return out
+
+
+def _evidence_endpoints(auth: Optional[dict]
+                        ) -> Tuple[List[Endpoint], Optional[Endpoint]]:
+    """(read set, coordinator endpoint) from commit-vote evidence."""
+    rs: List[Endpoint] = []
+    co: Optional[Endpoint] = None
+    if isinstance(auth, dict):
+        for ep in auth.get("rs") or ():
+            try:
+                rs.append((str(ep[0]), int(ep[1])))
+            except (TypeError, ValueError, IndexError):
+                continue
+        try:
+            if auth.get("co"):
+                co = (str(auth["co"][0]), int(auth["co"][1]))
+        except (TypeError, ValueError, IndexError):
+            co = None
+    return rs, co
+
+
+def derive_leaves(global_flat: Dict[str, np.ndarray],
+                  flats_by_slot: List[Optional[Dict[str, np.ndarray]]],
+                  weights: Sequence[float], selected: Sequence[int],
+                  lr: float, keys: Sequence[str]
+                  ) -> Dict[str, np.ndarray]:
+    """REDUCTION SPEC v1 writer merge restricted to `keys`, through the
+    SAME `meshagg.ENGINE` the writer runs — byte-identical per leaf by
+    construction (the reduction is leaf-independent).  Slots whose flat
+    is None (unselected — their blobs were never fetched) substitute a
+    shared zeros image: spec step 4 adds them as masked +0.0 terms, so
+    the bytes cannot depend on their real content."""
+    from bflc_demo_tpu.meshagg import spec
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+    zeros = {k: np.zeros(np.asarray(global_flat[k]).shape, np.float32)
+             for k in keys}
+    flats = [({k: f[k] for k in keys} if f is not None else zeros)
+             for f in flats_by_slot]
+    w = spec.merge_weight_vector(weights, selected, len(flats))
+    wsum = max(float(w.sum()), 1e-12)
+    accs = ENGINE.weighted_sum(list(keys), flats, w, wsum)
+    return spec.apply_step({k: global_flat[k] for k in keys}, accs, lr)
+
+
+def rederive_model_flat(prev_blob: bytes, delta_blobs: List[bytes],
+                        weights: Sequence[float],
+                        selected: Sequence[int], lr: float, *,
+                        sparse: bool = False,
+                        keys: Optional[Sequence[str]] = None
+                        ) -> Dict[str, np.ndarray]:
+    """The standalone validator-path merge over raw blob bytes — what
+    tools/check_reduction_spec.py differentials against the writer path
+    and the drill reuses.  Decodes each SELECTED blob through the one
+    chain, zeros the rest, and derives `keys` (default: all)."""
+    global_flat = unpack_pytree(prev_blob)
+    all_keys = sorted(global_flat.keys())
+    sel = set(int(s) for s in selected)
+    flats: List[Optional[Dict[str, np.ndarray]]] = []
+    for i, blob in enumerate(delta_blobs):
+        if i not in sel or blob is None:
+            flats.append(None)
+            continue
+        flat = dequantize_entries(unpack_pytree(blob))
+        if sparse:
+            flat = densify_entries(flat)
+        flats.append(flat)
+    return derive_leaves(global_flat, flats, weights, list(selected),
+                         lr, list(keys) if keys is not None else all_keys)
+
+
+class Rederiver:
+    """One validator's re-derivation state + verdict engine.
+
+    `check` / `check_cell` are called with the validator's lock held —
+    the replica state they read (pending selection, async buffer) is
+    exactly the certified prefix below the op being voted, and commits
+    are one or two ops per round, so the bounded fetch latency sits
+    where a round's one certification round-trip already does."""
+
+    def __init__(self, mode: str, index: int, n_validators: int, cfg, *,
+                 initial_model_blob: Optional[bytes] = None,
+                 cell_registry: Optional[dict] = None,
+                 timeout_s: float = 8.0):
+        self.mode = mode
+        self.index = int(index)
+        self.n = max(int(n_validators), 1)
+        self.cfg = cfg
+        self._sparse = sparse_enabled(cfg)
+        self._cell = cell_registry is not None
+        self._initial_blob = initial_model_blob
+        # (hash, blob) of the model this validator last VERIFIED — the
+        # next round's previous-model input with zero fetches; the
+        # verification chains round over round from the genesis blob
+        self._verified: Optional[Tuple[bytes, bytes]] = None
+        self.fetcher = BlobFetcher(timeout_s=timeout_s)
+        self.stats = {"ok": 0, "refused": 0, "skipped": 0,
+                      "escalated": 0, "cell_ok": 0, "cell_refused": 0,
+                      "cell_skipped": 0, "seconds": 0.0}
+        if obs_metrics.REGISTRY.enabled:
+            _G_COVERAGE.set(shard_coverage(self.n))
+
+    def close(self) -> None:
+        self.fetcher.close()
+
+    # ------------------------------------------------------------ verdicts
+    def _skip(self, reason: str) -> Tuple[str, None]:
+        """Degrade to guard-check: counted + WARNed, never a wedge."""
+        self.stats["skipped"] += 1
+        _C_SKIP.inc(reason=reason)
+        obs_flight.FLIGHT.record(
+            "event", "rederive_skipped", level="WARN", reason=reason,
+            validator=self.index)
+        return "", None
+
+    def _refuse(self, reason: str, detail: str) -> Tuple[str, None]:
+        self.stats["refused"] += 1
+        _C_REFUSE.inc(reason=reason)
+        obs_flight.FLIGHT.record(
+            "event", "rederive_refused", reason=reason, detail=detail,
+            validator=self.index)
+        obs_flight.FLIGHT.flush("rederive_refused")
+        return f"rederive/{reason}: {detail}", None
+
+    # ------------------------------------------------------------- commits
+    def check(self, ledger, op: bytes, auth: Optional[dict]
+              ) -> Tuple[str, Optional[dict]]:
+        """('', rl or None) to sign — rl carries the per-leaf digests
+        of a successful re-derivation (None on a counted skip); a
+        non-empty reason string refuses the vote (status REDERIVE)."""
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.TRACE.span("rederive", mode=self.mode):
+                return self._check_inner(ledger, op, auth)
+        finally:
+            dt = time.perf_counter() - t0
+            self.stats["seconds"] += dt
+            if obs_metrics.REGISTRY.enabled:
+                _M_SECONDS.observe(dt, mode=self.mode)
+
+    def _check_inner(self, ledger, op: bytes, auth: Optional[dict]
+                     ) -> Tuple[str, Optional[dict]]:
+        body = op[1:]
+        try:
+            claimed_hash = bytes(body[:32])
+            epoch, = struct.unpack_from("<q", body, 32)
+        except struct.error:
+            return "", None             # malformed: validate_op refuses
+        # merge inputs from OUR replica (the certified prefix).  A state
+        # the guards will refuse anyway (wrong epoch, no pending) is not
+        # re-derivable and not a degrade — let validate_op speak.
+        if epoch != ledger.epoch:
+            return "", None
+        if op[0] == _OP_COMMIT:
+            pending = getattr(ledger, "pending", lambda: None)()
+            updates_fn = getattr(ledger, "query_all_updates", None)
+            if pending is None or updates_fn is None:
+                return "", None
+            updates = updates_fn()
+            if not updates:
+                return "", None
+            hashes = [u.payload_hash for u in updates]
+            weights = [u.n_samples for u in updates]
+            selected = list(pending.selected)
+            senders = [u.sender for u in updates]
+        else:                           # _OP_ACOMMIT
+            sel_fn = getattr(ledger, "async_selection", None)
+            try:
+                k, = struct.unpack_from("<q", body, 40)
+            except struct.error:
+                return "", None
+            if sel_fn is None or not 0 < k <= ledger.async_buffer_depth:
+                return "", None
+            # FedBuff weights n/sqrt(1+s) re-derived from the CERTIFIED
+            # staleness stamps on our own replica — never trusted
+            entries, selected, weights, _loss = sel_fn(k)
+            hashes = [e.payload_hash for e in entries]
+            selected = list(selected)
+            senders = [e.sender for e in entries]
+
+        rs, co = _evidence_endpoints(auth)
+        # 1. the claimed new-model blob, hash-bound to the op
+        claimed_blob = None
+        if isinstance(auth, dict) and auth.get("mblob"):
+            try:
+                claimed_blob = bytes.fromhex(auth["mblob"])
+            except (TypeError, ValueError):
+                return self._refuse("evidence",
+                                    "unparseable mblob evidence")
+            if hashlib.sha256(claimed_blob).digest() != claimed_hash:
+                return self._refuse(
+                    "evidence", "mblob evidence does not hash to the "
+                                "op's model hash")
+        if claimed_blob is None:
+            with obs_trace.TRACE.span("rederive.fetch", what="claimed"):
+                got = self.fetcher.fetch([claimed_hash.hex()], rs, co)
+            if not got:
+                return self._skip("claimed_model_unavailable")
+            claimed_blob = got[claimed_hash.hex()]
+        # 2. the previous model this commit claims to have advanced
+        prev_hash = bytes(ledger.query_global_model()[0])
+        prev_blob = self._previous_blob(prev_hash, rs, co)
+        if prev_blob is None:
+            return self._skip("previous_model_unavailable")
+        try:
+            global_flat = unpack_pytree(prev_blob)
+            claimed_flat = unpack_pytree(claimed_blob)
+        except (ValueError, struct.error) as e:
+            return self._refuse("decode", f"model blob refused: {e}")
+        keys = sorted(global_flat.keys())
+        err = _schema_mismatch(keys, global_flat, claimed_flat)
+        if err:
+            return self._refuse("schema", err)
+        # 3. the selected deltas' payload blobs (hashes we co-signed)
+        need = sorted({hashes[s].hex() for s in selected})
+        with obs_trace.TRACE.span("rederive.fetch", what="deltas",
+                                  n=len(need)):
+            blobs = self.fetcher.fetch(need, rs, co)
+        if blobs is None:
+            return self._skip("delta_blobs_unavailable")
+        flats: List[Optional[Dict[str, np.ndarray]]] = []
+        sel = set(selected)
+        for i, h in enumerate(hashes):
+            if i not in sel:
+                flats.append(None)
+                continue
+            try:
+                flat = dequantize_entries(unpack_pytree(blobs[h.hex()]))
+                if self._sparse:
+                    flat = densify_entries(flat)
+                if self._cell:
+                    from bflc_demo_tpu.hier.partial import split_cellmeta
+                    flat = split_cellmeta(flat)[0]
+            except (ValueError, TypeError, struct.error) as e:
+                # the quorum certified this upload's HASH; bytes that
+                # match the hash but refuse the one decode chain mean
+                # the writer admitted garbage — present-but-wrong
+                return self._refuse(
+                    "decode", f"admitted delta {h.hex()[:12]} refused "
+                              f"by the decode chain: {e}")
+            flats.append(flat)
+        # 4. derive + compare (shard first, escalate on disagreement)
+        my_keys = (keys if self.mode == "full" or self.n <= 1
+                   else leaf_shard(keys, self.index, self.n, epoch))
+        lr = self.cfg.learning_rate
+        with obs_trace.TRACE.span("rederive.derive", leaves=len(my_keys)):
+            derived = derive_leaves(global_flat, flats, weights,
+                                    selected, lr, my_keys)
+        bad = _diverging_leaves(derived, claimed_flat)
+        if bad and self.mode != "full" and len(my_keys) < len(keys):
+            # per-leaf disagreement escalates THIS validator to full
+            # re-derivation before voting: the refusal then names every
+            # diverging leaf, not just this shard's
+            self.stats["escalated"] += 1
+            rest = [k for k in keys if k not in set(my_keys)]
+            with obs_trace.TRACE.span("rederive.derive", escalated=1,
+                                      leaves=len(rest)):
+                derived.update(derive_leaves(global_flat, flats,
+                                             weights, selected, lr,
+                                             rest))
+            bad = _diverging_leaves(derived, claimed_flat)
+        if bad:
+            return self._refuse(
+                "mismatch",
+                f"committed model hash is not the spec merge of the "
+                f"admitted set (diverging leaves: {bad[:4]}"
+                f"{'...' if len(bad) > 4 else ''})")
+        # 5. health enforcement: a byte-exact NaN/Inf aggregate still
+        # refuses — the poisoned-delta writer that certifies garbage.
+        # The refusal re-derives the per-row stats (nonfinite counts +
+        # L2 over the fetched rows, the same statistics the writer's
+        # health plane computes advisorily) so the page names WHO.
+        nonfinite = [k for k, a in derived.items()
+                     if np.issubdtype(np.asarray(a).dtype, np.floating)
+                     and not np.all(np.isfinite(a))]
+        if nonfinite:
+            culprits, l2s = _row_stats(flats, senders, my_keys)
+            return self._refuse(
+                "nonfinite",
+                f"aggregate contains NaN/Inf in leaves "
+                f"{nonfinite[:4]} (nonfinite rows from: "
+                f"{culprits[:4] or ['<aggregate-only>']}; "
+                f"row L2s: {l2s[:4]})")
+        # verified: this blob becomes next round's previous model
+        self._verified = (claimed_hash, claimed_blob)
+        self.fetcher.cache.put(claimed_hash.hex(), claimed_blob)
+        self.stats["ok"] += 1
+        rl = {k: hashlib.sha256(
+                  np.ascontiguousarray(derived[k]).tobytes()
+              ).hexdigest()[:16] for k in my_keys}
+        return "", {"mode": self.mode, "leaves": rl}
+
+    def _previous_blob(self, prev_hash: bytes, rs, co
+                       ) -> Optional[bytes]:
+        if self._verified is not None and self._verified[0] == prev_hash:
+            return self._verified[1]
+        if prev_hash == _ZERO_HASH:
+            # genesis: the chain has never committed — the previous
+            # model is the provisioned initial blob (configuration,
+            # like the validator keys)
+            return self._initial_blob
+        cached = self.fetcher.cache.get(prev_hash.hex())
+        if cached is not None:
+            return cached
+        with obs_trace.TRACE.span("rederive.fetch", what="prev_model"):
+            got = self.fetcher.fetch([prev_hash.hex()], rs, co)
+        return got[prev_hash.hex()] if got else None
+
+    # ---------------------------------------------------- hier cell tier
+    def check_cell(self, op: bytes, auth: Optional[dict]) -> str:
+        """'' to proceed; a reason string refuses a ROOT-tier cell
+        upload whose partial is not the deterministic FedAvg of its
+        member-signed deltas (PARITY divergence 4's re-derivable half,
+        one tier down).  Pure function of (op, auth) + the cell's read
+        surface — runs OUTSIDE the validator lock like the sparse
+        check.  Counted skip when the evidence or member blobs are
+        unavailable (a pre-plane cell, a dead aggregator)."""
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.TRACE.span("rederive.cell"):
+                err = self._check_cell_inner(op, auth)
+            if err:
+                self.stats["cell_refused"] += 1
+                _C_REFUSE.inc(reason="cell")
+            return err
+        finally:
+            dt = time.perf_counter() - t0
+            self.stats["seconds"] += dt
+            if obs_metrics.REGISTRY.enabled:
+                _M_SECONDS.observe(dt, mode="cell")
+
+    def _cell_skip(self, reason: str) -> str:
+        self.stats["cell_skipped"] += 1
+        _C_SKIP.inc(reason=reason)
+        obs_flight.FLIGHT.record(
+            "event", "rederive_skipped", level="WARN", reason=reason,
+            validator=self.index)
+        return ""
+
+    def _check_cell_inner(self, op: bytes, auth: Optional[dict]) -> str:
+        from bflc_demo_tpu.comm.identity import (_op_bytes, address_of,
+                                                 verify_signature)
+        from bflc_demo_tpu.hier.partial import (cell_evidence_digest,
+                                                cell_partial,
+                                                partial_blob,
+                                                split_cellmeta)
+        body = op[1:]
+        try:
+            slen, = struct.unpack_from("<q", body, 0)
+            payload_hash = body[8 + slen:8 + slen + 32]
+            op_n, = struct.unpack_from("<q", body, 8 + slen + 32)
+        except struct.error:
+            return ""                   # malformed: earlier checks speak
+        ev = auth.get("cell") if isinstance(auth, dict) else None
+        if not isinstance(ev, dict):
+            return self._cell_skip("cell_evidence_missing")
+        try:
+            blob = bytes.fromhex(auth.get("blob", ""))
+        except (TypeError, ValueError):
+            blob = b""
+        if not blob:
+            return self._cell_skip("cell_blob_missing")
+        if hashlib.sha256(blob).digest() != payload_hash:
+            return ("rederive/cell: partial blob evidence does not "
+                    "match the op's payload hash")
+        try:
+            flat = unpack_pytree(blob)
+            if self._sparse:
+                flat = densify_entries(flat)
+            partial_claimed, meta = split_cellmeta(flat)
+        except (ValueError, struct.error) as e:
+            return f"rederive/cell: partial blob refused: {e}"
+        if meta is None:
+            return "rederive/cell: partial without #cellmeta"
+        cell_index, n_clients, digest = meta
+        try:
+            cepoch = int(ev["epoch"])
+            listing = [(str(s), bytes.fromhex(h), int(n), float(c),
+                        bytes.fromhex(t), bytes.fromhex(p))
+                       for s, h, n, c, t, p in ev["updates"]]
+            medians = [float(m) for m in ev["medians"]]
+            selected = [int(s) for s in ev["selected"]]
+            read_ep = (str(ev["read_ep"][0]), int(ev["read_ep"][1]))
+        except (KeyError, TypeError, ValueError, IndexError) as e:
+            return f"rederive/cell: malformed evidence ({e})"
+        # the evidence listing is bound to the CERTIFIED bytes through
+        # the #cellmeta digest the aggregator signed — recompute it
+        want = cell_evidence_digest(
+            cepoch, cell_index,
+            [(s, h, n, c) for s, h, n, c, _t, _p in listing],
+            medians, selected)
+        if want != digest:
+            return ("rederive/cell: evidence listing does not match "
+                    "the certified #cellmeta digest")
+        if not selected or len(selected) != n_clients \
+                or n_clients != op_n:
+            return (f"rederive/cell: selected count {len(selected)} / "
+                    f"#cellmeta {n_clients} / op weight {op_n} disagree")
+        # member-SIGNED deltas: each admitted record must carry the
+        # member's own upload tag over exactly (hash, n, cost) at the
+        # cell epoch, under a self-authenticating key
+        for s, h, n, c, tag, pub in listing:
+            if address_of(pub) != s:
+                return (f"rederive/cell: member {s[:12]} "
+                        f"address/pubkey mismatch")
+            payload = h + struct.pack("<qd", n, c)
+            if not verify_signature(pub, _op_bytes("upload", s, cepoch,
+                                                   payload), tag):
+                return f"rederive/cell: member {s[:12]} tag unverifiable"
+        if any(not 0 <= s < len(listing) for s in selected):
+            return "rederive/cell: selection indexes outside the listing"
+        need = sorted({listing[s][1].hex() for s in selected})
+        with obs_trace.TRACE.span("rederive.fetch", what="members",
+                                  n=len(need)):
+            blobs = self.fetcher.fetch(need, [read_ep], None)
+        if blobs is None:
+            return self._cell_skip("member_blobs_unavailable")
+        admitted = []
+        for s in selected:
+            sender, h, n, c, _t, _p = listing[s]
+            try:
+                mflat = dequantize_entries(unpack_pytree(blobs[h.hex()]))
+                if self._sparse:
+                    mflat = densify_entries(mflat)
+            except (ValueError, TypeError, struct.error) as e:
+                return (f"rederive/cell: member delta {h.hex()[:12]} "
+                        f"refused by the decode chain: {e}")
+            admitted.append((sender, mflat, n, c))
+        try:
+            partial, n2, _cost = cell_partial(admitted)
+            rederived = partial_blob(
+                partial, cell_index, n2, digest,
+                density=(self.cfg.delta_density if self._sparse
+                         else 1.0))
+        except ValueError as e:
+            return f"rederive/cell: partial re-derivation refused: {e}"
+        if hashlib.sha256(rederived).digest() != payload_hash:
+            return ("rederive/cell: partial is not the deterministic "
+                    "FedAvg of its member-signed deltas")
+        bad = [k for k, a in partial.items()
+               if np.issubdtype(np.asarray(a).dtype, np.floating)
+               and not np.all(np.isfinite(a))]
+        if bad:
+            return (f"rederive/cell: re-derived partial is nonfinite "
+                    f"in leaves {bad[:4]}")
+        self.stats["cell_ok"] += 1
+        return ""
+
+
+def _schema_mismatch(keys: List[str], global_flat, claimed_flat) -> str:
+    if sorted(claimed_flat.keys()) != keys:
+        return (f"claimed model keys diverge from the previous "
+                f"model's (extra="
+                f"{sorted(set(claimed_flat) - set(keys))[:3]}, "
+                f"missing={sorted(set(keys) - set(claimed_flat))[:3]})")
+    for k in keys:
+        g, c = np.asarray(global_flat[k]), np.asarray(claimed_flat[k])
+        if g.shape != c.shape or g.dtype != c.dtype:
+            return (f"claimed leaf {k}: {c.shape}/{c.dtype} != "
+                    f"{g.shape}/{g.dtype}")
+    return ""
+
+
+def _diverging_leaves(derived: Dict[str, np.ndarray],
+                      claimed_flat: Dict[str, np.ndarray]) -> List[str]:
+    return [k for k, a in derived.items()
+            if np.ascontiguousarray(a).tobytes()
+            != np.ascontiguousarray(claimed_flat[k]).tobytes()]
+
+
+def _row_stats(flats, senders, keys) -> Tuple[List[str], List[str]]:
+    """(nonfinite senders, per-row 'sender=l2' strings) over the
+    fetched rows restricted to `keys` — the validator's own copy of the
+    health plane's per-delta statistics, re-derived, not trusted."""
+    culprits: List[str] = []
+    l2s: List[str] = []
+    for f, s in zip(flats, senders):
+        if f is None:
+            continue
+        sq, bad = 0.0, False
+        for k in keys:
+            v = f.get(k)
+            if v is None:
+                continue
+            a = np.asarray(v)
+            if not np.issubdtype(a.dtype, np.floating):
+                continue
+            finite = np.isfinite(a)
+            if not np.all(finite):
+                bad = True
+            sq += float(np.sum(np.square(
+                np.asarray(a, np.float64)[finite])))
+        if bad:
+            culprits.append(s)
+        l2s.append(f"{s[:10]}={sq ** 0.5:.3g}")
+    return culprits, l2s

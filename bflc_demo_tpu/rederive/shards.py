@@ -1,0 +1,73 @@
+"""Deterministic leaf-shard partition for sharded re-derivation.
+
+The shard map is PROTOCOL-adjacent data: every party — each validator,
+the writer's cross-check, an offline auditor — must compute the same
+assignment from public inputs alone, or the coverage argument (below)
+falls apart.  It is therefore a pure function of (leaf count, validator
+count, epoch): no randomness, no state, no negotiation.  A validator
+that crashes and rejoins mid-round re-derives its shard from the
+certified chain position exactly like everyone else (property-tested in
+tests/test_rederive.py).
+
+**Coverage rule.**  Each leaf is covered by ``shard_coverage(n)`` =
+``min(n, max(2, 2f+1))`` validators, ``f = (n-1)//3`` (the PBFT fault
+bound `protocol.constants.bft_fault_tolerance`).  2f+1 is the safety
+bar: a wrong leaf is then covered by >= f+1 HONEST validators even with
+f colluders, and f+1 honest refusals push the writer's attainable
+signer count to n - (f+1) = 2f < 2f+1 — the quorum is unreachable, so
+f colluding validators cannot save a lying writer (the acceptance
+drill).  The max(2, ...) floor keeps >= 2-way overlap at degenerate
+geometries (n in {2, 3} has f = 0), so every leaf's digest is always
+cross-checkable between at least two validators.
+
+**Rotation.**  Leaf j at epoch e is covered by validators
+``{(j + e + t) mod n : t < coverage}`` — round-robin with an epoch
+offset, so the per-round compute load is balanced across the set and
+drifts one slot per round (no validator owns a "hot" leaf forever).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set
+
+
+def shard_coverage(n_validators: int) -> int:
+    """How many validators re-derive each leaf (see module docstring)."""
+    n = int(n_validators)
+    if n <= 0:
+        raise ValueError(f"need a positive validator count, got {n}")
+    f = (n - 1) // 3
+    return min(n, max(2, 2 * f + 1))
+
+
+def leaf_owners(leaf_index: int, n_validators: int, epoch: int,
+                coverage: int = 0) -> Set[int]:
+    """The validator indices covering one leaf — THE assignment rule
+    (leaf_shard/shard_map are derived views of it)."""
+    n = int(n_validators)
+    c = coverage or shard_coverage(n)
+    base = (int(leaf_index) + int(epoch)) % n
+    return {(base + t) % n for t in range(c)}
+
+
+def leaf_shard(keys: Sequence[str], validator_index: int,
+               n_validators: int, epoch: int) -> List[str]:
+    """The sorted leaf keys validator `validator_index` must re-derive
+    at `epoch`.  `keys` must already be the canonical SORTED leaf order
+    (utils.serialization sorts; callers pass sorted(flat.keys()) — the
+    index of a key in that order is its protocol-visible leaf index)."""
+    n = int(n_validators)
+    if n <= 1:
+        return list(keys)
+    c = shard_coverage(n)
+    v = int(validator_index) % n
+    return [k for j, k in enumerate(keys)
+            if v in leaf_owners(j, n, epoch, c)]
+
+
+def shard_map(keys: Sequence[str], n_validators: int,
+              epoch: int) -> Dict[int, List[str]]:
+    """{validator index: its shard} over the whole set — the
+    cross-check / property-test / telemetry view."""
+    return {v: leaf_shard(keys, v, n_validators, epoch)
+            for v in range(max(int(n_validators), 1))}

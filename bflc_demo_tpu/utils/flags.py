@@ -52,6 +52,15 @@ class RunOptions:
     chaos_seed: int = -1             # processes runtime: >= 0 runs the
     #                                  seeded fault campaign (chaos/)
     chaos_profile: str = "standard"  # chaos schedule intensity profile
+    # processes runtime: validator re-derivation plane
+    # (bflc_demo_tpu.rederive) — with --bft-validators, validators
+    # re-derive every committed model hash from the admitted deltas
+    # (fetched off the read fan-out, hash-verified) and refuse to
+    # co-sign one they cannot reproduce.  'shard' re-derives a
+    # deterministic leaf subset per validator (min(n, max(2, 2f+1))-way
+    # coverage); 'full' re-derives everything; 'off' (default, or
+    # BFLC_REDERIVE_LEGACY=1) pins today's guard-check posture.
+    rederive: str = "off"
     # processes runtime: certified snapshots + ledger compaction
     # (ledger.snapshot) — every K rounds the writer appends a
     # quorum-certified snapshot op and GCs the log/WAL prefix behind it;
@@ -98,6 +107,13 @@ def build_parser() -> argparse.ArgumentParser:
             from bflc_demo_tpu.chaos.schedule import PROFILES
             p.add_argument(flag, choices=sorted(PROFILES),
                            default=f.default)
+        elif f.name == "rederive":
+            from bflc_demo_tpu.rederive import REDERIVE_MODES
+            p.add_argument(flag, choices=list(REDERIVE_MODES),
+                           default=f.default,
+                           help="validator re-derivation plane mode "
+                                "(processes runtime with "
+                                "--bft-validators; default off)")
         elif f.type == "bool" or isinstance(f.default, bool) or \
                 "bool" in str(f.type):
             # plain bools AND tri-state Optional[bool] flags (None

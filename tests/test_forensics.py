@@ -146,6 +146,54 @@ class TestBurnRateMath:
         assert alerts[0]["windows"]["fast"][-2:] == [1, 1]
 
 
+class TestNotifyCmd:
+    """--notify-cmd alert routing (ISSUE 15 satellite): one operator
+    command per alert with the alerts.jsonl record on stdin,
+    failure-isolated and counted."""
+
+    def _page_twice(self, eng):
+        for ep, wall in enumerate([0.5, 0.5, 9.0, 9.0]):
+            eng.observe_round({"epoch": ep, "round_wall_s": wall})
+
+    def _wait(self, cond, timeout_s=10.0):
+        import time as _t
+        t0 = _t.monotonic()
+        while not cond() and _t.monotonic() - t0 < timeout_s:
+            _t.sleep(0.05)
+        assert cond()
+
+    def test_alert_record_reaches_command_stdin(self, tmp_path):
+        import json as _json
+        import sys
+        out = tmp_path / "paged.json"
+        cmd = (f"{sys.executable} -c \"import sys; "
+               f"open({str(out)!r}, 'w').write(sys.stdin.read())\"")
+        eng = SLOEngine([SLOSpec("lat", "round_wall_s", 1.0)],
+                        notify_cmd=cmd)
+        self._page_twice(eng)
+        assert eng.notified == 1
+        self._wait(lambda: out.exists() and out.read_text().strip())
+        rec = _json.loads(out.read_text())
+        assert rec["type"] == "slo_alert" and rec["slo"] == "lat"
+        assert rec["epoch"] == 3
+        self._wait(lambda: eng.notify_failures == 0 and
+                   eng.report()["notified"] == 1)
+
+    def test_broken_command_is_counted_never_raised(self):
+        eng = SLOEngine([SLOSpec("lat", "round_wall_s", 1.0)],
+                        notify_cmd="false")
+        self._page_twice(eng)          # a failing pager must not kill
+        self._wait(lambda: eng.notify_failures == 1)
+        assert eng.report()["notify_failures"] == 1
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("BFLC_SLO_NOTIFY_CMD", "true")
+        eng = SLOEngine([SLOSpec("lat", "round_wall_s", 1.0)])
+        assert eng.notify_cmd == "true"
+        monkeypatch.delenv("BFLC_SLO_NOTIFY_CMD")
+        assert SLOEngine([]).notify_cmd == ""
+
+
 # ------------------------------------------------- alerts durability
 class TestAlertsDurability:
     def test_sigkill_leaves_parseable_alerts_jsonl(self, tmp_path):

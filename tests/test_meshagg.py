@@ -149,6 +149,17 @@ class TestDifferentialChecker:
         out = run_differential(trials=8, seed=20260804, max_n=48)
         assert out["mismatches"] == [], out["mismatches"]
 
+    def test_randomized_writer_vs_rederive_exact(self):
+        """The rederive leg (ISSUE 15): randomized trees/weights/
+        selections x dtype x density produce byte-identical committed
+        model hashes via the writer path and the validator
+        re-derivation path (bflc_demo_tpu.rederive), with every shard
+        leaf equal and the shard union covering the model."""
+        from check_reduction_spec import run_rederive_differential
+        out = run_rederive_differential(trials=6, seed=20260804,
+                                        max_n=16)
+        assert out["mismatches"] == [], out["mismatches"]
+
     def test_sparse_decode_images_host_vs_mesh_exact(self):
         """Sparse and sparse x i8/f16 decode images (ISSUE 13) reduce
         byte-identically on both legs — forced coverage of every

@@ -80,11 +80,26 @@ def main(argv=None) -> int:
                    help="exit nonzero when the SLO engine raised any "
                         "burn-rate alert (obs.slo alerts.jsonl); "
                         "requires telemetry")
+    p.add_argument("--notify-cmd", default="",
+                   help="operator command the SLO engine spawns PER "
+                        "alert with the alerts.jsonl record on stdin "
+                        "(obs.slo; e.g. a curl webhook one-liner) — "
+                        "failure-isolated and counted")
+    p.add_argument("--rederive", default="off",
+                   choices=["off", "shard", "full"],
+                   help="validator re-derivation plane mode "
+                        "(bflc_demo_tpu.rederive): validators refuse "
+                        "commits whose model hash they cannot "
+                        "reproduce; blob-unavailability under chaos "
+                        "degrades to counted skips")
     p.add_argument("--verbose", action="store_true", default=True)
     p.add_argument("--quiet", dest="verbose", action="store_false")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.notify_cmd:
+        # the driver-side SLO engine reads it at arming (obs.slo)
+        os.environ["BFLC_SLO_NOTIFY_CMD"] = args.notify_cmd
     import numpy as np
 
     from bflc_demo_tpu.data import load_occupancy, iid_shards
@@ -130,6 +145,7 @@ def main(argv=None) -> int:
             chaos_seed=args.seed, chaos_profile=args.profile,
             chaos_duration_s=(args.duration or None),
             telemetry_dir=telemetry_dir,
+            rederive=args.rederive,
             verbose=args.verbose)
     except Exception as e:              # noqa: BLE001 — the artifact must
         # record the failure mode; triage replays by seed

@@ -138,6 +138,85 @@ def run_differential(trials: int = 20, seed: int = 0,
             "report": ENGINE.report()}
 
 
+def run_rederive_differential(trials: int = 12, seed: int = 1,
+                              max_n: int = 24,
+                              n_validators: int = 4) -> dict:
+    """The validator re-derivation leg (bflc_demo_tpu.rederive): for
+    randomized trees x weights x selections x dtype x density, the
+    WRITER path (decode every admitted blob, ENGINE.aggregate_flat,
+    pack, hash) and the VALIDATOR path (`rederive_model_flat` over the
+    raw wire blobs — selected only, zeros elsewhere) must produce
+    byte-identical committed model hashes; and in shard mode every
+    validator's re-derived leaves must equal the writer's with the
+    shard union covering every leaf.  Empty `mismatches` = the plane
+    can refuse on inequality without ever refusing an honest writer."""
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+    from bflc_demo_tpu.rederive.core import (derive_leaves,
+                                             rederive_model_flat)
+    from bflc_demo_tpu.rederive.shards import leaf_shard
+    from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                   dequantize_entries,
+                                                   pack_entries,
+                                                   quantize_entries,
+                                                   sparsify_entries,
+                                                   unpack_pytree)
+
+    rng = np.random.default_rng(seed)
+    mismatches = []
+    with np.errstate(over="ignore", invalid="ignore"):
+        for t in range(trials):
+            g, _, weights, selected, lr, quant, density = \
+                _scenario(rng, max_n)
+            n = len(weights)
+            shapes = {k: np.asarray(v).shape for k, v in g.items()}
+            # the raw WIRE blobs (what clients sign and upload)
+            blobs = []
+            for _ in range(n):
+                flat = {k: (rng.standard_normal(shp)
+                            * 10.0 ** float(rng.integers(-6, 6))
+                            ).astype(np.float32)
+                        for k, shp in shapes.items()}
+                blobs.append(pack_entries(quantize_entries(
+                    sparsify_entries(flat, density), quant)))
+            prev_blob = pack_entries(g)
+            # writer path: decode all, one engine merge, pack, hash
+            decoded = [densify_entries(dequantize_entries(
+                           unpack_pytree(b))) for b in blobs]
+            w_out = ENGINE.aggregate_flat(g, decoded, weights, selected,
+                                          lr)
+            w_hash = hashlib.sha256(pack_entries(w_out)).digest()
+            # validator FULL path over raw blobs (selected only)
+            v_out = rederive_model_flat(prev_blob, blobs, weights,
+                                        selected, lr,
+                                        sparse=density < 1.0)
+            v_hash = hashlib.sha256(pack_entries(v_out)).digest()
+            bad = []
+            if v_hash != w_hash:
+                bad.append("#full-hash")
+            # validator SHARD path: per-validator leaves + union cover
+            keys = sorted(g.keys())
+            epoch = int(rng.integers(0, 50))
+            covered = set()
+            sel = set(selected)
+            flats = [decoded[i] if i in sel else None for i in range(n)]
+            for v in range(n_validators):
+                mine = leaf_shard(keys, v, n_validators, epoch)
+                covered.update(mine)
+                got = derive_leaves(g, flats, weights, selected, lr,
+                                    mine)
+                for k in mine:
+                    if np.asarray(got[k]).tobytes() != \
+                            np.asarray(w_out[k]).tobytes():
+                        bad.append(f"#shard-v{v}:{k}")
+            if covered != set(keys):
+                bad.append("#shard-coverage")
+            if bad:
+                mismatches.append({"trial": t, "n": n, "quant": quant,
+                                   "density": density, "leaves": bad})
+    return {"trials": trials, "seed": seed, "max_n": max_n,
+            "n_validators": n_validators, "mismatches": mismatches}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trials", type=int, default=20)
@@ -157,6 +236,18 @@ def main(argv=None) -> int:
         return 1
     print("OK: host-loop and mesh legs byte-identical on every "
           "scenario")
+    red = run_rederive_differential(max(args.trials // 2, 6), args.seed)
+    print(f"rederive differential: {red['trials']} trials x "
+          f"{red['n_validators']} validators")
+    if red["mismatches"]:
+        for m in red["mismatches"]:
+            print(f"  DIVERGED: {m}")
+        print("FAIL: validator re-derivation path is not "
+              "byte-identical to the writer path — the rederive plane "
+              "must stay off (--rederive off) until resolved")
+        return 1
+    print("OK: writer path and validator re-derivation path "
+          "byte-identical on every scenario")
     return 0
 
 

@@ -164,6 +164,15 @@ def _role_row(role, snap):
         cells.append(f"log {int(log):>5}  votes {n_b}b/{n_s}s "
                      f"({m_b * 1e3:.1f}/{m_s * 1e3:.1f}ms)  "
                      f"repairs {rep:.0f}  abandons {ab:.0f}")
+        # validator re-derivation plane (bflc_demo_tpu.rederive): how
+        # many commits this validator re-derived, the mean cost, and
+        # the degrade/refusal counters an operator pages on
+        n_rd, m_rd = _merged_hist(snap, "rederive_seconds")
+        if n_rd:
+            skip = _sum_counter(snap, "rederive_skipped_total")
+            ref = _sum_counter(snap, "rederive_refusals_total")
+            cells.append(f"rederive {n_rd}x{m_rd * 1e3:.1f}ms  "
+                         f"skip {skip:.0f}  refuse {ref:.0f}")
     elif role.startswith("cell"):
         # hierarchical cell tier (bflc_demo_tpu.hier): the aggregator is
         # a LedgerServer for its members, so it also has the writer-class
@@ -383,6 +392,13 @@ def _scrape_digest(rec) -> str:
             rep = _sum_counter(roles[role], "repair_events_total")
             if rep:
                 bits.append(f"{role}: repairs={rep:.0f}")
+            ref = _sum_counter(roles[role], "rederive_refusals_total")
+            skip = _sum_counter(roles[role], "rederive_skipped_total")
+            if ref or skip:
+                # a refused commit / a counted degrade is exactly the
+                # kind of event the timeline should interleave
+                bits.append(f"{role}: rederive refuse={ref:.0f} "
+                            f"skip={skip:.0f}")
     cov = rec.get("coverage", {})
     if cov.get("missing"):
         bits.append(f"dark: {','.join(cov['missing'])}")
