@@ -400,6 +400,14 @@ def _child() -> None:
         # with monotone-epoch audit (eval.benchmarks.endurance_config1)
         from bflc_demo_tpu.eval.benchmarks import endurance_config1
         extra["endurance"] = endurance_config1(rounds=50)
+    if os.environ.get("BFLC_BENCH_ENDURANCE_ASYNC"):
+        # the multi-thousand-round async campaign: snapshot-armed,
+        # replica-rederived buffered aggregation under composed
+        # heavytail + churn with committee reseats throughout — the
+        # bounded-WAL / bounded-memory / zero-false-page evidence
+        # (eval.benchmarks.endurance_async_config1)
+        from bflc_demo_tpu.eval.benchmarks import endurance_async_config1
+        extra["endurance_async"] = endurance_async_config1()
     if on_cpu:
         # VERDICT r5 weak #2: on cpu-fallback the round-time axis has
         # CV > 1 on this contended host and vs_baseline divides the

@@ -66,6 +66,15 @@ class ChaosCampaign:
         self.executed: List[dict] = []
         self.skipped: List[dict] = []
         self.client_respawns = 0
+        self.client_joins = 0
+        self.client_retires = 0
+        # churn wiring (the "churn" profile): the runtime registers a
+        # join factory (index -> handle fields) so the campaign can
+        # admit FRESH clients at new indices, and an address resolver
+        # so the invariant monitor can track a retiree's in-flight
+        # async deltas by sender address
+        self.join_fn: Callable = None
+        self.addr_of: Callable = None
         # telemetry hook (obs.collector.FleetCollector.observe_fault):
         # every executed/skipped fault is mirrored onto the run's
         # metrics.jsonl timeline, so a post-mortem reads fault -> metric
@@ -156,6 +165,51 @@ class ChaosCampaign:
         self._record(ev.as_dict(), executed=True)
         self._log(f"RESTART {ev.target}")
 
+    def _exec_retire(self, ev) -> None:
+        """Permanent departure (churn): kill with NO restart — the
+        handle is fenced out of supervision and the invariant monitor
+        starts watching that the departed sender's in-flight async
+        delta is drained/pruned instead of wedging the buffer."""
+        h = self.handles.get(ev.target)
+        if h is None or not h.alive():
+            return self._skip(ev, "target not alive")
+        live = [r for r, hh in self.handles.items()
+                if r.startswith("client-") and hh.alive()
+                and hh.restartable]
+        if len(live) <= 2:
+            return self._skip(ev, "too few live clients to retire one")
+        h.restartable = False
+        h.kill()
+        self.client_retires += 1
+        if self.monitor is not None and self.addr_of is not None:
+            try:
+                addr = self.addr_of(ev.target)
+                if addr:
+                    self.monitor.note_departed(addr)
+            except Exception:       # noqa: BLE001 — resolver failure
+                pass                # must not break the driver
+        self._record(ev.as_dict(), executed=True)
+        self._log(f"RETIRE {ev.target}")
+
+    def _exec_join(self, ev) -> None:
+        """Fresh admission (churn): spawn a brand-new client at a new
+        index through the runtime's join factory (new wallet, new
+        shard, ordinary register + state-sync path)."""
+        if self.join_fn is None:
+            return self._skip(ev, "no join factory registered")
+        if ev.target in self.handles:
+            return self._skip(ev, "index already admitted")
+        try:
+            i = int(ev.target.split("-")[1])
+            spawn_fn = self.join_fn(i)
+            proc = spawn_fn()
+        except Exception as e:          # noqa: BLE001 — a failed join
+            return self._skip(ev, f"join failed: {e}")
+        self.register(ev.target, spawn_fn, proc)
+        self.client_joins += 1
+        self._record(ev.as_dict(), executed=True)
+        self._log(f"JOIN {ev.target}")
+
     def _exec_tear_wal(self, ev) -> None:
         from bflc_demo_tpu.chaos.hooks import tear_wal_tail
         if not self.wal_path:
@@ -184,20 +238,27 @@ class ChaosCampaign:
                 self._exec_restart(ev)
             elif ev.kind == "tear_wal":
                 self._exec_tear_wal(ev)
+            elif ev.kind == "retire":
+                self._exec_retire(ev)
+            elif ev.kind == "join":
+                self._exec_join(ev)
             else:
                 self._skip(ev, f"unknown event kind {ev.kind!r}")
         if now - self._last_history >= self.history_every_s:
             self._last_history = now
             try:
                 self.monitor.check_history(probe, info)
+                self.monitor.check_departed_buffer(probe)
             except (ConnectionError, OSError):
                 pass                    # mid-fault probe failure: retried
         # fleet supervision: a client felled by a fault storm (its
         # FailoverClient exhausted every endpoint) respawns — signed,
         # idempotent ops make the rejoin safe; exit code 0 = finished
-        for role, h in self.handles.items():
+        for role, h in list(self.handles.items()):
             if not role.startswith("client-") or h.alive():
                 continue
+            if not h.restartable:
+                continue            # retired (churn): stays departed
             if h.proc is not None and h.proc.exitcode == 0:
                 continue
             pending_restart = any(
@@ -226,6 +287,8 @@ class ChaosCampaign:
             "faults_executed": self.executed,
             "faults_skipped": self.skipped,
             "client_respawns": self.client_respawns,
+            "client_joins": self.client_joins,
+            "client_retires": self.client_retires,
             "acked_uploads_checked": len(acked),
             "invariant_checks": dict(self.monitor.checks),
             "invariant_verdicts": verdicts,

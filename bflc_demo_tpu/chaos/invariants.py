@@ -63,6 +63,12 @@ class InvariantMonitor:
         self._base = 0
         self._base_head = _EMPTY
         self._base_epoch: Optional[int] = None
+        # churn plane: senders the campaign permanently retired.  Their
+        # in-flight async deltas must DRAIN (FIFO) or be staleness-pruned
+        # — a buffered entry from a departed sender that survives two
+        # epoch advances means the buffer wedged on a ghost.
+        self._departed: Dict[str, int] = {}         # addr -> epoch at exit
+        self._departed_seen: Dict[Tuple[str, str], int] = {}  # first epoch
 
     def _flag(self, msg: str) -> None:
         self.violations.append(msg)
@@ -73,6 +79,51 @@ class InvariantMonitor:
         obs_flight.FLIGHT.flush("invariant_violation")
         if self.verbose:
             print(f"[chaos][INVARIANT] {msg}", flush=True)
+
+    # --------------------------------------------------------------- churn
+    def note_departed(self, addr: str) -> None:
+        """The campaign retired this sender permanently (churn).  From
+        here on the monitor watches the writer's async buffer: the
+        retiree's in-flight deltas must drain or be pruned — never
+        wedge."""
+        self._departed[addr] = max(self._max_epoch, 0)
+        self.checks["departed_senders"] = \
+            self.checks.get("departed_senders", 0) + 1
+
+    def check_departed_buffer(self, probe) -> None:
+        """Probe the writer's live async buffer for ghost entries: a
+        buffered delta whose sender has departed is fine for a while
+        (it drains FIFO with everyone else's), but one that survives
+        two epoch advances past first sighting means the drain/prune
+        path lost track of it."""
+        if not self._departed:
+            return
+        try:
+            au = probe.request("aupdates")
+        except (ConnectionError, OSError):
+            return
+        if not au.get("ok"):
+            return
+        self.checks["departed_buffer_probes"] = \
+            self.checks.get("departed_buffer_probes", 0) + 1
+        live = set()
+        for u in au.get("updates", []):
+            s, h = u.get("addr") or u.get("sender"), u.get("hash")
+            if s not in self._departed or h is None:
+                continue
+            key = (s, h)
+            live.add(key)
+            first = self._departed_seen.setdefault(key, self._max_epoch)
+            if self._max_epoch - first >= 2:
+                self._flag(
+                    f"departed sender {s[:12]}'s async delta {h[:12]} "
+                    f"still buffered after {self._max_epoch - first} "
+                    f"epoch advances — buffer wedged on a ghost")
+        # an entry that vanished from the buffer drained or was pruned:
+        # forget it so a (signed, idempotent) re-sight starts fresh
+        for key in list(self._departed_seen):
+            if key not in live:
+                del self._departed_seen[key]
 
     # ------------------------------------------------------ cheap per-poll
     def observe_info(self, info: dict) -> None:
@@ -282,7 +333,41 @@ class InvariantMonitor:
         # surviving chain; open-round uploads have fetchable blobs
         verdicts["acked_upload_durability"] = self._check_acked(
             probe, acked_uploads) if synced else "SKIP(chain unreadable)"
+
+        # churn: after the settle tail no departed sender may still have
+        # a delta wedged in the async buffer (strict form of the
+        # periodic check — at the end, ANY surviving ghost entry is a
+        # wedge, the drains it needed have all had time to fire)
+        if self._departed:
+            verdicts["departed_drain"] = self._check_departed_final(probe)
         return verdicts
+
+    def _check_departed_final(self, probe) -> str:
+        try:
+            au = probe.request("aupdates")
+        except (ConnectionError, OSError):
+            return "SKIP(writer unreachable)"
+        if not au.get("ok"):
+            # async mode off (or probe refused): nothing can be buffered
+            return "PASS"
+        ghosts = [u for u in au.get("updates", [])
+                  if (u.get("sender") or u.get("addr")) in self._departed]
+        if not ghosts:
+            return "PASS"
+        # a ghost entry admitted AFTER the settle began is legal (the
+        # retiree's last signed delta raced its own kill); one we had
+        # already flagged as multi-epoch stale is the wedge
+        wedged = [u for u in ghosts
+                  if ((u.get("sender") or u.get("addr")), u.get("hash"))
+                  in self._departed_seen
+                  and self._max_epoch - self._departed_seen[
+                      ((u.get("sender") or u.get("addr")), u.get("hash"))
+                  ] >= 2]
+        if wedged:
+            self._flag(f"final: {len(wedged)} departed-sender delta(s) "
+                       f"wedged in the async buffer after settle")
+            return "FAIL"
+        return "PASS"
 
     def _check_acked(self, probe, acked: List[dict]) -> str:
         from bflc_demo_tpu.ledger.tool import decode_op

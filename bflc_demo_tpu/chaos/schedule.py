@@ -79,6 +79,20 @@ PROFILES: Dict[str, Dict[str, float]] = {
                       # puts the p95 client near ~400 ms/frame
                       heavytail_median_ms=40.0, heavytail_sigma=1.4,
                       heavytail_cap_ms=1500.0),
+    # the POPULATION-CHURN regime (Bonawitz 2019: devices join and
+    # leave continuously; a production FL population is never the
+    # population you started with).  Retires live clients (kill with
+    # no restart — the driver stops supervising them) and admits FRESH
+    # clients at new indices (new wallet, new shard assignment, riding
+    # the ordinary register + snapshot state-sync paths).  The live
+    # population never drops below churn_min_frac of the starting
+    # fleet and total admissions cap at churn_max_total x n_clients.
+    # Composable with any other profile via "+" (e.g.
+    # "heavytail+churn"); joined clients draw no heavytail delay —
+    # fresh hardware enters healthy.
+    "churn": dict(churn_leave_every=12.0, churn_join_every=12.0,
+                  churn_min_frac=0.5, churn_max_total=2.0,
+                  restart_after=(2.0, 5.0)),
 }
 
 
@@ -87,7 +101,7 @@ class FaultEvent:
     """One driver-side fault: kill/restart a role, or tear the WAL."""
 
     t: float                    # seconds from campaign t0
-    kind: str                   # "kill" | "restart" | "tear_wal"
+    kind: str    # "kill" | "restart" | "tear_wal" | "retire" | "join"
     target: str = ""            # role name ("" for tear_wal)
 
     def as_dict(self) -> dict:
@@ -131,9 +145,15 @@ class FaultSchedule:
                  n_standbys: int, n_validators: int,
                  profile: str = "standard", grace_s: float = 10.0,
                  settle_frac: float = 0.15):
-        if profile not in PROFILES:
+        # composed profiles: "+"-joined names (e.g. "heavytail+churn")
+        # overlay each part's campaign; a single-name profile keeps the
+        # exact pre-composition schedule bytes (same rng stream)
+        parts = [pt for pt in str(profile).split("+") if pt]
+        bad = [pt for pt in parts if pt not in PROFILES]
+        if not parts or bad:
             raise ValueError(f"unknown chaos profile {profile!r}; "
-                             f"have {sorted(PROFILES)}")
+                             f"have {sorted(PROFILES)} "
+                             f"(composable with '+')")
         self.seed = int(seed)
         self.duration_s = float(duration_s)
         self.n_clients = n_clients
@@ -143,8 +163,17 @@ class FaultSchedule:
         self.grace_s = grace_s
         self.events: List[FaultEvent] = []
         self.wire_windows: Dict[str, List[WireWindow]] = {}
-        self._generate(random.Random(self.seed),
-                       PROFILES[profile], settle_frac)
+        if len(parts) == 1:
+            self._generate(random.Random(self.seed),
+                           PROFILES[parts[0]], settle_frac)
+        else:
+            # each part draws from its own derived stream so adding a
+            # part never perturbs another's schedule (replayable per
+            # part, stable under composition)
+            for pt in parts:
+                self._generate(random.Random(f"{self.seed}:{pt}"),
+                               PROFILES[pt], settle_frac)
+            self.events.sort(key=lambda e: e.t)
 
     # ------------------------------------------------------------ helpers
     def _add_window(self, role: str, w: WireWindow) -> None:
@@ -187,6 +216,41 @@ class FaultSchedule:
                 self._add_window(f"client-{c}", WireWindow(
                     lo, self.duration_s, "delay", coordinator_roles,
                     p=1.0, delay_ms=delay))
+            return
+
+        if "churn_leave_every" in p:
+            # population-churn regime: retire live clients (no restart)
+            # and admit fresh ones at NEW indices — a seeded membership
+            # simulation so the same seed always produces the same
+            # join/leave trajectory.  The floor keeps enough trainers
+            # for drains to keep firing; the cap bounds total wallet /
+            # shard admissions.
+            floor = max(2, int(round(self.n_clients
+                                     * p["churn_min_frac"])))
+            cap = int(round(self.n_clients * p["churn_max_total"]))
+            moves = ([(t, "retire")
+                      for t in self._times(rng, p["churn_leave_every"],
+                                           lo, hi)]
+                     + [(t, "join")
+                        for t in self._times(rng, p["churn_join_every"],
+                                             lo, hi)])
+            live = list(range(self.n_clients))
+            next_idx = self.n_clients
+            for t, kind in sorted(moves):
+                if kind == "retire":
+                    if len(live) <= floor:
+                        continue
+                    i = live.pop(rng.randrange(len(live)))
+                    self.events.append(
+                        FaultEvent(t, "retire", f"client-{i}"))
+                else:
+                    if next_idx >= cap:
+                        continue
+                    live.append(next_idx)
+                    self.events.append(
+                        FaultEvent(t, "join", f"client-{next_idx}"))
+                    next_idx += 1
+            self.events.sort(key=lambda e: e.t)
             return
 
         def restart_delay():

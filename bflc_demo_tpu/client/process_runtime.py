@@ -1045,6 +1045,39 @@ def run_federated_processes(
                 (lambda i=i, sx=sx, sy=sy, eps=list(endpoints):
                  _spawn_client(i, sx, sy, eps)[0]), p)
 
+    if campaign is not None:
+        # churn wiring: the campaign admits FRESH clients at indices
+        # beyond the initial fleet (schedule "join" events).  A joined
+        # client is an ordinary client — new deterministic wallet from
+        # the same master-seed derivation, a recycled data shard, its
+        # own ack journal — admitted through the very register +
+        # state-sync path a respawn uses; and the monitor resolves a
+        # retiree's role to its wallet address so it can track the
+        # departed sender's in-flight async deltas by name.
+        def _client_addr(role: str) -> str:
+            i = int(role.split("-")[1])
+            return Wallet.from_seed(
+                master_seed + struct.pack("<q", i)).address
+
+        def _join_client(i: int):
+            jx, jy = shards[i % len(shards)]
+            eps = list(endpoints)
+
+            def _spawn():
+                p, ack = _spawn_client(i, jx, jy, eps)
+                if ack and ack not in ack_paths:
+                    ack_paths.append(ack)
+                if collector is not None and telemetry_dir:
+                    # late-admitted role joins the scrape surface too
+                    collector.file_roles.setdefault(
+                        f"client-{i}", os.path.join(
+                            telemetry_dir, f"client-{i}.metrics.json"))
+                return p
+            return _spawn
+
+        campaign.join_fn = _join_client
+        campaign.addr_of = _client_addr
+
     # --- telemetry plane (bflc_demo_tpu.obs): the driver scrapes the
     # whole fleet each committed round — telemetry RPC for socket-serving
     # roles, published file snapshots for clients/standbys — onto one

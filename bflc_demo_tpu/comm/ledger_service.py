@@ -127,6 +127,12 @@ _M_ASTALENESS = obs_metrics.REGISTRY.histogram(
 _M_AAGG = obs_metrics.REGISTRY.counter(
     "async_aggregations_total",
     "buffered aggregations committed (async mode)")
+_M_RESEAT = obs_metrics.REGISTRY.counter(
+    "committee_reseats_total",
+    "async committee re-elections applied "
+    "(ProtocolConfig.async_reseat_every)")
+_G_COMM_SIZE = obs_metrics.REGISTRY.gauge(
+    "committee_size", "currently seated committee members")
 # --- sparse upload deltas (--delta-density; utils.serialization): the
 # protocol density this writer admits (1.0 = dense) and the writer-side
 # decode cost of the densify inverse at admission — the operator's
@@ -1521,6 +1527,7 @@ class LedgerServer:
                 if self._async:
                     reply["async_buffer_depth"] = \
                         self.ledger.async_buffer_depth
+                reply["committee"] = self.ledger.committee()
                 snap = self._snapshot_offer()
                 if snap is not None:
                     reply["snapshot_epoch"] = snap["epoch"]
@@ -1591,6 +1598,7 @@ class LedgerServer:
                     if self._async:
                         _G_ABUF_DEPTH.set(
                             self.ledger.async_buffer_depth)
+                    _G_COMM_SIZE.set(len(self.ledger.committee()))
                     snap = self._snapshot_offer()
                     _G_SNAP_AGE.set(self.ledger.epoch - snap["epoch"]
                                     if snap is not None else -1)
@@ -1799,6 +1807,12 @@ class LedgerServer:
                                            self.cfg.learning_rate)
             blob = pack_entries(new_flat)
             digest = hashlib.sha256(blob).digest()
+            # capture reseat due-ness BEFORE the commit advances the
+            # drain counter (the ledger derives + embeds the seating
+            # itself; this is observability only)
+            reseat_due = self.ledger.async_reseat_due() \
+                if hasattr(self.ledger, "async_reseat_due") else False
+            old_seats = self.ledger.committee() if reseat_due else None
             st = self.ledger.async_commit(digest, epoch, k)
             if st != LedgerStatus.OK:
                 raise RuntimeError(f"async commit rejected: {st.name}")
@@ -1838,6 +1852,18 @@ class LedgerServer:
             max_staleness=max((e.staleness for e in entries),
                               default=0),
             loss=float(self.ledger.last_global_loss))
+        if reseat_due:
+            new_seats = self.ledger.committee()
+            obs_flight.FLIGHT.record(
+                "event", "committee_reseat", epoch=epoch,
+                seats=list(new_seats),
+                changed=sorted(set(new_seats)
+                               - set(old_seats or [])))
+            if obs_metrics.REGISTRY.enabled:
+                _M_RESEAT.inc()
+            if self.verbose:
+                print(f"[coordinator] epoch {epoch} committee reseat: "
+                      f"{old_seats} -> {new_seats}", flush=True)
         if self.verbose:
             print(f"[coordinator] epoch {epoch} async-aggregated "
                   f"({k} deltas, stalest "

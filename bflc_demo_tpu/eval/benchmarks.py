@@ -17,7 +17,7 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from bflc_demo_tpu.protocol.constants import (DEFAULT_PROTOCOL,
                                               ProtocolConfig)
@@ -268,6 +268,226 @@ def _endurance_wal_leg(rounds: int = 240,
         "bounded_ratio": round(
             legacy_sizes[-1] / max(max(armed_sizes), 1), 2),
     }
+
+
+def endurance_async_config1(rounds: int = 2000, *,
+                            reseat_every: int = 25,
+                            snapshot_interval: int = 64,
+                            churn_every: int = 40,
+                            slo_warmup: int = 50,
+                            seed: int = 0) -> Dict:
+    """The multi-thousand-round ASYNC campaign (production endurance):
+    `rounds` scripted buffered-aggregation drains driven directly on a
+    snapshot-armed, WAL-attached python ledger under composed
+    heavytail + churn semantics — stale base epochs in the admission
+    mix, senders permanently retiring and fresh ones registering
+    mid-campaign — with deterministic committee reseats every
+    `reseat_every` drains (ProtocolConfig.async_reseat_every).
+
+    Scripted like `_endurance_wal_leg` (op application is the work;
+    no sockets), so thousands of rounds take seconds, while every
+    durability claim is measured on the REAL protocol state machine:
+
+    - a full replica replays every certified op concurrently (the
+      validator re-derivation analog) and must agree on head, state
+      digest and seated committee at the end;
+    - a third ledger state-syncs from a snapshot taken mid-run INSIDE
+      a reseat window and replays the tail to the same head;
+    - the WAL and the held-op window must sawtooth (second-half
+      ceiling <= first-half), not ramp, across churn and reseats;
+    - a departed sender's in-flight delta must leave the buffer within
+      two drains of its retirement (never wedge);
+    - an SLO engine with adaptive baselining judges every round's
+      measured wall + admitted staleness + (zero) rederive skips: the
+      healthy campaign must page ZERO alerts — the false-page test.
+
+    Returns the evidence dict tests/test_endurance.py and
+    ``bench.py`` (BFLC_BENCH_ENDURANCE_ASYNC=1) assert and record."""
+    import os as _os
+    import random as _random
+    import tempfile
+    import hashlib as _hl
+
+    from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
+    from bflc_demo_tpu.ledger.snapshot import (make_snapshot_op,
+                                               restore_snapshot)
+    from bflc_demo_tpu.obs.slo import SLOEngine, SLOSpec
+    from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+    cfg = ProtocolConfig(
+        client_num=12, comm_count=3, aggregate_count=3,
+        needed_update_count=5, learning_rate=0.05, batch_size=16,
+        async_buffer=4, max_staleness=8,
+        async_reseat_every=reseat_every).validate()
+    rng = _random.Random(seed)
+    engine = SLOEngine([
+        SLOSpec("round_latency", "round_wall_s", 30.0,
+                warmup=slo_warmup, adapt_floor=0.25),
+        SLOSpec("async_staleness", "staleness_p95",
+                float(cfg.max_staleness)),
+        SLOSpec("rederive_skip", "rederive_skipped_delta", 0.0,
+                budget=0.05)])
+
+    with tempfile.TemporaryDirectory(prefix="bflc-endur-async-") as td:
+        path = _os.path.join(td, "run.wal")
+        led = make_ledger(cfg, backend="python")
+        replica = make_ledger(cfg, backend="python")
+        addrs = [f"addr-{i:04d}" for i in range(cfg.client_num)]
+        for a in addrs:
+            assert led.register_node(a) == LedgerStatus.OK
+        assert led.attach_wal(path)
+        live = list(addrs)              # senders still participating
+        next_idx = cfg.client_num
+        join_cap = 2 * cfg.client_num   # total admissions ever (churn)
+        departed: dict = {}             # addr -> drain it retired at
+        replayed = 0                    # replica's chain position
+        synced = None                   # the mid-run state-sync ledger
+
+        def _replay_to_tip():
+            nonlocal replayed
+            while replayed < led.log_size():
+                op = led.log_op(replayed)
+                assert replica.apply_op(op) == LedgerStatus.OK
+                if synced is not None:
+                    assert synced.apply_op(op) == LedgerStatus.OK
+                replayed += 1
+
+        wal_sizes, held_ops, state_sizes = [], [], []
+        reseats = 0
+        stale_admitted: List[int] = []
+        stale_refused = 0
+        wedged = 0
+        false_pages = 0
+        t_start = time.monotonic()
+        t_prev = t_start
+        for r in range(rounds):
+            ep = led.epoch
+            committee = set(led.committee())
+            # --- churn: one retirement + one fresh admission per window
+            if churn_every and r and r % churn_every == 0:
+                pool = [a for a in live if a not in committee]
+                if len(pool) > cfg.async_buffer + 2:
+                    gone = pool[rng.randrange(len(pool))]
+                    live.remove(gone)
+                    departed[gone] = r
+                if next_idx < join_cap:
+                    fresh = f"addr-{next_idx:04d}"
+                    next_idx += 1
+                    assert led.register_node(fresh) == LedgerStatus.OK
+                    live.append(fresh)
+            # --- heavytail admissions: fill the buffer from live
+            # trainers; ~1/8 arrive on a stale base epoch, and a few
+            # outright too-stale (the refusal path is part of the run)
+            trainers = [a for a in live if a not in committee]
+            rng.shuffle(trainers)
+            for a in trainers:
+                if led.async_buffer_depth >= cfg.async_buffer:
+                    break
+                base = ep
+                if ep > 0 and rng.random() < 0.125:
+                    base = max(0, ep - rng.randint(1, cfg.max_staleness))
+                if ep > cfg.max_staleness and rng.random() < 0.02:
+                    st = led.async_upload(
+                        a, _hl.sha256(f"x|{r}|{a}".encode()).digest(),
+                        10, 1.0, ep - cfg.max_staleness - 1)
+                    assert st == LedgerStatus.WRONG_EPOCH
+                    stale_refused += 1
+                    continue
+                h = _hl.sha256(f"{r}|{a}".encode()).digest()
+                st = led.async_upload(a, h, 10 + (r % 5), 1.0, base)
+                if st == LedgerStatus.OK:
+                    stale_admitted.append(ep - base)
+            k = led.async_buffer_depth
+            assert k == cfg.async_buffer
+            # --- committee scoring (live members only; a retired seat
+            # simply falls silent — unscored entries median to 0.0)
+            aseqs = [e.aseq for e in led.async_buffer_view()]
+            for a in committee:
+                if a in live:
+                    led.async_scores(
+                        a, [(q, rng.random()) for q in aseqs])
+            due = led.async_reseat_due()
+            mh = _hl.sha256(f"model|{r}".encode()).digest()
+            assert led.async_commit(mh, ep, k) == LedgerStatus.OK
+            if due:
+                reseats += 1
+            # --- departed-sender wedge check: a retiree's delta must
+            # leave the buffer within two drains of its retirement
+            buffered = {e.sender for e in led.async_buffer_view()}
+            for a, at in departed.items():
+                if a in buffered and r - at >= 2:
+                    wedged += 1
+            _replay_to_tip()
+            # --- mid-run state-sync INSIDE a reseat window: adopt the
+            # writer's state exactly as a late validator would
+            if synced is None and r == rounds // 2 \
+                    and reseat_every > 0 \
+                    and (led._acommit_count % reseat_every) \
+                    not in (0, reseat_every - 1):
+                synced = restore_snapshot(led.encode_state(), cfg,
+                                          led.log_size(),
+                                          led.log_head())
+            # --- snapshot arm: certified checkpoint + prefix GC (the
+            # writer's _emit_snapshot order), the WAL's sawtooth
+            if snapshot_interval and led.epoch % snapshot_interval == 0:
+                state = led.encode_state()
+                pos = led.log_size()
+                op = make_snapshot_op(led)
+                assert led.apply_op(op) == LedgerStatus.OK
+                _replay_to_tip()
+                led.gc_prefix(pos + 1, state)
+            wal_sizes.append(_os.path.getsize(path))
+            held_ops.append(led.log_size() - getattr(led, "log_base", 0))
+            state_sizes.append(len(led.encode_state()))
+            # --- SLO judging on the measured round
+            t_now = time.monotonic()
+            window = stale_admitted[-k:] or [0]
+            false_pages += len(engine.observe_round({
+                "epoch": r, "round_wall_s": t_now - t_prev,
+                "staleness_p95": float(sorted(window)[
+                    max(int(0.95 * len(window)) - 1, 0)]),
+                "rederive_skipped_delta": 0.0}))
+            t_prev = t_now
+        led.detach_wal()
+        # --- final re-derivation agreement: replica (full replay) and
+        # the mid-run state-sync ledger both land on the writer's head,
+        # state and seated committee
+        _replay_to_tip()
+        agree = (replica.log_head() == led.log_head()
+                 and replica.state_digest() == led.state_digest()
+                 and replica.committee() == led.committee())
+        if synced is not None:
+            agree = agree and (synced.log_head() == led.log_head()
+                               and synced.state_digest()
+                               == led.state_digest()
+                               and synced.committee() == led.committee())
+        half = len(wal_sizes) // 2
+        return {
+            "rounds": rounds, "reseat_every": reseat_every,
+            "snapshot_interval": snapshot_interval,
+            "final_epoch": led.epoch,
+            "epochs_monotone": led.epoch == rounds,
+            "reseats": reseats,
+            "final_committee": led.committee(),
+            "clients_retired": len(departed),
+            "clients_joined": next_idx - cfg.client_num,
+            "stale_admitted": sum(1 for s in stale_admitted if s > 0),
+            "stale_refused": stale_refused,
+            "departed_wedged": wedged,
+            "replica_agrees": bool(agree),
+            "state_synced_mid_reseat_window": synced is not None,
+            "max_wal_bytes": max(wal_sizes),
+            "first_half_max_wal_bytes": max(wal_sizes[:half]),
+            "second_half_max_wal_bytes": max(wal_sizes[half:]),
+            "max_held_ops": max(held_ops),
+            "first_half_max_held_ops": max(held_ops[:half]),
+            "second_half_max_held_ops": max(held_ops[half:]),
+            "max_state_bytes": max(state_sizes),
+            "second_half_max_state_bytes": max(state_sizes[half:]),
+            "slo_false_pages": false_pages,
+            "slo": engine.report(),
+            "wall_time_s": round(time.monotonic() - t_start, 3),
+        }
 
 
 # --------------------------------------------------- control plane (PR 3)

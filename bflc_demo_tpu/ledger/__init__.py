@@ -33,7 +33,9 @@ def make_ledger(cfg: ProtocolConfig = DEFAULT_PROTOCOL, *,
                 "async_buffer > 0 needs the python ledger backend (the "
                 "native ledger has no async-op ABI)")
         return PyLedger(*args, async_buffer=cfg.async_buffer,
-                        max_staleness=cfg.max_staleness)
+                        max_staleness=cfg.max_staleness,
+                        async_reseat_every=getattr(
+                            cfg, "async_reseat_every", 0))
     if backend in ("auto", "native"):
         from bflc_demo_tpu.ledger import bindings
         if bindings.native_available():

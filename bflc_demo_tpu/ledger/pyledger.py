@@ -37,12 +37,19 @@ def _put_str(b: bytearray, s: str) -> None:
     b += struct.pack("<q", len(raw)) + raw
 
 
+# sentinel for async_commit's `seats`: "derive the seating yourself"
+# (the writer path).  Distinct from None, which means "the op carried
+# no seating" (the replay path for a plain 48-byte ACOMMIT body).
+_DERIVE_SEATS = object()
+
+
 class PyLedger:
     backend = "python"
 
     def __init__(self, client_num: int, comm_count: int, aggregate_count: int,
                  needed_update_count: int, genesis_epoch: int = -999,
-                 async_buffer: int = 0, max_staleness: int = 20):
+                 async_buffer: int = 0, max_staleness: int = 20,
+                 async_reseat_every: int = 0):
         self.client_num = client_num
         self.comm_count = comm_count
         self.aggregate_count = aggregate_count
@@ -54,6 +61,14 @@ class PyLedger:
         # can never contain them (the byte-for-byte legacy pin)
         self.async_buffer = max(int(async_buffer), 0)
         self.max_staleness = max(int(max_staleness), 0)
+        # deterministic async committee re-election: every R-th
+        # successful OP_ACOMMIT drain reseats the committee from the
+        # median-score ranking of the drained window (R = 0 keeps the
+        # frozen-committee legacy bytes exactly).  _acommit_count is
+        # protocol state: it decides WHICH drains reseat, so it rides
+        # the canonical state bytes and every replica agrees on it.
+        self.async_reseat_every = max(int(async_reseat_every), 0)
+        self._acommit_count = 0
         self._abuf: List[AsyncUpdateInfo] = []
         self._ascores: Dict[int, Dict[str, float]] = {}
         self._aseq_next = 0
@@ -570,17 +585,12 @@ class PyLedger:
         self._append_log(encode_ascores_op(sender, pairs))
         return LedgerStatus.OK
 
-    def async_selection(self, k: int):
-        """Deterministic committee selection over the oldest `k` buffered
-        entries: (entries, selected_indices, weights, global_loss).
-
-        Median committee score per entry (0.0 when unscored — liveness:
-        an idle committee must not wedge aggregation), ranked
-        (median desc, aseq asc), top aggregate_count selected, each
-        weighted n_samples * 1/sqrt(1+staleness) (the FedBuff discount).
-        Pure function of ledger state — the writer aggregates with it
-        and any replica can re-derive it from the same certified
-        prefix."""
+    def _async_rank(self, k: int):
+        """The ONE ranking both async_selection and derive_async_seats
+        share: (entries, medians, order) over the oldest `k` buffered
+        entries — median committee score per entry (0.0 unscored),
+        ranked (median desc, aseq asc).  Pure function of ledger
+        state."""
         entries = list(self._abuf[:k])
         medians = []
         for e in entries:
@@ -595,6 +605,20 @@ class PyLedger:
                                             + row[n // 2]))))
         order = sorted(range(len(entries)),
                        key=lambda i: (-medians[i], entries[i].aseq))
+        return entries, medians, order
+
+    def async_selection(self, k: int):
+        """Deterministic committee selection over the oldest `k` buffered
+        entries: (entries, selected_indices, weights, global_loss).
+
+        Median committee score per entry (0.0 when unscored — liveness:
+        an idle committee must not wedge aggregation), ranked
+        (median desc, aseq asc), top aggregate_count selected, each
+        weighted n_samples * 1/sqrt(1+staleness) (the FedBuff discount).
+        Pure function of ledger state — the writer aggregates with it
+        and any replica can re-derive it from the same certified
+        prefix."""
+        entries, medians, order = self._async_rank(k)
         take = min(self.aggregate_count, len(entries))
         selected = order[:take]
         weights = [float(np.float32(entries[i].n_samples
@@ -607,8 +631,60 @@ class PyLedger:
             / wsum)) if wsum > 0 else 0.0)
         return entries, selected, weights, loss
 
+    def async_reseat_due(self) -> bool:
+        """Would the NEXT successful async drain reseat the committee?
+        Pure function of certified state (the acommit counter), so the
+        writer, every validator replica, and the rederive plane agree
+        on which drains carry a seating."""
+        return (self.async_buffer > 0 and self.async_reseat_every > 0
+                and (self._acommit_count + 1)
+                % self.async_reseat_every == 0)
+
+    def derive_async_seats(self, k: int) -> List[str]:
+        """The deterministic async re-election rule: seat the senders
+        of the best-ranked entries in the about-to-drain window
+        (median desc, aseq asc — the exact async_selection ranking),
+        distinct senders first-ranked-wins, topped up from the
+        incumbent committee and then the remaining population in
+        registration order so the committee never shrinks below
+        comm_count.  Pure function of ledger state BEFORE the drain —
+        call it before async_commit mutates the buffer."""
+        entries, _, order = self._async_rank(k)
+        seats: List[str] = []
+        for i in order:
+            s = entries[i].sender
+            if s in self._roles and s not in seats:
+                seats.append(s)
+            if len(seats) >= self.comm_count:
+                break
+        if len(seats) < self.comm_count:
+            # top-up passes are registration-order scans (the same
+            # deterministic order genesis election used): incumbents
+            # first (seat stability), then anyone registered
+            for a in self._reg_order:
+                if self._roles.get(a) == "comm" and a not in seats:
+                    seats.append(a)
+                if len(seats) >= self.comm_count:
+                    break
+        if len(seats) < self.comm_count:
+            for a in self._reg_order:
+                if a not in seats:
+                    seats.append(a)
+                if len(seats) >= self.comm_count:
+                    break
+        return seats
+
     def async_commit(self, new_model_hash: bytes, epoch: int,
-                     k: int) -> LedgerStatus:
+                     k: int, seats=_DERIVE_SEATS) -> LedgerStatus:
+        """Drain the oldest `k` buffered entries into a new model.
+
+        `seats` is the committee-reseat claim: the writer passes the
+        default sentinel ("derive it"), the replay path (apply_op)
+        passes the op's embedded seating — None for a plain 48-byte
+        body, a list for the extended body.  A claim that disagrees
+        with this replica's own derivation is refused (BAD_ARG), which
+        is exactly how a lying writer's reseat dies at the BFT quorum:
+        every validator re-executes this op and refuses to co-sign."""
         if not self.async_buffer:
             return LedgerStatus.BAD_ARG
         if self._epoch == self.genesis_epoch:
@@ -617,6 +693,17 @@ class PyLedger:
             return LedgerStatus.WRONG_EPOCH
         if not 0 < k <= len(self._abuf):
             return LedgerStatus.NOT_READY
+        due = self.async_reseat_due()
+        derived = self.derive_async_seats(k) if due else None
+        if seats is _DERIVE_SEATS:
+            claimed = derived
+        else:
+            claimed = seats
+            if due:
+                if claimed is None or list(claimed) != derived:
+                    return LedgerStatus.BAD_ARG
+            elif claimed is not None:
+                return LedgerStatus.BAD_ARG
         _, _, _, loss = self.async_selection(k)
         for e in self._abuf[:k]:
             self._ascores.pop(e.aseq, None)
@@ -624,10 +711,23 @@ class PyLedger:
         self._model_hash = bytes(new_model_hash)
         self._last_loss = loss
         self._epoch += 1
+        self._acommit_count += 1
+        if due:
+            for a in self._roles:
+                self._roles[a] = "trainer"
+            for a in derived:
+                self._roles[a] = "comm"
         op = bytearray([_OP_ACOMMIT])
         op += bytes(new_model_hash)
         op += struct.pack("<q", epoch)
         op += struct.pack("<q", k)
+        if due:
+            # the seating rides the certified op so standbys replaying
+            # the chain and rederive shards verifying a drain all see
+            # the identical seats the quorum signed off on
+            op += struct.pack("<q", len(derived))
+            for a in derived:
+                _put_str(op, a)
         self._append_log(bytes(op))
         return LedgerStatus.OK
 
@@ -734,6 +834,14 @@ class PyLedger:
                      e.avg_cost, e.base_epoch, e.staleness)
                     for e in self._abuf],
                    {a: dict(rows) for a, rows in self._ascores.items()})
+        # the reseat counter is a second optional tail, emitted ONLY
+        # when re-election is armed: R=0 / legacy ledgers keep their
+        # exact pre-reseat state bytes (pinned by test), and a restored
+        # replica needs the counter or it would disagree on which
+        # future drains reseat
+        acommits = (self._acommit_count
+                    if self.async_buffer and self.async_reseat_every
+                    else None)
         return encode_state_dict({
             "epoch": self._epoch, "model_hash": self._model_hash,
             "last_loss": self._last_loss,
@@ -742,7 +850,8 @@ class PyLedger:
             "reg_order": self._reg_order, "roles": self._roles,
             "updates": [(u.sender, u.payload_hash, u.n_samples,
                          u.avg_cost) for u in self._updates],
-            "scores": self._scores, "pending": pend, "async": asy})
+            "scores": self._scores, "pending": pend, "async": asy,
+            "async_acommits": acommits})
 
     def state_digest(self) -> bytes:
         """SHA-256 of the canonical state — what a snapshot op embeds
@@ -790,6 +899,7 @@ class PyLedger:
             self._ascores = {int(a): {k: float(v)
                                       for k, v in r.items()}
                              for a, r in rows.items()}
+        self._acommit_count = int(d.get("async_acommits") or 0)
         self._ops = []
         self._log = []
         self._base = int(base)
@@ -841,14 +951,14 @@ class PyLedger:
                 self._writer_index,
                 list(self._abuf),
                 {k: dict(v) for k, v in self._ascores.items()},
-                self._aseq_next, len(self._ops))
+                self._aseq_next, self._acommit_count, len(self._ops))
 
     def _restore(self, snap) -> None:
         (self._epoch, self._model_hash, self._last_loss, self._reg_order,
          self._roles, self._updates, self._update_slot, self._scores,
          self._pending, self._closed, self._generation,
          self._writer_index, self._abuf, self._ascores,
-         self._aseq_next, n_ops) = snap
+         self._aseq_next, self._acommit_count, n_ops) = snap
         del self._ops[n_ops:]
         del self._log[n_ops:]
 
@@ -961,12 +1071,28 @@ class PyLedger:
                     p += 12
                 return self.async_scores(sender, pairs)
             if code == _OP_ACOMMIT:
-                if len(body) != 48:
+                if len(body) < 48:
                     return LedgerStatus.BAD_ARG
                 payload = body[:32]
                 ep, = struct.unpack_from("<q", body, 32)
                 k, = struct.unpack_from("<q", body, 40)
-                return self.async_commit(payload, ep, k)
+                seats = None
+                if len(body) > 48:
+                    # extended body: a committee-reseat claim — <q n>
+                    # then n length-prefixed addresses, no trailing
+                    # junk.  async_commit re-derives and refuses a
+                    # seating this replica disagrees with.
+                    n, = struct.unpack_from("<q", body, 48)
+                    if n <= 0 or n > (len(body) - 56) // 8:
+                        return LedgerStatus.BAD_ARG
+                    off = 56
+                    seats = []
+                    for _ in range(n):
+                        a, off = _str_at(off)
+                        seats.append(a)
+                    if off != len(body):
+                        return LedgerStatus.BAD_ARG
+                return self.async_commit(payload, ep, k, seats)
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)

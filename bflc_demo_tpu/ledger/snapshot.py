@@ -142,6 +142,16 @@ def encode_state_dict(d: Dict) -> bytes:
             for scorer in sorted(row):
                 _put_str(b, scorer)
                 b += struct.pack("<f", _np.float32(row[scorer]))
+    # async committee re-election tail (ProtocolConfig.async_reseat_every
+    # > 0 only): the drain counter that decides which future ACOMMITs
+    # reseat.  Emitted ONLY when re-election is armed, so R=0 / legacy
+    # async state bytes stay byte-identical to the pre-reseat layout.
+    acommits = d.get("async_acommits")
+    if acommits is not None:
+        if asy is None:
+            raise ValueError(
+                "async_acommits tail requires the async tail")
+        b += struct.pack("<q", int(acommits))
     return bytes(b)
 
 
@@ -243,6 +253,7 @@ def decode_state(blob: bytes) -> Dict:
         d["pending"] = None
     if off == len(blob):
         d["async"] = None               # legacy / synchronous layout
+        d["async_acommits"] = None
         return d
     # async buffered-aggregation tail (present iff the emitting ledger
     # ran with async_buffer > 0)
@@ -269,6 +280,11 @@ def decode_state(blob: bytes) -> Dict:
                              "length")
         rows[aseq] = {rd_str(): rd_f() for _ in range(ln)}
     d["async"] = (aseq_next, entries, rows)
+    # optional re-election tail: the acommit counter (present iff the
+    # emitting ledger ran with async_reseat_every > 0)
+    d["async_acommits"] = None
+    if off != len(blob):
+        d["async_acommits"] = rd_q()
     if off != len(blob):
         raise ValueError(f"snapshot state: {len(blob) - off} trailing "
                          f"bytes")
@@ -306,7 +322,10 @@ def restore_snapshot(state_bytes: bytes, cfg, base: int, base_head: bytes):
                    cfg.needed_update_count, cfg.genesis_epoch,
                    async_buffer=(cfg.async_buffer
                                  if async_enabled(cfg) else 0),
-                   max_staleness=getattr(cfg, "max_staleness", 20))
+                   max_staleness=getattr(cfg, "max_staleness", 20),
+                   async_reseat_every=(
+                       getattr(cfg, "async_reseat_every", 0)
+                       if async_enabled(cfg) else 0))
     led._install_state(state_bytes, base, base_head)
     return led
 

@@ -154,6 +154,15 @@ def decode_op(op: bytes) -> dict:
             out["model_hash"] = body[:32].hex()
             out["epoch"], = struct.unpack_from("<q", body, 32)
             out["drained"], = struct.unpack_from("<q", body, 40)
+            if len(body) > 48:
+                # extended body: the embedded committee-reseat claim
+                # (async re-election, ProtocolConfig.async_reseat_every)
+                n, = struct.unpack_from("<q", body, 48)
+                off, addrs = 56, []
+                for _ in range(max(0, min(n, (len(body) - 56) // 8))):
+                    a, off = s_at(off)
+                    addrs.append(a)
+                out["committee"] = addrs
     except (struct.error, ValueError, UnicodeDecodeError) as e:
         out["malformed"] = f"{type(e).__name__}: {e}"
     return out

@@ -91,7 +91,17 @@ class SLOSpec:
     bound is healthy); ``budget`` is the tolerated breach fraction
     (0.1 = one round in ten may breach before burn reaches 1.0).
     A round whose signal is None is SKIPPED — absence of data is a
-    coverage problem (its own SLO), never a breach of this one."""
+    coverage problem (its own SLO), never a breach of this one.
+
+    ``warmup > 0`` arms ADAPTIVE baselining: the first ``warmup``
+    observed samples are collected (not judged) and the effective bound
+    is learned from that healthy history as a robust envelope —
+    ``median + adapt_mult * max(MAD, adapt_floor)`` for ``"<="``
+    objectives (mirrored for ``">="``) — then clamped to never be more
+    LAX than the static ``bound`` (the static bound stays the outer
+    guard-rail; adaptation only tightens toward what this deployment
+    actually delivers).  Median/MAD, not mean/stddev: one straggler
+    round in the warmup must not inflate the baseline it anchors."""
     name: str
     signal: str
     bound: float
@@ -99,6 +109,10 @@ class SLOSpec:
     budget: float = 0.1
     fast_window: int = 5
     slow_window: int = 25
+    # adaptive baselining (0 = static bound)
+    warmup: int = 0
+    adapt_mult: float = 4.0
+    adapt_floor: float = 0.0
     # page when fast >= burn_fast AND slow >= burn_slow.  Windows
     # younger than their configured length are PADDED with healthy
     # history (the denominator is the configured window), so the
@@ -112,9 +126,28 @@ class SLOSpec:
     burn_slow: float = 0.6
     description: str = ""
 
-    def healthy(self, value: float) -> bool:
-        return (value <= self.bound if self.op == "<="
-                else value >= self.bound)
+    def healthy(self, value: float, bound: Optional[float] = None) -> bool:
+        b = self.bound if bound is None else bound
+        return (value <= b if self.op == "<=" else value >= b)
+
+    def learn_bound(self, samples: List[float]) -> float:
+        """The adaptive-envelope rule (class docstring): robust center +
+        scaled robust spread, clamped by the static bound so a slow
+        warmup can only tighten, never loosen, the objective."""
+        med = _median(samples)
+        mad = _median([abs(v - med) for v in samples])
+        spread = self.adapt_mult * max(mad, self.adapt_floor)
+        if self.op == "<=":
+            return min(self.bound, med + spread)
+        return max(self.bound, med - spread)
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return 0.0
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
 
 
 def burn_rate(breaches: int, window: int, budget: float) -> float:
@@ -140,21 +173,48 @@ class _SLOState:
     active: bool = False
     last_fast_burn: float = 0.0
     last_slow_burn: float = 0.0
+    # adaptive baselining (SLOSpec.warmup): healthy-history samples
+    # collected during warmup, then the learned effective bound
+    baseline: List[float] = field(default_factory=list)
+    learned_bound: Optional[float] = None
+
+    def bound(self) -> float:
+        return (self.learned_bound if self.learned_bound is not None
+                else self.spec.bound)
+
+
+def adaptive_warmup() -> int:
+    """BFLC_SLO_ADAPTIVE=W arms adaptive baselining: wall-clock-shaped
+    objectives learn their bound from the run's own first W healthy
+    rounds instead of the deployment-agnostic static default (0 = off).
+    Malformed values read as off — a typo must not change judging."""
+    try:
+        return max(int(os.environ.get("BFLC_SLO_ADAPTIVE", "0")), 0)
+    except ValueError:
+        return 0
 
 
 def default_slos(*, round_latency_s: float = 30.0,
                  certify_latency_s: float = 5.0,
                  max_staleness: float = 8.0,
                  scrape_coverage: float = 0.9,
-                 acc_regression: float = 0.05) -> List[SLOSpec]:
+                 acc_regression: float = 0.05,
+                 warmup: Optional[int] = None) -> List[SLOSpec]:
     """The standing fleet objectives.  Bounds are deployment knobs —
     the process runtime scales round_latency off its own timeout and
     staleness off the protocol genome; these defaults suit config-1
-    geometry on a shared host."""
+    geometry on a shared host.  ``warmup`` (default: BFLC_SLO_ADAPTIVE)
+    arms adaptive baselining on the wall-clock objectives — round and
+    certify latency, whose absolute bounds are host-dependent; the
+    protocol-genome and fraction objectives stay static (their bounds
+    are principled, not environmental)."""
+    w = adaptive_warmup() if warmup is None else max(int(warmup), 0)
     return [
         SLOSpec("round_latency", "round_wall_s", round_latency_s,
+                warmup=w, adapt_floor=0.25,
                 description="commit-to-commit round wall time"),
         SLOSpec("certify_latency", "certify_p95_s", certify_latency_s,
+                warmup=w, adapt_floor=0.05,
                 description="per-round p95 BFT certification latency "
                             "(cumulative-histogram delta)"),
         SLOSpec("async_staleness", "staleness_p95", max_staleness,
@@ -171,6 +231,15 @@ def default_slos(*, round_latency_s: float = 30.0,
                 acc_regression,
                 description="committed accuracy must stay within "
                             "acc_regression of the best seen"),
+        # validator re-derivation coverage (ledger.rederive): a skipped
+        # re-derivation means a commit was certified WITHOUT its model
+        # hash being reproduced — tolerable as a rare cache race, a
+        # sustained burn is a coverage hole in the trust plane.  Only
+        # fires on fleets whose scrapes carry the validator counter.
+        SLOSpec("rederive_skip", "rederive_skipped_delta", 0.0,
+                budget=0.05,
+                description="validator re-derivations skipped this "
+                            "round (fleet-wide counter delta)"),
     ]
 
 
@@ -215,7 +284,19 @@ class SLOEngine:
             value = summary.get(spec.signal)
             if value is None:
                 continue                    # no data != breach
-            breached = not spec.healthy(float(value))
+            if spec.warmup > 0 and st.learned_bound is None:
+                # adaptive warmup: collect, don't judge — these rounds
+                # ARE the healthy history the bound is learned from
+                st.baseline.append(float(value))
+                if len(st.baseline) >= spec.warmup:
+                    st.learned_bound = spec.learn_bound(st.baseline)
+                    obs_flight.FLIGHT.record(
+                        "event", "slo_baseline_learned", slo=spec.name,
+                        epoch=epoch, samples=len(st.baseline),
+                        bound=round(st.learned_bound, 6),
+                        static_bound=spec.bound)
+                continue
+            breached = not spec.healthy(float(value), st.bound())
             st.judged += 1
             st.fast.append(1 if breached else 0)
             st.slow.append(1 if breached else 0)
@@ -253,7 +334,7 @@ class SLOEngine:
         alert = {
             "type": "slo_alert", "t": time.time(), "slo": spec.name,
             "epoch": epoch, "signal": spec.signal,
-            "value": round(value, 6), "bound": spec.bound,
+            "value": round(value, 6), "bound": st.bound(),
             "op": spec.op, "budget": spec.budget,
             "burn_fast": round(fast, 3), "burn_slow": round(slow, 3),
             "windows": {"fast": list(st.fast), "slow_breaches":
@@ -271,7 +352,7 @@ class SLOEngine:
         # disk even if the driver dies next — record AND flush
         obs_flight.FLIGHT.record(
             "event", "slo_alert", slo=spec.name, epoch=epoch,
-            value=round(value, 6), bound=spec.bound,
+            value=round(value, 6), bound=st.bound(),
             burn_fast=round(fast, 3), burn_slow=round(slow, 3))
         obs_flight.FLIGHT.flush("slo_alert")
         self._write_alerts()
@@ -351,7 +432,12 @@ class SLOEngine:
                 name: {"judged": st.judged, "breaches": st.breaches,
                        "alerts": st.alerts, "active": st.active,
                        "burn_fast": round(st.last_fast_burn, 3),
-                       "burn_slow": round(st.last_slow_burn, 3)}
+                       "burn_slow": round(st.last_slow_burn, 3),
+                       **({"learned_bound":
+                           round(st.learned_bound, 6)
+                           if st.learned_bound is not None else None,
+                           "warmup_collected": len(st.baseline)}
+                          if st.spec.warmup > 0 else {})}
                 for name, st in self._state.items()},
         }
 

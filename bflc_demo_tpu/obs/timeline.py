@@ -117,6 +117,26 @@ def _gauge(snapshot: dict, name: str, default=None):
     return s[0].get("value", default) if s else default
 
 
+def _counter_sum(snapshot: dict, name: str) -> Optional[float]:
+    """Sum of a counter's samples across label sets for one role
+    snapshot; None when the role doesn't export the metric."""
+    s = ((snapshot.get("metrics") or {}).get(name) or {}).get(
+        "samples")
+    if not s:
+        return None
+    return float(sum(x.get("value", 0.0) for x in s))
+
+
+def _fleet_counter(roles: dict, name: str,
+                   prefix: str = "validator") -> Optional[float]:
+    """Fleet-wide counter total over every answering role whose name
+    starts with `prefix`; None when no such role exports it."""
+    vals = [_counter_sum(snap, name) for role, snap in roles.items()
+            if role.startswith(prefix) and snap]
+    vals = [v for v in vals if v is not None]
+    return sum(vals) if vals else None
+
+
 class RoundTimeline:
     """The streaming joiner (module docstring).  Feed it canonical
     records via ``observe*``; query joined rounds via
@@ -137,6 +157,7 @@ class RoundTimeline:
         self.alerts: List[dict] = []
         self.spans: List[dict] = []
         self._prev_scrape_roles: Optional[dict] = None
+        self._prev_rederive_skip: Optional[float] = None
         self._span_reports: Optional[Dict[int, dict]] = None
 
     # ------------------------------------------------------------ ingest
@@ -207,6 +228,19 @@ class RoundTimeline:
                             "upload_lag_seconds")
                 if self._prev_scrape_roles is not None else None),
         }
+        # validator-plane coverage: fleet-summed rederive_skipped_total,
+        # differenced scrape-to-scrape so the SLO judges THIS round's
+        # skips, not the whole run's.  A shrinking total (validator
+        # restart reset its counter) reads as zero, never negative.
+        skip_total = _fleet_counter(roles, "rederive_skipped_total")
+        if skip_total is not None:
+            prev = self._prev_rederive_skip
+            digest["rederive_skipped_delta"] = (
+                max(skip_total - prev, 0.0) if prev is not None
+                else skip_total)
+            self._prev_rederive_skip = skip_total
+        else:
+            digest["rederive_skipped_delta"] = None
         if writer_answered is not None:
             self._prev_scrape_roles = writer_answered
         if r is not None and r >= 0:
@@ -359,6 +393,20 @@ class RoundTimeline:
                                  for s in scrapes) or None,
             "alerts": [a for a in self.alerts if a.get("epoch") == r],
         }
+        # committee seating: the writer's committee_reseat flight events
+        # (async re-election, ProtocolConfig.async_reseat_every) name the
+        # seats as of each reseat epoch — the round's seated committee
+        # is the newest reseat at or before it
+        reseats = [n for n in self.notes
+                   if n.get("name") == "committee_reseat"
+                   and isinstance(n.get("epoch"), int)
+                   and isinstance(n.get("seats"), list)]
+        if reseats:
+            seated = max((n for n in reseats if n["epoch"] <= r),
+                         key=lambda n: n["epoch"], default=None)
+            if seated is not None:
+                rec["committee"] = list(seated["seats"])
+            rec["reseat"] = any(n["epoch"] == r for n in reseats) or None
         rep = self._reports_by_epoch().get(r)
         if rep is not None:
             rec["trace"] = {
@@ -419,6 +467,7 @@ class RoundTimeline:
                 round(best_prior - float(acc), 6)
                 if acc is not None and best_prior is not None
                 else None),
+            "rederive_skipped_delta": last.get("rederive_skipped_delta"),
         }
 
 

@@ -85,6 +85,17 @@ class ProtocolConfig:
     async_buffer: int = 0
     max_staleness: int = 20
 
+    # asynchronous committee re-election (the BFLC re-election loop,
+    # restored for the async path): every R-th opcode-12 drain reseats
+    # the committee from the median-score ranking of the drained
+    # window — derived purely from the certified op stream, so writer,
+    # validators, standbys and the rederive plane all compute the
+    # identical seating and a writer cannot certify a seating it did
+    # not derive (validators re-execute the extended ACOMMIT body and
+    # refuse a mismatch).  0 (the default) or BFLC_ASYNC_LEGACY=1 pins
+    # today's frozen-committee async bytes exactly.
+    async_reseat_every: int = 0
+
     def validate(self) -> "ProtocolConfig":
         if not (0 < self.comm_count < self.client_num):
             raise ValueError(
@@ -121,6 +132,16 @@ class ProtocolConfig:
                 f"in-flight delta per sender the buffer could never "
                 f"fill and every aggregation would wait on stall "
                 f"recovery")
+        if self.async_reseat_every < 0:
+            raise ValueError(
+                f"async_reseat_every must be >= 0, got "
+                f"{self.async_reseat_every}")
+        if self.async_reseat_every > 0 and self.async_buffer <= 0:
+            raise ValueError(
+                "async_reseat_every requires async mode "
+                f"(async_buffer > 0), got reseat_every="
+                f"{self.async_reseat_every} with async_buffer="
+                f"{self.async_buffer}")
         return self
 
     @property

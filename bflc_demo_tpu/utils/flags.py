@@ -103,10 +103,22 @@ def build_parser() -> argparse.ArgumentParser:
         flag = "--" + f.name.replace("_", "-")
         if f.name == "chaos_profile":
             # validate at parse time (a typo must be an argparse error,
-            # not a mid-run ValueError from the schedule generator)
+            # not a mid-run ValueError from the schedule generator);
+            # "+"-composed blends (e.g. heavytail+churn) are one profile
             from bflc_demo_tpu.chaos.schedule import PROFILES
-            p.add_argument(flag, choices=sorted(PROFILES),
-                           default=f.default)
+
+            def _profile(v: str) -> str:
+                parts = [pt for pt in v.split("+") if pt]
+                bad = [pt for pt in parts if pt not in PROFILES]
+                if not parts or bad:
+                    raise argparse.ArgumentTypeError(
+                        f"unknown chaos profile {v!r}; have "
+                        f"{sorted(PROFILES)} (composable with '+')")
+                return v
+
+            p.add_argument(flag, type=_profile, default=f.default,
+                           help="chaos profile, single or '+'-composed "
+                                f"(have {sorted(PROFILES)})")
         elif f.name == "rederive":
             from bflc_demo_tpu.rederive import REDERIVE_MODES
             p.add_argument(flag, choices=list(REDERIVE_MODES),

@@ -45,7 +45,7 @@ class TestFaultSchedule:
 
     def test_profiles_and_structure(self):
         assert set(PROFILES) == {"light", "standard", "heavy",
-                                 "heavytail"}
+                                 "heavytail", "churn"}
         with pytest.raises(ValueError):
             FaultSchedule(1, duration_s=60, n_clients=4, n_standbys=1,
                           n_validators=4, profile="nope")

@@ -1,0 +1,456 @@
+"""Async committee re-election + production endurance (ISSUE 16): the
+deterministic reseat rule (every R-th buffered drain reseats the
+committee from the drained window's median-score ranking), its
+replay/snapshot determinism properties, the lying-writer refusals, the
+R=0 / BFLC_ASYNC_LEGACY byte pins, the churn chaos profile and its
+"+"-composition, adaptive SLO baselining, and the tier-1 twin of the
+multi-thousand-round endurance campaign (bench.py
+extra.endurance_async).
+"""
+
+import dataclasses
+import hashlib
+import random
+import struct
+
+import pytest
+
+from bflc_demo_tpu.ledger import LedgerStatus, async_enabled, make_ledger
+from bflc_demo_tpu.ledger.pyledger import _OP_ACOMMIT, _put_str
+from bflc_demo_tpu.ledger.snapshot import decode_state, restore_snapshot
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+
+RCFG = ProtocolConfig(client_num=6, comm_count=2, aggregate_count=2,
+                      needed_update_count=3, learning_rate=0.05,
+                      batch_size=16, async_buffer=3, max_staleness=4,
+                      async_reseat_every=2).validate()
+
+
+def _h(tag) -> bytes:
+    return hashlib.sha256(repr(tag).encode()).digest()
+
+
+def _led(cfg=RCFG):
+    led = make_ledger(cfg)
+    for i in range(cfg.client_num):
+        assert led.register_node(f"c{i}") == LedgerStatus.OK
+    return led
+
+
+def _drain(led, senders, scores=None, scorer=None):
+    """One buffered round: fill from `senders`, optionally score every
+    live entry, drain all of them."""
+    ep = led.epoch
+    for j, s in enumerate(senders):
+        assert led.async_upload(s, _h((ep, s)), 10 + j, 1.0,
+                                ep) == LedgerStatus.OK
+    if scores is not None:
+        who = scorer or led.committee()[0]
+        live = [e.aseq for e in led.async_buffer_view()]
+        assert led.async_scores(
+            who, list(zip(live, scores))) == LedgerStatus.OK
+    assert led.async_commit(_h(("m", ep)), ep,
+                            len(senders)) == LedgerStatus.OK
+
+
+def _replay(led, cfg=RCFG):
+    replica = make_ledger(cfg)
+    for i in range(led.log_size()):
+        assert replica.apply_op(led.log_op(i)) == LedgerStatus.OK
+    return replica
+
+
+class TestReseatRule:
+    def test_due_schedule_and_seating_from_window(self):
+        led = _led()
+        genesis_committee = led.committee()
+        # R=2: the first drain keeps the genesis committee, the second
+        # reseats it from the drained window's score ranking
+        assert not led.async_reseat_due()
+        _drain(led, ["c0", "c1", "c2"], [0.5, 0.5, 0.5])
+        assert led.committee() == genesis_committee
+        assert led.async_reseat_due()
+        # rank the window: c4 (0.9) then c5 (0.6) — those two get seated
+        ep = led.epoch
+        for j, s in enumerate(["c3", "c4", "c5"]):
+            assert led.async_upload(s, _h((ep, s)), 10 + j, 1.0,
+                                    ep) == LedgerStatus.OK
+        live = [e.aseq for e in led.async_buffer_view()]
+        assert led.async_scores(
+            led.committee()[0],
+            list(zip(live, [0.1, 0.9, 0.6]))) == LedgerStatus.OK
+        derived = led.derive_async_seats(3)
+        assert derived == ["c4", "c5"]
+        assert led.async_commit(_h(("m", ep)), ep, 3) == LedgerStatus.OK
+        assert set(led.committee()) == {"c4", "c5"}
+        # the counter reset the cadence: next drain is not a reseat
+        assert not led.async_reseat_due()
+
+    def test_unscored_window_tops_up_from_incumbents(self):
+        """A reseat over an unscored window still seats comm_count
+        addresses deterministically (rank ties at 0.0 → aseq order,
+        top-up scans registration order)."""
+        led = _led()
+        _drain(led, ["c0", "c1", "c2"])             # no scores at all
+        ep = led.epoch
+        assert led.async_reseat_due()
+        for s in ["c3", "c4"]:
+            assert led.async_upload(s, _h((ep, s)), 10, 1.0,
+                                    ep) == LedgerStatus.OK
+        derived = led.derive_async_seats(2)
+        assert len(derived) == RCFG.comm_count
+        assert derived == ["c3", "c4"]              # aseq order at 0.0
+        assert led.async_commit(_h(("m", ep)), ep, 2) == LedgerStatus.OK
+        assert set(led.committee()) == set(derived)
+
+
+class TestReseatDeterminismProperty:
+    """Every role derives the identical seating: full-chain replicas,
+    snapshot-restored standbys joining mid-reseat-window, and the
+    writer itself — across randomized arrival orders and scorings."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_shuffled_arrivals_replica_and_snapshot_agree(self, seed):
+        rng = random.Random(seed)
+        led = _led()
+        mid, mid_pos = None, 0
+        for r in range(6):
+            senders = rng.sample([f"c{i}" for i in range(6)], 3)
+            scores = [round(rng.random(), 3) for _ in senders]
+            scorer = rng.choice(led.committee())
+            _drain(led, senders, scores, scorer)
+            if r == 2:
+                # a standby state-syncs mid-window (R=2: after drain 3
+                # the counter sits mid-cadence) and must re-derive the
+                # remaining reseats identically
+                mid_pos = led.log_size()
+                mid = restore_snapshot(led.encode_state(), RCFG,
+                                       mid_pos, led.log_head())
+                assert mid.async_reseat_due() == led.async_reseat_due()
+        # a full-chain replica replays every op
+        replica = _replay(led)
+        assert replica.log_head() == led.log_head()
+        assert replica.state_digest() == led.state_digest()
+        assert replica.committee() == led.committee()
+        # the mid-run standby continues from its chain position
+        for i in range(mid_pos, led.log_size()):
+            assert mid.apply_op(led.log_op(i)) == LedgerStatus.OK
+        assert mid.log_head() == led.log_head()
+        assert mid.state_digest() == led.state_digest()
+        assert mid.committee() == led.committee()
+
+    def test_crash_rejoin_mid_window_via_wal(self, tmp_path):
+        """A writer crash between the (R-1)-th and R-th drain: WAL
+        replay restores the acommit counter, so the rejoined process
+        reseats on the exact drain the dead one would have."""
+        path = str(tmp_path / "reseat.wal")
+        led = _led()
+        assert led.attach_wal(path)
+        _drain(led, ["c0", "c1", "c2"], [0.5, 0.4, 0.3])
+        due_before = led.async_reseat_due()
+        assert due_before                           # mid-window crash
+        led.detach_wal()
+        risen = make_ledger(RCFG)
+        assert risen.replay_wal(path) > 0
+        assert risen.log_head() == led.log_head()
+        assert risen.async_reseat_due() == due_before
+        _drain(risen, ["c3", "c4", "c5"], [0.2, 0.9, 0.1])
+        _drain(led, ["c3", "c4", "c5"], [0.2, 0.9, 0.1])
+        assert risen.committee() == led.committee()
+        assert set(risen.committee()) == {"c3", "c4"}
+        assert risen.log_head() == led.log_head()
+        assert risen.state_digest() == led.state_digest()
+
+
+class TestLyingWriterRefused:
+    """The seating claim embedded in an extended ACOMMIT body is
+    re-derived by every replica; disagreement is BAD_ARG — the op never
+    certifies at a BFT quorum."""
+
+    def _at_due_drain(self):
+        led = _led()
+        _drain(led, ["c0", "c1", "c2"], [0.5, 0.4, 0.3])
+        replica = _replay(led)
+        ep = led.epoch
+        for j, s in enumerate(["c3", "c4", "c5"]):
+            for node in (led, replica):
+                assert node.async_upload(s, _h((ep, s)), 10 + j, 1.0,
+                                         ep) == LedgerStatus.OK
+        assert led.async_reseat_due() and replica.async_reseat_due()
+        return led, replica, ep
+
+    @staticmethod
+    def _acommit_op(mh, ep, k, seats):
+        op = bytearray([_OP_ACOMMIT])
+        op += mh + struct.pack("<qq", ep, k)
+        if seats is not None:
+            op += struct.pack("<q", len(seats))
+            for a in seats:
+                _put_str(op, a)
+        return bytes(op)
+
+    def test_forged_seating_refused_then_honest_one_lands(self):
+        led, replica, ep = self._at_due_drain()
+        honest = led.derive_async_seats(3)
+        lie = ["c0", "c1"]
+        assert lie != honest
+        before = replica.state_digest()
+        assert replica.apply_op(self._acommit_op(
+            _h(("m", ep)), ep, 3, lie)) == LedgerStatus.BAD_ARG
+        assert replica.state_digest() == before     # refusal is pure
+        # a due drain claiming NO reseat (plain 48-byte body) also dies
+        assert replica.apply_op(self._acommit_op(
+            _h(("m", ep)), ep, 3, None)) == LedgerStatus.BAD_ARG
+        # the honest writer's op replays cleanly
+        assert led.async_commit(_h(("m", ep)), ep, 3) == LedgerStatus.OK
+        assert replica.apply_op(
+            led.log_op(led.log_size() - 1)) == LedgerStatus.OK
+        assert replica.committee() == led.committee() == honest
+
+    def test_seating_on_a_non_due_drain_refused(self):
+        led = _led()
+        ep = led.epoch
+        for j, s in enumerate(["c0", "c1", "c2"]):
+            assert led.async_upload(s, _h((ep, s)), 10 + j, 1.0,
+                                    ep) == LedgerStatus.OK
+        assert not led.async_reseat_due()
+        assert led.apply_op(self._acommit_op(
+            _h(("m", ep)), ep, 3,
+            ["c0", "c1"])) == LedgerStatus.BAD_ARG
+
+    def test_malformed_extension_refused(self):
+        led, replica, ep = self._at_due_drain()
+        honest = led.derive_async_seats(3)
+        good = self._acommit_op(_h(("m", ep)), ep, 3, honest)
+        assert replica.apply_op(good + b"\x00") == LedgerStatus.BAD_ARG
+        zero = self._acommit_op(_h(("m", ep)), ep, 3, [])
+        assert replica.apply_op(zero) == LedgerStatus.BAD_ARG
+
+
+class TestLegacyBytePins:
+    """R=0 (the default) and BFLC_ASYNC_LEGACY=1 pin the pre-reseat
+    byte formats exactly: no acommit-counter tail in the canonical
+    state, golden chain/state digests unchanged run over run."""
+
+    # digests captured from the frozen-committee async format (R=0):
+    # any drift in the ACOMMIT codec or the canonical state layout for
+    # non-reseating chains fails here
+    GOLDEN_R0_HEAD = ("af0cf91c0e7ac131616a4a9c95f07985"
+                      "6c5e14e34c30838be89c64f37ab5d714")
+    GOLDEN_R0_STATE = ("eaf08845ece8b23bdbf8040973f53250"
+                       "206eaf99c886c5cdb19df6345601a324")
+
+    @staticmethod
+    def _scripted_r0():
+        cfg = dataclasses.replace(RCFG,
+                                  async_reseat_every=0).validate()
+        led = make_ledger(cfg)
+        for i in range(cfg.client_num):
+            assert led.register_node(f"c{i}") == LedgerStatus.OK
+        scorer = led.committee()[0]
+        for ep in range(2):
+            for j, s in enumerate(["c0", "c1", "c2"]):
+                assert led.async_upload(s, _h((ep, s)), 10 + j, 1.0,
+                                        ep) == LedgerStatus.OK
+            live = [e.aseq for e in led.async_buffer_view()]
+            assert led.async_scores(
+                scorer,
+                list(zip(live, [0.2, 0.9, 0.5]))) == LedgerStatus.OK
+            assert led.async_commit(_h(("m", ep)), ep,
+                                    3) == LedgerStatus.OK
+        return led
+
+    def test_r0_twin_runs_byte_identical_and_pinned(self):
+        a, b = self._scripted_r0(), self._scripted_r0()
+        assert a.log_head() == b.log_head()
+        assert a.encode_state() == b.encode_state()
+        assert a.log_head().hex() == self.GOLDEN_R0_HEAD
+        assert hashlib.sha256(
+            a.encode_state()).hexdigest() == self.GOLDEN_R0_STATE
+        # no reseat cadence -> no counter tail in the canonical state
+        assert decode_state(a.encode_state())["async_acommits"] is None
+        assert not a.async_reseat_due()
+
+    def test_r_positive_state_carries_and_restores_the_counter(self):
+        led = _led()
+        _drain(led, ["c0", "c1", "c2"], [0.5, 0.4, 0.3])
+        d = decode_state(led.encode_state())
+        assert d["async_acommits"] == 1
+        r = restore_snapshot(led.encode_state(), RCFG, led.log_size(),
+                             led.log_head())
+        assert led.async_reseat_due()
+        assert r.async_reseat_due()
+
+    def test_async_legacy_env_disables_the_reseat_family(self,
+                                                         monkeypatch):
+        monkeypatch.setenv("BFLC_ASYNC_LEGACY", "1")
+        assert not async_enabled(RCFG)
+        led = make_ledger(RCFG)
+        assert getattr(led, "async_buffer", 0) == 0
+
+    def test_reseat_requires_async_buffer(self):
+        with pytest.raises(ValueError, match="async_reseat_every"):
+            dataclasses.replace(RCFG, async_buffer=0,
+                                async_reseat_every=2).validate()
+
+
+class TestChurnSchedule:
+    def _mk(self, profile, seed=7):
+        from bflc_demo_tpu.chaos.schedule import FaultSchedule
+        return FaultSchedule(seed, duration_s=120, n_clients=6,
+                             n_standbys=1, n_validators=4,
+                             profile=profile)
+
+    def test_churn_profile_seeded_floor_and_cap(self):
+        s1, s2 = self._mk("churn"), self._mk("churn")
+        assert [e.as_dict() for e in s1.events] == \
+            [e.as_dict() for e in s2.events]
+        assert s1.events, "a 120s churn campaign must move members"
+        assert {e.kind for e in s1.events} <= {"retire", "join"}
+        assert not s1.wire_windows          # membership only, no wire
+        live = set(range(6))
+        floor = max(2, round(6 * 0.5))
+        joined = 0
+        for e in sorted(s1.events, key=lambda e: e.t):
+            i = int(e.target.split("-")[1])
+            if e.kind == "retire":
+                live.discard(i)
+                assert len(live) >= floor
+            else:
+                assert i >= 6               # fresh index, never reuse
+                live.add(i)
+                joined += 1
+        assert joined <= round(6 * 2.0)
+
+    def test_composition_overlays_without_perturbing_parts(self):
+        both = self._mk("heavytail+churn")
+        churn = self._mk("churn")
+        # composed parts draw from derived per-part streams: the same
+        # seed gives the composed campaign heavytail's wire shape AND a
+        # churn trajectory, each deterministic in its own right
+        assert set(both.wire_windows) == {f"client-{i}"
+                                          for i in range(6)}
+        assert {e.kind for e in both.events} <= {"retire", "join"}
+        assert both.events
+        again = self._mk("heavytail+churn")
+        assert [e.as_dict() for e in both.events] == \
+            [e.as_dict() for e in again.events]
+        assert {r: [w.as_dict() for w in ws]
+                for r, ws in both.wire_windows.items()} == \
+            {r: [w.as_dict() for w in ws]
+             for r, ws in again.wire_windows.items()}
+        # single-name profiles keep their pre-composition rng stream
+        solo1, solo2 = self._mk("churn"), self._mk("churn")
+        assert [e.as_dict() for e in solo1.events] == \
+            [e.as_dict() for e in solo2.events]
+        assert churn.events  # and the solo stream still yields churn
+
+    def test_unknown_and_empty_compositions_refused(self):
+        with pytest.raises(ValueError, match="unknown chaos profile"):
+            self._mk("heavytail+nope")
+        with pytest.raises(ValueError, match="unknown chaos profile"):
+            self._mk("+")
+
+
+class TestAdaptiveSLO:
+    def _spec(self, **kw):
+        from bflc_demo_tpu.obs.slo import SLOSpec
+        kw.setdefault("budget", 0.2)
+        return SLOSpec("lat", "v", 30.0, warmup=4, adapt_mult=4.0,
+                       adapt_floor=0.1, **kw)
+
+    def test_warmup_collects_then_learns_a_tight_bound(self):
+        from bflc_demo_tpu.obs.slo import SLOEngine
+        eng = SLOEngine([self._spec()])
+        for v in (1.0, 1.1, 1.2, 1.3):      # warmup: collected, not judged
+            assert eng.observe_round({"epoch": 0, "v": v}) == []
+        rep = eng.report()["slos"]["lat"]
+        assert rep["judged"] == 0 and rep["warmup_collected"] == 4
+        lb = rep["learned_bound"]
+        assert lb is not None and lb < 30.0
+        # median 1.15, MAD 0.1 -> 1.15 + 4*0.1 = 1.55
+        assert lb == pytest.approx(1.55, abs=1e-6)
+        # a value healthy vs the static bound but sick vs the learned
+        # one now breaches
+        assert eng.observe_round({"epoch": 1, "v": 5.0}) == []
+        assert eng.report()["slos"]["lat"]["breaches"] == 1
+        assert eng.observe_round({"epoch": 2, "v": 1.2}) == []
+        assert eng.report()["slos"]["lat"]["breaches"] == 1
+
+    def test_learned_bound_never_laxer_than_static(self):
+        spec = self._spec()
+        assert spec.learn_bound([100.0, 100.0, 100.0]) == 30.0
+        ge = self._spec(op=">=")
+        # ">=" mirror: learned bound can only RISE above the static
+        assert ge.learn_bound([100.0, 100.0, 100.0]) >= 30.0
+
+    def test_adaptive_env_parse(self, monkeypatch):
+        from bflc_demo_tpu.obs.slo import adaptive_warmup, default_slos
+        monkeypatch.setenv("BFLC_SLO_ADAPTIVE", "17")
+        assert adaptive_warmup() == 17
+        slos = {s.name: s for s in default_slos()}
+        assert slos["round_latency"].warmup == 17
+        assert slos["certify_latency"].warmup == 17
+        assert slos["async_staleness"].warmup == 0   # principled bound
+        monkeypatch.setenv("BFLC_SLO_ADAPTIVE", "banana")
+        assert adaptive_warmup() == 0
+
+    def test_rederive_skip_objective_judges_the_counter_delta(self):
+        from bflc_demo_tpu.obs.slo import SLOEngine, default_slos
+        slos = [s for s in default_slos()
+                if s.name == "rederive_skip"]
+        assert slos and slos[0].bound == 0.0
+        eng = SLOEngine(slos)
+        eng.observe_round({"epoch": 0, "rederive_skipped_delta": 0.0})
+        assert eng.report()["slos"]["rederive_skip"]["breaches"] == 0
+        for ep in range(1, 4):
+            eng.observe_round({"epoch": ep,
+                               "rederive_skipped_delta": 2.0})
+        rep = eng.report()["slos"]["rederive_skip"]
+        assert rep["breaches"] == 3 and rep["alerts"] >= 1
+
+
+class TestEnduranceAsyncCampaign:
+    """The headline artifact, tier-1 twin geometry: every acceptance
+    criterion of the 2,000-round campaign at a 240-round scale that
+    fits the tier-1 budget (measured well under a second)."""
+
+    def _assert_campaign(self, out):
+        assert out["epochs_monotone"], out
+        assert out["reseats"] > 0, out
+        assert len(out["final_committee"]) == 3, out
+        assert out["clients_retired"] > 0, out
+        assert out["clients_joined"] > 0, out
+        assert out["stale_admitted"] > 0, out
+        assert out["stale_refused"] > 0, out
+        # churned-out senders' in-flight deltas never wedge the buffer
+        assert out["departed_wedged"] == 0, out
+        # every role derives the identical seating
+        assert out["replica_agrees"], out
+        assert out["state_synced_mid_reseat_window"], out
+        # bounded memory + bounded WAL: the second half's ceilings do
+        # not exceed the first's (+1 op of commit-size slack)
+        assert out["second_half_max_wal_bytes"] <= \
+            out["first_half_max_wal_bytes"] + 512, out
+        assert out["second_half_max_held_ops"] <= \
+            out["first_half_max_held_ops"] + 4, out
+        # adaptive SLOs judged every post-warmup round, zero false pages
+        assert out["slo_false_pages"] == 0, out
+        assert out["slo"]["rounds_judged"] == out["rounds"], out
+
+    def test_tier1_twin_240_rounds(self):
+        from bflc_demo_tpu.eval.benchmarks import endurance_async_config1
+        out = endurance_async_config1(rounds=240, reseat_every=10,
+                                      snapshot_interval=32,
+                                      churn_every=8, slo_warmup=20)
+        assert out["rounds"] == 240 and out["final_epoch"] == 240, out
+        assert out["reseats"] == 24, out
+        self._assert_campaign(out)
+
+    @pytest.mark.slow
+    def test_full_campaign_2000_rounds(self):
+        from bflc_demo_tpu.eval.benchmarks import endurance_async_config1
+        out = endurance_async_config1()
+        assert out["rounds"] == 2000, out
+        assert out["reseats"] == 80, out
+        self._assert_campaign(out)

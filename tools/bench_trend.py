@@ -66,6 +66,24 @@ METRICS: List[Tuple[str, str, str, str]] = [
      "extra.sparse.sparsest_egress_bytes_per_round", "lower", "rel"),
     ("sparse_egress_vs_legacy_x",
      "extra.sparse.egress_vs_legacy_dense_f32_x", "higher", "rel"),
+    # async endurance campaign (eval.benchmarks.endurance_async_config1,
+    # bench.py extra.endurance_async): the reseat/churn regime.  WAL and
+    # held-op ceilings must stay bounded as the campaign lengthens;
+    # wedge/false-page counts are zero-tolerance so any absolute uptick
+    # flags; reseat count is a coverage axis — fewer reseats per run
+    # means the re-election plane quietly stopped exercising.
+    ("endurance_reseats",
+     "extra.endurance_async.reseats", "higher", "rel"),
+    ("endurance_max_wal_bytes",
+     "extra.endurance_async.max_wal_bytes", "lower", "rel"),
+    ("endurance_2nd_half_wal_bytes",
+     "extra.endurance_async.second_half_max_wal_bytes", "lower", "rel"),
+    ("endurance_max_held_ops",
+     "extra.endurance_async.max_held_ops", "lower", "rel"),
+    ("endurance_departed_wedged",
+     "extra.endurance_async.departed_wedged", "lower", "abs"),
+    ("endurance_slo_false_pages",
+     "extra.endurance_async.slo_false_pages", "lower", "abs"),
 ]
 
 

@@ -41,14 +41,27 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=7,
                    help="campaign seed (replays the exact schedule)")
     p.add_argument("--profile", default="standard",
-                   choices=["light", "standard", "heavy", "heavytail"],
-                   help="fault intensity; 'heavytail' is the pure "
-                        "straggler regime (seeded lognormal per-client "
-                        "delays, no kills) the async-aggregation bench "
-                        "runs under")
+                   help="fault profile: light|standard|heavy|heavytail|"
+                        "churn, or a '+'-composed blend (e.g. "
+                        "heavytail+churn — stragglers AND continuous "
+                        "membership turnover, the endurance regime); "
+                        "'heavytail' is the pure straggler regime the "
+                        "async-aggregation bench runs under")
     p.add_argument("--async-buffer", type=int, default=0,
                    help="run the soak in async buffered-aggregation "
                         "mode (--async-buffer K; 0 = synchronous)")
+    p.add_argument("--reseat-every", type=int, default=0,
+                   help="async committee re-election period R: every "
+                        "R-th buffered drain reseats the committee from "
+                        "the drained window's median-score ranking "
+                        "(ProtocolConfig.async_reseat_every; needs "
+                        "--async-buffer; 0 = frozen committee)")
+    p.add_argument("--progress-every", type=float, default=30.0,
+                   help="long-horizon mode: write <out>.progress.json "
+                        "every N seconds mid-run (last committed round, "
+                        "accuracy, faults fired) so a multi-thousand-"
+                        "round soak is inspectable while it runs; "
+                        "0 = off")
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--clients", type=int, default=20)
     p.add_argument("--standbys", type=int, default=2)
@@ -96,6 +109,13 @@ def main(argv=None) -> int:
     p.add_argument("--quiet", dest="verbose", action="store_false")
     args = p.parse_args(argv)
 
+    from bflc_demo_tpu.chaos.schedule import PROFILES
+    parts = [pt for pt in str(args.profile).split("+") if pt]
+    unknown = [pt for pt in parts if pt not in PROFILES]
+    if unknown or not parts:
+        p.error(f"unknown profile part(s) {unknown or [args.profile]}: "
+                f"choose from {sorted(PROFILES)} or compose with '+'")
+
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.notify_cmd:
         # the driver-side SLO engine reads it at arming (obs.slo)
@@ -114,10 +134,11 @@ def main(argv=None) -> int:
         client_num=n, comm_count=max(2, n // 5),
         aggregate_count=max(2, n // 4),
         needed_update_count=max(2, n // 2))).validate()
-    if args.async_buffer:
+    if args.async_buffer or args.reseat_every:
         import dataclasses
         cfg = dataclasses.replace(
-            cfg, async_buffer=args.async_buffer).validate()
+            cfg, async_buffer=args.async_buffer,
+            async_reseat_every=args.reseat_every).validate()
     xtr, ytr, xte, yte = load_occupancy()
     shards = iid_shards(np.asarray(xtr), np.asarray(ytr), cfg.client_num)
 
@@ -134,6 +155,19 @@ def main(argv=None) -> int:
     t0 = time.time()
     failure = ""
     res = None
+    stop_progress = None
+    if args.progress_every > 0 and telemetry_dir:
+        # long-horizon inspectability: a sidecar thread tails the run's
+        # own telemetry stream and rewrites <out>.progress.json
+        # atomically — `watch cat soak.json.progress.json` mid-campaign
+        import threading
+        stop_progress = threading.Event()
+
+        def _progress_loop():
+            while not stop_progress.wait(args.progress_every):
+                _write_progress(out, telemetry_dir, t0, args)
+
+        threading.Thread(target=_progress_loop, daemon=True).start()
     try:
         res = run_federated_processes(
             "make_softmax_regression", shards, (np.asarray(xte),
@@ -150,6 +184,10 @@ def main(argv=None) -> int:
     except Exception as e:              # noqa: BLE001 — the artifact must
         # record the failure mode; triage replays by seed
         failure = f"{type(e).__name__}: {e}"
+    finally:
+        if stop_progress is not None:
+            stop_progress.set()
+            _write_progress(out, telemetry_dir, t0, args, final=True)
 
     report = dict(res.chaos_report or {}) if res is not None else {}
     violations = report.get("violations", [])
@@ -164,7 +202,8 @@ def main(argv=None) -> int:
                      "standbys": args.standbys,
                      "validators": args.validators,
                      "quorum": args.quorum, "rounds": args.rounds,
-                     "async_buffer": cfg.async_buffer},
+                     "async_buffer": cfg.async_buffer,
+                     "async_reseat_every": cfg.async_reseat_every},
         "wall_time_s": round(time.time() - t0, 1),
         "failure": failure,
         "rounds_completed": (res.rounds_completed if res else 0),
@@ -193,6 +232,45 @@ def main(argv=None) -> int:
     for g in gates["failures"]:
         print(f"OPERATOR GATE FAILED: {g}")
     return 0 if ok else 1
+
+
+def _write_progress(out: str, telemetry_dir: str, t0: float, args,
+                    final: bool = False) -> None:
+    """One atomic progress snapshot off the run's own telemetry stream
+    (tmp-then-rename — a reader never sees a torn file).  Failure-
+    isolated: a torn/absent stream yields a sparse record, never an
+    exception into the soak driver."""
+    prog = {"t": time.time(), "elapsed_s": round(time.time() - t0, 1),
+            "seed": args.seed, "profile": args.profile,
+            "rounds_target": args.rounds, "final": final}
+    try:
+        from bflc_demo_tpu.obs.collector import load_timeline
+        recs = load_timeline(os.path.join(telemetry_dir,
+                                          "metrics.jsonl"))
+        commits = [r for r in recs if r.get("type") == "note"
+                   and r.get("name") == "round_commit"]
+        if commits:
+            prog["last_round"] = commits[-1].get("epoch")
+            prog["last_acc"] = commits[-1].get("acc")
+        prog["faults_fired"] = sum(1 for r in recs
+                                   if r.get("type") == "fault"
+                                   and r.get("executed"))
+        prog["churn_events"] = sum(
+            1 for r in recs if r.get("type") == "fault"
+            and r.get("kind") in ("retire", "join") and r.get("executed"))
+    except Exception:       # noqa: BLE001 — inspectability must never
+        pass                # take down the campaign it watches
+    path = out + ".progress.json"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(prog, fh, indent=2)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
 
 
 def operator_gates(telemetry_dir: str, *, fail_on_crit: bool = False,

@@ -249,6 +249,14 @@ def _role_row(role, snap):
             cells.append(f"async buf {int(depth)}  "
                          f"staleness p50/95/99 {st or '-'}  "
                          f"aggs {aggs:.0f}")
+        # async committee re-election (--reseat-every R): seated size
+        # + reseats applied; the seat NAMES render in the committee
+        # panel (writer flight events carry them)
+        reseats = _sum_counter(snap, "committee_reseats_total")
+        if reseats:
+            csize = _gauge_value(snap, "committee_size", 0)
+            cells.append(f"committee {int(csize)} seats  "
+                         f"reseats {reseats:.0f}")
         # sparse upload deltas (--delta-density): protocol density +
         # writer-side densify decode cost per admitted blob
         sp = _sparse_cell(snap)
@@ -322,6 +330,36 @@ def _slo_panel(art_dir: str) -> list:
     return lines
 
 
+def _reseat_events(art_dir: str) -> list:
+    """``committee_reseat`` flight events off the writer's flight dump
+    (async re-election, ProtocolConfig.async_reseat_every) — the only
+    artifact that names the SEATS, not just the count."""
+    if not art_dir:
+        return []
+    path = os.path.join(art_dir, "writer.flight.jsonl")
+    if not os.path.exists(path):
+        return []
+    try:
+        from bflc_demo_tpu.obs.flight import load_flight
+        evs = load_flight(path).get("events", [])
+    except (OSError, ValueError):
+        return []
+    return [e for e in evs if isinstance(e, dict)
+            and e.get("name") == "committee_reseat"]
+
+
+def _committee_panel(art_dir: str) -> list:
+    """Current seating per the newest reseat event; empty on frozen-
+    committee (R=0 / sync) fleets."""
+    evs = _reseat_events(art_dir)
+    if not evs:
+        return []
+    last = evs[-1]
+    return [f"committee ({len(evs)} reseat(s), newest epoch "
+            f"{last.get('epoch')}): "
+            f"{', '.join(last.get('seats') or []) or '?'}"]
+
+
 def render_once(timeline, art_dir: str = "") -> str:
     scrapes = [r for r in timeline if r.get("type") == "scrape"]
     if not scrapes:
@@ -335,6 +373,7 @@ def render_once(timeline, art_dir: str = "") -> str:
                 if cov.get("missing") else "")]
     for role in sorted(last.get("roles", {})):
         lines.append(_role_row(role, last["roles"][role]))
+    lines.extend(_committee_panel(art_dir))
     lines.extend(_slo_panel(art_dir))
     return "\n".join(lines)
 
@@ -413,6 +452,10 @@ def render_timeline(timeline, spans_dir: str = "") -> str:
         # the alert is read next to the fault/scrape that caused it
         from bflc_demo_tpu.obs.slo import load_alerts
         recs.extend(load_alerts(spans_dir))
+        # committee reseats (async re-election) interleave too: the
+        # seating change is read next to the drain that carried it
+        recs.extend({"type": "reseat", **e}
+                    for e in _reseat_events(spans_dir))
     if not recs:
         return "empty timeline"
     t0 = min(r.get("t", 0.0) for r in recs)
@@ -423,6 +466,12 @@ def render_timeline(timeline, spans_dir: str = "") -> str:
             what = (f"{r.get('kind', '?')} {r.get('target', '')}"
                     f"{'' if r.get('executed', True) else ' (skipped)'}")
             lines.append(f"+{dt:7.1f}s  FAULT   {what.strip()}")
+        elif r["type"] == "reseat":
+            changed = r.get("changed") or []
+            lines.append(
+                f"+{dt:7.1f}s  RESEAT  epoch {r.get('epoch')}: "
+                f"{','.join(r.get('seats') or []) or '?'}"
+                + (f" (in: {','.join(changed)})" if changed else ""))
         elif r["type"] == "slo_alert":
             lines.append(
                 f"+{dt:7.1f}s  ALERT   {r.get('slo')} round "

@@ -86,6 +86,10 @@ def render_round(rec: dict) -> str:
     if commit:
         lines.append("commit: " + "  ".join(
             f"{k}={v}" for k, v in sorted(commit.items())))
+    if rec.get("committee"):
+        lines.append(
+            "committee: " + ", ".join(rec["committee"])
+            + ("  (reseated this round)" if rec.get("reseat") else ""))
     tr = rec.get("trace")
     if tr:
         lines += ["", "## Critical path (partition of round wall)", ""]
