@@ -53,6 +53,12 @@ hash-equality verdict, and `extra.platform_detail` records the jax
 backend, device count/kind and whether the meshagg engine ran jitted —
 device evidence every artifact now carries (eval.benchmarks.
 mesh_agg_config1; full curve in TPU_RESULTS.md round 15).
+`extra.blocked_agg` (ISSUE 18) is the REDUCTION SPEC v2 axis: the
+blocked mesh leg vs the v1 mesh leg and host loop across a blocks x N
+sweep with byte-equality asserted on every cell, plus the
+sharded-model leg whose stacked delta matrix deliberately exceeds the
+v1 single-buffer staging path (eval.benchmarks.blocked_agg_config1);
+`extra.platform_detail.blocked_agg` records the block geometry.
 `extra.sparse` (ISSUE 13) is the sparse-upload-delta axis: writer
 egress/round dense vs the sparsest top-k leg (f32 and i8), the QSGD
 composition ratio sparse x i8 vs i8 alone, the accuracy gaps and the
@@ -354,6 +360,31 @@ def _child() -> None:
             # did the COMPILED leg actually execute in this process,
             # or did everything fall back to the host loop?
             "jitted": ma["engine"]["calls"].get("mesh", 0) > 0,
+        }
+        # blocked reduction (ISSUE 18, REDUCTION SPEC v2): blocks x N
+        # sweep of the blocked mesh leg vs the v1 mesh leg and the
+        # host loop (byte-equality asserted on every cell), plus the
+        # sharded-model leg whose stacked (N, P) delta matrix is
+        # deliberately larger than the v1 single-buffer staging path
+        # wants (eval.benchmarks.blocked_agg_config1)
+        from bflc_demo_tpu.eval.benchmarks import blocked_agg_config1
+        ba = blocked_agg_config1(batch_sizes=(64, 256),
+                                 blocks_sweep=(1, 4, 16), repeats=3)
+        extra["blocked_agg"] = {
+            "hashes_equal": ba["hashes_equal"],
+            "agg_speedup_vs_v1_x": ba.get("agg_speedup_vs_v1_x"),
+            "legs": ba["legs"],
+            "sharded_model": ba["sharded_model"],
+            "programs_compiled": ba["programs_compiled"],
+        }
+        extra["platform_detail"]["blocked_agg"] = {
+            # the block geometry the sweep exercised + what the engine
+            # last ran — device-count independence evidence rides the
+            # same artifact as the device story
+            "blocks_sweep": ba["geometry"]["blocks_sweep"],
+            "spec_version": ba["geometry"]["spec_version"],
+            "last_blocks": ba["engine"]["last_blocks"],
+            "blocked_calls": ba["engine"]["calls"].get("blocked", 0),
         }
         # async buffered aggregation (PR 9): sync vs async legs under
         # the heavytail straggler chaos profile — this is the

@@ -247,7 +247,7 @@ def verify_promotion_evidence(ev, ledger, standby_keys) -> bool:
 def _aggregate_flat(global_flat: Dict[str, np.ndarray],
                     delta_flats: List[Dict[str, np.ndarray]],
                     weights: List[float], selected: List[int],
-                    lr: float) -> Dict[str, np.ndarray]:
+                    lr: float, blocks: int = 1) -> Dict[str, np.ndarray]:
     """Server-side FedAvg on flat entries: global -= lr * weighted mean of
     the selected deltas (CommitteePrecompiled.cpp:403-414 semantics, the
     same arithmetic `core.aggregate.apply_selection` implements on device).
@@ -262,10 +262,12 @@ def _aggregate_flat(global_flat: Dict[str, np.ndarray],
     small batches and `BFLC_MESH_AGG_LEGACY=1` keep the pre-engine host
     loop.  The legs are byte-identical by construction (fixed-order
     float32 accumulation, differential-tested), so the certified model
-    hash never depends on which leg ran."""
+    hash never depends on which leg ran.  `blocks` is the genome's
+    reduce_blocks (REDUCTION SPEC v2) — an execution-shape knob, also
+    byte-invariant."""
     from bflc_demo_tpu.meshagg.engine import ENGINE
     return ENGINE.aggregate_flat(global_flat, delta_flats, weights,
-                                 selected, lr)
+                                 selected, lr, blocks=blocks)
 
 
 class LedgerServer:
@@ -386,6 +388,17 @@ class LedgerServer:
         # schema, rebuilt only when the model changes (not per upload)
         self._model_schema = {k: (a.shape, a.dtype) for k, a in
                               unpack_pytree(initial_model_blob).items()}
+        # fail-fast on a degenerate reduce_blocks genome (REDUCTION SPEC
+        # v2): the blocked partition must be well-formed over THIS
+        # model's flattened param count, and the first merge is far too
+        # late to find out it isn't
+        from bflc_demo_tpu.ledger.base import reduce_blocks as _rblocks
+        _blk = _rblocks(cfg)
+        if _blk > 1:
+            from bflc_demo_tpu.meshagg import spec as _spec
+            _spec.block_bounds(
+                sum(int(np.prod(s)) for s, _ in
+                    self._model_schema.values()), _blk)
         # gas: per-sender per-epoch storage-op budget (None = auto: 50
         # model-blob-sized uploads' worth — generous for honest traffic,
         # finite for spam; 0 disables metering).  Bounds what one identity
@@ -1784,7 +1797,9 @@ class LedgerServer:
             # (obs.health — observability only)
             health_scores = (self._async_candidate_scores(entries)
                              if obs_health.health_armed() else None)
+            from bflc_demo_tpu.ledger.base import reduce_blocks
             from bflc_demo_tpu.meshagg.engine import ENGINE
+            blocks = reduce_blocks(self.cfg)
             if ENGINE.choose_leg(len(entries)) == "mesh":
                 # meshagg drain: the FedBuff n/sqrt(1+s) weights enter
                 # as spec coefficients; same one-program reduction as
@@ -1793,7 +1808,7 @@ class LedgerServer:
                         for e in entries]
                 new_flat = ENGINE.aggregate_rows(
                     global_flat, rows, weights, list(selected),
-                    self.cfg.learning_rate)
+                    self.cfg.learning_rate, blocks=blocks)
             else:
                 delta_flats = [dequantize_entries(
                                    unpack_pytree(
@@ -1804,7 +1819,8 @@ class LedgerServer:
                                    for f in delta_flats]
                 new_flat = _aggregate_flat(global_flat, delta_flats,
                                            weights, list(selected),
-                                           self.cfg.learning_rate)
+                                           self.cfg.learning_rate,
+                                           blocks=blocks)
             blob = pack_entries(new_flat)
             digest = hashlib.sha256(blob).digest()
             # capture reseat due-ness BEFORE the commit advances the
@@ -2122,15 +2138,19 @@ class LedgerServer:
         # (obs.health — two attribute checks when dark)
         health_scores = (self._sync_candidate_scores(len(updates))
                          if obs_health.health_armed() else None)
+        from bflc_demo_tpu.ledger.base import reduce_blocks
+        blocks = reduce_blocks(self.cfg)
         if ENGINE.choose_leg(len(updates)) == "mesh":
             # meshagg: the admitted deltas were staged as flattened
             # rows at admission — the merge is one stack + one compiled
-            # program (REDUCTION SPEC v1, byte-identical to the host
-            # loop below; a missing row is re-derived from its blob)
+            # program per genome block (REDUCTION SPEC v1/v2,
+            # byte-identical to the host loop below; a missing row is
+            # re-derived from its blob)
             rows = [self._staged_row(u.payload_hash) for u in updates]
             new_flat = ENGINE.aggregate_rows(
                 global_flat, rows, [u.n_samples for u in updates],
-                list(pending.selected), self.cfg.learning_rate)
+                list(pending.selected), self.cfg.learning_rate,
+                blocks=blocks)
         else:
             # host loop: densify ∘ dequantize is the ONE shared decode
             # chain (utils.serialization): an identity on plain f32
@@ -2154,7 +2174,8 @@ class LedgerServer:
             new_flat = _aggregate_flat(global_flat, delta_flats,
                                        [u.n_samples for u in updates],
                                        list(pending.selected),
-                                       self.cfg.learning_rate)
+                                       self.cfg.learning_rate,
+                                       blocks=blocks)
         blob = pack_entries(new_flat)
         digest = hashlib.sha256(blob).digest()
         st = self.ledger.commit_model(digest, epoch)

@@ -162,7 +162,9 @@ class CellAggregatorServer(LedgerServer):
                     flat = densify_entries(flat)
                 admitted.append((u.sender, flat, u.n_samples,
                                  u.avg_cost))
-            partial, n_clients, mean_cost = cell_partial(admitted)
+            from bflc_demo_tpu.ledger.base import reduce_blocks
+            partial, n_clients, mean_cost = cell_partial(
+                admitted, blocks=reduce_blocks(self.cfg))
             evidence = cell_evidence_digest(
                 epoch, self.cell_index,
                 [(u.sender, u.payload_hash, u.n_samples, u.avg_cost)

@@ -116,7 +116,7 @@ def cell_evidence_digest(epoch: int, cell_index: int,
 
 
 def cell_partial(admitted: List[Tuple[str, Dict[str, np.ndarray], int,
-                                      float]]
+                                      float]], blocks: int = 1
                  ) -> Tuple[Dict[str, np.ndarray], int, float]:
     """(partial entries, admitted client count, mean cost) from the
     cell-selected member deltas.
@@ -129,10 +129,12 @@ def cell_partial(admitted: List[Tuple[str, Dict[str, np.ndarray], int,
     and therefore the certified hash, arrival-order independent).
 
     The sum runs through the meshagg engine under the SAME reduction
-    spec as the root writer's merge (meshagg.spec, REDUCTION SPEC v1:
+    spec as the root writer's merge (meshagg.spec, REDUCTION SPEC v1/v2:
     sorted-sender slot order here plays the ledger-slot-order role), so
     a large cell's partial is one compiled program and the bytes are
-    identical to the pre-engine loop on every leg."""
+    identical to the pre-engine loop on every leg.  `blocks` is the
+    genome's reduce_blocks (spec v2 execution shape — byte-invariant,
+    so the certified partial hash never depends on it)."""
     if not admitted:
         raise ValueError("cell_partial over an empty admitted set")
     ordered = sorted(admitted, key=lambda t: t[0])
@@ -148,7 +150,7 @@ def cell_partial(admitted: List[Tuple[str, Dict[str, np.ndarray], int,
             raise ValueError("admitted deltas disagree on entry keys")
     from bflc_demo_tpu.meshagg.engine import ENGINE
     accs = ENGINE.weighted_sum(keys, [flat for _, flat, _, _ in ordered],
-                               w, float(wsum))
+                               w, float(wsum), blocks=blocks)
     out: Dict[str, np.ndarray] = {
         key: accs[key].astype(np.asarray(ordered[0][1][key]).dtype)
         for key in keys}

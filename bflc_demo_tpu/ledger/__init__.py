@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from bflc_demo_tpu.ledger.base import (  # noqa: F401
     LedgerStatus, UpdateInfo, PendingInfo, AsyncUpdateInfo, ADDR_CAP,
-    async_enabled, async_legacy, staleness_weight)
+    async_enabled, async_legacy, blocked_enabled, blocked_legacy,
+    reduce_blocks, staleness_weight)
 from bflc_demo_tpu.ledger.pyledger import PyLedger  # noqa: F401
 from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
 
@@ -23,19 +24,28 @@ def make_ledger(cfg: ProtocolConfig = DEFAULT_PROTOCOL, *,
     BFLC_ASYNC_LEGACY pins it off) needs the python backend: the native
     ledger has no async-op ABI, and gating here — the one construction
     point — keeps every role (writer, validators, standbys, replicas)
-    on a backend that can apply the op family."""
+    on a backend that can apply the op family.  Blocked reduction
+    (cfg.reduce_blocks > 1, REDUCTION SPEC v2, unless
+    BFLC_BLOCKED_LEGACY pins it off) is gated the same way: commit ops
+    carry a geometry-claim tail the native OP_COMMIT parser has no ABI
+    for."""
     cfg.validate()
     args = (cfg.client_num, cfg.comm_count, cfg.aggregate_count,
             cfg.needed_update_count, cfg.genesis_epoch)
-    if async_enabled(cfg):
+    blocks = reduce_blocks(cfg)
+    if async_enabled(cfg) or blocks > 1:
         if backend == "native":
             raise ValueError(
-                "async_buffer > 0 needs the python ledger backend (the "
-                "native ledger has no async-op ABI)")
+                "async_buffer > 0 / reduce_blocks > 1 need the python "
+                "ledger backend (the native ledger has no async-op or "
+                "geometry-claim ABI)")
+        if not async_enabled(cfg):
+            return PyLedger(*args, reduce_blocks=blocks)
         return PyLedger(*args, async_buffer=cfg.async_buffer,
                         max_staleness=cfg.max_staleness,
                         async_reseat_every=getattr(
-                            cfg, "async_reseat_every", 0))
+                            cfg, "async_reseat_every", 0),
+                        reduce_blocks=blocks)
     if backend in ("auto", "native"):
         from bflc_demo_tpu.ledger import bindings
         if bindings.native_available():

@@ -50,6 +50,33 @@ def async_enabled(cfg) -> bool:
     return getattr(cfg, "async_buffer", 0) > 0 and not async_legacy()
 
 
+def blocked_legacy() -> bool:
+    """True when BFLC_BLOCKED_LEGACY pins REDUCTION SPEC v1's
+    single-block wire format regardless of ProtocolConfig.reduce_blocks
+    (the byte-for-byte rollback switch for the v2 blocked geometry)."""
+    return bool(os.environ.get("BFLC_BLOCKED_LEGACY"))
+
+
+def reduce_blocks(cfg) -> int:
+    """The ONE decision point for the protocol-agreed block geometry
+    (REDUCTION SPEC v2): the genome's reduce_blocks unless the legacy
+    pin flattens it to 1.  Shared by make_ledger, the writer's merge,
+    the hier cell tier, the rederive plane and the tools, so no layer
+    can disagree about the geometry a commit op must claim."""
+    if blocked_legacy():
+        return 1
+    try:
+        return max(int(getattr(cfg, "reduce_blocks", 1) or 1), 1)
+    except (TypeError, ValueError):
+        return 1
+
+
+def blocked_enabled(cfg) -> bool:
+    """True when commit ops carry (and replicas enforce) a block
+    geometry claim — i.e. the chain speaks the v2 wire format."""
+    return reduce_blocks(cfg) > 1
+
+
 def staleness_weight(staleness: int) -> float:
     """FedBuff's default staleness discount 1/sqrt(1+s) (Nguyen et al.
     2022, PAPERS.md §async) — THE one definition: writer aggregation,

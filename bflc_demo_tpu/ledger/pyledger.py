@@ -42,6 +42,18 @@ def _put_str(b: bytearray, s: str) -> None:
 # no seating" (the replay path for a plain 48-byte ACOMMIT body).
 _DERIVE_SEATS = object()
 
+# sentinel for commit_model/async_commit's `blocks` (REDUCTION SPEC v2
+# geometry claim): "derive the claim from this replica's genome" (the
+# writer path).  Distinct from None, which means "the op carried no
+# geometry claim" (the replay path for a v1-format body).
+_DERIVE_BLOCKS = object()
+
+# magic tag introducing the block-geometry claim tail on commit ops.
+# Chosen so it can never collide with the ACOMMIT seats claim: the
+# seats region starts with <q n> and an honest seat count's little-
+# endian bytes 1..7 are zero, while the tag's are "LK1".
+_BLOCKS_MAGIC = b"BLK1"
+
 
 class PyLedger:
     backend = "python"
@@ -49,7 +61,7 @@ class PyLedger:
     def __init__(self, client_num: int, comm_count: int, aggregate_count: int,
                  needed_update_count: int, genesis_epoch: int = -999,
                  async_buffer: int = 0, max_staleness: int = 20,
-                 async_reseat_every: int = 0):
+                 async_reseat_every: int = 0, reduce_blocks: int = 1):
         self.client_num = client_num
         self.comm_count = comm_count
         self.aggregate_count = aggregate_count
@@ -68,6 +80,14 @@ class PyLedger:
         # protocol state: it decides WHICH drains reseat, so it rides
         # the canonical state bytes and every replica agrees on it.
         self.async_reseat_every = max(int(async_reseat_every), 0)
+        # REDUCTION SPEC v2 block geometry (ProtocolConfig.reduce_blocks,
+        # flattened through ledger.base.reduce_blocks so BFLC_BLOCKED_
+        # LEGACY pins 1).  A genome CONSTANT, not mutable state — it
+        # never rides _snapshot()/state bytes.  With B > 1 every commit
+        # op carries a geometry-claim tail and a claim disagreeing with
+        # this value refuses BAD_ARG, so a lying writer's commit dies at
+        # every honest replica (and therefore at the BFT quorum).
+        self.reduce_blocks = max(int(reduce_blocks), 1)
         self._acommit_count = 0
         self._abuf: List[AsyncUpdateInfo] = []
         self._ascores: Dict[int, Dict[str, float]] = {}
@@ -497,11 +517,24 @@ class PyLedger:
     def pending(self) -> Optional[PendingInfo]:
         return self._pending
 
-    def commit_model(self, new_model_hash: bytes, epoch: int) -> LedgerStatus:
+    def commit_model(self, new_model_hash: bytes, epoch: int,
+                     blocks=_DERIVE_BLOCKS) -> LedgerStatus:
+        """Commit the aggregated model.  `blocks` is the REDUCTION SPEC
+        v2 geometry claim: the writer passes the default sentinel
+        ("derive it from the genome"), the replay path (apply_op) passes
+        the op's embedded claim — None for a v1 40-byte body, an int for
+        the tagged tail.  A claim that disagrees with this replica's
+        genome is refused (BAD_ARG) BEFORE any state mutates, which is
+        exactly how a writer lying about its reduction geometry fails
+        certification: every validator re-executes this op."""
         if self._pending is None:
             return LedgerStatus.NOT_READY
         if epoch != self._epoch:
             return LedgerStatus.WRONG_EPOCH
+        derived_blocks = (self.reduce_blocks
+                          if self.reduce_blocks > 1 else None)
+        if blocks is not _DERIVE_BLOCKS and blocks != derived_blocks:
+            return LedgerStatus.BAD_ARG
         self._model_hash = bytes(new_model_hash)
         self._last_loss = self._pending.global_loss
         for a in self._roles:
@@ -517,6 +550,11 @@ class PyLedger:
         op = bytearray([_OP_COMMIT])
         op += bytes(new_model_hash)
         op += struct.pack("<q", epoch)
+        if derived_blocks is not None:
+            # the geometry claim rides the certified op: replicas,
+            # standbys and rederive shards all see the blocking the
+            # quorum signed off on (v1 chains: no tail, bytes unchanged)
+            op += _BLOCKS_MAGIC + struct.pack("<q", derived_blocks)
         self._append_log(bytes(op))
         return LedgerStatus.OK
 
@@ -675,7 +713,8 @@ class PyLedger:
         return seats
 
     def async_commit(self, new_model_hash: bytes, epoch: int,
-                     k: int, seats=_DERIVE_SEATS) -> LedgerStatus:
+                     k: int, seats=_DERIVE_SEATS,
+                     blocks=_DERIVE_BLOCKS) -> LedgerStatus:
         """Drain the oldest `k` buffered entries into a new model.
 
         `seats` is the committee-reseat claim: the writer passes the
@@ -684,7 +723,9 @@ class PyLedger:
         body, a list for the extended body.  A claim that disagrees
         with this replica's own derivation is refused (BAD_ARG), which
         is exactly how a lying writer's reseat dies at the BFT quorum:
-        every validator re-executes this op and refuses to co-sign."""
+        every validator re-executes this op and refuses to co-sign.
+        `blocks` is the REDUCTION SPEC v2 geometry claim with the same
+        sentinel/None/value convention (see commit_model)."""
         if not self.async_buffer:
             return LedgerStatus.BAD_ARG
         if self._epoch == self.genesis_epoch:
@@ -693,6 +734,10 @@ class PyLedger:
             return LedgerStatus.WRONG_EPOCH
         if not 0 < k <= len(self._abuf):
             return LedgerStatus.NOT_READY
+        derived_blocks = (self.reduce_blocks
+                          if self.reduce_blocks > 1 else None)
+        if blocks is not _DERIVE_BLOCKS and blocks != derived_blocks:
+            return LedgerStatus.BAD_ARG
         due = self.async_reseat_due()
         derived = self.derive_async_seats(k) if due else None
         if seats is _DERIVE_SEATS:
@@ -728,6 +773,10 @@ class PyLedger:
             op += struct.pack("<q", len(derived))
             for a in derived:
                 _put_str(op, a)
+        if derived_blocks is not None:
+            # the geometry claim tail rides AFTER the seats region (the
+            # magic tag keeps the parse unambiguous either way)
+            op += _BLOCKS_MAGIC + struct.pack("<q", derived_blocks)
         self._append_log(bytes(op))
         return LedgerStatus.OK
 
@@ -1016,9 +1065,19 @@ class PyLedger:
                 scores = list(struct.unpack_from(f"<{cnt}f", body, off + 16))
                 return self.upload_scores(sender, ep, scores)
             if code == _OP_COMMIT:
+                # strict body: 40 bytes (v1), or 40 + the tagged
+                # 12-byte geometry claim (spec v2) — anything else is
+                # malformed, never silently-ignored trailing bytes
+                if len(body) == 40:
+                    claim = None
+                elif (len(body) == 52
+                        and body[40:44] == _BLOCKS_MAGIC):
+                    claim, = struct.unpack_from("<q", body, 44)
+                else:
+                    return LedgerStatus.BAD_ARG
                 payload = body[:32]
                 ep, = struct.unpack_from("<q", body, 32)
-                return self.commit_model(payload, ep)
+                return self.commit_model(payload, ep, blocks=claim)
             if code == _OP_CLOSE:
                 ep, = struct.unpack_from("<q", body, 0)
                 if ep != self._epoch:
@@ -1077,11 +1136,13 @@ class PyLedger:
                 ep, = struct.unpack_from("<q", body, 32)
                 k, = struct.unpack_from("<q", body, 40)
                 seats = None
-                if len(body) > 48:
+                claim = None
+                off = 48
+                if len(body) > off and body[off:off + 4] != _BLOCKS_MAGIC:
                     # extended body: a committee-reseat claim — <q n>
-                    # then n length-prefixed addresses, no trailing
-                    # junk.  async_commit re-derives and refuses a
-                    # seating this replica disagrees with.
+                    # then n length-prefixed addresses.  async_commit
+                    # re-derives and refuses a seating this replica
+                    # disagrees with.
                     n, = struct.unpack_from("<q", body, 48)
                     if n <= 0 or n > (len(body) - 56) // 8:
                         return LedgerStatus.BAD_ARG
@@ -1090,9 +1151,16 @@ class PyLedger:
                     for _ in range(n):
                         a, off = _str_at(off)
                         seats.append(a)
-                    if off != len(body):
+                if len(body) > off:
+                    # trailing bytes must be EXACTLY the tagged spec-v2
+                    # geometry claim; anything else is malformed
+                    if (body[off:off + 4] == _BLOCKS_MAGIC
+                            and off + 12 == len(body)):
+                        claim, = struct.unpack_from("<q", body, off + 4)
+                    else:
                         return LedgerStatus.BAD_ARG
-                return self.async_commit(payload, ep, k, seats)
+                return self.async_commit(payload, ep, k, seats,
+                                         blocks=claim)
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)

@@ -316,7 +316,7 @@ def restore_snapshot(state_bytes: bytes, cfg, base: int, base_head: bytes):
     AFTER the certified snapshot op).  The installer's trust argument is
     the caller's (`verify_snapshot_meta`): this only decodes + installs,
     raising ValueError on malformed bytes."""
-    from bflc_demo_tpu.ledger.base import async_enabled
+    from bflc_demo_tpu.ledger.base import async_enabled, reduce_blocks
     from bflc_demo_tpu.ledger.pyledger import PyLedger
     led = PyLedger(cfg.client_num, cfg.comm_count, cfg.aggregate_count,
                    cfg.needed_update_count, cfg.genesis_epoch,
@@ -325,7 +325,8 @@ def restore_snapshot(state_bytes: bytes, cfg, base: int, base_head: bytes):
                    max_staleness=getattr(cfg, "max_staleness", 20),
                    async_reseat_every=(
                        getattr(cfg, "async_reseat_every", 0)
-                       if async_enabled(cfg) else 0))
+                       if async_enabled(cfg) else 0),
+                   reduce_blocks=reduce_blocks(cfg))
     led._install_state(state_bytes, base, base_head)
     return led
 

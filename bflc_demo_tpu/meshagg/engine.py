@@ -41,6 +41,20 @@ jax failure (or a platform whose compiler breaks the no-FMA contract —
 caught by a one-time differential SELF-CHECK at first mesh use) falls
 back to the host loop rather than ever committing divergent bytes.
 
+REDUCTION SPEC v2 adds the BLOCKED leg: with ``reduce_blocks = B > 1``
+(a protocol genome field, never ``jax.device_count()``) the flattened
+param axis is cut into the spec's fixed contiguous blocks
+(`spec.block_bounds`) and each block runs the SAME terms+scan program
+pair over an ``(N, Pb)`` slice — peak staging memory drops to ~1/B of
+the v1 single ``(N, P)`` buffer, so a delta matrix bigger than one
+chip's HBM aggregates block-by-block instead of falling back.  When
+the block count divides the device count the blocks additionally run
+as ONE ``(N, B, Pb)`` program with the block axis laid out over a
+``params`` device mesh (NamedSharding) — placement only; the reduction
+is elementwise per parameter, so neither blocking nor sharding can
+change the certified bytes, and the self-check + differential checker
+assert exactly that rather than assuming it.
+
 `score_candidates_batched` is the committee-scoring twin: it stacks the
 candidate deltas and evaluates all of them in one vmapped program
 (core.scoring), sharding the stacked candidate axis over a ``clients``
@@ -74,6 +88,10 @@ _C_COMPILE = obs_metrics.REGISTRY.counter(
     "mesh_agg_compile_total",
     "engine programs compiled (cache misses per round geometry)",
     ("kernel",))
+_G_BLOCKS = obs_metrics.REGISTRY.gauge(
+    "mesh_agg_blocks",
+    "protocol-agreed reduce_blocks geometry of the last engine "
+    "reduction (REDUCTION SPEC v2; 1 = v1 single block)")
 
 _CACHE_CAP = 64         # distinct (N, P) programs kept per process
 _SCAN_UNROLL = 8        # loop-overhead amortisation; order unchanged
@@ -125,6 +143,7 @@ class MeshAggEngine:
         self.score_geometries: Dict[tuple, bool] = {}
         self.calls = {"mesh": 0, "host": 0}
         self.last_leg = "unused"
+        self.last_blocks = 1
         self._selfcheck: Optional[bool] = None     # None = not yet run
 
     # ------------------------------------------------------------ policy
@@ -136,6 +155,7 @@ class MeshAggEngine:
             "legacy_pin": _legacy(),
             "min_batch": _min_batch(),
             "last_leg": self.last_leg,
+            "last_blocks": self.last_blocks,
             "calls": dict(self.calls),
             "selfcheck": ("untested" if self._selfcheck is None
                           else "ok" if self._selfcheck else "FAILED"),
@@ -209,8 +229,20 @@ class MeshAggEngine:
             wsum = max(float(w.sum()), 1e-12)
             host = spec.host_weighted_sum(keys, flats, w, wsum)
             mesh = self._mesh_weighted_sum(keys, flats, w, wsum)
+            # blocked-leg differential (spec v2): an uneven geometry
+            # (42 params, 5 blocks -> last block short) through both
+            # the blocked kernel and the blocked host reference must
+            # reproduce the v1 host bytes exactly
+            blocked = self._mesh_weighted_sum(keys, flats, w, wsum,
+                                              blocks=5)
+            hostb = spec.blocked_host_weighted_sum(keys, flats, w,
+                                                   wsum, 5)
             ok = all(np.asarray(host[k]).tobytes()
-                     == np.asarray(mesh[k]).tobytes() for k in keys)
+                     == np.asarray(mesh[k]).tobytes()
+                     and np.asarray(host[k]).tobytes()
+                     == np.asarray(blocked[k]).tobytes()
+                     and np.asarray(host[k]).tobytes()
+                     == np.asarray(hostb[k]).tobytes() for k in keys)
             if not ok:
                 warnings.warn(
                     "meshagg: compiled reduction diverged from the "
@@ -275,13 +307,112 @@ class MeshAggEngine:
         terms_fn, reduce_fn = self._program(mat.shape[0], mat.shape[1])
         return np.asarray(reduce_fn(terms_fn(coeffs, gates, mat)))
 
+    def _blocked_program(self, n: int, blocks: int, pb: int):
+        """(terms_fn, reduce_fn) for one padded (N, blocks, Pb) cube —
+        the sharded-model program.  Same two-executable split as
+        `_program` (no cross-program FMA contraction possible); the
+        scan accumulates every block's ascending-slot chain in
+        lockstep, which is arithmetically identical to running the
+        blocks one at a time (spec v2: no cross-block arithmetic)."""
+        sig = ("blk", n, blocks, pb)
+        fns = self._programs.get(sig)
+        if fns is not None:
+            return fns
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def terms_fn(coeffs, gates, cube):
+            return jnp.where(gates[:, None, None],
+                             cube * coeffs[:, None, None],
+                             jnp.float32(0.0))
+
+        def reduce_fn(terms):
+            def body(acc, t):
+                return acc + t, None
+
+            acc, _ = lax.scan(body,
+                              jnp.zeros((blocks, pb), jnp.float32),
+                              terms, unroll=_SCAN_UNROLL)
+            return acc
+
+        fns = (jax.jit(terms_fn), jax.jit(reduce_fn))
+        if len(self._programs) >= _CACHE_CAP:
+            self._programs.pop(next(iter(self._programs)))
+        self._programs[sig] = fns
+        self.compile_total += 1
+        if obs_metrics.REGISTRY.enabled:
+            _C_COMPILE.inc(kernel="reduce")
+        return fns
+
+    @staticmethod
+    def _block_devices(blocks: int):
+        """The device list for the ONE-program sharded cube, or None
+        for the per-block loop.  Placement policy only — the genome's
+        block structure never depends on it; a 1-device host and an
+        8-device mesh produce identical bytes either way."""
+        try:
+            import jax
+            devs = jax.devices()
+        except Exception:                           # noqa: BLE001
+            return None
+        return devs if (len(devs) > 1 and blocks % len(devs) == 0) \
+            else None
+
+    def _mesh_rows_blocked(self, rows: List[np.ndarray], w: np.ndarray,
+                           wsum: float, blocks: int) -> np.ndarray:
+        """The BLOCKED compiled leg (spec v2): the genome's fixed
+        param-axis blocks, each reduced by the v1 program pair over an
+        ``(N, Pb)`` slice.  Peak staging is one block's matrix — ~1/B
+        of the v1 ``(N, P)`` monolith — so a delta matrix bigger than
+        one buffer aggregates block-by-block; equal-size blocks share
+        one cached program.  When the device count divides the block
+        count the blocks instead run as ONE padded ``(N, B, Pb)``
+        program laid out over a ``params`` device mesh."""
+        p = int(rows[0].size)
+        bounds = spec.block_bounds(p, blocks)
+        coeffs = spec.merge_coefficients(w, wsum)
+        gates = np.asarray(w, np.float32) > 0.0
+        n = len(rows)
+        devs = self._block_devices(len(bounds))
+        if devs is not None:
+            pb = bounds[0][1] - bounds[0][0]
+            # pad the flattened axis to B*Pb: pad lanes are literal
+            # zeros no real element ever meets (the reduction is
+            # elementwise) and the final slice drops them
+            cube = np.zeros((n, len(bounds) * pb), np.float32)
+            for i, r in enumerate(rows):
+                cube[i, :p] = r
+            cube = cube.reshape(n, len(bounds), pb)
+            import jax
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec)
+            mesh = Mesh(np.asarray(devs), ("params",))
+            cube = jax.device_put(cube, NamedSharding(
+                mesh, PartitionSpec(None, "params", None)))
+            terms_fn, reduce_fn = self._blocked_program(
+                n, len(bounds), pb)
+            acc = np.asarray(reduce_fn(terms_fn(coeffs, gates, cube)))
+            return acc.reshape(-1)[:p]
+        parts = []
+        for lo, hi in bounds:
+            mat = np.stack([r[lo:hi] for r in rows])
+            terms_fn, reduce_fn = self._program(n, hi - lo)
+            parts.append(np.asarray(reduce_fn(
+                terms_fn(coeffs, gates, mat))))
+        # spec v2's deterministic fixed-order combine: ascending-block
+        # concatenation
+        return (np.concatenate(parts) if parts
+                else np.zeros(0, np.float32))
+
     def _mesh_weighted_sum(self, keys: Sequence[str],
                            delta_flats: List[Dict[str, np.ndarray]],
-                           w: np.ndarray, wsum: float
+                           w: np.ndarray, wsum: float, blocks: int = 1
                            ) -> Dict[str, np.ndarray]:
         rows = [flatten_delta(d, keys) for d in delta_flats]
         layout, _ = _leaf_layout(keys, delta_flats[0])
-        acc = self._mesh_rows(rows, w, wsum)
+        acc = (self._mesh_rows_blocked(rows, w, wsum, blocks)
+               if blocks > 1 else self._mesh_rows(rows, w, wsum))
         return {k: acc[off:off + size].reshape(shape)
                 for k, off, size, shape in layout}
 
@@ -289,72 +420,92 @@ class MeshAggEngine:
     def weighted_sum(self, keys: Sequence[str],
                      delta_flats: List[Dict[str, np.ndarray]],
                      w: np.ndarray, wsum: float, *,
-                     force_leg: Optional[str] = None
+                     force_leg: Optional[str] = None, blocks: int = 1
                      ) -> Dict[str, np.ndarray]:
         """Spec steps 3-4 over the admitted set: float32 accumulators
-        per key.  ``force_leg`` ('host'/'mesh') is the benchmark /
-        differential-checker override; normal callers leave it None and
-        get the policy."""
+        per key.  ``force_leg`` ('host'/'mesh'/'blocked') is the
+        benchmark / differential-checker override; normal callers leave
+        it None and get the policy.  ``blocks`` is the genome's
+        ``reduce_blocks`` (spec v2) — byte-identical for every value,
+        so it only chooses the execution/staging shape."""
         n = len(delta_flats)
+        blocks = max(int(blocks), 1)
         leg = force_leg if force_leg is not None else self.choose_leg(n)
+        if leg == "blocked":        # explicit blocked-kernel force
+            leg, blocks = "mesh", max(blocks, 2)
         t0 = (time.perf_counter()
               if obs_metrics.REGISTRY.enabled else 0.0)
         if leg == "mesh":
             try:
-                out = self._mesh_weighted_sum(keys, delta_flats, w, wsum)
+                out = self._mesh_weighted_sum(keys, delta_flats, w,
+                                              wsum, blocks=blocks)
             except Exception as e:                  # noqa: BLE001
-                if force_leg == "mesh":
+                if force_leg in ("mesh", "blocked"):
                     raise
                 warnings.warn(f"meshagg: compiled leg failed ({e}) — "
                               f"host fallback", RuntimeWarning)
                 leg = "host"
-                out = spec.host_weighted_sum(keys, delta_flats, w, wsum)
+                out = (spec.blocked_host_weighted_sum(
+                    keys, delta_flats, w, wsum, blocks) if blocks > 1
+                    else spec.host_weighted_sum(keys, delta_flats, w,
+                                                wsum))
         elif leg == "legacy":
             out = spec.legacy_host_weighted_sum(keys, delta_flats, w,
                                                 wsum)
+        elif blocks > 1:
+            # the blocked host leg IS the spec v2 normative reference
+            out = spec.blocked_host_weighted_sum(keys, delta_flats, w,
+                                                 wsum, blocks)
         else:
             out = spec.host_weighted_sum(keys, delta_flats, w, wsum)
-        self._account(leg, n, t0)
+        self._account(leg, n, t0, blocks=blocks)
         return out
 
     def aggregate_flat(self, global_flat: Dict[str, np.ndarray],
                        delta_flats: List[Dict[str, np.ndarray]],
                        weights: Sequence[float], selected: Sequence[int],
-                       lr: float, *, force_leg: Optional[str] = None
-                       ) -> Dict[str, np.ndarray]:
+                       lr: float, *, force_leg: Optional[str] = None,
+                       blocks: int = 1) -> Dict[str, np.ndarray]:
         """The writer merge (spec steps 1-5): FedAvg / FedBuff-drain
         update of ``global_flat`` by the selected deltas."""
         w = spec.merge_weight_vector(weights, selected, len(delta_flats))
         wsum = max(float(w.sum()), 1e-12)
         accs = self.weighted_sum(list(global_flat.keys()), delta_flats,
-                                 w, wsum, force_leg=force_leg)
+                                 w, wsum, force_leg=force_leg,
+                                 blocks=blocks)
         return spec.apply_step(global_flat, accs, lr)
 
     def aggregate_rows(self, global_flat: Dict[str, np.ndarray],
                        rows: List[np.ndarray],
                        weights: Sequence[float], selected: Sequence[int],
-                       lr: float, *, force_leg: Optional[str] = None
-                       ) -> Dict[str, np.ndarray]:
+                       lr: float, *, force_leg: Optional[str] = None,
+                       blocks: int = 1) -> Dict[str, np.ndarray]:
         """The writer merge over STAGED rows (`flatten_delta` images in
         sorted-key order, built at admission): one `np.stack` + one
-        program, no per-leaf Python at aggregate time.  Falls back to
-        the host loop by unflattening the rows — the rows carry the
-        exact decode bytes, so the fallback is byte-identical too."""
+        program, no per-leaf Python at aggregate time (with ``blocks >
+        1``, one stack + program PER BLOCK — the staging buffer never
+        holds more than one block's matrix).  Falls back to the host
+        loop by unflattening the rows — the rows carry the exact decode
+        bytes, so the fallback is byte-identical too."""
         keys = sorted(global_flat.keys())
         n = len(rows)
+        blocks = max(int(blocks), 1)
         w = spec.merge_weight_vector(weights, selected, n)
         wsum = max(float(w.sum()), 1e-12)
         layout, p = _leaf_layout(keys, global_flat)
         leg = force_leg if force_leg is not None else self.choose_leg(n)
+        if leg == "blocked":
+            leg, blocks = "mesh", max(blocks, 2)
         t0 = (time.perf_counter()
               if obs_metrics.REGISTRY.enabled else 0.0)
         if leg == "mesh":
             try:
-                acc = self._mesh_rows(rows, w, wsum)
+                acc = (self._mesh_rows_blocked(rows, w, wsum, blocks)
+                       if blocks > 1 else self._mesh_rows(rows, w, wsum))
                 accs = {k: acc[off:off + size].reshape(shape)
                         for k, off, size, shape in layout}
             except Exception as e:                  # noqa: BLE001
-                if force_leg == "mesh":
+                if force_leg in ("mesh", "blocked"):
                     raise
                 warnings.warn(f"meshagg: compiled leg failed ({e}) — "
                               f"host fallback", RuntimeWarning)
@@ -365,19 +516,28 @@ class MeshAggEngine:
         if accs is None:
             flats = [{k: r[off:off + size].reshape(shape)
                       for k, off, size, shape in layout} for r in rows]
-            host_fn = (spec.legacy_host_weighted_sum
-                       if leg == "legacy" else spec.host_weighted_sum)
-            accs = host_fn(keys, flats, w, wsum)
-        self._account(leg, n, t0)
+            if leg == "legacy":
+                accs = spec.legacy_host_weighted_sum(keys, flats, w,
+                                                     wsum)
+            elif blocks > 1:
+                accs = spec.blocked_host_weighted_sum(keys, flats, w,
+                                                      wsum, blocks)
+            else:
+                accs = spec.host_weighted_sum(keys, flats, w, wsum)
+        self._account(leg, n, t0, blocks=blocks)
         return spec.apply_step(global_flat, accs, lr)
 
-    def _account(self, leg: str, n: int, t0: float) -> None:
-        self.calls[leg] = self.calls.get(leg, 0) + 1
-        self.last_leg = leg
+    def _account(self, leg: str, n: int, t0: float,
+                 blocks: int = 1) -> None:
+        label = ("blocked" if leg == "mesh" and blocks > 1 else leg)
+        self.calls[label] = self.calls.get(label, 0) + 1
+        self.last_leg = label
+        self.last_blocks = blocks
         if obs_metrics.REGISTRY.enabled:
             _M_SECONDS.observe(time.perf_counter() - t0,
-                               kernel="reduce", leg=leg)
+                               kernel="reduce", leg=label)
             _M_BATCH.observe(n)
+            _G_BLOCKS.set(blocks)
 
 
 ENGINE = MeshAggEngine()

@@ -1,4 +1,4 @@
-"""REDUCTION SPEC v1 — the fixed-order deterministic aggregation rule.
+"""REDUCTION SPEC v2 — the fixed-order deterministic aggregation rule.
 
 Validators re-derive the committed model hash (ROADMAP "validator-side
 FedAvg re-derivation"), so the weighted-merge arithmetic is PROTOCOL,
@@ -60,14 +60,32 @@ everywhere except subnormal corners.
    negative sum flushes to it), and ``-0 + (+0) == +0`` normalizes it
    where a skip would not, so "add the masked term" is the normative
    rule and both legs follow it.  A NaN/inf in an UNSELECTED delta is
-   masked out before it can poison the sum.  Spec v1
-   deliberately fixes the block count at ONE (pure sequential): it is
-   the historical chain's order, so certified hashes are unchanged
-   under the engine, and it is independent of device count — a 1-chip
-   validator re-derives a 256-chip writer's bytes.  A future spec rev
-   may introduce a fixed, protocol-agreed block structure for
-   cross-device psum-style reductions; that is a chain-visible change
-   and must ride a protocol genome field, never jax.device_count().
+   masked out before it can poison the sum.
+
+   **Spec v2: the protocol-agreed block structure.**  The flattened
+   ``(P,)`` param axis (leaves concatenated in sorted-key order) is cut
+   into ``reduce_blocks`` fixed contiguous blocks of ``Pb =
+   ceil(P / reduce_blocks)`` elements each (``block_bounds`` below is
+   the ONE normative partition; the last block may be short, and
+   ``reduce_blocks > P`` is a degenerate geometry it rejects).  WITHIN
+   each block the accumulation is exactly the v1 rule above; the
+   per-block partials then combine by CONCATENATION in ascending block
+   order.  Because the reduction is elementwise per parameter — no
+   arithmetic ever crosses a block boundary — every element's
+   ascending-slot addition chain is untouched by the partition, so the
+   v2 result is byte-identical to v1 for EVERY block count and every
+   device placement.  What the blocks buy is an execution degree of
+   freedom: each block is an independent program the engine can stage,
+   compile and shard separately (a delta matrix bigger than one chip's
+   HBM runs as per-block ``(N, Pb)`` programs or one params-axis
+   NamedSharding program) while the certified bytes stay a pure
+   function of the admitted set.  ``reduce_blocks`` rides the protocol
+   genome (`protocol.constants.ProtocolConfig`), NEVER
+   ``jax.device_count()`` — a 1-chip validator re-derives a 256-chip
+   writer's bytes — and blocked commit ops carry the claimed geometry
+   so a writer lying about it refuses BAD_ARG at every replica.
+   ``reduce_blocks = 1`` (the default, and what ``BFLC_BLOCKED_LEGACY=1``
+   pins) is exactly spec v1, wire format included.
 
 5. **Model update** (writer merge only).  Per leaf,
    ``new = float32(g) - float32(lr) * acc`` cast back to the leaf's
@@ -84,11 +102,11 @@ toolchain breaks it — e.g. by contracting step 3 into step 4).
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-SPEC_VERSION = 1
+SPEC_VERSION = 2
 
 # smallest normal float32 (2**-126): the FTZ/DAZ threshold
 MIN_NORMAL = np.float32(1.1754944e-38)
@@ -147,6 +165,69 @@ def host_weighted_sum(keys: Sequence[str],
                     # kernel's where-masked term does
                     acc = _daz(acc + np.float32(0.0))
             out[key] = acc if acc is not None else np.float32(0.0)
+    return out
+
+
+def block_bounds(p: int, blocks: int) -> List[Tuple[int, int]]:
+    """The ONE normative partition of the flattened ``(P,)`` param axis
+    (spec v2): ``blocks`` contiguous blocks of ``Pb = ceil(p/blocks)``
+    elements, block ``b`` covering ``[b*Pb, min((b+1)*Pb, p))``.  The
+    last block may be short; empty trailing blocks never exist because
+    ``blocks > p`` is a DEGENERATE geometry (a block would reduce
+    nothing) and is rejected here with the protocol's error."""
+    blocks = int(blocks)
+    if blocks < 1:
+        raise ValueError(f"reduce_blocks must be >= 1, got {blocks}")
+    if blocks > max(int(p), 1):
+        raise ValueError(
+            f"degenerate block geometry: reduce_blocks = {blocks} "
+            f"exceeds the flattened param count P = {p} (at least one "
+            f"block would be empty); the genome must satisfy "
+            f"reduce_blocks <= P for every model it certifies")
+    if p <= 0:
+        return [(0, 0)]
+    pb = -(-int(p) // blocks)  # ceil
+    return [(b * pb, min((b + 1) * pb, int(p)))
+            for b in range(blocks) if b * pb < int(p)]
+
+
+def blocked_host_weighted_sum(keys: Sequence[str],
+                              delta_flats: List[Dict[str, np.ndarray]],
+                              w: np.ndarray, wsum: float, blocks: int
+                              ) -> Dict[str, np.ndarray]:
+    """The NORMATIVE REFERENCE for spec v2's blocked reduction: flatten
+    each delta to ``(P,)`` in sorted-key order, run the v1 FTZ masked
+    sequential rule (steps 3-4) independently inside every
+    ``block_bounds`` block, concatenate the partials in ascending block
+    order, unflatten.  Byte-identical to ``host_weighted_sum`` for
+    every ``blocks`` — asserted by the differential checker and the
+    engine self-check, never assumed."""
+    if blocks <= 1 or not delta_flats:
+        return host_weighted_sum(keys, delta_flats, w, wsum)
+    shapes = [np.asarray(delta_flats[0][k]) for k in keys]
+    rows = [np.concatenate([np.asarray(d[k], np.float32).ravel()
+                            for k in keys]) if keys
+            else np.zeros(0, np.float32) for d in delta_flats]
+    p = int(rows[0].size)
+    coeffs = _daz(merge_coefficients(w, wsum))
+    gates = np.asarray(w, np.float32) > 0.0
+    acc = np.zeros(p, np.float32)
+    with np.errstate(invalid="ignore", over="ignore"):
+        for lo, hi in block_bounds(p, blocks):
+            part = np.zeros(hi - lo, np.float32)
+            for i, r in enumerate(rows):
+                if gates[i]:
+                    part = _daz(part + _daz(_daz(r[lo:hi]) * coeffs[i]))
+                else:
+                    part = _daz(part + np.float32(0.0))
+            # deterministic fixed-order combine: ascending-block
+            # concatenation — no cross-block arithmetic ever happens
+            acc[lo:hi] = part
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for k, ref in zip(keys, shapes):
+        out[k] = acc[off:off + ref.size].reshape(ref.shape)
+        off += ref.size
     return out
 
 

@@ -96,6 +96,21 @@ class ProtocolConfig:
     # today's frozen-committee async bytes exactly.
     async_reseat_every: int = 0
 
+    # REDUCTION SPEC v2: protocol-agreed blocked reduction.  With
+    # reduce_blocks = B > 1 the flattened (P,) param axis is cut into B
+    # fixed contiguous blocks (ceil(P/B) each, meshagg.spec.block_bounds
+    # — the ONE normative partition); WITHIN a block accumulation stays
+    # strict ascending-slot sequential FTZ float32 (spec step 4) and the
+    # per-block partials concatenate in ascending block order, so the
+    # result is byte-identical to v1 for EVERY B and every device count
+    # — a 1-chip validator re-derives a 256-chip writer's bytes.  The
+    # geometry is part of the protocol genome, never jax.device_count():
+    # blocked commit ops carry the claimed geometry and validators
+    # refuse (BAD_ARG) a writer whose claim disagrees with this field.
+    # 1 (the default) or BFLC_BLOCKED_LEGACY=1 pins the v1 single-block
+    # wire format byte-for-byte.
+    reduce_blocks: int = 1
+
     def validate(self) -> "ProtocolConfig":
         if not (0 < self.comm_count < self.client_num):
             raise ValueError(
@@ -142,6 +157,16 @@ class ProtocolConfig:
                 f"(async_buffer > 0), got reseat_every="
                 f"{self.async_reseat_every} with async_buffer="
                 f"{self.async_buffer}")
+        if self.reduce_blocks < 1:
+            raise ValueError(
+                f"reduce_blocks must be >= 1 (1 = REDUCTION SPEC v1 "
+                f"single block), got {self.reduce_blocks}")
+        if self.reduce_blocks > 65536:
+            raise ValueError(
+                f"reduce_blocks = {self.reduce_blocks} is degenerate "
+                f"(> 65536): blocks beyond the param count P reduce "
+                f"nothing, and P-scale geometries are rejected per "
+                f"model by meshagg.spec.block_bounds")
         return self
 
     @property

@@ -213,14 +213,18 @@ def _evidence_endpoints(auth: Optional[dict]
 def derive_leaves(global_flat: Dict[str, np.ndarray],
                   flats_by_slot: List[Optional[Dict[str, np.ndarray]]],
                   weights: Sequence[float], selected: Sequence[int],
-                  lr: float, keys: Sequence[str]
+                  lr: float, keys: Sequence[str], blocks: int = 1
                   ) -> Dict[str, np.ndarray]:
-    """REDUCTION SPEC v1 writer merge restricted to `keys`, through the
-    SAME `meshagg.ENGINE` the writer runs — byte-identical per leaf by
-    construction (the reduction is leaf-independent).  Slots whose flat
-    is None (unselected — their blobs were never fetched) substitute a
-    shared zeros image: spec step 4 adds them as masked +0.0 terms, so
-    the bytes cannot depend on their real content."""
+    """REDUCTION SPEC v1/v2 writer merge restricted to `keys`, through
+    the SAME `meshagg.ENGINE` the writer runs — byte-identical per leaf
+    by construction (the reduction is leaf-independent).  Slots whose
+    flat is None (unselected — their blobs were never fetched)
+    substitute a shared zeros image: spec step 4 adds them as masked
+    +0.0 terms, so the bytes cannot depend on their real content.
+    `blocks` is the genome's reduce_blocks — a validator re-deriving a
+    blocked commit gets identical bytes at ANY value (spec v2's whole
+    point), but running the claimed geometry keeps the rederive plane
+    an honest execution twin of the writer."""
     from bflc_demo_tpu.meshagg import spec
     from bflc_demo_tpu.meshagg.engine import ENGINE
     zeros = {k: np.zeros(np.asarray(global_flat[k]).shape, np.float32)
@@ -229,7 +233,13 @@ def derive_leaves(global_flat: Dict[str, np.ndarray],
              for f in flats_by_slot]
     w = spec.merge_weight_vector(weights, selected, len(flats))
     wsum = max(float(w.sum()), 1e-12)
-    accs = ENGINE.weighted_sum(list(keys), flats, w, wsum)
+    # a SHARD validator's key subset can flatten smaller than the
+    # genome's block count — clamp to the subset's own axis (the
+    # partition is an execution shape; any clamp is byte-invariant)
+    psub = sum(int(np.asarray(global_flat[k]).size) for k in keys)
+    eff_blocks = min(max(int(blocks), 1), max(psub, 1))
+    accs = ENGINE.weighted_sum(list(keys), flats, w, wsum,
+                               blocks=eff_blocks)
     return spec.apply_step({k: global_flat[k] for k in keys}, accs, lr)
 
 
@@ -237,8 +247,8 @@ def rederive_model_flat(prev_blob: bytes, delta_blobs: List[bytes],
                         weights: Sequence[float],
                         selected: Sequence[int], lr: float, *,
                         sparse: bool = False,
-                        keys: Optional[Sequence[str]] = None
-                        ) -> Dict[str, np.ndarray]:
+                        keys: Optional[Sequence[str]] = None,
+                        blocks: int = 1) -> Dict[str, np.ndarray]:
     """The standalone validator-path merge over raw blob bytes — what
     tools/check_reduction_spec.py differentials against the writer path
     and the drill reuses.  Decodes each SELECTED blob through the one
@@ -256,7 +266,8 @@ def rederive_model_flat(prev_blob: bytes, delta_blobs: List[bytes],
             flat = densify_entries(flat)
         flats.append(flat)
     return derive_leaves(global_flat, flats, weights, list(selected),
-                         lr, list(keys) if keys is not None else all_keys)
+                         lr, list(keys) if keys is not None else all_keys,
+                         blocks=blocks)
 
 
 class Rederiver:
@@ -433,9 +444,12 @@ class Rederiver:
         my_keys = (keys if self.mode == "full" or self.n <= 1
                    else leaf_shard(keys, self.index, self.n, epoch))
         lr = self.cfg.learning_rate
+        from bflc_demo_tpu.ledger.base import reduce_blocks
+        blocks = reduce_blocks(self.cfg)
         with obs_trace.TRACE.span("rederive.derive", leaves=len(my_keys)):
             derived = derive_leaves(global_flat, flats, weights,
-                                    selected, lr, my_keys)
+                                    selected, lr, my_keys,
+                                    blocks=blocks)
         bad = _diverging_leaves(derived, claimed_flat)
         if bad and self.mode != "full" and len(my_keys) < len(keys):
             # per-leaf disagreement escalates THIS validator to full
@@ -447,7 +461,7 @@ class Rederiver:
                                       leaves=len(rest)):
                 derived.update(derive_leaves(global_flat, flats,
                                              weights, selected, lr,
-                                             rest))
+                                             rest, blocks=blocks))
             bad = _diverging_leaves(derived, claimed_flat)
         if bad:
             return self._refuse(
@@ -617,7 +631,9 @@ class Rederiver:
                         f"refused by the decode chain: {e}")
             admitted.append((sender, mflat, n, c))
         try:
-            partial, n2, _cost = cell_partial(admitted)
+            from bflc_demo_tpu.ledger.base import reduce_blocks
+            partial, n2, _cost = cell_partial(
+                admitted, blocks=reduce_blocks(self.cfg))
             rederived = partial_blob(
                 partial, cell_index, n2, digest,
                 density=(self.cfg.delta_density if self._sparse

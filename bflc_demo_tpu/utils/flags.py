@@ -157,6 +157,21 @@ def build_parser() -> argparse.ArgumentParser:
                                 "certified hash over the sparse "
                                 "bytes, composes with --delta-dtype)")
             continue
+        if name == "reduce_blocks":
+            # REDUCTION SPEC v2 (meshagg.spec): protocol-agreed blocked
+            # reduction.  Validated by ProtocolConfig.validate; any
+            # value is byte-identical to v1 by construction
+            p.add_argument("--reduce-blocks", type=int, default=None,
+                           help="protocol: partition the flattened "
+                                "param axis into this many contiguous "
+                                "blocks for aggregation (REDUCTION "
+                                "SPEC v2; default 1 = v1 single "
+                                "block; result bytes are identical "
+                                "for any value — this is an execution-"
+                                "shape knob the quorum certifies, "
+                                "needs the python ledger backend; "
+                                "BFLC_BLOCKED_LEGACY=1 pins v1)")
+            continue
         p.add_argument("--" + name.replace("_", "-"),
                        type=type(default), default=None,
                        help=f"protocol: {name} (default {default})")

@@ -1,0 +1,404 @@
+"""REDUCTION SPEC v2 — protocol-agreed blocked reduction (ISSUE 18).
+
+Three properties carry the whole feature:
+
+- **byte invariance**: partitioning the flattened param axis into any
+  number of contiguous blocks (genome field ``reduce_blocks``) changes
+  NOTHING about the committed bytes — per-element accumulation order is
+  untouched, blocks only concatenate — pinned against the ISSUE-11
+  golden digests and the scripted end-to-end committed model hashes;
+- **device-count independence**: the blocked mesh leg reproduces the
+  blocked host reference (and therefore the v1 bytes) on 1, 2, 4 and 8
+  forced host devices — the partition comes from the genome, never from
+  ``jax.device_count()``;
+- **geometry is certified**: commit ops carry the block-count claim
+  (``BLK1`` tail), and a writer claiming a geometry that disagrees with
+  the replica's genome dies with BAD_ARG before any state mutates — the
+  lying-writer drill.
+"""
+
+import hashlib
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
+from bflc_demo_tpu.ledger.base import reduce_blocks
+from bflc_demo_tpu.ledger.pyledger import _BLOCKS_MAGIC, PyLedger
+from bflc_demo_tpu.meshagg import spec
+from bflc_demo_tpu.meshagg.engine import ENGINE
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import pack_entries
+
+from test_meshagg import (GOLDEN_AGG, GOLDEN_ASYNC_MODEL, GOLDEN_CELL,
+                          GOLDEN_SYNC_MODEL, _async_drain_model_hash,
+                          _golden_scenario, _sync_round_model_hash)
+
+
+class TestBlockBounds:
+    """spec.block_bounds is the NORMATIVE partition — every consumer
+    (engine legs, host reference, rederive) derives from it."""
+
+    def test_partition_covers_contiguously(self):
+        for p in (1, 5, 42, 97, 4096):
+            for blocks in (1, 2, 3, 7, p):
+                if blocks > p:
+                    continue
+                bounds = spec.block_bounds(p, blocks)
+                assert bounds[0][0] == 0 and bounds[-1][1] == p
+                for (_, hi), (lo2, _) in zip(bounds, bounds[1:]):
+                    assert hi == lo2
+                pb = -(-p // blocks)
+                assert all(hi - lo == pb for lo, hi in bounds[:-1])
+                assert 0 < bounds[-1][1] - bounds[-1][0] <= pb
+
+    def test_empty_model_is_one_empty_block(self):
+        assert spec.block_bounds(0, 1) == [(0, 0)]
+        assert spec.block_bounds(0, 1)[0][1] - \
+            spec.block_bounds(0, 1)[0][0] == 0
+
+    def test_degenerate_geometry_rejected(self):
+        with pytest.raises(ValueError, match="degenerate"):
+            spec.block_bounds(42, 43)
+        with pytest.raises(ValueError):
+            spec.block_bounds(10, 0)
+        with pytest.raises(ValueError):
+            spec.block_bounds(10, -1)
+
+    def test_genome_validation(self):
+        assert ProtocolConfig(reduce_blocks=1).validate()
+        assert ProtocolConfig(reduce_blocks=2).validate()
+        assert ProtocolConfig(reduce_blocks=65536).validate()
+        with pytest.raises(ValueError, match="reduce_blocks"):
+            ProtocolConfig(reduce_blocks=0).validate()
+        with pytest.raises(ValueError, match="reduce_blocks"):
+            ProtocolConfig(reduce_blocks=-3).validate()
+        with pytest.raises(ValueError, match="degenerate"):
+            ProtocolConfig(reduce_blocks=65537).validate()
+
+    def test_legacy_env_pins_v1(self, monkeypatch):
+        cfg = ProtocolConfig(reduce_blocks=8)
+        assert reduce_blocks(cfg) == 8
+        monkeypatch.setenv("BFLC_BLOCKED_LEGACY", "1")
+        assert reduce_blocks(cfg) == 1
+
+
+class TestBlockedGoldenPins:
+    """Any block count reproduces the ISSUE-11 golden digests — the
+    certified arithmetic is invariant under the v2 execution shape."""
+
+    @pytest.mark.parametrize("blocks", [2, 8])
+    @pytest.mark.parametrize("leg", ["host", "mesh"])
+    def test_blocked_merge_pins_golden_bytes(self, blocks, leg):
+        _, _, _, g, deltas, weights, selected = _golden_scenario()
+        out = ENGINE.aggregate_flat(g, deltas, weights, selected, 0.05,
+                                    force_leg=leg, blocks=blocks)
+        assert hashlib.sha256(
+            pack_entries(out)).hexdigest() == GOLDEN_AGG
+        assert ENGINE.last_blocks == blocks
+
+    def test_blocked_leg_accounting(self):
+        _, _, _, g, deltas, weights, selected = _golden_scenario()
+        before = ENGINE.calls.get("blocked", 0)
+        ENGINE.aggregate_flat(g, deltas, weights, selected, 0.05,
+                              force_leg="blocked")
+        assert ENGINE.calls.get("blocked", 0) == before + 1
+        assert ENGINE.last_leg == "blocked"
+
+    def test_blocked_host_reference_equals_v1_host(self):
+        _, keys, _, _, deltas, weights, selected = _golden_scenario()
+        keys = sorted(keys)
+        w = spec.merge_weight_vector(weights, selected, len(deltas))
+        wsum = max(float(w.sum()), 1e-12)
+        v1 = spec.host_weighted_sum(keys, deltas, w, wsum)
+        p = sum(int(np.asarray(deltas[0][k]).size) for k in keys)
+        for blocks in (1, 2, 5, 8, 64, p):
+            v2 = spec.blocked_host_weighted_sum(keys, deltas, w, wsum,
+                                                blocks)
+            for k in keys:
+                assert np.asarray(v2[k]).tobytes() == \
+                    np.asarray(v1[k]).tobytes(), (blocks, k)
+
+    def test_cell_partial_blocked_pins_golden_bytes(self):
+        from bflc_demo_tpu.hier.partial import cell_partial
+        rng, keys, shapes, _, _, _, _ = _golden_scenario()
+        admitted = []
+        for i in range(7):
+            flat = {k: rng.standard_normal(shapes[k]).astype(np.float32)
+                    for k in keys}
+            admitted.append((f"0x{i:040x}", flat, 10 + 3 * i,
+                             0.5 + 0.1 * i))
+        partial, n, _ = cell_partial(admitted, blocks=3)
+        assert hashlib.sha256(
+            pack_entries(partial)).hexdigest() == GOLDEN_CELL
+        assert n == 7
+
+    def test_shard_rederive_clamps_small_subsets(self):
+        """A rederive shard restricted to a key subset smaller than the
+        genome's block count must clamp, not raise — and the bytes are
+        invariant either way."""
+        from bflc_demo_tpu.rederive.core import derive_leaves
+        _, keys, _, g, deltas, weights, selected = _golden_scenario()
+        sub = [sorted(keys)[1]]                     # one (8,) leaf: P=8
+        flats = [d if i in set(selected) else None
+                 for i, d in enumerate(deltas)]
+        v1 = derive_leaves(g, flats, weights, selected, 0.05, sub)
+        vb = derive_leaves(g, flats, weights, selected, 0.05, sub,
+                           blocks=4096)
+        assert np.asarray(vb[sub[0]]).tobytes() == \
+            np.asarray(v1[sub[0]]).tobytes()
+
+
+class TestBlockedCertifiedHashParity:
+    """The scripted end-to-end rounds, re-run under a blocked genome:
+    the COMMITTED MODEL HASHES must equal the v1 goldens bit-for-bit
+    (reduce_blocks is an execution-shape knob, not an arithmetic one),
+    and BFLC_BLOCKED_LEGACY=1 must pin the v1 wire too."""
+
+    def test_sync_round_blocked_genome_pins_golden(self, monkeypatch):
+        monkeypatch.delenv("BFLC_MESH_AGG_LEGACY", raising=False)
+        monkeypatch.setenv("BFLC_MESH_AGG_MIN", "1")
+        assert _sync_round_model_hash(
+            reduce_blocks=2) == GOLDEN_SYNC_MODEL
+
+    def test_sync_round_legacy_env_pins_v1_wire(self, monkeypatch):
+        monkeypatch.setenv("BFLC_BLOCKED_LEGACY", "1")
+        assert _sync_round_model_hash(
+            reduce_blocks=2) == GOLDEN_SYNC_MODEL
+
+    def test_async_drain_blocked_genome_pins_golden(self, monkeypatch):
+        monkeypatch.delenv("BFLC_MESH_AGG_LEGACY", raising=False)
+        monkeypatch.setenv("BFLC_MESH_AGG_MIN", "1")
+        assert _async_drain_model_hash(
+            reduce_blocks=2) == GOLDEN_ASYNC_MODEL
+
+
+def _addr(i):
+    return f"0x{i:040x}"
+
+
+def _drive_round(led, cfg, epoch=0):
+    for i in range(cfg.comm_count, cfg.client_num):
+        led.upload_local_update(
+            _addr(i), hashlib.sha256(f"p{i}@{epoch}".encode()).digest(),
+            300 + i, 1.5, epoch)
+    rng = np.random.default_rng(42 + epoch)
+    for c in led.committee():
+        led.upload_scores(c, epoch, list(rng.random(
+            cfg.needed_update_count).astype(np.float32)))
+
+
+class TestGeometryClaimWire:
+    """The lying-writer drill: the block-count claim rides the commit
+    op; any disagreement with the replica's genome is BAD_ARG before
+    state mutates — so every BFT validator's re-execution refuses to
+    co-sign a writer lying about its reduction geometry."""
+
+    CFG2 = ProtocolConfig(reduce_blocks=2)
+    CFG1 = ProtocolConfig()
+
+    def _committed_writer(self, cfg):
+        led = make_ledger(cfg)
+        for i in range(cfg.client_num):
+            led.register_node(_addr(i))
+        _drive_round(led, cfg)
+        st = led.commit_model(hashlib.sha256(b"m1").digest(), 0)
+        assert st == LedgerStatus.OK
+        return led
+
+    def _replay_prefix(self, cfg, src, upto):
+        led = make_ledger(cfg, backend="python")
+        for j in range(upto):
+            assert led.apply_op(src.log_op(j)) == LedgerStatus.OK, j
+        return led
+
+    def test_blocked_genome_needs_python_backend(self):
+        with pytest.raises(ValueError, match="geometry-claim"):
+            make_ledger(self.CFG2, backend="native")
+        assert isinstance(make_ledger(self.CFG2), PyLedger)
+
+    def test_commit_op_carries_blk1_tail(self):
+        w = self._committed_writer(self.CFG2)
+        body = w.log_op(w.log_size() - 1)[1:]
+        assert len(body) == 52
+        assert body[40:44] == _BLOCKS_MAGIC
+        assert struct.unpack("<q", body[44:])[0] == 2
+
+    def test_v1_commit_op_bytes_unchanged(self):
+        w = self._committed_writer(self.CFG1)
+        assert len(w.log_op(w.log_size() - 1)[1:]) == 40
+
+    def test_honest_blocked_chain_replays(self):
+        w = self._committed_writer(self.CFG2)
+        r = self._replay_prefix(self.CFG2, w, w.log_size())
+        assert r.log_head() == w.log_head()
+
+    def test_v1_replica_refuses_blocked_claim(self):
+        w = self._committed_writer(self.CFG2)
+        r = self._replay_prefix(self.CFG1, w, w.log_size() - 1)
+        op = w.log_op(w.log_size() - 1)
+        assert r.apply_op(op) == LedgerStatus.BAD_ARG
+
+    def test_blocked_replica_refuses_plain_v1_commit(self):
+        w = self._committed_writer(self.CFG1)
+        r = self._replay_prefix(self.CFG2, w, w.log_size() - 1)
+        assert r.apply_op(
+            w.log_op(w.log_size() - 1)) == LedgerStatus.BAD_ARG
+
+    def test_lying_geometry_claim_dies_before_state(self):
+        w = self._committed_writer(self.CFG2)
+        op = w.log_op(w.log_size() - 1)
+        lie = bytes([op[0]]) + op[1:41] + _BLOCKS_MAGIC + \
+            struct.pack("<q", 8)
+        r = self._replay_prefix(self.CFG2, w, w.log_size() - 1)
+        head, epoch = r.log_head(), r.epoch
+        # validate_op (the BFT probe) refuses and restores
+        assert r.validate_op(lie) == LedgerStatus.BAD_ARG
+        assert r.log_head() == head and r.epoch == epoch
+        # apply_op refuses without mutating
+        assert r.apply_op(lie) == LedgerStatus.BAD_ARG
+        assert r.log_head() == head and r.epoch == epoch
+        # garbage tails are BAD_ARG, not silently ignored
+        assert r.apply_op(bytes([op[0]]) + op[1:41]
+                          + b"XY") == LedgerStatus.BAD_ARG
+        # the honest op still lands afterwards
+        assert r.apply_op(op) == LedgerStatus.OK
+        assert r.log_head() == w.log_head()
+
+    def test_async_drain_claim_wire(self):
+        cfg2 = ProtocolConfig(async_buffer=8, reduce_blocks=2)
+        cfg1 = ProtocolConfig(async_buffer=8)
+
+        def seeded(cfg):
+            led = make_ledger(cfg)
+            for i in range(cfg.client_num):
+                led.register_node(_addr(i))
+            return led
+
+        w = seeded(cfg2)
+        for i in range(4, 8):
+            assert w.async_upload(
+                _addr(i), hashlib.sha256(f"a{i}".encode()).digest(),
+                100 + i, 1.0, 0) == LedgerStatus.OK
+        assert w.async_commit(hashlib.sha256(b"am").digest(), 0,
+                              3) == LedgerStatus.OK
+        aop = w.log_op(w.log_size() - 1)
+        body = aop[1:]
+        assert body[48:52] == _BLOCKS_MAGIC
+        assert struct.unpack("<q", body[52:])[0] == 2
+        # honest replay
+        r = make_ledger(cfg2)
+        for j in range(w.log_size()):
+            assert r.apply_op(w.log_op(j)) == LedgerStatus.OK, j
+        assert r.log_head() == w.log_head()
+        # v1-async replica refuses the tagged drain
+        r1 = make_ledger(cfg1)
+        for j in range(w.log_size() - 1):
+            assert r1.apply_op(w.log_op(j)) == LedgerStatus.OK
+        assert r1.apply_op(aop) == LedgerStatus.BAD_ARG
+        # lying claim and stripped tail both refused by blocked replica
+        r2 = make_ledger(cfg2)
+        for j in range(w.log_size() - 1):
+            assert r2.apply_op(w.log_op(j)) == LedgerStatus.OK
+        lie = bytes([aop[0]]) + body[:48] + _BLOCKS_MAGIC + \
+            struct.pack("<q", 16)
+        assert r2.apply_op(lie) == LedgerStatus.BAD_ARG
+        assert r2.apply_op(
+            bytes([aop[0]]) + body[:48]) == LedgerStatus.BAD_ARG
+        assert r2.apply_op(aop) == LedgerStatus.OK
+
+
+class TestDeviceCountIndependence:
+    """The partition is genome, not hardware: conftest forces 8 host
+    devices, and blocks=8 divides 8, so the sharded params-axis cube
+    program actually runs here — its bytes must equal the blocked host
+    reference and the v1 host loop."""
+
+    def test_sharded_cube_leg_matches_host_bytes(self):
+        import jax
+        assert jax.device_count() == 8, jax.devices()
+        rng = np.random.default_rng(20260807)
+        keys = ["/W", "/b"]
+        deltas = [{"/W": rng.standard_normal((10, 4)).astype(np.float32),
+                   "/b": rng.standard_normal((8,)).astype(np.float32)}
+                  for _ in range(24)]
+        w = spec.merge_weight_vector(
+            [float(5 + i) for i in range(24)], list(range(24)), 24)
+        wsum = max(float(w.sum()), 1e-12)
+        v1 = ENGINE.weighted_sum(keys, deltas, w, wsum,
+                                 force_leg="host")
+        blocked = ENGINE.weighted_sum(keys, deltas, w, wsum,
+                                      force_leg="mesh", blocks=8)
+        for k in keys:
+            assert np.asarray(blocked[k]).tobytes() == \
+                np.asarray(v1[k]).tobytes(), k
+        assert ENGINE.last_blocks == 8
+        # the padded-cube program was compiled for this geometry
+        assert any(sig[0] == "blk" for sig in ENGINE._programs
+                   if isinstance(sig, tuple))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("ndev", [1, 2, 4])
+    def test_forced_device_counts_reproduce_bytes(self, ndev):
+        """Subprocess with a forced N-device CPU backend: the blocked
+        mesh leg's certified hash is a constant — computed fresh per
+        device count and compared against the in-process 8-device
+        value via the blocked HOST reference (pure numpy, device-free,
+        identical everywhere by construction)."""
+        code = (
+            "import hashlib\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "assert jax.device_count() == %d, jax.devices()\n"
+            "from bflc_demo_tpu.meshagg import spec\n"
+            "from bflc_demo_tpu.meshagg.engine import ENGINE\n"
+            "from bflc_demo_tpu.utils.serialization import pack_entries\n"
+            "rng = np.random.default_rng(20260807)\n"
+            "keys = ['/W', '/b']\n"
+            "deltas = [{'/W': rng.standard_normal((10, 4))"
+            ".astype(np.float32),\n"
+            "           '/b': rng.standard_normal((8,))"
+            ".astype(np.float32)}\n"
+            "          for _ in range(24)]\n"
+            "w = spec.merge_weight_vector([float(5 + i) "
+            "for i in range(24)], list(range(24)), 24)\n"
+            "wsum = max(float(w.sum()), 1e-12)\n"
+            "m = ENGINE.weighted_sum(keys, deltas, w, wsum, "
+            "force_leg='mesh', blocks=8)\n"
+            "h = spec.blocked_host_weighted_sum(keys, deltas, w, "
+            "wsum, 8)\n"
+            "for k in keys:\n"
+            "    assert np.asarray(m[k]).tobytes() == "
+            "np.asarray(h[k]).tobytes(), k\n"
+            "print('DEVHASH', hashlib.sha256(pack_entries("
+            "{k: np.asarray(m[k]) for k in keys})).hexdigest())\n"
+        ) % ndev
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=("--xla_force_host_platform_device_count"
+                              f"={ndev}"))
+        r = subprocess.run([sys.executable, "-c", code],
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))),
+                           capture_output=True, text=True, timeout=300,
+                           env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        got = [ln for ln in r.stdout.splitlines()
+               if ln.startswith("DEVHASH")][0].split()[1]
+        # the same scenario through the device-free host reference in
+        # THIS process — equality across processes = across counts
+        rng = np.random.default_rng(20260807)
+        keys = ["/W", "/b"]
+        deltas = [{"/W": rng.standard_normal((10, 4)).astype(np.float32),
+                   "/b": rng.standard_normal((8,)).astype(np.float32)}
+                  for _ in range(24)]
+        w = spec.merge_weight_vector(
+            [float(5 + i) for i in range(24)], list(range(24)), 24)
+        ref = spec.blocked_host_weighted_sum(
+            keys, deltas, w, max(float(w.sum()), 1e-12), 8)
+        want = hashlib.sha256(pack_entries(
+            {k: np.asarray(ref[k]) for k in keys})).hexdigest()
+        assert got == want, (ndev, got, want)

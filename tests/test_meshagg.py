@@ -201,16 +201,19 @@ def _tree(rng, scale=1.0):
                    ).astype(np.float32)}
 
 
-def _sync_round_model_hash():
+def _sync_round_model_hash(**cfg_overrides):
     """Scripted config-1 sync round through a real LedgerServer; the
-    committed model hash is the certified artifact under test."""
+    committed model hash is the certified artifact under test.
+    `cfg_overrides` lets byte-invariance pins (e.g. REDUCTION SPEC v2
+    `reduce_blocks`, tests/test_blocked.py) re-run the identical script
+    under a different genome."""
     from bflc_demo_tpu.comm.identity import provision_wallets
     from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                    LedgerServer)
     from bflc_demo_tpu.protocol.constants import ProtocolConfig
     cfg = ProtocolConfig(client_num=20, comm_count=4, aggregate_count=6,
                          needed_update_count=10, learning_rate=0.05,
-                         batch_size=16).validate()
+                         batch_size=16, **cfg_overrides).validate()
     rng = np.random.default_rng(11)
     blob0 = pack_pytree(_tree(rng))
     wallets, _ = provision_wallets(20, b"meshagg-parity-seed")
@@ -249,9 +252,10 @@ def _sync_round_model_hash():
         srv.close()
 
 
-def _async_drain_model_hash():
+def _async_drain_model_hash(**cfg_overrides):
     """Two scripted FedBuff drains (the second with a staleness mix)
-    through a real async-mode LedgerServer."""
+    through a real async-mode LedgerServer.  `cfg_overrides` as in
+    `_sync_round_model_hash`."""
     from bflc_demo_tpu.comm.identity import _op_bytes, provision_wallets
     from bflc_demo_tpu.comm.ledger_service import (CoordinatorClient,
                                                    LedgerServer)
@@ -260,7 +264,7 @@ def _async_drain_model_hash():
     cfg = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=2,
                          needed_update_count=4, learning_rate=0.05,
                          batch_size=16, async_buffer=4,
-                         max_staleness=4).validate()
+                         max_staleness=4, **cfg_overrides).validate()
     rng = np.random.default_rng(12)
     blob0 = pack_pytree(_tree(rng))
     wallets, _ = provision_wallets(8, b"meshagg-async-parity")

@@ -84,6 +84,16 @@ METRICS: List[Tuple[str, str, str, str]] = [
      "extra.endurance_async.departed_wedged", "lower", "abs"),
     ("endurance_slo_false_pages",
      "extra.endurance_async.slo_false_pages", "lower", "abs"),
+    # blocked reduction (eval.benchmarks.blocked_agg_config1, bench.py
+    # extra.blocked_agg, REDUCTION SPEC v2): the agg speedup of the
+    # best blocked cell vs the v1 mesh leg at matched (largest) N, and
+    # the sharded-model leg's wall — the geometry whose (N, P) stack
+    # exceeds the v1 single-buffer staging path.  Time axes, so on the
+    # cpu-fallback host a flag is a prompt to look, not a verdict.
+    ("blocked_agg_speedup_x",
+     "extra.blocked_agg.agg_speedup_vs_v1_x", "higher", "rel"),
+    ("blocked_sharded_wall_s",
+     "extra.blocked_agg.sharded_model.blocked_wall_s", "lower", "rel"),
 ]
 
 

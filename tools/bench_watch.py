@@ -9,7 +9,9 @@ first):
 
   1. `python bench.py` — headline + MFU, snapshotted to BENCH_LATEST.json
      (a later chip-less `bench.py` replays it, labelled `cached: true` +
-     `captured_at`);
+     `captured_at`); the line carries every `extra.*` axis, including
+     the REDUCTION SPEC v2 `extra.blocked_agg` blocks x N sweep with
+     its sharded-model leg and hash-equality verdict;
   2. `tools/tpu_validate.py` — native Mosaic compile + timing of the
      Pallas flash kernels (fwd, blockwise bwd, streaming-carry);
   3. `tools/tpu_flash_train.py` — seq-8192 flash-vs-einsum training;

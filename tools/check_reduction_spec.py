@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Differential checker for meshagg REDUCTION SPEC v1.
+"""Differential checker for meshagg REDUCTION SPEC v1/v2.
 
 The on-mesh aggregation engine (bflc_demo_tpu/meshagg) promises that its
 compiled leg and its host-loop leg produce BYTE-IDENTICAL results — that
@@ -15,6 +15,14 @@ sparsify -> quantize -> dequantize -> densify chain) — each scenario
 reduced by BOTH legs and compared with exact byte equality, plus the
 full ``aggregate_flat`` writer merge against the certified
 canonical-bytes hash.
+
+REDUCTION SPEC v2 rides the same sweep: every scenario is additionally
+reduced under ``reduce_blocks`` in {1, 2, 8, 64} (clamped to the
+scenario's flattened param count) on the blocked host reference AND
+the blocked mesh leg — all of them must be byte-identical to the v1
+host loop, which is the spec's central claim (blocking the param axis
+never moves a single accumulation out of slot order, so the committed
+bytes cannot depend on the block count or the device count).
 
 Runnable standalone (CI / a new platform's smoke test):
 
@@ -89,11 +97,17 @@ def _scenario(rng, max_n):
     return g, deltas, weights, selected, lr, quant, density
 
 
+BLOCKS_SWEEP = (1, 2, 8, 64)
+
+
 def run_differential(trials: int = 20, seed: int = 0,
-                     max_n: int = 64) -> dict:
-    """Host leg vs compiled leg over `trials` randomized scenarios.
-    Returns {"trials", "mismatches": [...], "compile_total"} — empty
-    mismatches means the spec held."""
+                     max_n: int = 64,
+                     blocks_sweep=BLOCKS_SWEEP) -> dict:
+    """Host leg vs compiled leg over `trials` randomized scenarios,
+    then the same scenario under every ``reduce_blocks`` in
+    `blocks_sweep` (v2 blocked host reference + blocked mesh leg, both
+    vs the v1 host bytes).  Returns {"trials", "mismatches": [...],
+    "compile_total"} — empty mismatches means the spec held."""
     from bflc_demo_tpu.meshagg import spec
     from bflc_demo_tpu.meshagg.engine import ENGINE
     from bflc_demo_tpu.utils.serialization import pack_entries
@@ -119,14 +133,38 @@ def run_differential(trials: int = 20, seed: int = 0,
                                        force_leg="mesh")
             bad = [k for k in keys if np.asarray(host[k]).tobytes()
                    != np.asarray(mesh[k]).tobytes()]
+            # REDUCTION SPEC v2: the blocked host reference and the
+            # blocked mesh leg, every geometry in the sweep, must
+            # reproduce the v1 host bytes exactly
+            p_total = sum(int(np.asarray(deltas[0][k]).size)
+                          for k in keys) if deltas else 0
+            for b in blocks_sweep:
+                eff = min(int(b), max(p_total, 1))
+                bh = ENGINE.weighted_sum(keys, deltas, w, wsum,
+                                         force_leg="host", blocks=eff)
+                bm = ENGINE.weighted_sum(keys, deltas, w, wsum,
+                                         force_leg="mesh", blocks=eff)
+                bad.extend(f"#blocked-host-b{b}:{k}" for k in keys
+                           if np.asarray(bh[k]).tobytes()
+                           != np.asarray(host[k]).tobytes())
+                bad.extend(f"#blocked-mesh-b{b}:{k}" for k in keys
+                           if np.asarray(bm[k]).tobytes()
+                           != np.asarray(host[k]).tobytes())
             # and the full writer merge: certified canonical bytes equal
             h_out = ENGINE.aggregate_flat(g, deltas, weights, selected,
                                           lr, force_leg="host")
             m_out = ENGINE.aggregate_flat(g, deltas, weights, selected,
                                           lr, force_leg="mesh")
-            if hashlib.sha256(pack_entries(h_out)).digest() != \
-                    hashlib.sha256(pack_entries(m_out)).digest():
+            h_hash = hashlib.sha256(pack_entries(h_out)).digest()
+            if h_hash != hashlib.sha256(pack_entries(m_out)).digest():
                 bad.append("#aggregate_flat-hash")
+            blk = min(int(blocks_sweep[-1]) if blocks_sweep else 1,
+                      max(p_total, 1))
+            b_out = ENGINE.aggregate_flat(g, deltas, weights, selected,
+                                          lr, force_leg="mesh",
+                                          blocks=blk)
+            if h_hash != hashlib.sha256(pack_entries(b_out)).digest():
+                bad.append("#aggregate_flat-blocked-hash")
             if bad:
                 mismatches.append({
                     "trial": t, "n": len(deltas), "quant": quant,
@@ -148,8 +186,11 @@ def run_rederive_differential(trials: int = 12, seed: int = 1,
     raw wire blobs — selected only, zeros elsewhere) must produce
     byte-identical committed model hashes; and in shard mode every
     validator's re-derived leaves must equal the writer's with the
-    shard union covering every leaf.  Empty `mismatches` = the plane
-    can refuse on inequality without ever refusing an honest writer."""
+    shard union covering every leaf.  Each trial additionally runs the
+    validator paths under a swept ``reduce_blocks`` geometry
+    (REDUCTION SPEC v2) — the re-derived hashes must not move.  Empty
+    `mismatches` = the plane can refuse on inequality without ever
+    refusing an honest writer."""
     from bflc_demo_tpu.meshagg.engine import ENGINE
     from bflc_demo_tpu.rederive.core import (derive_leaves,
                                              rederive_model_flat)
@@ -193,6 +234,18 @@ def run_rederive_differential(trials: int = 12, seed: int = 1,
             bad = []
             if v_hash != w_hash:
                 bad.append("#full-hash")
+            # v2: the same re-derivation under a blocked geometry —
+            # byte-identical by the spec's construction
+            blk = int(BLOCKS_SWEEP[t % len(BLOCKS_SWEEP)])
+            p_total = sum(int(np.asarray(v).size) for v in g.values())
+            blk = min(blk, max(p_total, 1))
+            vb_out = rederive_model_flat(prev_blob, blobs, weights,
+                                         selected, lr,
+                                         sparse=density < 1.0,
+                                         blocks=blk)
+            if hashlib.sha256(
+                    pack_entries(vb_out)).digest() != w_hash:
+                bad.append(f"#full-blocked-hash-b{blk}")
             # validator SHARD path: per-validator leaves + union cover
             keys = sorted(g.keys())
             epoch = int(rng.integers(0, 50))
@@ -203,7 +256,7 @@ def run_rederive_differential(trials: int = 12, seed: int = 1,
                 mine = leaf_shard(keys, v, n_validators, epoch)
                 covered.update(mine)
                 got = derive_leaves(g, flats, weights, selected, lr,
-                                    mine)
+                                    mine, blocks=blk)
                 for k in mine:
                     if np.asarray(got[k]).tobytes() != \
                             np.asarray(w_out[k]).tobytes():
@@ -225,6 +278,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     out = run_differential(args.trials, args.seed, args.max_n)
     print(f"reduction spec differential: {out['trials']} trials, "
+          f"blocks sweep {list(BLOCKS_SWEEP)}, "
           f"{out['compile_total']} programs compiled, "
           f"selfcheck={out['report']['selfcheck']}")
     if out["mismatches"]:
@@ -234,8 +288,8 @@ def main(argv=None) -> int:
               "this platform — certified aggregation must stay on the "
               "host loop (BFLC_MESH_AGG_LEGACY=1) until resolved")
         return 1
-    print("OK: host-loop and mesh legs byte-identical on every "
-          "scenario")
+    print("OK: host-loop, mesh, and blocked (v2) legs byte-identical "
+          "on every scenario")
     red = run_rederive_differential(max(args.trials // 2, 6), args.seed)
     print(f"rederive differential: {red['trials']} trials x "
           f"{red['n_validators']} validators")

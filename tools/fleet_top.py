@@ -276,14 +276,22 @@ def _role_row(role, snap):
                                   kernel="reduce", leg="host")
         n_ml, m_ml = _merged_hist(snap, "mesh_agg_seconds",
                                   kernel="reduce", leg="legacy")
-        if n_mm or n_mh or n_ml:
+        # REDUCTION SPEC v2: the blocked leg reports under its own
+        # label, and the genome's block geometry rides the gauge
+        n_bk, m_bk = _merged_hist(snap, "mesh_agg_seconds",
+                                  kernel="reduce", leg="blocked")
+        if n_mm or n_mh or n_ml or n_bk:
             nb, mb = _merged_hist(snap, "mesh_agg_batch_size")
             comp = _sum_counter(snap, "mesh_agg_compile_total")
             n_h = n_mh + n_ml
             m_h = ((m_mh * n_mh + m_ml * n_ml) / n_h) if n_h else 0.0
-            cells.append(f"mesh-agg jit {n_mm}x{m_mm * 1e3:.1f}ms / "
-                         f"host {n_h}x{m_h * 1e3:.1f}ms  "
-                         f"batch~{mb:.0f}  compiles {comp:.0f}")
+            cell = (f"mesh-agg jit {n_mm}x{m_mm * 1e3:.1f}ms / "
+                    f"host {n_h}x{m_h * 1e3:.1f}ms")
+            if n_bk:
+                blk = int(_gauge_value(snap, "mesh_agg_blocks", 0))
+                cell += (f" / blk{blk} {n_bk}x{m_bk * 1e3:.1f}ms")
+            cells.append(cell + f"  batch~{mb:.0f}  "
+                         f"compiles {comp:.0f}")
     wire_in = costs.get("wire.bytes_in", 0)
     wire_out = costs.get("wire.bytes_out", 0)
     if wire_in or wire_out:

@@ -193,7 +193,9 @@ def profile_mesh_agg(args) -> None:
     """In-process meshagg microprofile: N admitted-shaped deltas merged
     by the compiled mesh leg and the host loop (REDUCTION SPEC v1),
     with the differential verdict and the engine telemetry row the
-    fleet tools render.  `--clients` sets N (via --mesh-agg N)."""
+    fleet tools render.  `--mesh-agg N` sets N; `--reduce-blocks B`
+    additionally times the REDUCTION SPEC v2 blocked leg at that
+    geometry (bytes must equal the v1 legs — the verdict prints)."""
     import hashlib as _hl
     import statistics
     import time as _time
@@ -236,6 +238,23 @@ def profile_mesh_agg(args) -> None:
             out = run()
             ts.append(_time.perf_counter() - t1)
         legs[leg] = (statistics.median(ts), out)
+    blocks = max(int(getattr(args, "reduce_blocks", 1)), 1)
+    if blocks > 1:
+        # REDUCTION SPEC v2: the blocked leg at the genome geometry —
+        # same bytes, 1/B peak staging, params-shardable on a mesh
+        t0 = _time.perf_counter()
+        out_blk = ENGINE.aggregate_rows(g, rows, weights, selected,
+                                        0.05, force_leg="mesh",
+                                        blocks=blocks)
+        blk_compile_s = _time.perf_counter() - t0
+        ts = []
+        for _ in range(5):
+            t1 = _time.perf_counter()
+            ENGINE.aggregate_rows(g, rows, weights, selected, 0.05,
+                                  force_leg="mesh", blocks=blocks)
+            ts.append(_time.perf_counter() - t1)
+        legs["blocked"] = (statistics.median(ts), out_blk,
+                           blk_compile_s)
     h_mesh = _hl.sha256(pack_entries(out_mesh)).hexdigest()
     h_host = _hl.sha256(pack_entries(legs["host"][1])).hexdigest()
     rep = ENGINE.report()
@@ -246,9 +265,17 @@ def profile_mesh_agg(args) -> None:
           f"(first call incl. compile {compile_s * 1e3:.0f} ms)")
     print(f"host loop (pre-engine): {legs['host'][0] * 1e3:8.2f} ms   "
           f"speedup {legs['host'][0] / max(legs['mesh'][0], 1e-9):.2f}x")
+    if blocks > 1:
+        blk_med, out_blk, blk_compile_s = legs["blocked"]
+        h_blk = _hl.sha256(pack_entries(out_blk)).hexdigest()
+        print(f"blocked leg (B={blocks:4d}): {blk_med * 1e3:8.2f} ms   "
+              f"(first call incl. compile {blk_compile_s * 1e3:.0f} ms)"
+              f"   vs v1 mesh {legs['mesh'][0] / max(blk_med, 1e-9):.2f}x"
+              f"   bytes=={'OK' if h_blk == h_host else 'DIVERGED'}")
     print(f"certified bytes identical: {h_mesh == h_host}   "
           f"selfcheck={rep['selfcheck']}   "
-          f"programs compiled={rep['compile_total']}")
+          f"programs compiled={rep['compile_total']}   "
+          f"last_blocks={rep['last_blocks']}")
     from fleet_top import _role_row
     print(_role_row("profile", obs_metrics.REGISTRY.snapshot()))
 
@@ -280,6 +307,11 @@ def main() -> None:
                          "leg AND the host loop, print per-leg "
                          "latency, the hash-equality verdict and the "
                          "telemetry row (0 = off)")
+    ap.add_argument("--reduce-blocks", type=int, default=1, metavar="B",
+                    help="with --mesh-agg: additionally profile the "
+                         "REDUCTION SPEC v2 blocked leg at this block "
+                         "count (byte-equality verdict prints; 1 = "
+                         "v1 only)")
     ap.add_argument("--delta-density", type=float, default=1.0,
                     help="run the round with sparse top-k uploads at "
                          "this density (utils.serialization "
