@@ -67,6 +67,14 @@ density x dtype grid is TPU_RESULTS.md round 17).  `extra.rederive`
 (ISSUE 15) is the validator re-derivation plane axis: off/shard/full
 round-wall overhead, per-validator re-derivation cost, and the
 lying-writer refusal drill (eval.benchmarks.rederive_config1).
+`extra.device` (ISSUE 19) is the device-plane self-attribution
+section (obs.device): platform, per-program-family compile counts /
+wall seconds / cost-analysis FLOPs+bytes / cache hits, peak memory
+watermark, and the meshagg engine's program-cache report;
+`extra.device_overhead` is the armed-vs-BFLC_DEVICE_OBS=0 federation
+round-time ratio plus the steady-state recompile evidence
+(post-warmup sync rounds must report zero fleet fresh compiles —
+eval.benchmarks.device_overhead_config1).
 BFLC_BENCH_NO_CONTROL_PLANE=1 skips all
 of it; BFLC_BENCH_FED_BASELINE=1 re-runs the federation on the legacy
 control plane for the ratio.
@@ -142,6 +150,14 @@ def _child() -> None:
     from bflc_demo_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()
+    # arm the metrics registry in THIS process so the device plane
+    # (obs.device) attributes the in-process mesh runs — compile
+    # events, cost analysis and cache hits land in extra.device.
+    # Observability only: certified bytes are byte-identical either
+    # way (tests/test_device_obs.py)
+    from bflc_demo_tpu.obs import metrics as obs_metrics
+    obs_metrics.REGISTRY.enabled = True
+    obs_metrics.REGISTRY.role = "bench"
     platform = jax.devices()[0].platform
     # batched path (20 rounds, 5 per dispatch); the headline is the WARM
     # mean — steady-state rounds after the compile-bearing first dispatch
@@ -192,6 +208,18 @@ def _child() -> None:
         extra["flops_per_round"] = round(rp["flops_per_round"])
         if rp.get("mfu") is not None:
             extra["mfu"] = round(rp["mfu"], 6)
+    # device-plane self-attribution (ISSUE 19, obs.device): platform,
+    # per-program-family compile counts / wall seconds / cost-analysis
+    # FLOPs+bytes / cache hits, peak memory watermark and the meshagg
+    # engine's program-cache report — every artifact now says what the
+    # device actually compiled and ran, not just how long it took
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+    from bflc_demo_tpu.obs import device as obs_device
+    extra["device"] = obs_device.report()
+    extra["device"]["engine"] = {
+        "compile_total": ENGINE.report().get("compile_total"),
+        "cached_programs": ENGINE.report().get("cached_programs"),
+    }
     # control-plane axes (PR 3).  The active crypto backend is recorded
     # unconditionally: cross-host perf numbers are uninterpretable without
     # knowing whether Ed25519 ran on the `cryptography` wheel or the
@@ -259,6 +287,33 @@ def _child() -> None:
             "round_wall_time_s_slo_legacy": so[
                 "round_wall_time_s_slo_legacy"],
         }
+        # device plane (obs.device): armed vs BFLC_DEVICE_OBS=0 round
+        # time at config-1 — the 1% bar (compile/memory attribution is
+        # cheaper than the other planes: it only fires on cache misses
+        # and publisher ticks), plus the armed leg's steady-state
+        # recompile evidence (post-warmup sync rounds must show ZERO
+        # fleet fresh compiles — the recompile gate)
+        from bflc_demo_tpu.eval.benchmarks import device_overhead_config1
+        do = device_overhead_config1(rounds=2, trials=2)
+        extra["device_overhead"] = {
+            "overhead_frac": do.get("overhead_frac"),
+            "round_wall_time_s_device_armed": do[
+                "round_wall_time_s_device_armed"],
+            "round_wall_time_s_device_legacy": do[
+                "round_wall_time_s_device_legacy"],
+            "steady_state_recompiles": (do.get("device") or {}).get(
+                "steady_state_recompiles"),
+            "worst_storm_verdict": (do.get("device") or {}).get(
+                "worst_storm_verdict"),
+        }
+        # steady-state recompile gate (tools/check_reduction_spec):
+        # a repeated identical reduction scenario must add zero fresh
+        # XLA programs after its warmup pass — the in-process twin of
+        # the fleet-level zero-recompile evidence above
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from check_reduction_spec import run_steady_state_check
+        extra["device"]["steady_state_gate"] = run_steady_state_check()
         # data-plane axes (PR 5): coordinator egress bytes/round,
         # read-source shares, cache hit ratio, compression ratio and
         # the quantized-delta accuracy gap, vs a

@@ -110,6 +110,18 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             kw["trace_sample"] = opts.trace_sample
+        if opts.xprof_window:
+            # device-plane profiler window (obs.device.XprofWindow):
+            # the capture artifacts are telemetry artifacts, so the
+            # flag needs the dir unless BFLC_XPROF_DIR points elsewhere
+            import os as _os
+            if not opts.telemetry_dir \
+                    and not _os.environ.get("BFLC_XPROF_DIR"):
+                print("--xprof-window needs --telemetry-dir (or "
+                      "BFLC_XPROF_DIR) for the capture artifacts",
+                      file=sys.stderr)
+                return 2
+            kw["xprof_window"] = opts.xprof_window
         if opts.cells or opts.cell_size:
             # hierarchical cell federation (bflc_demo_tpu.hier): cohort
             # clients into cells; one certified cell-aggregate op per
@@ -136,11 +148,12 @@ def main(argv=None) -> int:
         if opts.standbys or opts.quorum or opts.bft_validators \
                 or opts.chaos_seed >= 0 or opts.snapshot_interval \
                 or opts.snapshot_dir or opts.telemetry_dir \
-                or opts.trace_sample or opts.rederive != "off":
+                or opts.trace_sample or opts.xprof_window \
+                or opts.rederive != "off":
             print("--standbys/--quorum/--bft-validators/--chaos-seed/"
                   "--snapshot-interval/--snapshot-dir/--telemetry-dir/"
-                  "--trace-sample/--rederive apply to --runtime "
-                  "processes", file=sys.stderr)
+                  "--trace-sample/--xprof-window/--rederive apply to "
+                  "--runtime processes", file=sys.stderr)
             return 2
     elif opts.runtime == "mesh" and opts.attest_scores is not None \
             and not (opts.standbys or opts.tls_dir or opts.quorum
@@ -159,12 +172,13 @@ def main(argv=None) -> int:
             or opts.chaos_seed >= 0 or opts.cells or opts.cell_size \
             or opts.snapshot_interval or opts.snapshot_dir \
             or opts.telemetry_dir or opts.trace_sample \
-            or opts.rederive != "off":
+            or opts.xprof_window or opts.rederive != "off":
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
               "--chaos-seed/--cells/--cell-size/--snapshot-interval/"
-              "--snapshot-dir/--telemetry-dir/--trace-sample/--rederive "
-              "apply to the processes runtime; --attest-scores to "
-              "mesh/executor", file=sys.stderr)
+              "--snapshot-dir/--telemetry-dir/--trace-sample/"
+              "--xprof-window/--rederive apply to the processes "
+              "runtime; --attest-scores to mesh/executor",
+              file=sys.stderr)
         return 2
     if cfg is not None and opts.runtime != "processes":
         # sparse upload deltas are a wire-protocol mode like

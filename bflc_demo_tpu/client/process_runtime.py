@@ -745,6 +745,7 @@ def run_federated_processes(
         chaos_dir: str = "",
         telemetry_dir: str = "",
         trace_sample: float = 0.0,
+        xprof_window: str = "",
         snapshot_interval: int = 0,
         snapshot_dir: str = "",
         rederive: str = "off",
@@ -804,6 +805,12 @@ def run_federated_processes(
     and the read fan-out, and tools/trace_report.py reassembles the
     per-round critical path offline.  0 (default, or
     BFLC_TRACE_LEGACY=1) records and sends nothing.
+    xprof_window: "R:K" arms a driver-side jax.profiler capture window
+    around committed rounds R..R+K-1 (obs.device.XprofWindow; K
+    defaults to 1).  Defaults from BFLC_XPROF; the artifact dir is
+    BFLC_XPROF_DIR or <telemetry_dir>/xprof, and a recompile-storm
+    CRIT triggers a one-round on-demand capture through the same
+    window.  Empty + no env = fully inert.
     snapshot_interval: emit a certified snapshot op every K rounds
     (ledger.snapshot): the writer's log/WAL prefix behind each certified
     checkpoint is garbage-collected (bounded on-disk growth), standbys
@@ -1116,6 +1123,18 @@ def run_federated_processes(
                        standbys=standbys, validators=bft_validators,
                        quorum=quorum)
         collector.scrape(tag="fleet_up")
+    # profiler capture window (obs.device): --xprof-window R:K /
+    # BFLC_XPROF brackets jax.profiler.trace around committed rounds
+    # R..R+K-1 in the DRIVER (the process that runs sponsor eval and
+    # owns the round loop); a storm CRIT triggers a one-round capture
+    # through the same window.  Unarmed = one None check per round.
+    xprof = None
+    if xprof_window or os.environ.get("BFLC_XPROF"):
+        from bflc_demo_tpu.obs import device as obs_device
+        xprof_dir = os.environ.get("BFLC_XPROF_DIR", "") or (
+            os.path.join(telemetry_dir, "xprof") if telemetry_dir
+            else "")
+        xprof = obs_device.arm_xprof(xprof_window, xprof_dir)
 
     from bflc_demo_tpu.comm.failover import FailoverClient
     xte, yte = test_set
@@ -1168,6 +1187,8 @@ def run_federated_processes(
                         collector.note("round_commit",
                                        epoch=mr["epoch"] - 1, acc=acc)
                         collector.scrape(tag=f"round-{mr['epoch'] - 1}")
+                    if xprof is not None:
+                        xprof.on_round(mr["epoch"] - 1)
             if kill_writer_at_epoch is not None and not writer_killed \
                     and info["epoch"] >= kill_writer_at_epoch:
                 # the no-single-point-of-failure drill: SIGKILL the primary
@@ -1217,6 +1238,9 @@ def run_federated_processes(
                 telemetry_report["slo"] = forensics.report()
                 telemetry_report["alerts_jsonl"] = os.path.join(
                     telemetry_dir, "alerts.jsonl")
+            if xprof is not None and xprof.out_dir:
+                # profiler capture artifacts (obs.device.XprofWindow)
+                telemetry_report["xprof_dir"] = xprof.out_dir
         final_ep = sponsor.current_endpoint
         replica_report = None
         if replicas > 0:
@@ -1242,6 +1266,8 @@ def run_federated_processes(
                     raise RuntimeError("replica/writer head divergence")
             replica_report = reports[0]
     finally:
+        if xprof is not None:
+            xprof.close()
         sponsor_router.close()
         sponsor.close()
         for i, p in enumerate(clients):

@@ -43,6 +43,7 @@ from bflc_demo_tpu.comm.identity import (PublicDirectory, ReplayGuard,
                                          address_of, _op_bytes)
 from bflc_demo_tpu.comm.wire import (blob_bytes, send_msg, recv_msg,
                                      WireError)
+from bflc_demo_tpu.obs import device as obs_device
 from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import health as obs_health
 from bflc_demo_tpu.obs import metrics as obs_metrics
@@ -1615,6 +1616,15 @@ class LedgerServer:
                     snap = self._snapshot_offer()
                     _G_SNAP_AGE.set(self.ledger.epoch - snap["epoch"]
                                     if snap is not None else -1)
+                    # device-plane memory watermark sampled at scrape
+                    # time like the other instantaneous gauges — every
+                    # per-round scrape then carries a CURRENT watermark
+                    # and appends one device_mem record (obs.device;
+                    # inert under BFLC_DEVICE_OBS=0)
+                    try:
+                        obs_device.sample_memory(reason="scrape")
+                    except Exception:   # noqa: BLE001 — observability
+                        pass
                 # `epoch` stamps the writer's authoritative round
                 # position into every scrape record (obs.collector):
                 # health/flight records already carry their epoch but

@@ -99,15 +99,29 @@ def local_train_impl(apply_fn: ApplyFn, params: Pytree, x: jax.Array,
     return delta, jnp.mean(epoch_costs)
 
 
-local_train = functools.partial(
-    jax.jit, static_argnames=("apply_fn", "batch_size", "local_epochs",
-                              "optimizer")
-)(local_train_impl)
+from bflc_demo_tpu.obs import device as _obs_device
+
+# the device plane's signature-tracking wrapper records a compile event
+# (plus execute-time histograms) whenever a NEW abstract signature hits
+# the jit cache; inert while telemetry is dark, untouched jit underneath
+local_train = _obs_device.observe_jit(
+    functools.partial(
+        jax.jit, static_argnames=("apply_fn", "batch_size",
+                                  "local_epochs", "optimizer")
+    )(local_train_impl),
+    "train_step",
+    static_argnames=("apply_fn", "batch_size", "local_epochs",
+                     "optimizer"))
 
 
-@functools.partial(jax.jit, static_argnames=("apply_fn",))
-def evaluate(apply_fn: ApplyFn, params: Pytree, x: jax.Array, y: jax.Array,
-             ) -> jax.Array:
+def _evaluate_impl(apply_fn: ApplyFn, params: Pytree, x: jax.Array,
+                   y: jax.Array) -> jax.Array:
     """Accuracy of ``params`` on (x, y) — the reference's only quality metric
     (local_testing main.py:172-193; global_testing main.py:285-306)."""
     return _accuracy(apply_fn(params, x), y)
+
+
+evaluate = _obs_device.observe_jit(
+    functools.partial(jax.jit, static_argnames=("apply_fn",))(
+        _evaluate_impl),
+    "eval_step", static_argnames=("apply_fn",))

@@ -807,6 +807,7 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
             os.environ.pop("BFLC_CONTROL_PLANE_LEGACY", None)
         os.environ["BFLC_PROC_TRACE"] = "1"
         trace_summary = None
+        device_summary = None
         try:
             with tempfile.TemporaryDirectory(prefix="bflc-fed-bench-") \
                     as td:
@@ -824,6 +825,13 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
                     # reclaimed: the artifact of record is the summary,
                     # not the span files
                     trace_summary = _trace_summary(
+                        os.path.join(td, "telemetry"))
+                if telemetry or trace_sample:
+                    # device-plane evidence, same before-the-tempdir-
+                    # dies rule: post-warmup per-round fresh-compile
+                    # deltas (the steady-state gate's data) + storm
+                    # verdicts + the worst memory watermark
+                    device_summary = _device_summary(
                         os.path.join(td, "telemetry"))
         finally:
             for k, v in saved.items():
@@ -889,6 +897,10 @@ def federation_config1(rounds: int = 3, *, standbys: int = 2,
             # journeys reassembled and how completely the critical path
             # attributes round wall time (obs.trace)
             "trace": trace_summary,
+            # device-plane summary (None when telemetry was dark or the
+            # device plane pinned): post-warmup recompile deltas, storm
+            # verdicts, memory watermark (obs.device / obs.timeline)
+            "device": device_summary,
         }
 
     out: Dict = {
@@ -1803,6 +1815,50 @@ def _trace_summary(telemetry_dir: str) -> Optional[Dict]:
     }
 
 
+def _device_summary(telemetry_dir: str) -> Optional[Dict]:
+    """Compact device-plane evidence off a federation's telemetry
+    artifacts (obs.device + the obs.timeline joiner): per-round fleet
+    fresh-compile deltas AFTER the warmup window — steady-state sync
+    rounds must show zero, the recompile gate's data — plus the
+    driver's storm verdicts and the worst memory watermark seen.
+    None when the artifacts carry no device stream (telemetry dark or
+    BFLC_DEVICE_OBS=0)."""
+    from bflc_demo_tpu.obs.device import LEVELS
+    from bflc_demo_tpu.obs.timeline import (DEVICE_SLO_WARMUP_ROUNDS,
+                                            load_round_timeline)
+    try:
+        tl = load_round_timeline(telemetry_dir)
+    except Exception:           # noqa: BLE001 — evidence, not gating
+        return None
+    deltas = {}
+    for r in tl.rounds():
+        d = tl.slo_summary(r).get("device_recompiles_delta")
+        if d is not None:
+            deltas[r] = d
+    storms = [rec for rec in tl.device
+              if rec.get("type") == "device_storm"]
+    mems = [rec for rec in tl.device if rec.get("type") == "device_mem"]
+    if not deltas and not storms and not mems:
+        return None
+    worst = max((LEVELS.index(rec.get("verdict", "ok"))
+                 for rec in storms if rec.get("verdict") in LEVELS),
+                default=0)
+    return {
+        "warmup_rounds": DEVICE_SLO_WARMUP_ROUNDS,
+        "recompiles_delta_by_round": {str(r): d
+                                      for r, d in deltas.items()},
+        # the steady-state gate's headline: total fleet fresh compiles
+        # over every post-warmup round (zero on a healthy sync loop)
+        "steady_state_recompiles": (sum(deltas.values())
+                                    if deltas else None),
+        "storm_rounds": len(storms),
+        "worst_storm_verdict": LEVELS[worst],
+        "mem_peak_bytes": max((float(rec.get("peak_bytes", 0.0))
+                               for rec in mems), default=None),
+        "mem_source": mems[-1].get("source") if mems else None,
+    }
+
+
 def trace_overhead_config1(rounds: int = 3, trials: int = 1,
                            **kw) -> Dict:
     """Causal-tracing overhead measured, not asserted (the tracing PR's
@@ -1943,6 +1999,59 @@ def slo_overhead_config1(rounds: int = 3, trials: int = 2,
         "round_times_legacy": legacy_times,
         "overhead_frac": (round(armed_t / legacy_t - 1.0, 4)
                           if legacy_t else None),
+        "last_trial_armed": armed_last["fast"],
+        "last_trial_legacy": legacy_last["fast"],
+    }
+
+
+def device_overhead_config1(rounds: int = 3, trials: int = 2,
+                            **kw) -> Dict:
+    """Device-plane overhead measured, not asserted (the device PR's 1%
+    acceptance bar, same harness as slo_overhead_config1): the
+    identical config-1 federation with telemetry armed on BOTH legs,
+    the compile/memory/storm plane armed vs pinned off with
+    BFLC_DEVICE_OBS=0 in the fleet's environment (spawned children
+    inherit it), steady round wall time compared on the per-leg minimum
+    over trials.  The armed leg's `device` summary rides along — the
+    steady-state recompile evidence (post-warmup sync rounds must show
+    zero fleet fresh compiles).
+
+    Leg order ALTERNATES per trial (the session-warmup artifact,
+    TPU_RESULTS.md round 13); use an even `trials`."""
+    armed_times, legacy_times = [], []
+    armed_last = legacy_last = None
+    for trial in range(trials):
+        legs = [False, True] if trial % 2 == 0 else [True, False]
+        for legacy in legs:
+            saved = os.environ.get("BFLC_DEVICE_OBS")
+            if legacy:
+                os.environ["BFLC_DEVICE_OBS"] = "0"
+            else:
+                os.environ.pop("BFLC_DEVICE_OBS", None)
+            try:
+                res = federation_config1(rounds=rounds, telemetry=True,
+                                         **kw)
+            finally:
+                if saved is None:
+                    os.environ.pop("BFLC_DEVICE_OBS", None)
+                else:
+                    os.environ["BFLC_DEVICE_OBS"] = saved
+            if legacy:
+                legacy_last = res
+                legacy_times.append(res["fast"]["round_wall_time_s"])
+            else:
+                armed_last = res
+                armed_times.append(res["fast"]["round_wall_time_s"])
+    armed_t, legacy_t = min(armed_times), min(legacy_times)
+    return {
+        "rounds": rounds, "trials": trials,
+        "round_wall_time_s_device_armed": armed_t,
+        "round_wall_time_s_device_legacy": legacy_t,
+        "round_times_armed": armed_times,
+        "round_times_legacy": legacy_times,
+        "overhead_frac": (round(armed_t / legacy_t - 1.0, 4)
+                          if legacy_t else None),
+        "device": armed_last["fast"].get("device"),
         "last_trial_armed": armed_last["fast"],
         "last_trial_legacy": legacy_last["fast"],
     }

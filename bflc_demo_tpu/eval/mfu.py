@@ -48,15 +48,11 @@ def chip_peak_flops(device=None) -> Optional[float]:
     return None
 
 
-def cost_analysis_flops(compiled) -> float:
+def cost_analysis_flops(compiled, family: str = "mfu") -> float:
     """FLOPs from a jax AOT `compiled` object; 0.0 when the backend does
-    not report them.  Handles both dict and per-device-list layouts."""
-    try:
-        ca = compiled.cost_analysis()
-    except Exception:               # noqa: BLE001 — backend-optional API
-        return 0.0
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
-    if not isinstance(ca, dict):
-        return 0.0
-    return float(ca.get("flops", 0.0) or 0.0)
+    not report them.  Routed through the device plane's ONE shared
+    helper (obs.device.cost_analysis_stats), so an unusable backend
+    reply counts ``device_cost_analysis_unavailable_total{family}``
+    instead of vanishing in a bare swallow."""
+    from bflc_demo_tpu.obs import device as obs_device
+    return obs_device.cost_analysis_stats(compiled, family)["flops"]

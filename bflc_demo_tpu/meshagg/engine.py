@@ -72,6 +72,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from bflc_demo_tpu.meshagg import spec
+from bflc_demo_tpu.obs import device as obs_device
 from bflc_demo_tpu.obs import metrics as obs_metrics
 
 Pytree = Any
@@ -266,6 +267,7 @@ class MeshAggEngine:
         cannot contract across executable boundaries."""
         sig = (n, p)
         fns = self._programs.get(sig)
+        obs_device.record_cache("reduce", hit=fns is not None)
         if fns is not None:
             return fns
         import jax
@@ -288,7 +290,11 @@ class MeshAggEngine:
                               terms, unroll=_SCAN_UNROLL)
             return acc
 
-        fns = (jax.jit(terms_fn), jax.jit(reduce_fn))
+        # device-plane attribution rides the same jit objects: the AOT
+        # swap in obs.device lowers/compiles the identical program, so
+        # the certified bytes cannot move (tests/test_device_obs.py)
+        fns = (obs_device.instrument(jax.jit(terms_fn), "reduce"),
+               obs_device.instrument(jax.jit(reduce_fn), "reduce"))
         if len(self._programs) >= _CACHE_CAP:
             self._programs.pop(next(iter(self._programs)))
         self._programs[sig] = fns
@@ -316,6 +322,7 @@ class MeshAggEngine:
         blocks one at a time (spec v2: no cross-block arithmetic)."""
         sig = ("blk", n, blocks, pb)
         fns = self._programs.get(sig)
+        obs_device.record_cache("blocked", hit=fns is not None)
         if fns is not None:
             return fns
         import jax
@@ -336,7 +343,8 @@ class MeshAggEngine:
                               terms, unroll=_SCAN_UNROLL)
             return acc
 
-        fns = (jax.jit(terms_fn), jax.jit(reduce_fn))
+        fns = (obs_device.instrument(jax.jit(terms_fn), "blocked"),
+               obs_device.instrument(jax.jit(reduce_fn), "blocked"))
         if len(self._programs) >= _CACHE_CAP:
             self._programs.pop(next(iter(self._programs)))
         self._programs[sig] = fns
@@ -594,13 +602,20 @@ def score_candidates_batched(apply_fn, global_params: Pytree,
     # model IS a fresh compile, unlike the flat reduce kernel)
     sig = (id(apply_fn), len(devs),
            tuple((tuple(a.shape), str(a.dtype)) for a in leaves))
-    if sig not in ENGINE.score_geometries:
+    fresh = sig not in ENGINE.score_geometries
+    obs_device.record_cache("score", hit=not fresh)
+    if fresh:
         ENGINE.score_geometries[sig] = True
         _C_COMPILE.inc(kernel="score")
     t0 = time.perf_counter() if obs_metrics.REGISTRY.enabled else 0.0
     out = score_candidates(apply_fn, global_params, stacked, lr, x, y)
     if obs_metrics.REGISTRY.enabled:
-        _M_SECONDS.observe(time.perf_counter() - t0,
-                           kernel="score",
+        dt = time.perf_counter() - t0
+        _M_SECONDS.observe(dt, kernel="score",
                            leg="mesh" if len(devs) > 1 else "host")
+        if fresh:
+            # the score program compiles inside score_candidates'
+            # jit cache — first-call wall stands in for compile time
+            obs_device.record_compile("score", dt, estimated=True)
+        obs_device.observe_execute("score", dt)
     return out

@@ -66,6 +66,11 @@ def install_process_telemetry(role: str, out_dir: str, *,
     # it is pinned off)
     from bflc_demo_tpu.obs import health as _health
     _health.install(out_dir)
+    # device plane (obs.device): point compile/memory records at
+    # <role>.device.jsonl and register the terminal flusher with the
+    # flight recorder's kill path (inert under BFLC_DEVICE_OBS=0)
+    from bflc_demo_tpu.obs import device as _device
+    _device.install(out_dir)
     if trace_sample > 0.0:
         from bflc_demo_tpu.obs import trace as obs_trace
         obs_trace.TRACE.install(role, out_dir, sample=trace_sample,
@@ -78,6 +83,12 @@ def install_process_telemetry(role: str, out_dir: str, *,
 
         def _loop() -> None:
             while True:
+                try:
+                    # memory watermark gauges ride every snapshot the
+                    # scrape loop reads (device stats / RSS fallback)
+                    _device.sample_memory()
+                except Exception:       # noqa: BLE001 — observability
+                    pass
                 publish_snapshot(path)
                 time.sleep(interval_s)
 

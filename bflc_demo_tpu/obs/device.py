@@ -1,0 +1,800 @@
+"""Device-plane observability: XLA compile/memory telemetry, per-program
+cost attribution, recompile-storm detection, and profiler capture
+windows.
+
+Four observability layers (metrics, traces, health, forensics/SLO)
+watch the *protocol*; this module watches the *device plane* the repo
+is named for:
+
+- **compile & cost attribution** — every jit boundary the system owns
+  (the meshagg engine's geometry-keyed program cache, the rederive
+  plane, the client train step) reports per program-family compile
+  events, compile wall seconds, ``compiled.cost_analysis()``
+  FLOPs/bytes, execute-time histograms and cache hit/miss counters
+  into the one MetricsRegistry — so fleet scrapes, fleet_top and the
+  per-round timeline inherit device attribution with no new transport;
+- **recompile-storm detection** — `RecompileStormDetector` runs the
+  health plane's rolling median/MAD machinery over per-round
+  fresh-compile counts per family: after a family's warmup window the
+  steady state is ZERO compiles, so any fresh compile is a large
+  robust z (WARN), and a sustained streak is CRIT (async mode's
+  varying round geometry is the live risk this detector exists for);
+- **memory watermarks** — ``device.memory_stats()`` on TPU with an
+  RSS / getrusage / tracemalloc CPU fallback chain, published as
+  gauges each publisher tick and judged by a memory-ceiling SLO
+  objective (obs.slo);
+- **profiler capture windows** — `XprofWindow` arms
+  ``jax.profiler.trace`` around rounds R..R+K (``--xprof-window R:K``
+  / ``BFLC_XPROF``) or on-demand from a CRIT verdict, with the
+  artifact dir registered into incident bundles.
+
+**The device plane changes no trust and no bytes.**  The AOT swap in
+`instrument` lowers and compiles the SAME jit program XLA would build
+on first call (that is where the true compile wall time and
+cost_analysis come from), and any failure anywhere in this module
+permanently falls back to the untouched jit path — counted, never
+raised.  ``BFLC_DEVICE_OBS=0`` pins the plane off entirely; committed
+model hashes are byte-identical either way (tests/test_device_obs.py
+drills it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
+
+LEVELS = ("ok", "warn", "crit")
+
+# --- device-plane telemetry (obs.metrics; no-ops unless the registry
+# is enabled).  Families are coarse program identities ("reduce",
+# "blocked", "score", "train_step", "eval_step", "rederive") — bounded
+# by construction, so label cardinality cannot blow up.
+_C_COMPILE = obs_metrics.REGISTRY.counter(
+    "device_compile_total",
+    "XLA compile events by program family (fresh lowerings, not cache "
+    "hits)", ("family",))
+_M_COMPILE_S = obs_metrics.REGISTRY.histogram(
+    "device_compile_seconds",
+    "compile wall seconds per fresh lowering", ("family",))
+_C_CACHE = obs_metrics.REGISTRY.counter(
+    "device_program_cache_total",
+    "program-cache lookups by family and outcome",
+    ("family", "event"))
+_M_EXEC = obs_metrics.REGISTRY.histogram(
+    "device_execute_seconds",
+    "per-call execute wall seconds (dispatch + host sync as the caller "
+    "sees it — never an added block_until_ready)", ("family",))
+_G_FLOPS = obs_metrics.REGISTRY.gauge(
+    "device_program_flops",
+    "cost_analysis FLOPs of the family's last compiled program",
+    ("family",))
+_G_PROG_BYTES = obs_metrics.REGISTRY.gauge(
+    "device_program_bytes",
+    "cost_analysis bytes-accessed of the family's last compiled "
+    "program", ("family",))
+_C_COST_NA = obs_metrics.REGISTRY.counter(
+    "device_cost_analysis_unavailable_total",
+    "cost_analysis() calls that raised or returned an unusable shape "
+    "(the counted replacement for eval/mfu.py's old silent swallow)",
+    ("family",))
+_C_AOT_FALLBACK = obs_metrics.REGISTRY.counter(
+    "device_aot_fallback_total",
+    "instrumented programs that permanently fell back to the plain jit "
+    "path after an AOT lower/compile/call failure", ("family",))
+_G_MEM_USE = obs_metrics.REGISTRY.gauge(
+    "device_mem_bytes_in_use",
+    "current device (or process) memory bytes", ("source",))
+_G_MEM_PEAK = obs_metrics.REGISTRY.gauge(
+    "device_mem_peak_bytes",
+    "peak device (or process) memory watermark bytes", ("source",))
+_G_MEM_LIMIT = obs_metrics.REGISTRY.gauge(
+    "device_mem_limit_bytes",
+    "device memory capacity when the backend reports one (0 = unknown)",
+    ("source",))
+_G_STORM = obs_metrics.REGISTRY.gauge(
+    "device_storm_verdict",
+    "last recompile-storm verdict (0 ok / 1 warn / 2 crit)")
+_C_STORM = obs_metrics.REGISTRY.counter(
+    "device_storm_trips_total",
+    "recompile-storm trips by family and level", ("family", "level"))
+_C_XPROF = obs_metrics.REGISTRY.counter(
+    "device_xprof_captures_total",
+    "jax.profiler capture windows started, by trigger",
+    ("trigger",))
+
+#: per-process output sink (obs.install_process_telemetry arms it with
+#: the telemetry dir): device records append to
+#: <dir>/<role>.device.jsonl.  Unarmed -> metrics/flight only.
+_SINK = {"dir": "", "terminal": False}
+
+#: in-process mirrors of the per-family counters so `report()` (the
+#: bench.py `device` artifact section) never has to parse a registry
+#: snapshot — plain dicts, updated only when the plane is armed.
+_STATE: Dict[str, Dict[str, Any]] = {
+    "compiles": {}, "compile_seconds": {}, "flops": {}, "bytes": {},
+    "cache_hit": {}, "cache_miss": {}, "execute_calls": {},
+    "cost_unavailable": {}, "aot_fallback": {},
+}
+
+#: module-level capture window, armed by `arm_xprof` (the driver) so a
+#: storm CRIT anywhere in-process can trigger an on-demand capture.
+XPROF: Optional["XprofWindow"] = None
+
+
+def install(out_dir: str) -> None:
+    """Point this process's device records at `out_dir` and register
+    the terminal flusher with the flight recorder's kill path, so a
+    SIGKILLed role's last compile/memory samples survive like its
+    spans do."""
+    _SINK["dir"] = out_dir
+    if not _SINK["terminal"]:
+        _SINK["terminal"] = True
+        obs_flight.TERMINAL_FLUSHES.append(_terminal_flush)
+
+
+def device_legacy() -> bool:
+    """BFLC_DEVICE_OBS=0 (or false/off/no) pins the whole device plane
+    off — the overhead benchmark's baseline switch and the certified-
+    bytes drill's legacy leg.  Unset or truthy leaves it armed with
+    the rest of telemetry."""
+    v = os.environ.get("BFLC_DEVICE_OBS")
+    return v is not None and v.strip().lower() in (
+        "0", "", "false", "off", "no")
+
+
+def device_armed() -> bool:
+    """The ONE arming decision every instrumented jit boundary asks:
+    telemetry on and no legacy pin.  Dark fleets pay two attribute
+    checks and keep the untouched jit path."""
+    return obs_metrics.REGISTRY.enabled and not device_legacy()
+
+
+def append_record(rec: Dict[str, Any]) -> None:
+    """Eager-append one device record to this process's
+    ``<role>.device.jsonl`` (health-plane idiom: append-only, a torn
+    tail line is the loader's problem, an OSError is nobody's)."""
+    d = _SINK["dir"]
+    if not d:
+        return
+    role = rec.get("role") or obs_metrics.REGISTRY.role or "proc"
+    rec.setdefault("role", role)
+    try:
+        with open(os.path.join(d, f"{role}.device.jsonl"), "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def _bump(table: str, family: str, amount: float = 1.0) -> None:
+    _STATE[table][family] = _STATE[table].get(family, 0.0) + amount
+
+
+# --------------------------------------------------- cost attribution
+def cost_analysis_stats(compiled: Any, family: str = "unattributed"
+                        ) -> Dict[str, float]:
+    """{"flops", "bytes"} from ``compiled.cost_analysis()`` — the ONE
+    shared helper (eval/mfu.py routes through it).  Per-device lists
+    take the first entry; anything unusable counts
+    `device_cost_analysis_unavailable_total` and returns zeros —
+    counted, never a bare swallow."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            raise TypeError(f"cost_analysis returned {type(ca)}")
+        return {"flops": float(ca.get("flops", 0.0) or 0.0),
+                "bytes": float(ca.get("bytes accessed", 0.0) or 0.0)}
+    except Exception:           # noqa: BLE001 — counted degrade
+        _bump("cost_unavailable", family)
+        _C_COST_NA.inc(family=family)
+        return {"flops": 0.0, "bytes": 0.0}
+
+
+def record_compile(family: str, seconds: float, *,
+                   flops: float = 0.0, bytes_accessed: float = 0.0,
+                   estimated: bool = False) -> None:
+    """One fresh-lowering compile event: metrics + mirror + sink +
+    flight.  `estimated=True` marks first-call wall time standing in
+    for compile time (the static-argnames jits, where the trace/compile
+    split is not observable without paying a second compile)."""
+    if not device_armed():
+        return
+    _bump("compiles", family)
+    _bump("compile_seconds", family, seconds)
+    if flops:
+        _STATE["flops"][family] = float(flops)
+    if bytes_accessed:
+        _STATE["bytes"][family] = float(bytes_accessed)
+    _C_COMPILE.inc(family=family)
+    _M_COMPILE_S.observe(seconds, family=family)
+    if flops:
+        _G_FLOPS.set(flops, family=family)
+    if bytes_accessed:
+        _G_PROG_BYTES.set(bytes_accessed, family=family)
+    obs_flight.FLIGHT.record(
+        "event", "device_compile", family=family,
+        seconds=round(seconds, 6), flops=flops,
+        estimated=bool(estimated))
+    append_record({
+        "type": "device_compile", "t": time.time(), "family": family,
+        "seconds": round(float(seconds), 6), "flops": float(flops),
+        "bytes": float(bytes_accessed), "estimated": bool(estimated)})
+
+
+def record_cache(family: str, *, hit: bool) -> None:
+    """One program-cache lookup outcome for `family`."""
+    if not device_armed():
+        return
+    event = "hit" if hit else "miss"
+    _bump("cache_hit" if hit else "cache_miss", family)
+    _C_CACHE.inc(family=family, event=event)
+
+
+def observe_execute(family: str, seconds: float) -> None:
+    """One instrumented program call's wall seconds."""
+    if not device_armed():
+        return
+    _bump("execute_calls", family)
+    _M_EXEC.observe(seconds, family=family)
+
+
+# ------------------------------------------------- instrumented jits
+class _InstrumentedProgram:
+    """AOT-swap wrapper for a geometry-fixed jit (pure array args, no
+    statics — the meshagg engine's cached programs).  Armed, the first
+    call runs ``fn.lower(*args).compile()`` — the SAME program the jit
+    cache would build, so certified bytes cannot change — which is
+    where the true compile wall seconds and cost_analysis come from;
+    every later call dispatches the compiled executable.  Disarmed, or
+    after ANY failure (permanently, counted), calls pass straight to
+    the untouched jit."""
+
+    __slots__ = ("fn", "family", "_compiled", "_dead")
+
+    def __init__(self, fn: Callable, family: str):
+        self.fn = fn
+        self.family = family
+        self._compiled: Optional[Any] = None
+        self._dead = False
+
+    def _fallback(self, exc_site: str) -> None:
+        self._dead = True
+        self._compiled = None
+        _bump("aot_fallback", self.family)
+        _C_AOT_FALLBACK.inc(family=self.family)
+        obs_flight.FLIGHT.record(
+            "event", "device_aot_fallback", level="WARN",
+            family=self.family, site=exc_site)
+
+    def __call__(self, *args):
+        if self._dead or not device_armed():
+            return self.fn(*args)
+        if self._compiled is None:
+            try:
+                t0 = time.perf_counter()
+                compiled = self.fn.lower(*args).compile()
+                dt = time.perf_counter() - t0
+            except Exception:   # noqa: BLE001 — counted degrade
+                self._fallback("compile")
+                return self.fn(*args)
+            self._compiled = compiled
+            stats = cost_analysis_stats(compiled, self.family)
+            record_compile(self.family, dt, flops=stats["flops"],
+                           bytes_accessed=stats["bytes"])
+        t0 = time.perf_counter()
+        try:
+            out = self._compiled(*args)
+        except Exception:       # noqa: BLE001 — counted degrade
+            self._fallback("execute")
+            return self.fn(*args)
+        observe_execute(self.family, time.perf_counter() - t0)
+        return out
+
+
+def instrument(fn: Callable, family: str) -> Callable:
+    """Wrap a geometry-fixed jit for AOT compile/cost attribution.
+    The wrapper is permanent but inert while disarmed (one attribute
+    check per call)."""
+    return _InstrumentedProgram(fn, family)
+
+
+def _static_token(v: Any) -> Any:
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return ("id", id(v))
+
+
+class _JitObserver:
+    """Signature-tracking wrapper for a static-argnames jit (the client
+    train/eval steps).  A NEW abstract signature — leaf shapes/dtypes +
+    pytree structure + static values — means jit will compile; the
+    first call's wall time is recorded as an ESTIMATED compile event
+    (re-lowering just to time the compile would double the client's
+    compile cost).  Known signatures record execute time only."""
+
+    __slots__ = ("fn", "family", "static_argnames", "_seen")
+
+    def __init__(self, fn: Callable, family: str,
+                 static_argnames: Tuple[str, ...] = ()):
+        self.fn = fn
+        self.family = family
+        self.static_argnames = tuple(static_argnames)
+        self._seen: set = set()
+
+    @staticmethod
+    def _leaf_sig(v: Any) -> Tuple:
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return ("a", tuple(v.shape), str(v.dtype))
+        if isinstance(v, (bool, int, str, bytes)):
+            # likely a static (batch_size, local_epochs): a new value
+            # IS a recompile, so it joins the signature
+            return ("s", v)
+        if isinstance(v, float):
+            # traced weak-typed scalar (lr): value changes don't
+            # recompile, so the value stays OUT of the signature
+            return ("f",)
+        if callable(v):
+            return ("c", id(v))
+        return ("o", type(v).__name__)
+
+    def _signature(self, args, kwargs):
+        import jax
+        statics = tuple(sorted(
+            (k, _static_token(v)) for k, v in kwargs.items()
+            if k in self.static_argnames))
+        dyn = {k: v for k, v in kwargs.items()
+               if k not in self.static_argnames}
+        leaves, treedef = jax.tree_util.tree_flatten((args, dyn))
+        return (str(treedef),
+                tuple(self._leaf_sig(v) for v in leaves), statics)
+
+    def __call__(self, *args, **kwargs):
+        if not device_armed():
+            return self.fn(*args, **kwargs)
+        try:
+            sig = self._signature(args, kwargs)
+        except Exception:       # noqa: BLE001 — observability only
+            return self.fn(*args, **kwargs)
+        fresh = sig not in self._seen
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if fresh:
+            self._seen.add(sig)
+            record_compile(self.family, dt, estimated=True)
+        observe_execute(self.family, dt)
+        return out
+
+
+def observe_jit(fn: Callable, family: str,
+                static_argnames: Tuple[str, ...] = ()) -> Callable:
+    """Wrap a static-argnames jit for signature-tracked compile-event
+    and execute-time observation (no AOT — see _JitObserver)."""
+    return _JitObserver(fn, family, static_argnames)
+
+
+# ------------------------------------------------- memory watermarks
+_LAST_PEAK = {"bytes": 0.0}
+
+
+def _device_memory_sample() -> Optional[Dict[str, Any]]:
+    """Backend memory_stats from an ALREADY-initialized jax — never
+    the import/init that would drag a backend up just to measure it."""
+    jax = sys.modules.get("jax")
+    if jax is None or not _STATE["compiles"] and not _STATE["execute_calls"]:
+        return None
+    try:
+        for dev in jax.devices():
+            ms_fn = getattr(dev, "memory_stats", None)
+            ms = ms_fn() if callable(ms_fn) else None
+            if not ms:
+                continue
+            return {
+                "source": f"device:{dev.platform}",
+                "bytes_in_use": float(ms.get("bytes_in_use", 0) or 0),
+                "peak_bytes": float(ms.get("peak_bytes_in_use", 0)
+                                    or ms.get("bytes_in_use", 0) or 0),
+                "bytes_limit": float(ms.get("bytes_limit", 0) or 0)}
+    except Exception:           # noqa: BLE001 — observability only
+        return None
+    return None
+
+
+def _host_memory_sample() -> Optional[Dict[str, Any]]:
+    """CPU fallback chain: /proc RSS/HWM -> getrusage -> tracemalloc."""
+    try:
+        cur = peak = 0.0
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    cur = float(line.split()[1]) * 1024.0
+                elif line.startswith("VmHWM:"):
+                    peak = float(line.split()[1]) * 1024.0
+        if cur or peak:
+            return {"source": "rss", "bytes_in_use": cur,
+                    "peak_bytes": max(peak, cur), "bytes_limit": 0.0}
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        peak = float(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+        if peak:
+            return {"source": "getrusage", "bytes_in_use": 0.0,
+                    "peak_bytes": peak, "bytes_limit": 0.0}
+    except Exception:           # noqa: BLE001
+        pass
+    try:
+        import tracemalloc
+        if tracemalloc.is_tracing():
+            cur, peak = tracemalloc.get_traced_memory()
+            return {"source": "tracemalloc",
+                    "bytes_in_use": float(cur),
+                    "peak_bytes": float(peak), "bytes_limit": 0.0}
+    except Exception:           # noqa: BLE001
+        pass
+    return None
+
+
+def memory_sample() -> Dict[str, Any]:
+    """One memory watermark: ``device.memory_stats()`` when a backend
+    is up, else the host fallback chain.  Pure read — no gauges."""
+    sample = _device_memory_sample() or _host_memory_sample()
+    if sample is None:
+        sample = {"source": "none", "bytes_in_use": 0.0,
+                  "peak_bytes": 0.0, "bytes_limit": 0.0}
+    ceiling = os.environ.get("BFLC_DEVICE_MEM_CEILING_BYTES")
+    if ceiling and not sample.get("bytes_limit"):
+        try:
+            sample["bytes_limit"] = float(ceiling)
+        except ValueError:
+            pass
+    return sample
+
+
+def sample_memory(*, reason: str = "tick") -> Dict[str, Any]:
+    """Take one watermark, publish the gauges, and append a sink
+    record when the peak moved >1% (watermarks change rarely; the
+    jsonl should not grow one line per publisher tick)."""
+    sample = memory_sample()
+    if not device_armed():
+        return sample
+    src = sample["source"]
+    _G_MEM_USE.set(sample["bytes_in_use"], source=src)
+    _G_MEM_PEAK.set(sample["peak_bytes"], source=src)
+    _G_MEM_LIMIT.set(sample.get("bytes_limit", 0.0), source=src)
+    peak = float(sample["peak_bytes"])
+    if peak > _LAST_PEAK["bytes"] * 1.01 or reason != "tick":
+        _LAST_PEAK["bytes"] = max(peak, _LAST_PEAK["bytes"])
+        append_record({"type": "device_mem", "t": time.time(),
+                       "reason": reason, **sample})
+    return sample
+
+
+# --------------------------------------------- recompile-storm plane
+class RecompileStormDetector:
+    """Streaming recompile-storm verdicts: the health plane's rolling
+    median/MAD machinery over per-round FRESH-COMPILE counts per
+    program family.
+
+    After a family's warmup window the healthy steady state is zero
+    compiles per round, so the rolling median collapses to 0 and the
+    robust scale to ``abs_floor`` — one fresh compile then scores
+    ``z = 1/abs_floor`` (WARN at the default 0.25 -> z=4), two or more
+    score crit-worthy, and ``crit_streak`` consecutive tripping rounds
+    for the same family escalate to CRIT (one legitimate one-off — an
+    async re-election changing the score geometry — must not page).
+    Streaks EXPIRE past ``streak_gap`` detector rounds, and no family
+    is judged before ``min_baseline`` observations or inside its own
+    ``warmup`` rounds (cold start cannot produce false verdicts —
+    every family legitimately compiles on its first appearance).
+    """
+
+    def __init__(self, *, window: int = 64, min_baseline: int = 4,
+                 warmup: int = 2, warn_z: float = 4.0,
+                 crit_z: float = 8.0, rel_floor: float = 0.05,
+                 abs_floor: float = 0.25, crit_streak: int = 2,
+                 streak_gap: int = 8, role: str = "driver",
+                 keep_records: int = 512):
+        self.window = int(window)
+        self.min_baseline = int(min_baseline)
+        self.warmup = int(warmup)
+        self.warn_z = float(warn_z)
+        self.crit_z = float(crit_z)
+        self.rel_floor = float(rel_floor)
+        self.abs_floor = float(abs_floor)
+        self.crit_streak = int(crit_streak)
+        self.streak_gap = int(streak_gap)
+        self.role = role
+        self._hist: Dict[str, deque] = {}
+        # family -> (consecutive tripping rounds, detector round of
+        # the last trip) — the round anchor expires stale streaks
+        self._streak: Dict[str, Tuple[int, int]] = {}
+        self.records: deque = deque(maxlen=keep_records)
+        self.rounds = 0
+
+    def _baseline(self, hist: deque) -> Optional[Tuple[float, float]]:
+        if len(hist) < self.min_baseline:
+            return None
+        vals = sorted(hist)
+        n = len(vals)
+        med = (vals[n // 2] if n % 2
+               else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+        devs = sorted(abs(v - med) for v in vals)
+        mad = (devs[n // 2] if n % 2
+               else 0.5 * (devs[n // 2 - 1] + devs[n // 2]))
+        return med, max(1.4826 * mad, self.rel_floor * abs(med),
+                        self.abs_floor)
+
+    def observe_round(self, epoch: int,
+                      compiles_by_family: Dict[str, float]
+                      ) -> Dict[str, Any]:
+        """Ingest one round's fresh-compile deltas (families absent
+        this round count as zero — the zeros ARE the baseline) and
+        return the round's storm record."""
+        self.rounds += 1
+        fams: Dict[str, Dict[str, Any]] = {}
+        worst = 0
+        for fam in sorted(set(self._hist) | set(compiles_by_family)):
+            fresh = float(compiles_by_family.get(fam, 0.0))
+            hist = self._hist.setdefault(
+                fam, deque(maxlen=self.window))
+            level = 0
+            z = None
+            judged = len(hist) >= self.warmup
+            baseline = self._baseline(hist) if judged else None
+            if baseline is not None:
+                z = (fresh - baseline[0]) / baseline[1]
+                tripping = abs(z) >= self.warn_z
+                if tripping:
+                    prev, last = self._streak.get(fam, (0, -10 ** 9))
+                    streak = (prev + 1 if self.rounds - last
+                              <= self.streak_gap else 1)
+                    self._streak[fam] = (streak, self.rounds)
+                    level = 2 if (abs(z) >= self.crit_z
+                                  and streak >= self.crit_streak) \
+                        or streak >= self.crit_streak else 1
+                else:
+                    self._streak.pop(fam, None)
+            hist.append(fresh)          # update AFTER judging
+            if level:
+                _C_STORM.inc(family=fam, level=LEVELS[level])
+            worst = max(worst, level)
+            fams[fam] = {"fresh": fresh,
+                         "z": round(z, 2) if z is not None else None,
+                         "level": LEVELS[level]}
+        record = {"type": "device_storm", "t": time.time(),
+                  "role": self.role, "epoch": int(epoch),
+                  "verdict": LEVELS[worst], "families": fams}
+        self.records.append(record)
+        _G_STORM.set(worst)
+        if worst:
+            obs_flight.FLIGHT.record(
+                "event", "device_storm", level=LEVELS[worst].upper(),
+                epoch=int(epoch), verdict=LEVELS[worst],
+                families=[f for f, d in fams.items()
+                          if d["level"] != "ok"])
+        if worst >= 2:
+            obs_flight.FLIGHT.flush("device_storm_crit")
+            if XPROF is not None:
+                XPROF.trigger_once("storm_crit")
+        append_record(dict(record))
+        return record
+
+
+# -------------------------------------------- profiler capture window
+class XprofWindow:
+    """A ``jax.profiler`` capture window around rounds R..R+K-1
+    (spec "R:K", K default 1), plus one-shot on-demand captures from a
+    CRIT verdict (`trigger_once`).  Entirely inert when unarmed; every
+    profiler call is failure-isolated and counted."""
+
+    def __init__(self, spec: str = "", out_dir: str = ""):
+        self.out_dir = out_dir
+        self.start_round: Optional[int] = None
+        self.count = 1
+        self.active = False
+        self._stop_after: Optional[int] = None
+        self._pending_trigger: Optional[str] = None
+        self._window_done = False
+        self._dead = False
+        spec = (spec or "").strip()
+        if spec:
+            try:
+                r, _, k = spec.partition(":")
+                self.start_round = int(r)
+                self.count = max(int(k), 1) if k else 1
+            except ValueError:
+                self.start_round = None
+
+    @property
+    def armed(self) -> bool:
+        return (not self._dead
+                and (self.start_round is not None
+                     or self._pending_trigger is not None
+                     or self.active))
+
+    def trigger_once(self, reason: str) -> None:
+        """Arm a one-round capture starting at the next round boundary
+        (no-op while a window is already open or after a profiler
+        failure)."""
+        if not self._dead and not self.active \
+                and self._pending_trigger is None and self.out_dir:
+            self._pending_trigger = reason
+
+    def _start(self, epoch: int, trigger: str, rounds: int) -> None:
+        try:
+            import jax
+            os.makedirs(self.out_dir, exist_ok=True)
+            jax.profiler.start_trace(self.out_dir)
+        except Exception:       # noqa: BLE001 — counted degrade
+            self._dead = True
+            obs_flight.FLIGHT.record(
+                "event", "device_xprof_failed", level="WARN",
+                trigger=trigger)
+            return
+        self.active = True
+        self._stop_after = epoch + max(rounds, 1) - 1
+        _C_XPROF.inc(trigger=trigger)
+        obs_flight.FLIGHT.record(
+            "event", "device_xprof_start", trigger=trigger,
+            epoch=int(epoch), rounds=rounds, dir=self.out_dir)
+        append_record({"type": "device_xprof", "t": time.time(),
+                       "event": "start", "trigger": trigger,
+                       "epoch": int(epoch), "rounds": rounds,
+                       "dir": self.out_dir})
+
+    def _stop(self, epoch: int) -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:       # noqa: BLE001
+            self._dead = True
+        self.active = False
+        self._stop_after = None
+        obs_flight.FLIGHT.record(
+            "event", "device_xprof_stop", epoch=int(epoch),
+            dir=self.out_dir)
+        append_record({"type": "device_xprof", "t": time.time(),
+                       "event": "stop", "epoch": int(epoch),
+                       "dir": self.out_dir})
+
+    def on_round(self, epoch: int) -> None:
+        """Drive the window from the round loop (driver-side): close a
+        finished window, then open the configured or triggered one."""
+        if self._dead:
+            return
+        epoch = int(epoch)
+        if self.active and self._stop_after is not None \
+                and epoch > self._stop_after:
+            self._stop(epoch)
+        if self.active or not self.out_dir:
+            return
+        if self.start_round is not None and not self._window_done \
+                and epoch >= self.start_round:
+            self._window_done = True
+            self._start(epoch, "window", self.count)
+        elif self._pending_trigger is not None:
+            trigger, self._pending_trigger = self._pending_trigger, None
+            self._start(epoch, trigger, 1)
+
+    def close(self) -> None:
+        if self.active:
+            self._stop(self._stop_after or -1)
+
+
+def arm_xprof(spec: str = "", out_dir: str = "") -> XprofWindow:
+    """Build + publish the module-level capture window.  `spec` and
+    `out_dir` default from ``BFLC_XPROF`` / ``BFLC_XPROF_DIR``."""
+    global XPROF
+    spec = spec or os.environ.get("BFLC_XPROF", "")
+    out_dir = out_dir or os.environ.get("BFLC_XPROF_DIR", "")
+    XPROF = XprofWindow(spec, out_dir)
+    return XPROF
+
+
+# --------------------------------------------------------- reporting
+def report() -> Dict[str, Any]:
+    """The bench-artifact `device` section: platform, per-family
+    compile/cost attribution, memory watermark.  Plain dicts from the
+    in-process mirrors — valid whether or not a registry scrape ever
+    ran."""
+    fams: Dict[str, Dict[str, Any]] = {}
+    for fam in sorted(set().union(*(_STATE[k] for k in _STATE))):
+        fams[fam] = {
+            "compiles": int(_STATE["compiles"].get(fam, 0)),
+            "compile_seconds": round(
+                _STATE["compile_seconds"].get(fam, 0.0), 6),
+            "flops": _STATE["flops"].get(fam, 0.0),
+            "bytes": _STATE["bytes"].get(fam, 0.0),
+            "cache_hits": int(_STATE["cache_hit"].get(fam, 0)),
+            "cache_misses": int(_STATE["cache_miss"].get(fam, 0)),
+            "execute_calls": int(_STATE["execute_calls"].get(fam, 0)),
+        }
+    return {
+        "enabled": device_armed(),
+        "legacy_pin": device_legacy(),
+        "platform": _platform(),
+        "families": fams,
+        "memory": memory_sample(),
+        "cost_analysis_unavailable": int(sum(
+            _STATE["cost_unavailable"].values())),
+        "aot_fallbacks": int(sum(_STATE["aot_fallback"].values())),
+    }
+
+
+def _platform() -> str:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "uninitialized"
+    try:
+        return str(jax.devices()[0].platform)
+    except Exception:           # noqa: BLE001
+        return "unknown"
+
+
+def _terminal_flush() -> None:
+    """Flight-recorder terminal path: the dying role's final memory
+    watermark and per-family mirror, appended before the process
+    goes away (fired from SIGTERM / excepthook / atexit)."""
+    try:
+        sample_memory(reason="terminal")
+        if any(_STATE["compiles"].values()) \
+                or any(_STATE["execute_calls"].values()):
+            append_record({
+                "type": "device_terminal", "t": time.time(),
+                "families": report()["families"]})
+    except Exception:           # noqa: BLE001 — dying anyway
+        pass
+
+
+def load_device_records(path: str) -> List[Dict[str, Any]]:
+    """Every parseable device record under `path` (a dir is globbed
+    for *.device.jsonl; torn trailing lines skipped).  The ONE loader
+    obs_query, incident_bundle and chaos_soak's storm gate share."""
+    files = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".device.jsonl"):
+                files.append(os.path.join(path, name))
+    else:
+        files = [path]
+    records: List[Dict[str, Any]] = []
+    for fp in files:
+        try:
+            with open(fp) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue            # torn tail line
+                    if isinstance(rec, dict) and str(
+                            rec.get("type", "")).startswith("device_"):
+                        rec.setdefault("role",
+                                       os.path.basename(fp).split(
+                                           ".device.jsonl")[0])
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: (r.get("t", 0.0), r.get("epoch", 0)))
+    return records
+
+
+def reset_state() -> None:
+    """Clear the in-process mirrors (tests; never part of a run)."""
+    for table in _STATE.values():
+        table.clear()
+    _LAST_PEAK["bytes"] = 0.0

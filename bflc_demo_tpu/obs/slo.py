@@ -240,6 +240,25 @@ def default_slos(*, round_latency_s: float = 30.0,
                 budget=0.05,
                 description="validator re-derivations skipped this "
                             "round (fleet-wide counter delta)"),
+        # device plane (obs.device): post-warmup steady state is ZERO
+        # fresh XLA compiles per round — any delta is a breach, and a
+        # sustained burn is a recompile storm (async round-geometry
+        # churn is the live risk).  The timeline skips the signal
+        # (None) for the first rounds, so legitimate warmup compiles
+        # are never judged.  Only fires on fleets whose scrapes carry
+        # the device counters.
+        SLOSpec("device_recompiles", "device_recompiles_delta", 0.0,
+                budget=0.05,
+                description="fleet-wide fresh XLA compile events this "
+                            "round, post-warmup (device plane)"),
+        # memory-ceiling objective: peak watermark as a fraction of the
+        # device's reported bytes_limit (TPU) or the operator ceiling
+        # BFLC_DEVICE_MEM_CEILING_BYTES; fleets with no known ceiling
+        # report None and SKIP.
+        SLOSpec("device_mem_ceiling", "device_mem_frac", 0.9,
+                budget=0.05,
+                description="worst role peak memory / capacity "
+                            "(device plane watermark)"),
     ]
 
 

@@ -54,6 +54,12 @@ from typing import Any, Dict, List, Optional
 #: records from any earlier revision)
 SCHEMA_VERSION = 1
 
+#: rounds whose device signals report None (SLO SKIP): every program
+#: family legitimately compiles on its first appearance, and the
+#: zero-tolerance device_recompiles objective must only ever judge the
+#: post-warmup steady state
+DEVICE_SLO_WARMUP_ROUNDS = 2
+
 
 def _round_of_tag(tag) -> Optional[int]:
     """'round-41' -> 41 (the pre-epoch-stamp scrape convention)."""
@@ -137,6 +143,46 @@ def _fleet_counter(roles: dict, name: str,
     return sum(vals) if vals else None
 
 
+def _fleet_counter_by_label(roles: dict, name: str,
+                            label: str) -> Dict[str, float]:
+    """Fleet-wide per-label counter totals across EVERY answering role
+    ({} when no role exports the metric) — the per-family compile
+    attribution the recompile-storm detector differences."""
+    out: Dict[str, float] = {}
+    for _role, snap in roles.items():
+        if not snap:
+            continue
+        samples = ((snap.get("metrics") or {}).get(name) or {}).get(
+            "samples") or []
+        for s in samples:
+            key = str((s.get("labels") or {}).get(label, ""))
+            out[key] = out.get(key, 0.0) + float(s.get("value", 0.0))
+    return out
+
+
+def _fleet_mem_frac(roles: dict) -> Optional[float]:
+    """Worst role's peak-memory watermark as a fraction of its known
+    capacity; None when no answering role knows a ceiling (backend
+    bytes_limit on TPU, BFLC_DEVICE_MEM_CEILING_BYTES elsewhere)."""
+    worst = None
+    for _role, snap in roles.items():
+        if not snap:
+            continue
+        metrics = snap.get("metrics") or {}
+
+        def _max_sample(name):
+            s = (metrics.get(name) or {}).get("samples") or []
+            vals = [float(x.get("value", 0.0)) for x in s]
+            return max(vals) if vals else 0.0
+
+        peak = _max_sample("device_mem_peak_bytes")
+        limit = _max_sample("device_mem_limit_bytes")
+        if peak > 0.0 and limit > 0.0:
+            frac = peak / limit
+            worst = frac if worst is None else max(worst, frac)
+    return worst
+
+
 class RoundTimeline:
     """The streaming joiner (module docstring).  Feed it canonical
     records via ``observe*``; query joined rounds via
@@ -156,8 +202,13 @@ class RoundTimeline:
         self.notes: List[dict] = []
         self.alerts: List[dict] = []
         self.spans: List[dict] = []
+        # device-plane records (obs.device jsonl): compile events are
+        # wall-clock (window-assigned at query time), storm verdicts
+        # are epoch-keyed
+        self.device: List[dict] = []
         self._prev_scrape_roles: Optional[dict] = None
         self._prev_rederive_skip: Optional[float] = None
+        self._prev_device_fams: Optional[Dict[str, float]] = None
         self._span_reports: Optional[Dict[int, dict]] = None
 
     # ------------------------------------------------------------ ingest
@@ -178,6 +229,8 @@ class RoundTimeline:
             self.observe_health(rec)
         elif t == "slo_alert":
             self.observe_alert(rec)
+        elif isinstance(t, str) and t.startswith("device_"):
+            self.observe_device(rec)
         # anything else: a future stream this revision doesn't know
 
     def _observe_note(self, rec: dict) -> None:
@@ -241,6 +294,29 @@ class RoundTimeline:
             self._prev_rederive_skip = skip_total
         else:
             digest["rederive_skipped_delta"] = None
+        # device plane: fleet-summed fresh-compile counters, differenced
+        # scrape-to-scrape per family (the storm detector's feed) and
+        # totalled (the device_recompiles SLO signal).  The FIRST
+        # observation reports None — the compiles before it are warmup,
+        # and a restarted role's shrinking counter clamps to zero like
+        # the rederive delta above.
+        dev_fams = _fleet_counter_by_label(
+            roles, "device_compile_total", "family")
+        if dev_fams:
+            prev_fams = self._prev_device_fams
+            if prev_fams is None:
+                digest["device_fresh_by_family"] = None
+                digest["device_recompiles_delta"] = None
+            else:
+                by_fam = {f: max(v - prev_fams.get(f, 0.0), 0.0)
+                          for f, v in dev_fams.items()}
+                digest["device_fresh_by_family"] = by_fam
+                digest["device_recompiles_delta"] = sum(by_fam.values())
+            self._prev_device_fams = dev_fams
+        else:
+            digest["device_fresh_by_family"] = None
+            digest["device_recompiles_delta"] = None
+        digest["device_mem_frac"] = _fleet_mem_frac(roles)
         if writer_answered is not None:
             self._prev_scrape_roles = writer_answered
         if r is not None and r >= 0:
@@ -261,6 +337,15 @@ class RoundTimeline:
     def observe_alert(self, rec: dict) -> None:
         if rec.get("type") == "slo_alert":
             self.alerts.append(rec)
+
+    def observe_device(self, rec: dict) -> None:
+        """One device-plane record (obs.device ``*.device.jsonl``):
+        compile events / memory watermarks / storm verdicts / xprof
+        markers.  Storm records are epoch-keyed; the rest join by wall
+        window at query time."""
+        if isinstance(rec, dict) and str(
+                rec.get("type", "")).startswith("device_"):
+            self.device.append(rec)
 
     def observe_spans(self, spans: List[dict]) -> None:
         """Offline feed: spans as obs.trace.load_spans returns them
@@ -311,6 +396,9 @@ class RoundTimeline:
             self.notes = [n for n in self.notes
                           if not isinstance(n.get("t"), (int, float))
                           or n["t"] >= floor_t]
+            self.device = [d for d in self.device
+                           if not isinstance(d.get("t"), (int, float))
+                           or d["t"] >= floor_t]
 
     # ------------------------------------------------------------- query
     def rounds(self) -> List[int]:
@@ -363,6 +451,22 @@ class RoundTimeline:
                 if isinstance(f.get("t"), (int, float))
                 and lo < f["t"] <= t1]
 
+    def device_in_round(self, r: int) -> List[dict]:
+        """Round r's device records: epoch-keyed storm verdicts plus
+        the wall-window slice of compile / memory / xprof events
+        (same window rule as faults_in_round)."""
+        out = [d for d in self.device
+               if d.get("type") == "device_storm"
+               and d.get("epoch") == r]
+        t0, t1 = self.round_bounds(r)
+        if t1 is not None:
+            lo = t0 if t0 is not None else t1 - 3600.0
+            out += [d for d in self.device
+                    if d.get("type") != "device_storm"
+                    and isinstance(d.get("t"), (int, float))
+                    and lo < d["t"] <= t1]
+        return out
+
     def round_record(self, r: int) -> Dict[str, Any]:
         """The joined per-round forensic record — every pillar's view of
         round r on one dict (module docstring shape)."""
@@ -407,6 +511,34 @@ class RoundTimeline:
             if seated is not None:
                 rec["committee"] = list(seated["seats"])
             rec["reseat"] = any(n["epoch"] == r for n in reseats) or None
+        # device plane: the round's compile events / storm verdict /
+        # memory watermark plus the last scrape's fleet deltas (what
+        # obs_query --round prints and incident bundles slice)
+        dev = self.device_in_round(r)
+        last = scrapes[-1] if scrapes else {}
+        if dev or last.get("device_recompiles_delta") is not None \
+                or last.get("device_mem_frac") is not None:
+            compiles = [d for d in dev
+                        if d.get("type") == "device_compile"]
+            by_fam: Dict[str, int] = {}
+            for d in compiles:
+                f = str(d.get("family", "unattributed"))
+                by_fam[f] = by_fam.get(f, 0) + 1
+            storms = [d for d in dev if d.get("type") == "device_storm"]
+            mems = [d for d in dev if d.get("type") == "device_mem"]
+            rec["device"] = {
+                "recompiles_delta": last.get("device_recompiles_delta"),
+                "mem_frac": last.get("device_mem_frac"),
+                "compiles": len(compiles),
+                "compiles_by_family": by_fam,
+                "compile_events": compiles,
+                "storm": storms[-1] if storms else None,
+                "mem_peak_bytes": max(
+                    (float(d.get("peak_bytes", 0.0)) for d in mems),
+                    default=None),
+                "xprof": [d for d in dev
+                          if d.get("type") == "device_xprof"],
+            }
         rep = self._reports_by_epoch().get(r)
         if rep is not None:
             rec["trace"] = {
@@ -468,6 +600,13 @@ class RoundTimeline:
                 if acc is not None and best_prior is not None
                 else None),
             "rederive_skipped_delta": last.get("rederive_skipped_delta"),
+            # device signals skip (None) inside the warmup window —
+            # first-appearance compiles are legitimate, and the
+            # device_recompiles objective is zero-tolerance after it
+            "device_recompiles_delta": (
+                last.get("device_recompiles_delta")
+                if r >= DEVICE_SLO_WARMUP_ROUNDS else None),
+            "device_mem_frac": last.get("device_mem_frac"),
         }
 
 
@@ -480,10 +619,31 @@ class RoundForensics:
     histogram deltas are all observable).  Every failure in here is
     swallowed — forensics must never take down the driver loop."""
 
-    def __init__(self, engine=None, keep_rounds: int = 1024):
+    def __init__(self, engine=None, keep_rounds: int = 1024,
+                 storm_detector=None):
         self.timeline = RoundTimeline(keep_rounds=keep_rounds)
         self.engine = engine
+        # recompile-storm plane (obs.device): fed each judged round's
+        # per-family fresh-compile deltas; its records join the
+        # timeline like any device stream
+        self.storm = storm_detector
         self._judged: set = set()
+
+    def _feed_storm(self, rr: int) -> None:
+        if self.storm is None:
+            return
+        by_fam: Dict[str, float] = {}
+        fed = False
+        for s in self.timeline.scrapes.get(rr, ()):
+            fams = s.get("device_fresh_by_family")
+            if fams is None:
+                continue                # pre-warmup / dark scrape
+            fed = True
+            for f, v in fams.items():
+                by_fam[f] = by_fam.get(f, 0.0) + float(v)
+        if fed:
+            self.timeline.observe_device(
+                self.storm.observe_round(rr, by_fam))
 
     def observe(self, rec: dict) -> None:
         try:
@@ -500,6 +660,7 @@ class RoundForensics:
             for rr in sorted(ep for ep in self.timeline.commits
                              if ep <= r and ep not in self._judged):
                 self._judged.add(rr)
+                self._feed_storm(rr)
                 for alert in self.engine.observe_round(
                         self.timeline.slo_summary(rr),
                         context=self.timeline.round_record(rr)):
@@ -512,6 +673,10 @@ class RoundForensics:
             self.timeline.rounds())}
         if self.engine is not None:
             rep.update(self.engine.report())
+        if self.storm is not None and self.storm.records:
+            last = self.storm.records[-1]
+            rep["storm"] = {"rounds": self.storm.rounds,
+                            "verdict": last.get("verdict")}
         return rep
 
 
@@ -529,6 +694,7 @@ def arm_forensics(collector, telemetry_dir: str, *,
     NOT this process's metrics registry: drivers never install process
     telemetry (only spawned children do), so a registry check would
     leave the plane dark on every real fleet."""
+    from bflc_demo_tpu.obs import device as obs_device
     from bflc_demo_tpu.obs import slo as obs_slo
     if obs_slo.slo_legacy():
         return None
@@ -538,7 +704,14 @@ def arm_forensics(collector, telemetry_dir: str, *,
     engine = obs_slo.SLOEngine(
         obs_slo.default_slos(**kw),
         jsonl_path=os.path.join(telemetry_dir, "alerts.jsonl"))
-    forensics = RoundForensics(engine)
+    # device plane: drivers never install process telemetry, so the
+    # driver-side storm records need their sink pointed here
+    # explicitly; the detector itself is inert under the device pin
+    storm = None
+    if not obs_device.device_legacy():
+        obs_device.install(telemetry_dir)
+        storm = obs_device.RecompileStormDetector(role="driver")
+    forensics = RoundForensics(engine, storm_detector=storm)
     collector.add_observer(forensics.observe)
     return forensics
 
@@ -548,7 +721,8 @@ def load_round_timeline(telemetry_dir: str,
                         keep_rounds: int = 4096) -> RoundTimeline:
     """Rebuild the joined timeline from a telemetry artifact directory:
     metrics.jsonl (scrapes/faults/notes), every *.health.jsonl,
-    *.spans.jsonl, *.flight.jsonl, and alerts.jsonl when present.  Every
+    *.spans.jsonl, *.flight.jsonl, *.device.jsonl, and alerts.jsonl
+    when present.  Every
     stream is optional and torn/garbled lines are skipped — a post-
     mortem must parse whatever a dead fleet left behind."""
     from bflc_demo_tpu.obs.collector import load_timeline as _load_jsonl
@@ -573,6 +747,10 @@ def load_round_timeline(telemetry_dir: str,
         elif name.endswith(".flight.jsonl"):
             role = name[:-len(".flight.jsonl")]
             tl.observe_flight(_load_flight_events(path), role)
+        elif name.endswith(".device.jsonl"):
+            from bflc_demo_tpu.obs import device as obs_device
+            for rec in obs_device.load_device_records(path):
+                tl.observe_device(rec)
     for rec in _load_jsonl(os.path.join(telemetry_dir, "alerts.jsonl")):
         tl.observe_alert(rec)
     return tl

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bflc_demo_tpu.obs import device as obs_device
 from bflc_demo_tpu.obs import flight as obs_flight
 from bflc_demo_tpu.obs import metrics as obs_metrics
 from bflc_demo_tpu.obs import trace as obs_trace
@@ -238,8 +239,15 @@ def derive_leaves(global_flat: Dict[str, np.ndarray],
     # partition is an execution shape; any clamp is byte-invariant)
     psub = sum(int(np.asarray(global_flat[k]).size) for k in keys)
     eff_blocks = min(max(int(blocks), 1), max(psub, 1))
+    # device-plane cache attribution: rederive RIDES the engine's
+    # shared program cache (same geometry as the writer merge), so
+    # per-family compile counts stay with the engine families and
+    # rederive records only whether ITS merge found a warm program
+    before = ENGINE.compile_total
     accs = ENGINE.weighted_sum(list(keys), flats, w, wsum,
                                blocks=eff_blocks)
+    obs_device.record_cache("rederive",
+                            hit=ENGINE.compile_total == before)
     return spec.apply_step({k: global_flat[k] for k in keys}, accs, lr)
 
 
@@ -338,6 +346,7 @@ class Rederiver:
             self.stats["seconds"] += dt
             if obs_metrics.REGISTRY.enabled:
                 _M_SECONDS.observe(dt, mode=self.mode)
+                obs_device.observe_execute("rederive", dt)
 
     def _check_inner(self, ledger, op: bytes, auth: Optional[dict]
                      ) -> Tuple[str, Optional[dict]]:

@@ -75,6 +75,12 @@ class RunOptions:
     # tools/trace_report.py.  BFLC_TRACE_LEGACY=1 pins tracing out.
     telemetry_dir: str = ""
     trace_sample: float = 0.0
+    # processes runtime: device-plane profiler capture window
+    # (obs.device) — "R:K" brackets jax.profiler.trace around committed
+    # rounds R..R+K-1 in the driver; needs --telemetry-dir (the trace
+    # artifacts land in <telemetry-dir>/xprof unless BFLC_XPROF_DIR
+    # overrides).  BFLC_XPROF is the env twin.
+    xprof_window: str = ""
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 

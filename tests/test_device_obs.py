@@ -1,0 +1,433 @@
+"""Device-plane observability (bflc_demo_tpu/obs/device.py; ISSUE 19).
+
+The properties under test:
+
+- compile & cost attribution: the meshagg engine's geometry-keyed
+  program cache reports fresh compiles / cache hits per program family,
+  and a forced geometry change produces exactly the expected fresh
+  events; static-argnames jits are signature-tracked (observe_jit);
+- certified bytes are IDENTICAL with the plane armed and disarmed
+  (`BFLC_DEVICE_OBS=0` legacy pin) — the device plane changes no trust
+  and no bytes;
+- the recompile-storm detector WARNs on one post-warmup fresh compile,
+  escalates a sustained streak to CRIT, and raises ZERO false verdicts
+  on the steady-state zero-compile loop (including its own cold start);
+- memory watermarks fall back to the host chain (RSS/getrusage/
+  tracemalloc) on CPU and honor BFLC_DEVICE_MEM_CEILING_BYTES;
+- xprof capture windows are entirely inert when unarmed;
+- the device jsonl sink round-trips through the shared loader, joins
+  the round timeline (scrape differencing with a warmup-None guard),
+  and feeds chaos_soak's --fail-on-recompile-storm operator gate and
+  check_reduction_spec's steady-state recompile gate.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.obs import device as obs_device
+from bflc_demo_tpu.obs import flight as obs_flight
+from bflc_demo_tpu.obs import metrics as obs_metrics
+from bflc_demo_tpu.obs.timeline import (DEVICE_SLO_WARMUP_ROUNDS,
+                                        RoundTimeline)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+
+def _tool(name):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture
+def armed(tmp_path):
+    """Armed device plane: registry on, no legacy pin, mirrors reset,
+    sink pointed at tmp_path.  Everything restored on exit."""
+    saved_enabled = obs_metrics.REGISTRY.enabled
+    saved_role = obs_metrics.REGISTRY.role
+    saved_pin = os.environ.pop("BFLC_DEVICE_OBS", None)
+    saved_dir = obs_device._SINK["dir"]
+    saved_xprof = obs_device.XPROF
+    obs_metrics.REGISTRY.enabled = True
+    obs_metrics.REGISTRY.role = "writer"
+    obs_device.reset_state()
+    obs_device._SINK["dir"] = str(tmp_path)
+    try:
+        yield tmp_path
+    finally:
+        obs_metrics.REGISTRY.enabled = saved_enabled
+        obs_metrics.REGISTRY.role = saved_role
+        obs_device._SINK["dir"] = saved_dir
+        obs_device.XPROF = saved_xprof
+        if saved_pin is not None:
+            os.environ["BFLC_DEVICE_OBS"] = saved_pin
+        obs_device.reset_state()
+
+
+def _scenario(p, n=3, seed=0):
+    """One tiny fixed reduction scenario with a distinctive param count
+    (the engine program cache is keyed on (n, p) and shared across the
+    test session — unusual primes guarantee fresh geometries)."""
+    rng = np.random.default_rng(seed)
+    g = {"/w": rng.standard_normal(p).astype(np.float32)}
+    deltas = [{"/w": rng.standard_normal(p).astype(np.float32)}
+              for _ in range(n)]
+    weights = [float(rng.integers(1, 50)) for _ in range(n)]
+    selected = list(range(n))
+    return g, deltas, weights, selected
+
+
+# ------------------------------------------------ compile attribution
+class TestCompileAttribution:
+    def test_engine_geometry_change_records_fresh_compiles(self, armed):
+        """A new (n, p) geometry is a cache miss + fresh compile events
+        for family 'reduce'; the SAME geometry again is a cache hit and
+        zero fresh compiles — the steady-state invariant the storm
+        detector pages on."""
+        from bflc_demo_tpu.meshagg import spec
+        from bflc_demo_tpu.meshagg.engine import ENGINE
+
+        def _run(p):
+            g, deltas, weights, selected = _scenario(p)
+            w = spec.merge_weight_vector(weights, selected, len(deltas))
+            ENGINE.weighted_sum(sorted(g), deltas, w,
+                                max(float(w.sum()), 1e-12),
+                                force_leg="mesh")
+
+        def _fam():
+            return obs_device.report()["families"].get("reduce", {})
+
+        _run(7919)
+        after_first = _fam()
+        assert after_first.get("compiles", 0) >= 1
+        assert after_first.get("cache_misses", 0) == 1
+        assert after_first.get("compile_seconds", 0) > 0
+        _run(7919)                       # same geometry: hit, no compile
+        after_repeat = _fam()
+        assert after_repeat["compiles"] == after_first["compiles"]
+        assert after_repeat["cache_hits"] == 1
+        _run(7927)                       # forced recompile
+        after_change = _fam()
+        assert after_change["compiles"] > after_first["compiles"]
+        assert after_change["cache_misses"] == 2
+        # execute time is observed on every call, not just fresh ones
+        assert after_change["execute_calls"] >= 3
+
+    def test_observe_jit_signature_tracking(self, armed):
+        """A static-argnames-style jit records one ESTIMATED compile
+        event per new abstract signature and execute time on every
+        call."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = obs_device.observe_jit(jax.jit(lambda x: x * 2.0),
+                                    "train_step")
+        fn(jnp.ones((4,), jnp.float32))
+        fn(jnp.ones((4,), jnp.float32))      # known signature
+        fn(jnp.ones((5,), jnp.float32))      # new shape -> compile
+        fam = obs_device.report()["families"]["train_step"]
+        assert fam["compiles"] == 2
+        assert fam["execute_calls"] == 3
+        recs = obs_device.load_device_records(str(armed))
+        est = [r for r in recs if r["type"] == "device_compile"
+               and r["family"] == "train_step"]
+        assert len(est) == 2 and all(r["estimated"] for r in est)
+
+    def test_cost_analysis_unavailable_is_counted(self, armed):
+        """The shared helper never bare-swallows: a raising
+        cost_analysis yields zeros AND a counted unavailability
+        (the eval/mfu.py satellite's contract)."""
+        class _Bad:
+            def cost_analysis(self):
+                raise RuntimeError("no backend")
+
+        class _Listy:
+            def cost_analysis(self):
+                return [{"flops": 5.0, "bytes accessed": 7.0}]
+
+        assert obs_device.cost_analysis_stats(_Bad(), "mfu") == {
+            "flops": 0.0, "bytes": 0.0}
+        assert obs_device.report()["cost_analysis_unavailable"] == 1
+        assert obs_device.cost_analysis_stats(_Listy(), "mfu") == {
+            "flops": 5.0, "bytes": 7.0}
+        assert obs_device.report()["cost_analysis_unavailable"] == 1
+
+    def test_disarmed_plane_records_nothing(self, armed):
+        os.environ["BFLC_DEVICE_OBS"] = "0"
+        assert obs_device.device_legacy()
+        assert not obs_device.device_armed()
+        obs_device.record_compile("reduce", 1.0)
+        obs_device.record_cache("reduce", hit=False)
+        obs_device.observe_execute("reduce", 0.1)
+        rep = obs_device.report()
+        assert rep["legacy_pin"] and not rep["enabled"]
+        assert rep["families"] == {}
+
+
+# --------------------------------------------- certified-byte identity
+class TestByteIdentity:
+    def test_certified_bytes_identical_armed_vs_disarmed(self, armed):
+        """The AOT swap compiles the SAME program the jit cache would
+        build: aggregate_flat bytes match exactly with the plane armed
+        and under the BFLC_DEVICE_OBS=0 pin."""
+        import hashlib
+
+        from bflc_demo_tpu.meshagg.engine import ENGINE
+        from bflc_demo_tpu.utils.serialization import pack_entries
+
+        g, deltas, weights, selected = _scenario(7933)
+        out_armed = ENGINE.aggregate_flat(g, deltas, weights, selected,
+                                          0.3, force_leg="mesh")
+        h_armed = hashlib.sha256(pack_entries(out_armed)).hexdigest()
+        os.environ["BFLC_DEVICE_OBS"] = "0"
+        out_legacy = ENGINE.aggregate_flat(g, deltas, weights, selected,
+                                           0.3, force_leg="mesh")
+        h_legacy = hashlib.sha256(pack_entries(out_legacy)).hexdigest()
+        assert h_armed == h_legacy
+
+
+# ------------------------------------------------------ storm detector
+class TestStormDetector:
+    def test_steady_state_has_zero_false_positives(self):
+        det = obs_device.RecompileStormDetector(role="driver")
+        verdicts = [det.observe_round(0, {"reduce": 3.0})["verdict"]]
+        verdicts += [det.observe_round(r, {"reduce": 0.0})["verdict"]
+                     for r in range(1, 30)]
+        assert verdicts == ["ok"] * 30
+
+    def test_warn_then_crit_escalation_then_recovery(self):
+        det = obs_device.RecompileStormDetector(role="driver")
+        det.observe_round(0, {"reduce": 3.0})       # cold start
+        for r in range(1, 9):
+            det.observe_round(r, {"reduce": 0.0})
+        warn = det.observe_round(9, {"reduce": 1.0})
+        assert warn["verdict"] == "warn"
+        assert warn["families"]["reduce"]["z"] == pytest.approx(4.0)
+        crit = det.observe_round(10, {"reduce": 1.0})
+        assert crit["verdict"] == "crit"            # 2-round streak
+        calm = det.observe_round(11, {"reduce": 0.0})
+        assert calm["verdict"] == "ok"              # streak cleared
+
+    def test_cold_start_and_min_baseline_never_judge(self):
+        """Every family legitimately compiles on first appearance —
+        warmup + min_baseline keep those rounds verdict-free."""
+        det = obs_device.RecompileStormDetector(role="driver")
+        for r in range(4):
+            rec = det.observe_round(r, {"score": 5.0})
+            assert rec["verdict"] == "ok"
+            assert rec["families"]["score"]["z"] is None
+
+    def test_crit_flushes_flight_and_triggers_xprof(self, armed):
+        obs_device.XPROF = obs_device.XprofWindow(
+            "", str(armed / "xp"))
+        det = obs_device.RecompileStormDetector(role="driver")
+        det.observe_round(0, {"reduce": 0.0})
+        for r in range(1, 9):
+            det.observe_round(r, {"reduce": 0.0})
+        det.observe_round(9, {"reduce": 2.0})
+        det.observe_round(10, {"reduce": 2.0})      # CRIT
+        assert obs_device.XPROF._pending_trigger == "storm_crit"
+        recs = obs_device.load_device_records(str(armed))
+        crits = [r for r in recs if r["type"] == "device_storm"
+                 and r["verdict"] == "crit"]
+        assert crits and crits[-1]["epoch"] == 10
+        assert crits[-1]["families"]["reduce"]["level"] == "crit"
+
+
+# ----------------------------------------------------- memory plane
+class TestMemoryWatermark:
+    def test_cpu_fallback_chain_reports_a_real_watermark(self, armed):
+        sample = obs_device.memory_sample()
+        assert sample["source"] in ("rss", "getrusage", "tracemalloc",
+                                    "device:cpu")
+        assert sample["peak_bytes"] > 0
+
+    def test_env_ceiling_fills_bytes_limit(self, armed, monkeypatch):
+        monkeypatch.setenv("BFLC_DEVICE_MEM_CEILING_BYTES", "123456789")
+        assert obs_device.memory_sample()["bytes_limit"] == 123456789.0
+
+    def test_scrape_reason_appends_sink_record(self, armed):
+        obs_device.sample_memory(reason="scrape")
+        recs = [r for r in obs_device.load_device_records(str(armed))
+                if r["type"] == "device_mem"]
+        assert recs and recs[-1]["reason"] == "scrape"
+        # unchanged peak on a plain tick: no new line per tick
+        n = len(recs)
+        obs_device.sample_memory(reason="tick")
+        recs2 = [r for r in obs_device.load_device_records(str(armed))
+                 if r["type"] == "device_mem"]
+        assert len(recs2) == n
+
+
+# --------------------------------------------------- xprof gating
+class TestXprofGating:
+    def test_unarmed_window_is_inert(self, armed):
+        w = obs_device.XprofWindow("", "")
+        assert not w.armed
+        for r in range(5):
+            w.on_round(r)
+        w.trigger_once("storm_crit")     # no out_dir -> still inert
+        assert not w.armed
+        w.close()
+        assert not [r for r in
+                    obs_device.load_device_records(str(armed))
+                    if r["type"] == "device_xprof"]
+
+    def test_spec_parse_and_bad_spec(self, tmp_path):
+        w = obs_device.XprofWindow("5:3", str(tmp_path))
+        assert w.armed and w.start_round == 5 and w.count == 3
+        bad = obs_device.XprofWindow("abc", str(tmp_path))
+        assert bad.start_round is None and not bad.armed
+
+    def test_arm_xprof_env_twin(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BFLC_XPROF", "7:2")
+        monkeypatch.setenv("BFLC_XPROF_DIR", str(tmp_path))
+        w = obs_device.arm_xprof()
+        try:
+            assert w.start_round == 7 and w.count == 2
+            assert w.out_dir == str(tmp_path)
+            assert obs_device.XPROF is w
+        finally:
+            obs_device.XPROF = None
+
+
+# ------------------------------------------------- sink + loader
+class TestSinkRoundtrip:
+    def test_install_registers_terminal_flush_and_roundtrips(
+            self, armed):
+        obs_device.install(str(armed))
+        assert obs_device._terminal_flush in obs_flight.TERMINAL_FLUSHES
+        obs_device.record_compile("reduce", 0.02, flops=10.0)
+        path = armed / "writer.device.jsonl"
+        assert path.exists()
+        with open(path, "a") as fh:
+            fh.write('{"type": "device_compile", "family": ')  # torn
+        other = armed / "client-1.device.jsonl"
+        with open(other, "w") as fh:
+            fh.write(json.dumps({"type": "device_mem", "t": 1.0,
+                                 "peak_bytes": 5.0}) + "\n")
+        recs = obs_device.load_device_records(str(armed))
+        assert [r["type"] for r in recs] == ["device_mem",
+                                             "device_compile"]
+        assert recs[0]["role"] == "client-1"     # from the filename
+        assert recs[1]["role"] == "writer"
+
+
+# --------------------------------------------------- timeline join
+def _snap(cum_compiles, peak=0.0, limit=0.0):
+    m = {"device_compile_total": {"type": "counter", "samples": [
+        {"labels": {"family": "reduce"}, "value": cum_compiles}]}}
+    if peak:
+        m["device_mem_peak_bytes"] = {"type": "gauge", "samples": [
+            {"labels": {"source": "rss"}, "value": peak}]}
+        m["device_mem_limit_bytes"] = {"type": "gauge", "samples": [
+            {"labels": {"source": "rss"}, "value": limit}]}
+    return {"metrics": m}
+
+
+class TestTimelineJoin:
+    def test_scrape_differencing_with_warmup_none(self):
+        """Cumulative counters difference scrape-to-scrape; the first
+        observation and the SLO warmup rounds report None, so warmup
+        compiles can never breach the zero-tolerance objective."""
+        tl = RoundTimeline()
+        for r, cum in enumerate([3.0, 3.0, 4.0, 4.0]):
+            tl.observe({"type": "note", "t": 100.0 + r,
+                        "name": "round_commit", "epoch": r})
+            tl.observe({"type": "scrape", "t": 100.1 + r,
+                        "epoch": r + 1,
+                        "roles": {"writer": _snap(
+                            cum, peak=900.0, limit=1000.0)},
+                        "coverage": {"answered": 1, "expected": 1,
+                                     "missing": []}})
+        assert tl.scrapes[0][0]["device_recompiles_delta"] is None
+        assert tl.scrapes[1][0]["device_recompiles_delta"] == 0.0
+        assert tl.scrapes[2][0]["device_recompiles_delta"] == 1.0
+        assert DEVICE_SLO_WARMUP_ROUNDS == 2
+        assert tl.slo_summary(1)["device_recompiles_delta"] is None
+        assert tl.slo_summary(2)["device_recompiles_delta"] == 1.0
+        assert tl.slo_summary(3)["device_recompiles_delta"] == 0.0
+        assert tl.slo_summary(2)["device_mem_frac"] == \
+            pytest.approx(0.9)
+        rec = tl.round_record(2)
+        assert rec["device"]["recompiles_delta"] == 1.0
+        assert rec["device"]["mem_frac"] == pytest.approx(0.9)
+
+    def test_device_records_join_round_record(self):
+        tl = RoundTimeline()
+        tl.observe({"type": "note", "t": 100.0, "name": "round_commit",
+                    "epoch": 2})
+        tl.observe_device({"type": "device_storm", "t": 100.2,
+                           "role": "driver", "epoch": 2,
+                           "verdict": "warn",
+                           "families": {"reduce": {
+                               "fresh": 1.0, "z": 4.0,
+                               "level": "warn"}}})
+        rec = tl.round_record(2)
+        assert rec["device"]["storm"]["verdict"] == "warn"
+
+
+# ----------------------------------------------- operator/CI gates
+class TestOperatorGates:
+    def test_chaos_soak_recompile_storm_gate(self, tmp_path):
+        soak = _tool("chaos_soak")
+        stormy = tmp_path / "stormy"
+        stormy.mkdir()
+        with open(stormy / "driver.device.jsonl", "w") as fh:
+            fh.write(json.dumps({
+                "type": "device_storm", "t": 1.0, "epoch": 9,
+                "verdict": "crit", "families": {
+                    "reduce": {"fresh": 2.0, "z": 8.0,
+                               "level": "crit"}}}) + "\n")
+            fh.write(json.dumps({
+                "type": "device_storm", "t": 2.0, "epoch": 10,
+                "verdict": "ok", "families": {}}) + "\n")
+        g = soak.operator_gates(str(stormy), fail_on_storm=True)
+        assert len(g["storm_rounds"]) == 1
+        assert g["storm_rounds"][0]["epoch"] == 9
+        assert g["storm_rounds"][0]["families"] == ["reduce"]
+        assert any("recompile-storm" in f for f in g["failures"])
+        # unarmed: recorded as evidence, never a failure
+        g2 = soak.operator_gates(str(stormy))
+        assert g2["storm_rounds"] and not g2["failures"]
+
+    def test_steady_state_recompile_gate_holds(self, armed):
+        """check_reduction_spec's repeated-scenario gate: the second
+        and later passes of one fixed scenario add ZERO fresh XLA
+        programs (the in-process twin of the fleet evidence)."""
+        from check_reduction_spec import run_steady_state_check
+        out = run_steady_state_check(repeats=2, max_n=8)
+        assert out["fresh_after_warmup"] == 0
+        assert len(out["compile_totals"]) == 2
+
+
+# ----------------------------------------------- bench artifact schema
+class TestBenchSchema:
+    def test_report_is_the_bench_device_section(self, armed):
+        obs_device.record_compile("reduce", 0.01, flops=100.0,
+                                  bytes_accessed=400.0)
+        obs_device.record_cache("reduce", hit=True)
+        obs_device.observe_execute("reduce", 0.001)
+        rep = obs_device.report()
+        assert set(rep) == {"enabled", "legacy_pin", "platform",
+                            "families", "memory",
+                            "cost_analysis_unavailable",
+                            "aot_fallbacks"}
+        fam = rep["families"]["reduce"]
+        assert set(fam) == {"compiles", "compile_seconds", "flops",
+                            "bytes", "cache_hits", "cache_misses",
+                            "execute_calls"}
+        assert fam["compiles"] == 1 and fam["flops"] == 100.0
+        assert set(rep["memory"]) >= {"source", "bytes_in_use",
+                                      "peak_bytes"}
+        assert json.loads(json.dumps(rep))      # artifact-serializable

@@ -818,7 +818,7 @@ class TestForensicsDrill:
         g3 = soak.operator_gates(str(clean), fail_on_crit=True,
                                  fail_on_slo=True)
         assert g3 == {"crit_rounds": [], "slo_alerts": [],
-                      "failures": []}
+                      "storm_rounds": [], "failures": []}
         # gating without telemetry is itself a failure, not a pass
         g4 = soak.operator_gates("", fail_on_crit=True)
         assert g4["failures"]

@@ -94,6 +94,19 @@ METRICS: List[Tuple[str, str, str, str]] = [
      "extra.blocked_agg.agg_speedup_vs_v1_x", "higher", "rel"),
     ("blocked_sharded_wall_s",
      "extra.blocked_agg.sharded_model.blocked_wall_s", "lower", "rel"),
+    # device-plane observability (obs.device, bench.py extra.device /
+    # extra.device_overhead): the armed-vs-BFLC_DEVICE_OBS=0 round-wall
+    # ratio is a near-zero fraction ("abs" — the 1% bar), and both
+    # recompile axes are zero-tolerance: post-warmup fleet fresh
+    # compiles and the repeated-scenario steady-state gate must stay
+    # at zero, so ANY absolute uptick flags.
+    ("device_overhead_frac",
+     "extra.device_overhead.overhead_frac", "lower", "abs"),
+    ("device_steady_recompiles",
+     "extra.device_overhead.steady_state_recompiles", "lower", "abs"),
+    ("device_gate_fresh_compiles",
+     "extra.device.steady_state_gate.fresh_after_warmup", "lower",
+     "abs"),
 ]
 
 

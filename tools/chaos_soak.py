@@ -93,6 +93,11 @@ def main(argv=None) -> int:
                    help="exit nonzero when the SLO engine raised any "
                         "burn-rate alert (obs.slo alerts.jsonl); "
                         "requires telemetry")
+    p.add_argument("--fail-on-recompile-storm", action="store_true",
+                   help="exit nonzero when the device plane recorded "
+                        "any CRIT recompile-storm verdict (obs.device "
+                        "*.device.jsonl) — post-warmup steady state "
+                        "must not recompile; requires telemetry")
     p.add_argument("--notify-cmd", default="",
                    help="operator command the SLO engine spawns PER "
                         "alert with the alerts.jsonl record on stdin "
@@ -194,7 +199,8 @@ def main(argv=None) -> int:
     final_acc = res.final_accuracy if res is not None else 0.0
     gates = operator_gates(telemetry_dir,
                            fail_on_crit=args.fail_on_crit,
-                           fail_on_slo=args.fail_on_slo)
+                           fail_on_slo=args.fail_on_slo,
+                           fail_on_storm=args.fail_on_recompile_storm)
     artifact = {
         "seed": args.seed,
         "profile": args.profile,
@@ -274,24 +280,28 @@ def _write_progress(out: str, telemetry_dir: str, t0: float, args,
 
 
 def operator_gates(telemetry_dir: str, *, fail_on_crit: bool = False,
-                   fail_on_slo: bool = False) -> dict:
+                   fail_on_slo: bool = False,
+                   fail_on_storm: bool = False) -> dict:
     """Verdict-gated operations (the ROADMAP 'verdict-driven operator
-    tooling' item): turn the run's health verdicts (obs.health) and SLO
-    burn-rate alerts (obs.slo) into exit-code evidence.  Enforcement
-    lives HERE, outside the protocol — the observability planes
-    themselves gate nothing (PARITY.md).  Returns {crit_rounds,
-    slo_alerts, failures}; `failures` is non-empty iff an armed gate
+    tooling' item): turn the run's health verdicts (obs.health), SLO
+    burn-rate alerts (obs.slo) and device recompile-storm verdicts
+    (obs.device) into exit-code evidence.  Enforcement lives HERE,
+    outside the protocol — the observability planes themselves gate
+    nothing (PARITY.md).  Returns {crit_rounds, slo_alerts,
+    storm_rounds, failures}; `failures` is non-empty iff an armed gate
     tripped.  Drilled in tier-1 with a scripted attacker
     (tests/test_forensics.py)."""
-    gates: dict = {"crit_rounds": [], "slo_alerts": [], "failures": []}
+    gates: dict = {"crit_rounds": [], "slo_alerts": [],
+                   "storm_rounds": [], "failures": []}
     if not telemetry_dir or not os.path.isdir(telemetry_dir):
-        if fail_on_crit or fail_on_slo:
+        if fail_on_crit or fail_on_slo or fail_on_storm:
             gates["failures"].append(
                 "gating requested but no telemetry dir — run without "
                 "--no-telemetry")
         return gates
     from bflc_demo_tpu.obs.health import load_health_records
     from bflc_demo_tpu.obs.slo import load_alerts
+    from bflc_demo_tpu.obs.device import load_device_records
     gates["crit_rounds"] = [
         {"epoch": r.get("epoch"), "role": r.get("role"),
          "flagged": [s["sender"] for s in r.get("senders", [])
@@ -312,6 +322,19 @@ def operator_gates(telemetry_dir: str, *, fail_on_crit: bool = False,
             f"--fail-on-slo: {len(gates['slo_alerts'])} SLO alert(s), "
             f"first {gates['slo_alerts'][0]['slo']} at epoch "
             f"{gates['slo_alerts'][0]['epoch']}")
+    gates["storm_rounds"] = [
+        {"epoch": r.get("epoch"), "role": r.get("role"),
+         "families": sorted(f for f, d in
+                            (r.get("families") or {}).items()
+                            if d.get("level") == "crit")}
+        for r in load_device_records(telemetry_dir)
+        if r.get("type") == "device_storm"
+        and r.get("verdict") == "crit"]
+    if fail_on_storm and gates["storm_rounds"]:
+        gates["failures"].append(
+            f"--fail-on-recompile-storm: "
+            f"{len(gates['storm_rounds'])} CRIT storm round(s), first "
+            f"at epoch {gates['storm_rounds'][0]['epoch']}")
     return gates
 
 

@@ -176,6 +176,39 @@ def run_differential(trials: int = 20, seed: int = 0,
             "report": ENGINE.report()}
 
 
+def run_steady_state_check(repeats: int = 3, seed: int = 0,
+                           max_n: int = 16) -> dict:
+    """Steady-state recompile gate (device-plane observability): one
+    FIXED scenario reduced `repeats` times through both legs and a
+    blocked geometry.  The first pass may compile (geometry-keyed
+    program-cache misses); every later pass must add ZERO fresh
+    programs — the same guarantee the storm detector pages on when a
+    live fleet violates it.  Returns {"repeats", "compile_totals",
+    "fresh_after_warmup"}; the gate holds iff fresh_after_warmup == 0."""
+    from bflc_demo_tpu.meshagg import spec
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+
+    rng = np.random.default_rng(seed)
+    g, deltas, weights, selected, lr, _, _ = _scenario(rng, max_n)
+    keys = sorted(g.keys())
+    w = spec.merge_weight_vector(weights, selected, len(deltas))
+    wsum = max(float(w.sum()), 1e-12)
+    p_total = sum(int(np.asarray(deltas[0][k]).size)
+                  for k in keys) if deltas else 0
+    blk = min(8, max(p_total, 1))
+    totals = []
+    with np.errstate(over="ignore", invalid="ignore"):
+        for _ in range(max(int(repeats), 2)):
+            ENGINE.weighted_sum(keys, deltas, w, wsum, force_leg="mesh")
+            ENGINE.weighted_sum(keys, deltas, w, wsum, force_leg="mesh",
+                                blocks=blk)
+            ENGINE.aggregate_flat(g, deltas, weights, selected, lr,
+                                  force_leg="mesh")
+            totals.append(int(ENGINE.compile_total))
+    return {"repeats": len(totals), "compile_totals": totals,
+            "fresh_after_warmup": totals[-1] - totals[0]}
+
+
 def run_rederive_differential(trials: int = 12, seed: int = 1,
                               max_n: int = 24,
                               n_validators: int = 4) -> dict:
@@ -290,6 +323,16 @@ def main(argv=None) -> int:
         return 1
     print("OK: host-loop, mesh, and blocked (v2) legs byte-identical "
           "on every scenario")
+    ss = run_steady_state_check(seed=args.seed)
+    print(f"steady-state recompile gate: {ss['repeats']} repeats, "
+          f"compile totals {ss['compile_totals']}, "
+          f"fresh after warmup {ss['fresh_after_warmup']}")
+    if ss["fresh_after_warmup"]:
+        print("FAIL: a repeated identical scenario compiled fresh XLA "
+              "programs after its warmup pass — the geometry-keyed "
+              "program cache is not holding (a live fleet would page "
+              "via the recompile-storm detector)")
+        return 1
     red = run_rederive_differential(max(args.trials // 2, 6), args.seed)
     print(f"rederive differential: {red['trials']} trials x "
           f"{red['n_validators']} validators")

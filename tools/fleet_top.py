@@ -292,6 +292,31 @@ def _role_row(role, snap):
                 cell += (f" / blk{blk} {n_bk}x{m_bk * 1e3:.1f}ms")
             cells.append(cell + f"  batch~{mb:.0f}  "
                          f"compiles {comp:.0f}")
+    # device plane (obs.device): per-role XLA compile/cache attribution
+    # and the process memory watermark — any role that traced a jit
+    # boundary gets the cell; quiet otherwise (BFLC_DEVICE_OBS=0 pin)
+    dcomp = _sum_counter(snap, "device_compile_total")
+    dhits = _sum_counter(snap, "device_program_cache_total", event="hit")
+    dmiss = _sum_counter(snap, "device_program_cache_total",
+                         event="miss")
+    if dcomp or dhits or dmiss:
+        n_ex, m_ex = _merged_hist(snap, "device_execute_seconds")
+        cell = f"xla compiles {dcomp:.0f}"
+        if dhits + dmiss:
+            cell += f"  cache {dhits / (dhits + dmiss):.0%}"
+        if n_ex:
+            cell += f"  exec {n_ex}x{m_ex * 1e3:.1f}ms"
+        cells.append(cell)
+    peak = max((s.get("value", 0.0)
+                for s in _metric(snap, "device_mem_peak_bytes")),
+               default=0.0)
+    if peak:
+        lim = max((s.get("value", 0.0)
+                   for s in _metric(snap, "device_mem_limit_bytes")),
+                  default=0.0)
+        cells.append(f"mem peak {peak / 1e6:.0f}MB"
+                     + (f" ({peak / lim:.0%} of ceiling)"
+                        if lim else ""))
     wire_in = costs.get("wire.bytes_in", 0)
     wire_out = costs.get("wire.bytes_out", 0)
     if wire_in or wire_out:

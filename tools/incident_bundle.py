@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from bflc_demo_tpu.obs import device as obs_device      # noqa: E402
 from bflc_demo_tpu.obs import slo as obs_slo            # noqa: E402
 from bflc_demo_tpu.obs import trace as obs_trace        # noqa: E402
 from bflc_demo_tpu.obs.collector import load_timeline   # noqa: E402
@@ -151,6 +152,14 @@ def build_bundle(telemetry_dir: str, out_path: str, *,
                     if _keep_flight_line(ln, _in_wall)]
                 data = ("\n".join(kept) + "\n").encode() \
                     if kept else b""
+            elif name.endswith(".device.jsonl"):
+                # device-plane records (obs.device): storm verdicts
+                # slice by epoch, compile/memory/xprof events by wall
+                data = _slice_jsonl_records(
+                    obs_device.load_device_records(path),
+                    lambda rec: (lo_r <= rec["epoch"] <= hi_r
+                                 if isinstance(rec.get("epoch"), int)
+                                 else _in_wall(rec.get("t"))))
             elif name == "alerts.jsonl":
                 try:
                     with open(path, "rb") as fh:
@@ -165,6 +174,11 @@ def build_bundle(telemetry_dir: str, out_path: str, *,
         narrative = _narrative(tl, alert, center, lo_r, hi_r)
         _add_bytes(tar, "narrative.md", narrative.encode())
         files.append("narrative.md")
+        # profiler capture windows (obs.device.XprofWindow): register
+        # the artifact dir by reference — capture trees are large and
+        # tool-specific, so the bundle carries the pointer + listing,
+        # never the bytes
+        xprof = _xprof_registration(telemetry_dir)
         manifest = {
             "type": "incident_bundle", "t": time.time(),
             "telemetry_dir": os.path.abspath(telemetry_dir),
@@ -172,10 +186,37 @@ def build_bundle(telemetry_dir: str, out_path: str, *,
             "window_rounds": [lo_r, hi_r],
             "window_wall": [t_lo, t_hi],
             "files": files,
+            "xprof": xprof,
         }
         _add_bytes(tar, "manifest.json",
                    (json.dumps(manifest, indent=2) + "\n").encode())
     return manifest
+
+
+def _xprof_registration(telemetry_dir: str) -> Optional[dict]:
+    """The run's profiler-capture dirs: the default <dir>/xprof tree
+    plus any dir a device_xprof record points at.  {dir: [relative
+    files...]} or None when the run captured nothing."""
+    dirs = []
+    default = os.path.join(telemetry_dir, "xprof")
+    if os.path.isdir(default):
+        dirs.append(default)
+    for rec in obs_device.load_device_records(telemetry_dir):
+        d = rec.get("dir")
+        if rec.get("type") == "device_xprof" and d \
+                and os.path.isdir(d) and d not in dirs:
+            dirs.append(d)
+    if not dirs:
+        return None
+    out = {}
+    for d in dirs:
+        listing = []
+        for root, _, names in os.walk(d):
+            for name in sorted(names):
+                listing.append(os.path.relpath(
+                    os.path.join(root, name), d))
+        out[os.path.abspath(d)] = sorted(listing)
+    return out
 
 
 def _keep_flight_line(line: str, in_wall) -> bool:

@@ -113,6 +113,45 @@ def render_round(rec: dict) -> str:
             lines.append(f"- {f.get('kind', '?')} "
                          f"{f.get('target', '')} "
                          f"(t={f.get('t', 0):.3f})")
+    dev = rec.get("device")
+    if dev:
+        lines += ["", "## Device (XLA compile / memory)", ""]
+        delta = dev.get("recompiles_delta")
+        by_fam = dev.get("compiles_by_family") or {}
+        fams = ", ".join(f"{f}={int(v)}" for f, v in
+                         sorted(by_fam.items()) if v) or "-"
+        if delta is None:
+            lines.append("fresh compiles - (warmup round)")
+        else:
+            lines.append(f"fresh compiles {int(delta)}  "
+                         f"by family: {fams}")
+        mem = dev.get("mem_peak_bytes")
+        frac = dev.get("mem_frac")
+        if mem is not None or frac is not None:
+            lines.append(
+                "mem peak "
+                + (f"{mem / 1e6:.1f}MB" if mem is not None else "-")
+                + (f"  ({frac:.0%} of ceiling)"
+                   if frac is not None else ""))
+        for ev in dev.get("compile_events", [])[:8]:
+            lines.append(
+                f"- compile {ev.get('family')}: "
+                f"{ev.get('seconds', 0):.3f}s"
+                + (f"  {ev.get('flops', 0):.3g} FLOPs" if ev.get("flops")
+                   else "")
+                + ("  (estimated)" if ev.get("estimated") else ""))
+        storm = dev.get("storm")
+        if storm:
+            worst = ", ".join(
+                f"{f} z={d.get('z')}" for f, d in
+                sorted((storm.get("families") or {}).items())
+                if d.get("level") != "ok")
+            lines.append(f"storm verdict "
+                         f"{(storm.get('verdict') or 'ok').upper()}"
+                         + (f" — {worst}" if worst else ""))
+        for xp in dev.get("xprof", []):
+            lines.append(f"- xprof capture ({xp.get('trigger', '?')}) "
+                         f"-> {xp.get('dir', '?')}")
     for role, h in sorted(rec.get("health", {}).items()):
         lines += ["", f"## Health — {role}: "
                       f"{h.get('verdict', 'ok').upper()}", ""]
