@@ -67,7 +67,13 @@ density x dtype grid is TPU_RESULTS.md round 17).  `extra.rederive`
 (ISSUE 15) is the validator re-derivation plane axis: off/shard/full
 round-wall overhead, per-validator re-derivation cost, and the
 lying-writer refusal drill (eval.benchmarks.rederive_config1).
-`extra.device` (ISSUE 19) is the device-plane self-attribution
+`extra.closed_loop` (ISSUE 20) is the closed-loop compression axis:
+the round-3 accuracy trail of stateless / error-feedback / adaptive
+sparse legs vs fast dense, egress reduction vs the legacy dense plane,
+EF's rounds-to-0.85 saved at the sparsest density, and the certified
+adaptive-density leg's moved-knob / clean-honest-path verdicts
+(eval.benchmarks.closed_loop_config1; the 8-round fat-MLP artifact of
+record is TPU_RESULTS.md).  `extra.device` (ISSUE 19) is the device-plane self-attribution
 section (obs.device): platform, per-program-family compile counts /
 wall seconds / cost-analysis FLOPs+bytes / cache hits, peak memory
 watermark, and the meshagg engine's program-cache report;
@@ -365,6 +371,34 @@ def _child() -> None:
                 "encode_share_of_round"),
             "decode_share_of_round_d001": sp_sparsest.get(
                 "decode_share_of_round"),
+        }
+        # closed-loop compression (ISSUE 20): error-feedback catch-up +
+        # the certified adaptive-density loop — this is the bench-budget
+        # twin (2 rounds, thin fleet; the 3-round fat-MLP artifact of
+        # record lives in TPU_RESULTS.md): EF-vs-stateless accuracy gap
+        # at the sparsest density, the EF egress reduction vs dense, and
+        # the adaptive leg's moved-knob + clean-honest-path verdicts
+        from bflc_demo_tpu.eval.benchmarks import closed_loop_config1
+        cl = closed_loop_config1(rounds=3, model_hidden=2048,
+                                 validators=4, timeout_s=300.0)
+        extra["closed_loop"] = {
+            "egress_reduction_ef_x": cl.get("egress_reduction_ef_x"),
+            "egress_reduction_adaptive_x": cl.get(
+                "egress_reduction_adaptive_x"),
+            "egress_reduction_at_matched_acc_x": cl.get(
+                "egress_reduction_at_matched_acc_x"),
+            "acc_gap_stateless": cl.get("acc_gap_stateless"),
+            "acc_gap_ef": cl.get("acc_gap_ef"),
+            "acc_gap_adaptive": cl.get("acc_gap_adaptive"),
+            "acc_catch_up": cl.get("acc_catch_up"),
+            "rounds_to_085_ef": cl.get("rounds_to_085_ef"),
+            "ef_rounds_saved": cl.get("ef_rounds_saved"),
+            "adaptive_density_moved": cl.get("adaptive_density_moved"),
+            "adaptive_honest_path_clean": cl.get(
+                "adaptive_honest_path_clean"),
+            "adaptive_eff_density_final": cl["legs"]["adaptive"].get(
+                "eff_density_final"),
+            "geometry": cl["geometry"],
         }
         # hierarchical-federation axes (PR 6): root-coordinator cost vs
         # simulated thin-client count at fixed cell count — the headline

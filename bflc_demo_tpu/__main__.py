@@ -140,6 +140,18 @@ def main(argv=None) -> int:
             print("--attest-scores applies to the mesh/executor runtimes",
                   file=sys.stderr)
             return 2
+        if opts.error_feedback:
+            # client-local error feedback (closed-loop compression):
+            # the spawned client processes inherit the env decision —
+            # no protocol change, so no cfg plumbing
+            from bflc_demo_tpu.utils.serialization import sparse_enabled
+            if cfg is None or not (sparse_enabled(cfg)
+                                   or cfg.delta_dtype != "f32"):
+                print("--error-feedback needs a lossy encode to "
+                      "compensate: arm --delta-density < 1 and/or "
+                      "--delta-dtype f16|i8", file=sys.stderr)
+                return 2
+            os.environ["BFLC_ERROR_FEEDBACK"] = "1"
     elif opts.runtime == "executor":
         if opts.tls_dir:
             kw["tls_dir"] = opts.tls_dir
@@ -149,11 +161,12 @@ def main(argv=None) -> int:
                 or opts.chaos_seed >= 0 or opts.snapshot_interval \
                 or opts.snapshot_dir or opts.telemetry_dir \
                 or opts.trace_sample or opts.xprof_window \
-                or opts.rederive != "off":
+                or opts.rederive != "off" or opts.error_feedback:
             print("--standbys/--quorum/--bft-validators/--chaos-seed/"
                   "--snapshot-interval/--snapshot-dir/--telemetry-dir/"
-                  "--trace-sample/--xprof-window/--rederive apply to "
-                  "--runtime processes", file=sys.stderr)
+                  "--trace-sample/--xprof-window/--rederive/"
+                  "--error-feedback apply to --runtime processes",
+                  file=sys.stderr)
             return 2
     elif opts.runtime == "mesh" and opts.attest_scores is not None \
             and not (opts.standbys or opts.tls_dir or opts.quorum
@@ -172,12 +185,13 @@ def main(argv=None) -> int:
             or opts.chaos_seed >= 0 or opts.cells or opts.cell_size \
             or opts.snapshot_interval or opts.snapshot_dir \
             or opts.telemetry_dir or opts.trace_sample \
-            or opts.xprof_window or opts.rederive != "off":
+            or opts.xprof_window or opts.rederive != "off" \
+            or opts.error_feedback:
         print("--standbys/--tls-dir/--quorum/--bft-validators/"
               "--chaos-seed/--cells/--cell-size/--snapshot-interval/"
               "--snapshot-dir/--telemetry-dir/--trace-sample/"
-              "--xprof-window/--rederive apply to the processes "
-              "runtime; --attest-scores to mesh/executor",
+              "--xprof-window/--rederive/--error-feedback apply to the "
+              "processes runtime; --attest-scores to mesh/executor",
               file=sys.stderr)
         return 2
     if cfg is not None and opts.runtime != "processes":

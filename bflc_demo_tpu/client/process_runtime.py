@@ -54,17 +54,25 @@ _M_SPARSE_ENCODE = obs_metrics.REGISTRY.histogram(
     "client-side sparse delta encode (top-k select + pack) per upload")
 
 
-def _encode_delta(delta, cfg) -> bytes:
-    """The ONE client-side delta encoder: sparse top-k when the genome
-    arms it (--delta-density < 1; certified hash over the sparse
-    canonical bytes), else the unchanged quantized/dense pipeline —
-    sync loop, async loop and any future uploader share this so the
-    encodings can never drift apart (utils.serialization)."""
-    from bflc_demo_tpu.utils.serialization import (pack_pytree,
+def _encode_delta(delta, cfg, density: Optional[float] = None) -> bytes:
+    """The ONE client-side delta encoder: sparse (top-k or count-sketch,
+    the genome's delta_codec) when the genome arms it (--delta-density
+    < 1; certified hash over the sparse canonical bytes), else the
+    unchanged quantized/dense pipeline — sync loop, async loop and any
+    future uploader share this so the encodings can never drift apart
+    (utils.serialization).  `density` overrides the genome's static
+    value with the round's EFFECTIVE density when the closed
+    compression loop is armed (the writer's `state` reply carries it —
+    certified chain state, ledger.OP_GENOME)."""
+    from bflc_demo_tpu.utils.serialization import (delta_codec,
+                                                   pack_pytree,
                                                    pack_quantized,
                                                    pack_sparse,
                                                    sparse_enabled)
     if sparse_enabled(cfg):
+        dens = float(density) if density is not None \
+            else cfg.delta_density
+        codec = delta_codec(cfg)
         if obs_metrics.REGISTRY.enabled:
             # materialize the (possibly still-dispatching) jax leaves
             # BEFORE the timer: the encode metric must charge the
@@ -72,13 +80,76 @@ def _encode_delta(delta, cfg) -> bytes:
             import jax
             delta = jax.tree_util.tree_map(np.asarray, delta)
             t0 = time.perf_counter()
-            blob = pack_sparse(delta, cfg.delta_density,
-                               cfg.delta_dtype)
+            blob = pack_sparse(delta, dens, cfg.delta_dtype,
+                               codec=codec)
             _M_SPARSE_ENCODE.observe(time.perf_counter() - t0)
             return blob
-        return pack_sparse(delta, cfg.delta_density, cfg.delta_dtype)
+        return pack_sparse(delta, dens, cfg.delta_dtype, codec=codec)
     return (pack_pytree(delta) if cfg.delta_dtype == "f32"
             else pack_quantized(delta, cfg.delta_dtype))
+
+
+class _DeltaEncoder:
+    """Per-client stateful encode wrapper around `_encode_delta` — the
+    error-feedback half of the closed compression loop.
+
+    With --error-feedback / BFLC_ERROR_FEEDBACK=1 (and a lossy encode
+    armed; utils.serialization.error_feedback_enabled) the encoder
+    keeps, client-locally, exactly what the lossy encode DROPPED this
+    round: it runs the ONE shared decode inverse (densify ∘ dequantize)
+    over the just-packed blob and stores `compensated - decoded` — the
+    top-k/sketch truncation plus quantization rounding — then adds that
+    residual into the NEXT round's delta before encoding (EF-SGD
+    memory).  Nothing about the wire changes: the blob, the certified
+    hash the client signs, and every server-side guard are the plain
+    sparse/quantized protocol, so EF and non-EF clients interoperate on
+    one chain and --no-error-feedback pins today's bytes exactly.
+
+    The residual is only meaningful against a continuous model lineage:
+    callers pass the base epoch each delta was trained from, and any
+    discontinuity — a rejoin after a crash, an async base-epoch jump
+    past a skipped model version, a re-home onto another cell's chain
+    position — resets the memory (the dropped mass was measured against
+    updates that no longer compose with this base)."""
+
+    def __init__(self, cfg, template):
+        from bflc_demo_tpu.utils.serialization import \
+            error_feedback_enabled
+        self.cfg = cfg
+        self.template = template
+        self.armed = error_feedback_enabled(cfg)
+        self._residual = None           # template-shaped np pytree
+        self._next_base: Optional[int] = None
+
+    def reset(self) -> None:
+        self._residual = None
+        self._next_base = None
+
+    def encode(self, delta, *, base_epoch: int,
+               density: Optional[float] = None) -> bytes:
+        if not self.armed:
+            return _encode_delta(delta, self.cfg, density=density)
+        import jax
+
+        from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                       dequantize_entries,
+                                                       restore_pytree,
+                                                       unpack_pytree)
+        if self._next_base is not None and base_epoch != self._next_base:
+            self._residual = None       # lineage discontinuity
+        self._next_base = base_epoch + 1
+        delta = jax.tree_util.tree_map(np.asarray, delta)
+        if self._residual is not None:
+            delta = jax.tree_util.tree_map(
+                lambda d, r: (d + r).astype(d.dtype, copy=False),
+                delta, self._residual)
+        blob = _encode_delta(delta, self.cfg, density=density)
+        decoded = restore_pytree(self.template, densify_entries(
+            dequantize_entries(unpack_pytree(blob))))
+        self._residual = jax.tree_util.tree_map(
+            lambda d, q: np.asarray(d, np.float32)
+            - np.asarray(q, np.float32), delta, decoded)
+        return blob
 
 
 def _force_cpu_jax() -> None:
@@ -254,6 +325,11 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
     uploaded_base = cfg.initial_trained_epoch
     scored_aseqs: set = set()
     known_log = 0
+    # stateful encode wrapper (error-feedback residual; a no-op pass-
+    # through to _encode_delta when EF is disarmed).  An async BASE-
+    # EPOCH JUMP — the model advanced past versions this trainer never
+    # uploaded against — resets the residual inside encode().
+    enc = _DeltaEncoder(cfg, template)
     while True:
         st = client.request("state", addr=wallet.address)
         epoch = st["epoch"]
@@ -290,7 +366,8 @@ def _client_async_loop(client, router, wallet, model, template, cfg,
                     model.apply, params, xj, yj, lr=cfg.learning_rate,
                     batch_size=cfg.batch_size,
                     local_epochs=cfg.local_epochs)
-            blob = _encode_delta(delta, cfg)
+            blob = enc.encode(delta, base_epoch=base_epoch,
+                              density=st.get("eff_density"))
             digest = hashlib.sha256(blob).digest()
             router.cache.put(digest.hex(), blob)
             n = int(x.shape[0])
@@ -482,6 +559,11 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
 
     trained_epoch = scored_epoch = cfg.initial_trained_epoch
     known_log = 0
+    # stateful encode wrapper (error-feedback residual; pass-through
+    # when disarmed).  A missed training round — committee duty, a
+    # crash + rejoin, a cell re-home — shows up as an epoch gap and
+    # resets the residual inside encode().
+    enc = _DeltaEncoder(cfg, template)
     while True:
         st = client.request("state", addr=wallet.address)
         epoch = st["epoch"]
@@ -515,8 +597,11 @@ def _client_proc(endpoints: List[Tuple[str, int]], wallet_seed: bytes,
                     local_epochs=cfg.local_epochs)
             # opt-in sparse/quantized upload (utils.serialization): the
             # blob — and therefore the hash this client SIGNS and the
-            # quorum certifies — is the sparse/quantized canonical bytes
-            blob = _encode_delta(delta, cfg)
+            # quorum certifies — is the sparse/quantized canonical
+            # bytes, at the round's EFFECTIVE density when the closed
+            # loop is armed (the `state` reply carries it)
+            blob = enc.encode(delta, base_epoch=epoch,
+                              density=st.get("eff_density"))
             digest = hashlib.sha256(blob).digest()
             router.cache.put(digest.hex(), blob)
             n = int(x.shape[0])

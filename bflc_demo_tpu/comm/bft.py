@@ -433,11 +433,15 @@ def check_sparse_upload_op(op: bytes, auth: Optional[dict]) -> str:
     point of sparsification) blob whose sha256 equals the op's payload
     hash, and `densify_entries(dequantize_entries(...))` must accept
     it — so a colluding writer can no more certify a malformed `#topk`
-    blob than it can forge a client tag.  Validators hold no model
-    schema (that stays writer-side admission); what they pin is the
-    content binding plus the structural sparse contract: in-bounds,
-    strictly ascending, count-consistent indices.  Only call in sparse
-    mode — dense fleets carry no blob evidence and must not start."""
+    or `#sketch` blob than it can forge a client tag.  Validators hold
+    no model schema (that stays writer-side admission); what they pin
+    is the content binding plus the structural sparse contract —
+    in-bounds, strictly ascending, count-consistent indices for top-k
+    records; sane geometry, matching table size and bounded claimed
+    extent for count-sketch records (the records are self-describing,
+    so BOTH codecs re-execute through the one decode chain with no
+    codec switch here).  Only call in sparse mode — dense fleets carry
+    no blob evidence and must not start."""
     if not op or op[0] not in (_OP_UPLOAD, _OP_AUPLOAD):
         return ""
     body = op[1:]
@@ -1069,7 +1073,16 @@ class ValidatorNode:
         if self._rederiver is None or self._cell_registry is None \
                 or not op or op[0] != _OP_UPLOAD:
             return ""
-        return self._rederiver.check_cell(op, auth)
+        # the effective density at this replica's chain position rides
+        # along: with the closed loop armed, cell partials re-encode at
+        # the knob a certified genome-update op set, not the static
+        # genome value (a plain float read — no lock needed, and the
+        # genome op only moves it at round boundaries).  Static fleets
+        # pass None: the rederiver falls back to the genome knob.
+        from bflc_demo_tpu.ledger.base import adapt_enabled
+        eff = (float(self.ledger.effective_density)
+               if adapt_enabled(self.cfg) else None)
+        return self._rederiver.check_cell(op, auth, density=eff)
 
     def _snapshot_install(self, msg: dict) -> dict:
         """State-sync a REJOINING replica that lags below the writer's

@@ -146,6 +146,20 @@ _M_SPARSE_DECODE = obs_metrics.REGISTRY.histogram(
     "sparse_decode_seconds",
     "writer-side sparse delta decode (dequantize + densify) per "
     "admitted blob")
+# --- closed-loop compression (--adapt-every; ledger.OP_GENOME): the
+# LIVE effective knobs the certified genome schedule currently pins
+# (delta_density above already tracks the effective density), the epoch
+# of the last applied genome-update op, and how many the chain carries
+# — tools/fleet_top.py renders the writer-row adaptive panel off these.
+_G_EFF_STALENESS = obs_metrics.REGISTRY.gauge(
+    "effective_staleness",
+    "effective FedBuff max-staleness bound (certified genome schedule)")
+_G_GENOME_EPOCH = obs_metrics.REGISTRY.gauge(
+    "genome_epoch",
+    "epoch of the last applied genome-update op (-1: none yet)")
+_M_GENOME = obs_metrics.REGISTRY.counter(
+    "genome_updates_total",
+    "certified genome-update ops applied (closed-loop compression)")
 
 _PROMO_MAGIC = b"BFLCPROM1"
 
@@ -522,6 +536,15 @@ class LedgerServer:
         # (density 1.0 or BFLC_SPARSE_LEGACY=1) reject #topk entries as
         # the schema garbage they then are.
         self._sparse = sparse_enabled(cfg)
+        # closed-loop compression (--adapt-every N, control.loop): every
+        # N-th commit the writer proposes a certified genome-update op
+        # (opcode 13) retuning the EFFECTIVE delta density / staleness
+        # bound from the round's convergence telemetry; every validator
+        # re-runs the fixed rule and refuses a transition it cannot
+        # re-derive.  Off (N=0 or BFLC_ADAPT_LEGACY=1) pins the static
+        # knobs byte-for-byte.
+        from bflc_demo_tpu.ledger.base import adapt_enabled
+        self._adapt = adapt_enabled(cfg)
         # validator re-derivation plane (bflc_demo_tpu.rederive): when
         # armed, every commit/acommit op's auth evidence carries the
         # claimed NEW model blob (hash-bound to the op) plus the current
@@ -1359,8 +1382,10 @@ class LedgerServer:
                 addr = m["addr"]
                 self._touch(addr)
                 role, epoch = self.ledger.query_state(addr)
-                return {"ok": True, "role": role, "epoch": epoch,
-                        "round_closed": self.ledger.round_closed}
+                reply = {"ok": True, "role": role, "epoch": epoch,
+                         "round_closed": self.ledger.round_closed}
+                reply.update(self._state_knobs())
+                return reply
             if method == "upload":
                 if self._async:
                     # one protocol per chain: a client whose local
@@ -1541,6 +1566,14 @@ class LedgerServer:
                 if self._async:
                     reply["async_buffer_depth"] = \
                         self.ledger.async_buffer_depth
+                if self._adapt:
+                    reply["eff_density"] = \
+                        float(self.ledger.effective_density)
+                    reply["eff_staleness"] = \
+                        int(self.ledger.effective_staleness)
+                    ge = self.ledger.genome_epoch
+                    reply["genome_epoch"] = (-1 if ge is None
+                                             else int(ge))
                 reply["committee"] = self.ledger.committee()
                 snap = self._snapshot_offer()
                 if snap is not None:
@@ -1607,8 +1640,13 @@ class LedgerServer:
                                       else self.ledger.log_size()))
                     _G_SUBS.set(len(self._sub_acked))
                     _G_LOG_BASE.set(getattr(self.ledger, "log_base", 0))
-                    _G_DENSITY.set(self.cfg.delta_density
+                    _G_DENSITY.set(self._effective_density()
                                    if self._sparse else 1.0)
+                    if self._adapt:
+                        _G_EFF_STALENESS.set(
+                            self.ledger.effective_staleness)
+                        ge = self.ledger.genome_epoch
+                        _G_GENOME_EPOCH.set(-1 if ge is None else ge)
                     if self._async:
                         _G_ABUF_DEPTH.set(
                             self.ledger.async_buffer_depth)
@@ -1842,6 +1880,7 @@ class LedgerServer:
             st = self.ledger.async_commit(digest, epoch, k)
             if st != LedgerStatus.OK:
                 raise RuntimeError(f"async commit rejected: {st.name}")
+            self._propose_genome_if_due(global_flat, new_flat, epoch)
             if self._rederive:
                 self._stash_rederive(
                     blob, {e.payload_hash: self._blobs[e.payload_hash]
@@ -2137,6 +2176,66 @@ class LedgerServer:
                                   epoch=self.ledger.epoch):
             self._aggregate_and_commit_inner(t0)
 
+    def _effective_density(self) -> float:
+        """The delta density in force THIS epoch: the ledger's
+        effective knob when the adaptive loop is armed, the static
+        genome value otherwise."""
+        if self._adapt:
+            return float(self.ledger.effective_density)
+        return float(self.cfg.delta_density)
+
+    def _state_knobs(self) -> dict:
+        """Effective-knob section of a `state` reply: the knobs every
+        honest encoder must use THIS epoch (certified chain state —
+        ledger.OP_GENOME).  Clients override their genome density with
+        these; the hier cell tier overrides this hook to mirror the
+        ROOT's knobs downstream to its members."""
+        if not self._adapt:
+            return {}
+        return {"eff_density": float(self.ledger.effective_density),
+                "eff_staleness": int(self.ledger.effective_staleness)}
+
+    def _propose_genome_if_due(self, old_flat, new_flat,
+                               commit_epoch: int) -> None:
+        """Closed-loop knob retuning at the round boundary (lock held,
+        called immediately after a successful commit — no RPC can
+        observe the new epoch before the knob transition lands, so the
+        effective knobs are constant within every round at every chain
+        position).  The ledger's propose_genome runs the exact guard
+        chain every replica will re-run; a refusal here is surfaced,
+        never wedged."""
+        if not self._adapt or not self.ledger.genome_due():
+            return
+        from bflc_demo_tpu.control.loop import model_telemetry
+        norm, drift = model_telemetry(old_flat, new_flat)
+        old_d = float(self.ledger.effective_density)
+        old_s = int(self.ledger.effective_staleness)
+        disag = float(self.ledger.last_disagreement)
+        st = self.ledger.propose_genome(float(norm), float(drift))
+        if st != LedgerStatus.OK:
+            if self.verbose:
+                print(f"[coordinator] genome update refused: {st.name}",
+                      flush=True)
+            return
+        self._cv.notify_all()
+        _M_GENOME.inc()
+        obs_flight.FLIGHT.record(
+            "event", "genome_update", epoch=self.ledger.epoch,
+            commit_epoch=commit_epoch,
+            old_density=old_d,
+            new_density=float(self.ledger.effective_density),
+            old_staleness=old_s,
+            new_staleness=int(self.ledger.effective_staleness),
+            update_norm=float(norm), drift=float(drift),
+            disagreement=disag)
+        if self.verbose:
+            print(f"[coordinator] epoch {self.ledger.epoch} genome "
+                  f"update: density {old_d:g} -> "
+                  f"{self.ledger.effective_density:g}, staleness "
+                  f"{old_s} -> {self.ledger.effective_staleness} "
+                  f"(norm={norm:g} drift={drift:g} disag={disag:g})",
+                  flush=True)
+
     def _aggregate_and_commit_inner(self, t0: float) -> None:
         from bflc_demo_tpu.meshagg.engine import ENGINE
         pending = self.ledger.pending()
@@ -2191,6 +2290,7 @@ class LedgerServer:
         st = self.ledger.commit_model(digest, epoch)
         if st != LedgerStatus.OK:
             raise RuntimeError(f"commit rejected: {st.name}")
+        self._propose_genome_if_due(global_flat, new_flat, epoch)
         if self._rederive:
             self._stash_rederive(
                 blob, {u.payload_hash: self._blobs[u.payload_hash]

@@ -1,0 +1,15 @@
+"""Closed-loop compression control (ROADMAP item 3).
+
+One fixed, deterministic decision rule mapping certified convergence
+telemetry to the protocol's effective compression knobs — the policy
+half of the genome-update op (ledger opcode 13).  The rule lives here,
+OUTSIDE the ledger, because it is protocol law, not ledger mechanics:
+the writer proposes `decide(...)`'s output and every validator re-runs
+the same function over the same inputs inside `PyLedger.apply_op`,
+refusing BAD_ARG on any mismatch — the same trust shape as the BLK1
+geometry claim and the async reseat seating.  A writer therefore
+cannot certify a knob schedule the rule does not produce.
+"""
+
+from bflc_demo_tpu.control.loop import (decide, model_telemetry,  # noqa: F401
+                                        score_disagreement)

@@ -1,0 +1,117 @@
+"""The ONE fixed decision rule for the closed compression loop.
+
+Every function here is a pure, deterministic map over IEEE float32 /
+int64 values — the writer computes it once to PROPOSE a genome-update
+op, and every replica recomputes it inside `PyLedger.apply_op` to
+decide whether to accept that op.  Two honest hosts can therefore
+never disagree: all float arithmetic is quantized to float32 at every
+step (the same pinning discipline as `comm.bft.check_op_auth`), and
+the integer staleness arithmetic is exact.
+
+Telemetry inputs (the health plane's convergence axes, obs.health):
+
+- ``disagreement`` — mean per-candidate IQR of the committee's score
+  rows.  Derived HERE from certified chain state (the score ops every
+  validator co-signed), so the ledger re-derives it independently and
+  a writer cannot fabricate it.
+- ``update_norm`` / ``drift`` — L2 of the committed model step and its
+  size relative to the model.  These are model-plane writer claims
+  (the chain stores hashes, not tensors): replicas check finiteness
+  and rule-consistency, and the rederive plane (--rederive) holds the
+  committed bytes they summarize to account (PARITY.md).
+
+The rule itself (``decide``) is intentionally a coarse multiplicative
+ladder, not a tuned controller: knobs only ever move by x2 steps and
+clamp to genome bounds, so a single noisy round can cost at most one
+rung and the schedule is trivially auditable from the op stream.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+# rule thresholds (protocol law — changing one is a protocol change,
+# like editing an opcode body)
+DISAGREE_HIGH = np.float32(0.25)   # committee conflict: back off
+DISAGREE_LOW = np.float32(0.05)    # committee consensus: compress more
+DRIFT_HIGH = np.float32(2.0)       # step >> model: training unstable
+
+
+def score_disagreement(rows: Sequence[Sequence[float]]) -> np.float32:
+    """Mean per-candidate inter-quartile range across committee score
+    rows — the health plane's disagreement statistic re-stated as
+    protocol arithmetic (f64 percentiles, one f32 round at the end).
+    `rows` is [[member0's scores...], [member1's...], ...], every row
+    the same length; empty/ragged input scores 0.0 (nothing to
+    disagree about)."""
+    if not rows:
+        return np.float32(0.0)
+    k = len(rows[0])
+    if k == 0 or any(len(r) != k for r in rows):
+        return np.float32(0.0)
+    a = np.asarray([[float(s) for s in r] for r in rows], np.float64)
+    q75, q25 = np.percentile(a, [75.0, 25.0], axis=0)
+    return np.float32(np.mean(q75 - q25))
+
+
+def model_telemetry(old_flat, new_flat) -> Tuple[np.float32, np.float32]:
+    """(update_norm, drift) over a committed round's model step:
+    update_norm = ||new - old||_2, drift = update_norm / (||old||_2 +
+    1e-12) — f64 accumulation, one f32 round each.  Computed by the
+    writer at commit (it holds both blobs); carried on the genome op
+    as a finiteness-checked claim (module docstring)."""
+    sq_step = 0.0
+    sq_old = 0.0
+    for key in sorted(new_flat.keys()):
+        n = np.asarray(new_flat[key])
+        if not np.issubdtype(n.dtype, np.floating):
+            continue
+        o = np.asarray(old_flat[key], np.float64)
+        d = np.asarray(n, np.float64) - o
+        sq_step += float(np.sum(d * d))
+        sq_old += float(np.sum(o * o))
+    norm = np.float32(np.sqrt(sq_step))
+    drift = np.float32(np.sqrt(sq_step) / (np.sqrt(sq_old) + 1e-12))
+    return norm, drift
+
+
+def decide(eff_density: float, eff_staleness: int,
+           update_norm: float, drift: float, disagreement: float, *,
+           density_floor: float, density_cap: float,
+           staleness_cap: int) -> Tuple[np.float32, int]:
+    """(new_density, new_staleness) from the current effective knobs
+    and one round's telemetry — THE fixed rule (module docstring).
+
+    - Unhealthy round (non-finite telemetry, committee disagreement
+      above DISAGREE_HIGH, or drift above DRIFT_HIGH): BACK OFF —
+      double the density toward the genome cap (send more signal) and
+      halve the staleness bound toward 1 (admit fresher deltas only).
+    - Converging round (disagreement below DISAGREE_LOW): COMPRESS —
+      halve the density toward density_floor and recover the staleness
+      bound toward the genome cap.
+    - Anything in between: HOLD.
+
+    Density moves on an f32-quantized multiplicative ladder (x0.5 /
+    x2, clamped to [density_floor, density_cap]); staleness is exact
+    integer halving/doubling in [1, staleness_cap].  staleness_cap <= 0
+    (sync mode) pins staleness untouched."""
+    d = np.float32(eff_density)
+    s = int(eff_staleness)
+    floor = np.float32(density_floor)
+    cap = np.float32(density_cap)
+    unhealthy = (not np.isfinite(np.float32(update_norm))
+                 or not np.isfinite(np.float32(drift))
+                 or not np.isfinite(np.float32(disagreement))
+                 or np.float32(disagreement) > DISAGREE_HIGH
+                 or np.float32(drift) > DRIFT_HIGH)
+    if unhealthy:
+        d = np.float32(min(np.float32(d * np.float32(2.0)), cap))
+        if staleness_cap > 0:
+            s = max(s // 2, 1)
+    elif np.float32(disagreement) < DISAGREE_LOW:
+        d = np.float32(max(np.float32(d * np.float32(0.5)), floor))
+        if staleness_cap > 0:
+            s = min(max(s * 2, 1), int(staleness_cap))
+    return np.float32(d), int(s)

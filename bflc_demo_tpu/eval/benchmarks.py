@@ -1314,6 +1314,202 @@ def sparse_config1(rounds: int = 3, *, standbys: int = 2,
     return out
 
 
+def closed_loop_config1(rounds: int = 8, *, standbys: int = 0,
+                        validators: int = 4, quorum: int = 0,
+                        model_hidden: int = 4096,
+                        density: float = 0.01,
+                        adapt_start: float = 0.1,
+                        timeout_s: float = 900.0) -> Dict:
+    """Closed-loop compression benchmark (ISSUE 20): the sparse_config1
+    methodology with the legs the loop adds.
+
+    Five legs at the config-1 BFT fleet geometry (same fat MLP as
+    sparse_config1 so blob movement dominates the wire):
+
+    - `legacy_dense`: BFLC_DATA_PLANE_LEGACY=1 dense f32 — the egress
+      baseline every reduction ratio is taken against (the PR-5/PR-12
+      methodology; round-17's 23.1x was measured against this leg).
+    - `dense_f32`: fast-path dense — the accuracy reference.
+    - `sl_d{density}`: STATELESS sparse top-k at `density` — the PR-12
+      posture whose few-round accuracy trail (~0.11 behind dense at
+      density 0.01, TPU_RESULTS.md round 17) motivated the loop.
+    - `ef_d{density}`: the same density with BFLC_ERROR_FEEDBACK=1 —
+      client-local residual accumulation, byte-identical wire
+      protocol.  BFLC deltas are model differences re-measured against
+      the current global each round (core/local_train), so unapplied
+      movement self-corrects and EF's win here is FASTER CATCH-UP at a
+      fixed sparse density (rounds-to-0.85), not the dense-rate
+      equality plain-SGD EF theory promises for gradient deltas.
+    - `adaptive`: the certified genome-update loop (adapt_every=1,
+      density `adapt_start` decaying toward the `density` floor on the
+      fixed rule) — the leg that closes the EARLY-ROUND gap: it spends
+      bandwidth while the model is far from converged and ramps to the
+      floor as disagreement stabilizes.  Evidence: the effective
+      density actually MOVED mid-run (final_info's eff_density /
+      genome_epoch, served by the writer's certified ledger), every
+      round committed, and the replica replay inside
+      run_federated_processes re-derived the same head — i.e. zero
+      certification refusals on the honest path while the knob
+      transitioned.
+    """
+    import dataclasses
+
+    from bflc_demo_tpu.data import load_occupancy, iid_shards
+    from bflc_demo_tpu.obs.collector import load_timeline
+
+    cfg = DEFAULT_PROTOCOL
+    xtr, ytr, xte, yte = load_occupancy()
+    shards = iid_shards(xtr, ytr, cfg.client_num)
+    factory_kw = {"input_shape": (5,), "hidden": int(model_hidden),
+                  "num_classes": 2}
+
+    def _leg(run_cfg, *, error_feedback: bool = False,
+             legacy_plane: bool = False) -> Dict:
+        from bflc_demo_tpu.client.process_runtime import \
+            run_federated_processes
+        saved = {k: os.environ.get(k)
+                 for k in ("BFLC_PROC_TRACE", "BFLC_ERROR_FEEDBACK",
+                           "BFLC_DATA_PLANE_LEGACY")}
+        os.environ["BFLC_PROC_TRACE"] = "1"
+        if error_feedback:
+            os.environ["BFLC_ERROR_FEEDBACK"] = "1"
+        else:
+            os.environ.pop("BFLC_ERROR_FEEDBACK", None)
+        if legacy_plane:
+            os.environ["BFLC_DATA_PLANE_LEGACY"] = "1"
+        else:
+            os.environ.pop("BFLC_DATA_PLANE_LEGACY", None)
+        try:
+            with tempfile.TemporaryDirectory(
+                    prefix="bflc-closed-loop-bench-") as td:
+                res = run_federated_processes(
+                    "make_mlp", shards, (xte, yte), run_cfg,
+                    rounds=rounds, factory_kw=factory_kw,
+                    standbys=standbys, quorum=quorum,
+                    bft_validators=validators,
+                    wal_path=os.path.join(td, "writer.wal"),
+                    telemetry_dir=os.path.join(td, "telemetry"),
+                    timeout_s=timeout_s)
+                timeline = load_timeline(res.telemetry_report["jsonl"]) \
+                    if res.telemetry_report else []
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        info = res.final_info or {}
+        costs = (info.get("perf") or {}).get("costs", {})
+        rounds_done = max(res.rounds_completed, 1)
+        # time-to-quality: first committed epoch whose sponsor accuracy
+        # reached 0.85 (None: never in this leg's budget) — the
+        # trendable rounds-to-target axis (tools/bench_trend.py)
+        to_target = next((int(e) for e, a in res.accuracy_history
+                          if a >= 0.85), None)
+        # the early-round criterion: sponsor accuracy after the 3rd
+        # committed round (None when the leg died before round 3)
+        acc3 = next((round(float(a), 4)
+                     for e, a in res.accuracy_history if int(e) == 3),
+                    None)
+        out = {
+            "density": float(run_cfg.delta_density),
+            "adapt_every": int(run_cfg.adapt_every),
+            "error_feedback": bool(error_feedback),
+            "rounds": res.rounds_completed,
+            "best_acc": round(res.best_accuracy(), 4),
+            "acc_at_3": acc3,
+            "rounds_to_085": to_target,
+            "writer_egress_bytes_per_round": int(
+                _writer_egress_per_round(
+                    timeline, float(costs.get("wire.bytes_out", 0.0)),
+                    rounds_done)),
+            "log_head": info.get("log_head"),
+            # the replica replay re-derived the committed head from the
+            # raw op stream — the zero-refusal / integrity evidence
+            "replica_verified": res.replica_report is not None,
+        }
+        if "eff_density" in info:
+            out["eff_density_final"] = info["eff_density"]
+            out["genome_epoch"] = info.get("genome_epoch")
+        return out
+
+    legs: Dict[str, Dict] = {
+        "legacy_dense": _leg(
+            dataclasses.replace(cfg, delta_density=1.0),
+            legacy_plane=True),
+        "dense_f32": _leg(dataclasses.replace(cfg, delta_density=1.0)),
+        f"sl_d{density:g}": _leg(
+            dataclasses.replace(cfg, delta_density=float(density))),
+        f"ef_d{density:g}": _leg(
+            dataclasses.replace(cfg, delta_density=float(density)),
+            error_feedback=True),
+        "adaptive": _leg(
+            dataclasses.replace(cfg, delta_density=float(adapt_start),
+                                adapt_every=1,
+                                density_floor=float(density))),
+    }
+    out: Dict = {
+        "geometry": {"clients": cfg.client_num, "standbys": standbys,
+                     "validators": validators, "quorum": quorum,
+                     "rounds": rounds, "model": "mlp",
+                     "model_hidden": int(model_hidden),
+                     "density": float(density),
+                     "adapt_start": float(adapt_start)},
+        "legs": legs,
+    }
+    legacy, dense = legs["legacy_dense"], legs["dense_f32"]
+    sl = legs[f"sl_d{density:g}"]
+    ef, ad = legs[f"ef_d{density:g}"], legs["adaptive"]
+
+    def _ratio(leg):
+        b = leg["writer_egress_bytes_per_round"]
+        base = legacy["writer_egress_bytes_per_round"]
+        return round(base / b, 2) if b and base else None
+
+    # egress ratios vs the legacy dense plane (PR-12 methodology)
+    out["egress_reduction_ef_x"] = _ratio(ef)
+    out["egress_reduction_adaptive_x"] = _ratio(ad)
+    out["egress_reduction_fast_dense_x"] = _ratio(dense)
+
+    def _gap3(leg):
+        if dense["acc_at_3"] is None or leg["acc_at_3"] is None:
+            return None
+        return round(dense["acc_at_3"] - leg["acc_at_3"], 4)
+
+    # the early-round trail at the 3rd committed round (the ~0.11
+    # stateless number the loop exists to govern)
+    out["acc_gap_stateless"] = _gap3(sl)
+    out["acc_gap_ef"] = _gap3(ef)
+    out["acc_gap_adaptive"] = _gap3(ad)
+    # how much of the stateless trail the EF leg recovered at round 3
+    if out["acc_gap_stateless"] is not None \
+            and out["acc_gap_ef"] is not None:
+        out["acc_catch_up"] = round(
+            out["acc_gap_stateless"] - out["acc_gap_ef"], 4)
+    out["rounds_to_085_dense"] = dense["rounds_to_085"]
+    out["rounds_to_085_stateless"] = sl["rounds_to_085"]
+    out["rounds_to_085_ef"] = ef["rounds_to_085"]
+    out["rounds_to_085_adaptive"] = ad["rounds_to_085"]
+    # EF's honest win at a fixed sparse density: rounds-to-target saved
+    # vs the stateless PR-12 posture
+    if sl["rounds_to_085"] is not None and ef["rounds_to_085"] is not None:
+        out["ef_rounds_saved"] = sl["rounds_to_085"] - ef["rounds_to_085"]
+    # the matched-accuracy qualifier: the best egress ratio among legs
+    # that stayed within 0.02 of dense at round 3
+    matched = [r for r, g in ((_ratio(leg), _gap3(leg))
+                              for leg in (sl, ef, ad))
+               if r is not None and g is not None and g <= 0.02]
+    if matched:
+        out["egress_reduction_at_matched_acc_x"] = max(matched)
+    out["adaptive_density_moved"] = (
+        ad.get("eff_density_final") is not None
+        and ad["eff_density_final"] < float(adapt_start)
+        and ad.get("genome_epoch") is not None)
+    out["adaptive_honest_path_clean"] = (
+        ad["rounds"] == rounds and ad["replica_verified"])
+    return out
+
+
 # ------------------------------------------- hierarchical federation (PR 6)
 def _flat_entries(template):
     """[(keystr, leaf_index)] of a pytree template — the canonical entry

@@ -124,6 +124,12 @@ class CellAggregatorServer(LedgerServer):
         self._outbox: Optional[dict] = None
         self._partial_epoch: Optional[int] = None
         self._bridge_thread: Optional[threading.Thread] = None
+        # the ROOT's effective delta density, mirrored off its `state`
+        # replies when the closed compression loop is armed there
+        # (comm.ledger_service._state_knobs); None = static genome knob.
+        # Governs the cell->root partial re-encode AND what this
+        # aggregator serves its own members in their `state` replies.
+        self._root_eff_density: Optional[float] = None
         if obs_metrics.REGISTRY.enabled:
             _G_CELL.set(cell_index)
 
@@ -172,18 +178,27 @@ class CellAggregatorServer(LedgerServer):
                 [float(m) for m in pending.medians],
                 list(pending.selected))
             # sparse mode: re-sparsify the dense partial for the
-            # cell->root bridge hop (hier.partial.partial_blob)
+            # cell->root bridge hop (hier.partial.partial_blob) — at
+            # the ROOT's effective density when the closed loop is
+            # armed there (the root's validators re-encode with the
+            # same effective knob; rederive.core.check_cell)
             blob = partial_blob(partial, self.cell_index, n_clients,
                                 evidence,
-                                density=(self.cfg.delta_density
+                                density=(self._bridge_density()
                                          if self._sparse else 1.0))
         # the member's trace context (ambient here: the partial computes
         # inside the triggering member's scores dispatch) rides the
         # outbox so the BRIDGE upload to the root continues the same
-        # trace one tier up (obs.trace; None when untraced)
+        # trace one tier up (obs.trace; None when untraced).  The dense
+        # partial + evidence digest ride along so the bridge can
+        # RE-encode at the root's then-current effective density
+        # (_outbox_blob) if a genome op lands before the upload.
         self._outbox = {"epoch": epoch, "blob": blob, "n": n_clients,
                         "cost": mean_cost,
                         "hash": hashlib.sha256(blob).digest(),
+                        "partial": partial, "ev": evidence,
+                        "enc_density": (self._bridge_density()
+                                        if self._sparse else 1.0),
                         "tp": (obs_trace.TRACE.current_traceparent()
                                if obs_trace.TRACE.enabled else None)}
         if self._rederive:
@@ -327,6 +342,46 @@ class CellAggregatorServer(LedgerServer):
                       f"{type(e).__name__}: {e}", flush=True)
 
     # ------------------------------------------------------ root bridge
+    def _bridge_density(self) -> float:
+        """Density for the cell->root partial re-encode: the root's
+        mirrored effective knob when its closed loop is armed, else the
+        static genome value."""
+        ed = self._root_eff_density
+        return float(ed) if ed is not None \
+            else float(self.cfg.delta_density)
+
+    def _state_knobs(self) -> dict:
+        """Serve MEMBERS the root's mirrored effective density (the
+        cell ledger runs no control loop of its own — hier.cells
+        .cell_protocol zeroes adapt_every): a member's next upload then
+        encodes at the same knob the whole hierarchy agreed on."""
+        ed = self._root_eff_density
+        if ed is None:
+            return super()._state_knobs()
+        return {"eff_density": float(ed)}
+
+    def _effective_density(self) -> float:
+        """The scrape gauge (tools/fleet_top.py) shows the LIVE knob
+        this cell admits/encodes at — the root's mirrored effective
+        density, not the static genome value."""
+        return self._bridge_density()
+
+    def _outbox_blob(self, outbox: dict) -> Tuple[bytes, bytes]:
+        """(blob, hash) for this outbox at the density in force NOW
+        (the mirror updated this very loop iteration): a genome op
+        landing between partial compute and bridge upload would
+        otherwise leave the cell encoded at the previous round's knob,
+        and the root's re-derivers — who re-encode at the CERTIFIED
+        effective density — would refuse an honest cell."""
+        dens = self._bridge_density() if self._sparse else 1.0
+        if outbox.get("enc_density") != dens:
+            outbox["blob"] = partial_blob(
+                outbox["partial"], self.cell_index, outbox["n"],
+                outbox["ev"], density=dens)
+            outbox["hash"] = hashlib.sha256(outbox["blob"]).digest()
+            outbox["enc_density"] = dens
+        return outbox["blob"], outbox["hash"]
+
     def _sign(self, kind: str, epoch: int, payload: bytes) -> str:
         return self.wallet.sign(_op_bytes(
             kind, self.wallet.address, epoch, payload)).hex()
@@ -455,6 +510,10 @@ class CellAggregatorServer(LedgerServer):
                     st = client.request("state",
                                         addr=self.wallet.address)
                     repoch = st["epoch"]
+                    ed = st.get("eff_density")
+                    self._root_eff_density = (float(ed)
+                                              if ed is not None
+                                              else None)
                     if repoch < 0:      # root still enrolling cells
                         known_log = client.request(
                             "wait", log_size=known_log,
@@ -466,7 +525,7 @@ class CellAggregatorServer(LedgerServer):
                     if st["role"] == "trainer" and outbox is not None \
                             and outbox["epoch"] == repoch \
                             and repoch > submitted_epoch:
-                        digest = outbox["hash"]
+                        blob, digest = self._outbox_blob(outbox)
                         payload = digest + struct.pack(
                             "<qd", outbox["n"], float(outbox["cost"]))
                         t0 = time.perf_counter()
@@ -479,7 +538,7 @@ class CellAggregatorServer(LedgerServer):
                                 epoch=repoch, cell=self.cell_index):
                             r = client.request(
                                 "upload", addr=self.wallet.address,
-                                blob=outbox["blob"], hash=digest.hex(),
+                                blob=blob, hash=digest.hex(),
                                 n=outbox["n"],
                                 cost=float(outbox["cost"]),
                                 epoch=repoch,

@@ -101,9 +101,15 @@ def cell_protocol(cfg: ProtocolConfig, n_members: int) -> ProtocolConfig:
     comm = max(1, min(cfg.comm_count, n_members // 2, n_members - 1))
     needed = max(1, min(cfg.needed_update_count, n_members - comm))
     agg = max(1, min(cfg.aggregate_count, needed))
+    # the closed compression loop runs at the ROOT only: the cell tier
+    # never proposes genome-update ops of its own — the aggregator
+    # mirrors the root's effective knobs downstream to its members
+    # (CellAggregatorServer._state_knobs), so exactly one certified
+    # schedule governs the whole hierarchy
     return dataclasses.replace(
         cfg, client_num=n_members, comm_count=comm,
-        needed_update_count=needed, aggregate_count=agg).validate()
+        needed_update_count=needed, aggregate_count=agg,
+        adapt_every=0).validate()
 
 
 def root_protocol(cfg: ProtocolConfig, n_cells: int) -> ProtocolConfig:

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 from bflc_demo_tpu.ledger.base import (  # noqa: F401
     LedgerStatus, UpdateInfo, PendingInfo, AsyncUpdateInfo, ADDR_CAP,
-    async_enabled, async_legacy, blocked_enabled, blocked_legacy,
-    reduce_blocks, staleness_weight)
+    adapt_enabled, adapt_legacy, async_enabled, async_legacy,
+    blocked_enabled, blocked_legacy, reduce_blocks, staleness_weight)
 from bflc_demo_tpu.ledger.pyledger import PyLedger  # noqa: F401
 from bflc_demo_tpu.protocol.constants import ProtocolConfig, DEFAULT_PROTOCOL
 
@@ -28,24 +28,31 @@ def make_ledger(cfg: ProtocolConfig = DEFAULT_PROTOCOL, *,
     (cfg.reduce_blocks > 1, REDUCTION SPEC v2, unless
     BFLC_BLOCKED_LEGACY pins it off) is gated the same way: commit ops
     carry a geometry-claim tail the native OP_COMMIT parser has no ABI
-    for."""
+    for.  The closed compression loop (cfg.adapt_every > 0, unless
+    BFLC_ADAPT_LEGACY pins it off) is gated the same way again: the
+    genome-update op (opcode 13) has no native ABI."""
     cfg.validate()
     args = (cfg.client_num, cfg.comm_count, cfg.aggregate_count,
             cfg.needed_update_count, cfg.genesis_epoch)
     blocks = reduce_blocks(cfg)
-    if async_enabled(cfg) or blocks > 1:
+    if async_enabled(cfg) or blocks > 1 or adapt_enabled(cfg):
         if backend == "native":
             raise ValueError(
-                "async_buffer > 0 / reduce_blocks > 1 need the python "
-                "ledger backend (the native ledger has no async-op or "
-                "geometry-claim ABI)")
+                "async_buffer > 0 / reduce_blocks > 1 / adapt_every > 0 "
+                "need the python ledger backend (the native ledger has "
+                "no async-op, geometry-claim or genome-update ABI)")
+        kw = {}
+        if adapt_enabled(cfg):
+            kw = dict(delta_density=cfg.delta_density,
+                      density_floor=cfg.density_floor,
+                      adapt_every=cfg.adapt_every)
         if not async_enabled(cfg):
-            return PyLedger(*args, reduce_blocks=blocks)
+            return PyLedger(*args, reduce_blocks=blocks, **kw)
         return PyLedger(*args, async_buffer=cfg.async_buffer,
                         max_staleness=cfg.max_staleness,
                         async_reseat_every=getattr(
                             cfg, "async_reseat_every", 0),
-                        reduce_blocks=blocks)
+                        reduce_blocks=blocks, **kw)
     if backend in ("auto", "native"):
         from bflc_demo_tpu.ledger import bindings
         if bindings.native_available():

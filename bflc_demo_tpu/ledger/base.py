@@ -33,6 +33,10 @@ OP_REGISTER, OP_UPLOAD, OP_SCORES = 1, 2, 3
 # native ledger never applies these (make_ledger gates them out), so the
 # C++ opcode table stays untouched and chain-compatible for sync chains.
 OP_AUPLOAD, OP_ASCORES, OP_ACOMMIT = 10, 11, 12
+# certified genome update (closed-loop compression, ROADMAP item 3):
+# python-backend-only like the async family — make_ledger gates the
+# native ledger out, so the C++ opcode table stays untouched.
+OP_GENOME = 13
 
 
 def async_legacy() -> bool:
@@ -75,6 +79,22 @@ def blocked_enabled(cfg) -> bool:
     """True when commit ops carry (and replicas enforce) a block
     geometry claim — i.e. the chain speaks the v2 wire format."""
     return reduce_blocks(cfg) > 1
+
+
+def adapt_legacy() -> bool:
+    """True when BFLC_ADAPT_LEGACY pins the static compression knobs
+    regardless of ProtocolConfig.adapt_every (the closed-loop rollback
+    switch: no genome-update op is ever proposed or accepted, effective
+    knobs stay the genome's, bytes match the pre-loop protocol)."""
+    return bool(os.environ.get("BFLC_ADAPT_LEGACY"))
+
+
+def adapt_enabled(cfg) -> bool:
+    """The ONE decision point for the adaptive control loop: a positive
+    adapt interval in the protocol genome AND no legacy pin.  Shared by
+    make_ledger, the writer, the clients, the cells and the tools so no
+    layer can disagree about whether knobs may move mid-run."""
+    return getattr(cfg, "adapt_every", 0) > 0 and not adapt_legacy()
 
 
 def staleness_weight(staleness: int) -> float:
@@ -144,6 +164,27 @@ def encode_ascores_op(sender: str,
     for aseq, s in pairs:
         op += struct.pack("<q", int(aseq))
         op += struct.pack("<f", np.float32(s))
+    return bytes(op)
+
+
+def encode_genome_op(epoch: int, new_density: float, new_staleness: int,
+                     update_norm: float, drift: float,
+                     disagreement: float) -> bytes:
+    """Genome update (opcode 13): the writer's PROPOSED effective-knob
+    transition plus the telemetry inputs it derived it from.  Every
+    replica re-runs the fixed rule (control.loop.decide) over the
+    carried inputs, re-derives `disagreement` from its own certified
+    score state, and refuses BAD_ARG on any mismatch — so the op binds
+    the schedule to the rule, not to the writer's word.  All floats
+    store f32 (the op is canonical bytes; f32 is the protocol's pinned
+    precision everywhere else on the chain)."""
+    op = bytearray([OP_GENOME])
+    op += struct.pack("<q", int(epoch))
+    op += struct.pack("<f", np.float32(new_density))
+    op += struct.pack("<q", int(new_staleness))
+    op += struct.pack("<f", np.float32(update_norm))
+    op += struct.pack("<f", np.float32(drift))
+    op += struct.pack("<f", np.float32(disagreement))
     return bytes(op)
 
 

@@ -16,10 +16,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bflc_demo_tpu.control.loop import decide, score_disagreement
 from bflc_demo_tpu.ledger.base import (AsyncUpdateInfo, LedgerStatus,
                                        PendingInfo, UpdateInfo,
                                        encode_aupload_op,
                                        encode_ascores_op,
+                                       encode_genome_op,
                                        encode_register_op,
                                        encode_scores_op, encode_upload_op,
                                        staleness_weight)
@@ -30,6 +32,9 @@ _OP_SNAPSHOT = 9
 # asynchronous buffered aggregation (FedBuff op family — python backend
 # only; ledger/base.py OP_AUPLOAD/OP_ASCORES/OP_ACOMMIT)
 _OP_AUPLOAD, _OP_ASCORES, _OP_ACOMMIT = 10, 11, 12
+# certified genome update (closed-loop compression — python backend
+# only; ledger/base.py OP_GENOME)
+_OP_GENOME = 13
 
 
 def _put_str(b: bytearray, s: str) -> None:
@@ -61,7 +66,9 @@ class PyLedger:
     def __init__(self, client_num: int, comm_count: int, aggregate_count: int,
                  needed_update_count: int, genesis_epoch: int = -999,
                  async_buffer: int = 0, max_staleness: int = 20,
-                 async_reseat_every: int = 0, reduce_blocks: int = 1):
+                 async_reseat_every: int = 0, reduce_blocks: int = 1,
+                 delta_density: float = 1.0, density_floor: float = 0.01,
+                 adapt_every: int = 0):
         self.client_num = client_num
         self.comm_count = comm_count
         self.aggregate_count = aggregate_count
@@ -88,6 +95,24 @@ class PyLedger:
         # this value refuses BAD_ARG, so a lying writer's commit dies at
         # every honest replica (and therefore at the BFT quorum).
         self.reduce_blocks = max(int(reduce_blocks), 1)
+        # closed-loop compression (ProtocolConfig.adapt_every, flattened
+        # through ledger.base.adapt_enabled so BFLC_ADAPT_LEGACY pins 0).
+        # The genome's delta_density/density_floor are CONSTANTS (rule
+        # bounds); the EFFECTIVE knobs are mutable protocol state moved
+        # only by certified genome-update ops (opcode 13) — they ride
+        # _snapshot()/state bytes so every replica agrees on the knob
+        # values at every chain position.
+        self.adapt_every = max(int(adapt_every), 0)
+        self.delta_density = float(delta_density)
+        self.density_floor = float(density_floor)
+        self._eff_density = float(delta_density)
+        self._eff_staleness = self.max_staleness
+        self._genome_epoch: Optional[int] = None
+        # committee disagreement of the last committed round (f32; the
+        # re-derivable telemetry input of the genome op), captured at
+        # commit BEFORE the score buffers clear — on the writer and on
+        # every replica alike, because both run the same commit path
+        self._last_disagreement = 0.0
         self._acommit_count = 0
         self._abuf: List[AsyncUpdateInfo] = []
         self._ascores: Dict[int, Dict[str, float]] = {}
@@ -535,6 +560,12 @@ class PyLedger:
                           if self.reduce_blocks > 1 else None)
         if blocks is not _DERIVE_BLOCKS and blocks != derived_blocks:
             return LedgerStatus.BAD_ARG
+        if self.adapt_every:
+            # capture the round's committee disagreement before the
+            # score buffers clear: the certified telemetry input the
+            # next genome-update op must match (control.loop docstring)
+            self._last_disagreement = float(
+                score_disagreement(self.committee_score_rows()))
         self._model_hash = bytes(new_model_hash)
         self._last_loss = self._pending.global_loss
         for a in self._roles:
@@ -580,8 +611,10 @@ class PyLedger:
         if base_epoch < 0 or base_epoch > self._epoch:
             return LedgerStatus.BAD_ARG     # trained on the future
         # staleness stamped HERE — deterministic: every replica applies
-        # this op at the same chain position, hence the same epoch
-        if self._epoch - base_epoch > self.max_staleness:
+        # this op at the same chain position, hence the same epoch.
+        # The EFFECTIVE bound gates (== max_staleness until a certified
+        # genome-update op tightens it; ledger.base.OP_GENOME)
+        if self._epoch - base_epoch > self._eff_staleness:
             return LedgerStatus.WRONG_EPOCH
         if any(e.sender == sender for e in self._abuf):
             return LedgerStatus.DUPLICATE   # one in-flight delta/sender
@@ -749,6 +782,17 @@ class PyLedger:
                     return LedgerStatus.BAD_ARG
             elif claimed is not None:
                 return LedgerStatus.BAD_ARG
+        if self.adapt_every:
+            # async twin of commit_model's disagreement capture: a
+            # scorer×entry matrix over the drained window, complete
+            # rows only in sorted scorer order (the committee_score_
+            # rows discipline) — deterministic on every replica
+            maps = [self._ascores.get(e.aseq, {})
+                    for e in self._abuf[:k]]
+            scorers = sorted({s for m in maps for s in m})
+            self._last_disagreement = float(score_disagreement(
+                [[m[s] for m in maps] for s in scorers
+                 if all(s in m for m in maps)]))
         _, _, _, loss = self.async_selection(k)
         for e in self._abuf[:k]:
             self._ascores.pop(e.aseq, None)
@@ -779,6 +823,109 @@ class PyLedger:
             op += _BLOCKS_MAGIC + struct.pack("<q", derived_blocks)
         self._append_log(bytes(op))
         return LedgerStatus.OK
+
+    # --- certified genome update (closed-loop compression) ------------
+    # The writer retunes the EFFECTIVE compression knobs from one
+    # round's convergence telemetry — but only through an op every
+    # replica re-validates: the fixed rule (control.loop.decide) is
+    # re-executed over the op's carried inputs, and the disagreement
+    # input is re-derived from this replica's own certified score
+    # state.  Any mismatch refuses BAD_ARG before state mutates, the
+    # exact trust shape of the BLK1 geometry claim and the async
+    # reseat seating — a writer cannot certify a knob schedule the
+    # rule does not produce from telemetry the chain does not support.
+
+    def genome_due(self) -> bool:
+        """Would a genome-update op be accepted at the CURRENT epoch?
+        Pure function of certified state — the writer's proposal gate
+        and the tools' schedule oracle."""
+        return (self.adapt_every > 0
+                and self._epoch != self.genesis_epoch
+                and self._epoch > 0
+                and self._epoch % self.adapt_every == 0
+                and self._genome_epoch != self._epoch)
+
+    def propose_genome(self, update_norm: float,
+                       drift: float) -> LedgerStatus:
+        """Writer path: derive the knob transition from the fixed rule
+        over this ledger's own state + the round's model telemetry, and
+        append it (genome_update runs the same checks a replica will)."""
+        nd, ns = decide(
+            self._eff_density, self._eff_staleness, update_norm, drift,
+            self._last_disagreement, density_floor=self.density_floor,
+            density_cap=self.delta_density,
+            staleness_cap=self.max_staleness if self.async_buffer else 0)
+        return self.genome_update(self._epoch, float(nd), int(ns),
+                                  update_norm, drift,
+                                  self._last_disagreement)
+
+    def genome_update(self, epoch: int, new_density: float,
+                      new_staleness: int, update_norm: float,
+                      drift: float, disagreement: float) -> LedgerStatus:
+        """Validate-and-apply a genome-update claim (writer append AND
+        replica replay — one guard set, so the quorum's co-signature is
+        an independent re-derivation):
+
+        - armed + on-schedule: the op only exists at epochs that are
+          positive multiples of adapt_every, at most once per epoch,
+          and only at the round boundary (no sync round in flight), so
+          the effective knobs are constant within a round at every
+          chain position;
+        - ``disagreement`` must equal this replica's own capture from
+          the certified score ops, bit-exact in f32;
+        - (new_density, new_staleness) must equal the fixed rule's
+          output over the carried telemetry — a writer proposing any
+          other transition (or lying about the rule inputs it claims
+          to have derived it from) dies here at every honest replica.
+        Non-finite update_norm/drift claims are legal inputs: the rule
+        maps them to its back-off arm deterministically."""
+        if not self.adapt_every:
+            return LedgerStatus.BAD_ARG     # static chain: op family off
+        if self._epoch == self.genesis_epoch:
+            return LedgerStatus.NOT_STARTED
+        if epoch != self._epoch:
+            return LedgerStatus.WRONG_EPOCH
+        if self._epoch <= 0 or self._epoch % self.adapt_every != 0:
+            return LedgerStatus.BAD_ARG     # off-schedule
+        if self._genome_epoch == self._epoch:
+            return LedgerStatus.DUPLICATE   # one transition per epoch
+        if self._updates or self._scores or self._pending is not None:
+            return LedgerStatus.NOT_READY   # mid-round: boundary only
+        if np.float32(disagreement) != np.float32(self._last_disagreement):
+            return LedgerStatus.BAD_ARG     # fabricated telemetry
+        nd, ns = decide(
+            self._eff_density, self._eff_staleness, update_norm, drift,
+            disagreement, density_floor=self.density_floor,
+            density_cap=self.delta_density,
+            staleness_cap=self.max_staleness if self.async_buffer else 0)
+        if np.float32(new_density) != nd or int(new_staleness) != ns:
+            return LedgerStatus.BAD_ARG     # not the rule's output
+        self._eff_density = float(nd)
+        self._eff_staleness = int(ns)
+        self._genome_epoch = self._epoch
+        self._append_log(encode_genome_op(epoch, nd, ns, update_norm,
+                                          drift, disagreement))
+        return LedgerStatus.OK
+
+    @property
+    def effective_density(self) -> float:
+        """The density every honest encoder/validator uses THIS epoch
+        (the genome's delta_density until a genome-update op moves it)."""
+        return self._eff_density
+
+    @property
+    def effective_staleness(self) -> int:
+        """The staleness bound async_upload gates on THIS epoch."""
+        return self._eff_staleness
+
+    @property
+    def last_disagreement(self) -> float:
+        return self._last_disagreement
+
+    @property
+    def genome_epoch(self) -> Optional[int]:
+        """Epoch of the last applied genome-update op (None: never)."""
+        return self._genome_epoch
 
     def async_buffer_view(self) -> List[AsyncUpdateInfo]:
         """Current buffered entries, admission order (the committee's
@@ -891,6 +1038,17 @@ class PyLedger:
         acommits = (self._acommit_count
                     if self.async_buffer and self.async_reseat_every
                     else None)
+        # the closed-loop tail (effective knobs + disagreement capture)
+        # is a third optional section, emitted ONLY when the adaptive
+        # mode is armed: static chains keep their exact legacy state
+        # bytes, and a restored replica needs the knobs or it would
+        # disagree on every later density/staleness-dependent check
+        genome = None
+        if self.adapt_every:
+            genome = (self._eff_density, self._eff_staleness,
+                      -1 if self._genome_epoch is None
+                      else self._genome_epoch,
+                      self._last_disagreement)
         return encode_state_dict({
             "epoch": self._epoch, "model_hash": self._model_hash,
             "last_loss": self._last_loss,
@@ -900,7 +1058,7 @@ class PyLedger:
             "updates": [(u.sender, u.payload_hash, u.n_samples,
                          u.avg_cost) for u in self._updates],
             "scores": self._scores, "pending": pend, "async": asy,
-            "async_acommits": acommits})
+            "async_acommits": acommits, "genome": genome})
 
     def state_digest(self) -> bytes:
         """SHA-256 of the canonical state — what a snapshot op embeds
@@ -949,6 +1107,18 @@ class PyLedger:
                                       for k, v in r.items()}
                              for a, r in rows.items()}
         self._acommit_count = int(d.get("async_acommits") or 0)
+        genome = d.get("genome")
+        if genome is None:
+            self._eff_density = self.delta_density
+            self._eff_staleness = self.max_staleness
+            self._genome_epoch = None
+            self._last_disagreement = 0.0
+        else:
+            dens, stale, gep, disag = genome
+            self._eff_density = float(dens)
+            self._eff_staleness = int(stale)
+            self._genome_epoch = None if int(gep) < 0 else int(gep)
+            self._last_disagreement = float(disag)
         self._ops = []
         self._log = []
         self._base = int(base)
@@ -1000,14 +1170,19 @@ class PyLedger:
                 self._writer_index,
                 list(self._abuf),
                 {k: dict(v) for k, v in self._ascores.items()},
-                self._aseq_next, self._acommit_count, len(self._ops))
+                self._aseq_next, self._acommit_count,
+                self._eff_density, self._eff_staleness,
+                self._genome_epoch, self._last_disagreement,
+                len(self._ops))
 
     def _restore(self, snap) -> None:
         (self._epoch, self._model_hash, self._last_loss, self._reg_order,
          self._roles, self._updates, self._update_slot, self._scores,
          self._pending, self._closed, self._generation,
          self._writer_index, self._abuf, self._ascores,
-         self._aseq_next, self._acommit_count, n_ops) = snap
+         self._aseq_next, self._acommit_count,
+         self._eff_density, self._eff_staleness,
+         self._genome_epoch, self._last_disagreement, n_ops) = snap
         del self._ops[n_ops:]
         del self._log[n_ops:]
 
@@ -1161,6 +1336,22 @@ class PyLedger:
                         return LedgerStatus.BAD_ARG
                 return self.async_commit(payload, ep, k, seats,
                                          blocks=claim)
+            if code == _OP_GENOME:
+                # strict 32-byte body: <q epoch><f density><q staleness>
+                # <f update_norm><f drift><f disagreement> — f32 fields
+                # round-trip bit-exactly through unpack/repack, so the
+                # replayed append reproduces the writer's op bytes and
+                # the hash chain stays identical
+                if len(body) != 32:
+                    return LedgerStatus.BAD_ARG
+                ep, = struct.unpack_from("<q", body, 0)
+                dens, = struct.unpack_from("<f", body, 8)
+                stale, = struct.unpack_from("<q", body, 12)
+                norm, = struct.unpack_from("<f", body, 20)
+                drift, = struct.unpack_from("<f", body, 24)
+                disag, = struct.unpack_from("<f", body, 28)
+                return self.genome_update(ep, dens, stale, norm, drift,
+                                          disag)
             if code == _OP_RESEAT:
                 ep, = struct.unpack_from("<q", body, 0)
                 n, = struct.unpack_from("<q", body, 8)

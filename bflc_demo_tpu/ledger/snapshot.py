@@ -50,6 +50,14 @@ OP_SNAPSHOT = 9                     # ledger op codec (pyledger/ledger.cpp)
 
 _EMPTY_HEAD = b"\0" * 32
 
+# magic tag introducing the closed-loop (genome) state tail.  The tail
+# is always exactly 28 bytes and always LAST, so the parser can test
+# "exactly 28 bytes remain and they start with the tag" — an async
+# tail's leading <q aseq_next> can never satisfy both (its minimal
+# section is 24 bytes and any extension crosses 28).
+_GENOME_MAGIC = b"GNM1"
+_GENOME_TAIL_LEN = 4 + 4 + 8 + 8 + 4
+
 
 def _put_str(b: bytearray, s: str) -> None:
     raw = s.encode()
@@ -152,6 +160,19 @@ def encode_state_dict(d: Dict) -> bytes:
             raise ValueError(
                 "async_acommits tail requires the async tail")
         b += struct.pack("<q", int(acommits))
+    # closed-loop compression tail (ProtocolConfig.adapt_every > 0
+    # only): the EFFECTIVE knobs + the disagreement capture that gate
+    # the next genome-update op.  Emitted LAST, introduced by a magic
+    # tag so it parses unambiguously whether or not the async tails
+    # precede it; static chains keep the exact legacy layout.
+    genome = d.get("genome")
+    if genome is not None:
+        eff_density, eff_staleness, genome_epoch, disagreement = genome
+        b += _GENOME_MAGIC
+        b += struct.pack("<f", _np.float32(eff_density))
+        b += struct.pack("<q", int(eff_staleness))
+        b += struct.pack("<q", int(genome_epoch))
+        b += struct.pack("<f", _np.float32(disagreement))
     return bytes(b)
 
 
@@ -251,9 +272,26 @@ def decode_state(blob: bytes) -> Dict:
         d["pending"] = (medians, order, selected, rd_f())
     else:
         d["pending"] = None
+    d["async"] = None                   # legacy / synchronous layout
+    d["async_acommits"] = None
+    d["genome"] = None
+
+    def genome_next() -> bool:
+        return (len(blob) - off == _GENOME_TAIL_LEN
+                and blob[off:off + 4] == _GENOME_MAGIC)
+
+    def rd_genome() -> None:
+        nonlocal off
+        off += 4
+        dens = rd_f()
+        stale = rd_q()
+        gep = rd_q()
+        d["genome"] = (dens, stale, gep, rd_f())
+
     if off == len(blob):
-        d["async"] = None               # legacy / synchronous layout
-        d["async_acommits"] = None
+        return d
+    if genome_next():                   # sync chain, adaptive armed
+        rd_genome()
         return d
     # async buffered-aggregation tail (present iff the emitting ledger
     # ran with async_buffer > 0)
@@ -281,10 +319,12 @@ def decode_state(blob: bytes) -> Dict:
         rows[aseq] = {rd_str(): rd_f() for _ in range(ln)}
     d["async"] = (aseq_next, entries, rows)
     # optional re-election tail: the acommit counter (present iff the
-    # emitting ledger ran with async_reseat_every > 0)
-    d["async_acommits"] = None
-    if off != len(blob):
+    # emitting ledger ran with async_reseat_every > 0) — the genome
+    # tail's magic + fixed length disambiguates it from a counter
+    if off != len(blob) and not genome_next():
         d["async_acommits"] = rd_q()
+    if off != len(blob) and genome_next():
+        rd_genome()
     if off != len(blob):
         raise ValueError(f"snapshot state: {len(blob) - off} trailing "
                          f"bytes")
@@ -316,7 +356,8 @@ def restore_snapshot(state_bytes: bytes, cfg, base: int, base_head: bytes):
     AFTER the certified snapshot op).  The installer's trust argument is
     the caller's (`verify_snapshot_meta`): this only decodes + installs,
     raising ValueError on malformed bytes."""
-    from bflc_demo_tpu.ledger.base import async_enabled, reduce_blocks
+    from bflc_demo_tpu.ledger.base import (adapt_enabled, async_enabled,
+                                           reduce_blocks)
     from bflc_demo_tpu.ledger.pyledger import PyLedger
     led = PyLedger(cfg.client_num, cfg.comm_count, cfg.aggregate_count,
                    cfg.needed_update_count, cfg.genesis_epoch,
@@ -326,7 +367,11 @@ def restore_snapshot(state_bytes: bytes, cfg, base: int, base_head: bytes):
                    async_reseat_every=(
                        getattr(cfg, "async_reseat_every", 0)
                        if async_enabled(cfg) else 0),
-                   reduce_blocks=reduce_blocks(cfg))
+                   reduce_blocks=reduce_blocks(cfg),
+                   delta_density=getattr(cfg, "delta_density", 1.0),
+                   density_floor=getattr(cfg, "density_floor", 0.01),
+                   adapt_every=(getattr(cfg, "adapt_every", 0)
+                                if adapt_enabled(cfg) else 0))
     led._install_state(state_bytes, base, base_head)
     return led
 

@@ -511,6 +511,22 @@ class RoundTimeline:
             if seated is not None:
                 rec["committee"] = list(seated["seats"])
             rec["reseat"] = any(n["epoch"] == r for n in reseats) or None
+        # closed-loop compression: the writer's genome_update flight
+        # events name each certified knob transition (ledger.OP_GENOME)
+        # — the record carries the transition THIS round's commit
+        # proposed, with the old->new values and the deciding telemetry
+        # so forensics can answer "why did density change here?"
+        genomes = [n for n in self.notes
+                   if n.get("name") == "genome_update"
+                   and isinstance(n.get("commit_epoch"), int)
+                   and n["commit_epoch"] == r]
+        if genomes:
+            rec["genome_updates"] = [
+                {k: n.get(k) for k in (
+                    "epoch", "commit_epoch", "old_density",
+                    "new_density", "old_staleness", "new_staleness",
+                    "update_norm", "drift", "disagreement")}
+                for n in genomes]
         # device plane: the round's compile events / storm verdict /
         # memory watermark plus the last scrape's fleet deltas (what
         # obs_query --round prints and incident bundles slice)

@@ -96,6 +96,34 @@ class ProtocolConfig:
     # today's frozen-committee async bytes exactly.
     async_reseat_every: int = 0
 
+    # data plane: which sparse codec a density-armed client encodes
+    # with (part of the protocol genome like delta_density).  "topk"
+    # (the default) is PR 12's deterministic top-k scatter records;
+    # "sketch" is a deterministic seeded count-sketch (reserved
+    # `#sketch` records, utils.serialization.sketch_entries) spending
+    # the SAME per-leaf slot budget on a hashed table instead of
+    # explicit indices — roughly half the bytes at equal density, at
+    # the cost of estimation noise.  Both decode through the ONE
+    # `densify_entries` inverse, so the decode side is codec-agnostic
+    # and the trust machinery is untouched.  Irrelevant (and inert)
+    # at delta_density 1.0 or under BFLC_SPARSE_LEGACY=1.
+    delta_codec: str = "topk"
+
+    # closed-loop compression (ROADMAP item 3): with adapt_every = R >
+    # 0 the writer proposes a certified genome-update op (opcode 13)
+    # after every R-th committed round, retuning the EFFECTIVE
+    # delta_density (and, in async mode, max_staleness) from certified
+    # convergence telemetry on the ONE fixed decision rule
+    # (control.loop.decide).  Validators re-derive the rule and refuse
+    # BAD_ARG on any mismatch — same trust shape as the BLK1 geometry
+    # claim — so the schedule is chain state every role agrees on, not
+    # writer policy.  delta_density above stays the STARTING density;
+    # density_floor bounds how far the loop may ramp down.  0 (the
+    # default) or BFLC_ADAPT_LEGACY=1 pins the static-knob protocol
+    # byte-for-byte.
+    adapt_every: int = 0
+    density_floor: float = 0.01
+
     # REDUCTION SPEC v2: protocol-agreed blocked reduction.  With
     # reduce_blocks = B > 1 the flattened (P,) param axis is cut into B
     # fixed contiguous blocks (ceil(P/B) each, meshagg.spec.block_bounds
@@ -157,6 +185,28 @@ class ProtocolConfig:
                 f"(async_buffer > 0), got reseat_every="
                 f"{self.async_reseat_every} with async_buffer="
                 f"{self.async_buffer}")
+        if self.delta_codec not in ("topk", "sketch"):
+            raise ValueError(
+                f"delta_codec must be one of ('topk', 'sketch'), got "
+                f"{self.delta_codec!r}")
+        if self.adapt_every < 0:
+            raise ValueError(
+                f"adapt_every must be >= 0, got {self.adapt_every}")
+        if not 0.0 < self.density_floor <= 1.0:
+            raise ValueError(
+                f"density_floor must be in (0, 1], got "
+                f"{self.density_floor}")
+        if self.adapt_every > 0 and self.delta_density >= 1.0:
+            raise ValueError(
+                "adapt_every > 0 retunes a SPARSE fleet's effective "
+                "density (delta_density is the starting value and the "
+                "cap); arm sparsity with delta_density < 1 first")
+        if self.adapt_every > 0 and self.density_floor > \
+                self.delta_density:
+            raise ValueError(
+                f"density_floor ({self.density_floor}) exceeds the "
+                f"starting delta_density ({self.delta_density}): the "
+                f"control loop could never hold a legal density")
         if self.reduce_blocks < 1:
             raise ValueError(
                 f"reduce_blocks must be >= 1 (1 = REDUCTION SPEC v1 "

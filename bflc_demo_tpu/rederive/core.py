@@ -520,18 +520,24 @@ class Rederiver:
         return got[prev_hash.hex()] if got else None
 
     # ---------------------------------------------------- hier cell tier
-    def check_cell(self, op: bytes, auth: Optional[dict]) -> str:
+    def check_cell(self, op: bytes, auth: Optional[dict],
+                   density: Optional[float] = None) -> str:
         """'' to proceed; a reason string refuses a ROOT-tier cell
         upload whose partial is not the deterministic FedAvg of its
         member-signed deltas (PARITY divergence 4's re-derivable half,
         one tier down).  Pure function of (op, auth) + the cell's read
         surface — runs OUTSIDE the validator lock like the sparse
         check.  Counted skip when the evidence or member blobs are
-        unavailable (a pre-plane cell, a dead aggregator)."""
+        unavailable (a pre-plane cell, a dead aggregator).
+
+        `density` is the EFFECTIVE delta density in force at this
+        chain position (the caller's replica ledger knows it when the
+        closed loop is armed — ledger.OP_GENOME); None falls back to
+        the static genome knob, so static fleets are unchanged."""
         t0 = time.perf_counter()
         try:
             with obs_trace.TRACE.span("rederive.cell"):
-                err = self._check_cell_inner(op, auth)
+                err = self._check_cell_inner(op, auth, density)
             if err:
                 self.stats["cell_refused"] += 1
                 _C_REFUSE.inc(reason="cell")
@@ -550,7 +556,8 @@ class Rederiver:
             validator=self.index)
         return ""
 
-    def _check_cell_inner(self, op: bytes, auth: Optional[dict]) -> str:
+    def _check_cell_inner(self, op: bytes, auth: Optional[dict],
+                          density: Optional[float] = None) -> str:
         from bflc_demo_tpu.comm.identity import (_op_bytes, address_of,
                                                  verify_signature)
         from bflc_demo_tpu.hier.partial import (cell_evidence_digest,
@@ -643,10 +650,11 @@ class Rederiver:
             from bflc_demo_tpu.ledger.base import reduce_blocks
             partial, n2, _cost = cell_partial(
                 admitted, blocks=reduce_blocks(self.cfg))
+            eff = (float(density) if density is not None
+                   else self.cfg.delta_density)
             rederived = partial_blob(
                 partial, cell_index, n2, digest,
-                density=(self.cfg.delta_density if self._sparse
-                         else 1.0))
+                density=(eff if self._sparse else 1.0))
         except ValueError as e:
             return f"rederive/cell: partial re-derivation refused: {e}"
         if hashlib.sha256(rederived).digest() != payload_hash:

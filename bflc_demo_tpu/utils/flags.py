@@ -81,6 +81,14 @@ class RunOptions:
     # artifacts land in <telemetry-dir>/xprof unless BFLC_XPROF_DIR
     # overrides).  BFLC_XPROF is the env twin.
     xprof_window: str = ""
+    # processes runtime: client-side error-feedback residual
+    # accumulation (closed-loop compression; utils.serialization
+    # .error_feedback_enabled).  Client-local only — never part of the
+    # protocol genome: the wire bytes stay the plain sparse/quantized
+    # protocol and mixed fleets interoperate.  Exported to the spawned
+    # client processes as BFLC_ERROR_FEEDBACK=1; off (default) pins the
+    # PR-12 trajectory byte-for-byte.
+    error_feedback: bool = False
     secure: bool = False             # secure aggregation (config4 mesh)
     verbose: bool = True
 

@@ -82,6 +82,19 @@ QSCALE_SUFFIX = "#qscale"
 # (same '#' collision argument): uint32 [ndim, *shape, *ascending idx]
 TOPK_SUFFIX = "#topk"
 
+# reserved key suffix carrying a count-sketch leaf's geometry record
+# (same '#' collision argument): uint32 [ndim, *shape, depth, width].
+# The paired values leaf is the (depth*width,) float32 sketch table.
+SKETCH_SUFFIX = "#sketch"
+
+# the sparse codecs the genome may name (ProtocolConfig.delta_codec)
+DELTA_CODECS = ("topk", "sketch")
+
+# densify refuses a #sketch record claiming more hash rows than any
+# honest encoder emits (encoders use min(3, slots)) — a bound on the
+# decode's (depth, size) working set, not a format feature
+_SKETCH_MAX_DEPTH = 4
+
 # densify refuses a #topk record claiming more dimensions than any
 # model here could honestly produce — a bound, not a format feature
 _TOPK_MAX_NDIM = 8
@@ -106,6 +119,35 @@ def sparse_enabled(cfg) -> bool:
     protocol genome opted in (delta_density < 1) and no legacy pin."""
     return float(getattr(cfg, "delta_density", 1.0)) < 1.0 \
         and not sparse_legacy()
+
+
+def error_feedback_enabled(cfg) -> bool:
+    """Client-side error-feedback arming (--error-feedback /
+    BFLC_ERROR_FEEDBACK=1): accumulate the tensor the lossy encode
+    DROPPED each round and fold it into the next round's delta before
+    encoding (EF-SGD / EF21 memory; Seide et al. 2014, Karimireddy et
+    al. 2019).  Deliberately NOT part of the protocol genome: the
+    residual never crosses the wire, the certified bytes are the plain
+    sparse/quantized protocol, and a mixed fleet (some clients EF, some
+    not) interoperates — so this is a per-process env decision, not a
+    chain-agreed knob.  Only meaningful when the encode is actually
+    lossy (sparsity or quantization armed); with a lossless f32 dense
+    encode the residual is identically zero and the flag is inert."""
+    if os.environ.get("BFLC_ERROR_FEEDBACK", "") in ("", "0"):
+        return False
+    return sparse_enabled(cfg) or \
+        str(getattr(cfg, "delta_dtype", "f32")) != "f32"
+
+
+def delta_codec(cfg) -> str:
+    """The ONE codec decision every sparse-aware layer asks: the
+    genome's `delta_codec` when sparsity is armed, else 'topk' (which
+    at density 1.0 is the dense identity).  An unknown codec name is a
+    config error callers surface via ProtocolConfig.validate; here it
+    degrades to 'topk' so a stale peer never crashes mid-decode (the
+    decode side is self-describing and codec-agnostic anyway)."""
+    codec = str(getattr(cfg, "delta_codec", "topk") or "topk")
+    return codec if codec in DELTA_CODECS else "topk"
 
 
 def topk_count(size: int, density: float) -> int:
@@ -354,26 +396,175 @@ def sparsify_entries(flat: Dict[str, np.ndarray],
     return out
 
 
+def _sketch_hashes(key: str, row: int, size: int,
+                   width: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(bucket, sign) vectors over a leaf's flat indices for one hash
+    row — the deterministic seeded multiply-shift family both the
+    encoder and the ONE decode inverse derive from (key, row) alone,
+    so the sketch is self-describing: no density, epoch or shared
+    state feeds the hash.  sha256 seeds a 64-bit odd multiplier and
+    offset; the high bits pick the bucket, bit 31 the sign — pure
+    uint64 modular arithmetic, bit-identical on every host."""
+    seed = hashlib.sha256(
+        b"bflc-sketch|" + key.encode() + b"|" + struct.pack("<q", row)
+    ).digest()
+    a = np.uint64(int.from_bytes(seed[:8], "little") | 1)
+    c = np.uint64(int.from_bytes(seed[8:16], "little"))
+    j = np.arange(size, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        mixed = a * j + c
+    bucket = ((mixed >> np.uint64(32)) % np.uint64(width)).astype(np.int64)
+    sign = (1.0 - 2.0 * ((mixed >> np.uint64(31)) & np.uint64(1)).astype(
+        np.float64))
+    return bucket, sign
+
+
+def sketch_geometry(size: int, density: float) -> Tuple[int, int]:
+    """(depth, width) for a leaf at this density — or (0, 0) meaning
+    PASS THROUGH DENSE (the slot budget covers the whole leaf, so the
+    sketch would only lose information for no byte win).  The total
+    slot budget is `topk_count(size, density)` — the same table the
+    top-k codec spends on values, so the two codecs are byte-comparable
+    at equal density; depth is min(3, budget) so tiny leaves degrade
+    gracefully to a single hash row."""
+    slots = topk_count(size, density)
+    if slots <= 0 or slots >= size:
+        return 0, 0
+    depth = min(3, slots)
+    width = (slots + depth - 1) // depth
+    return depth, width
+
+
+def sketch_entries(flat: Dict[str, np.ndarray],
+                   density: float) -> Dict[str, np.ndarray]:
+    """Deterministic count-sketch image of flat {path: array} entries —
+    the top-k alternative (Konečný et al. 2016's sketched updates;
+    Charikar et al. 2002).  Each float leaf folds into a
+    (depth*width,) float32 table (depth rows of seeded multiply-shift
+    bucket/sign hashes, f64 accumulation then one f32 round — so every
+    honest encoder produces byte-identical tables) plus a reserved
+    `<key>#sketch` uint32 record ``[ndim, *shape, depth, width]``.
+    The table rides the EXISTING value pipeline (f32, or f16/i8 through
+    `quantize_entries`), the certified hash is over the sketch
+    canonical bytes, and `densify_entries` is the ONE decode inverse
+    (median-of-rows estimate).  Leaves whose slot budget reaches their
+    size stay DENSE; density >= 1 is the identity and emits no
+    `#sketch` entry anywhere — the byte-for-byte dense pin."""
+    if density >= 1.0:
+        return dict(flat)
+    if density < 0.0:
+        raise ValueError(f"density must be in [0, 1], got {density}")
+    out: Dict[str, np.ndarray] = {}
+    for key, arr in flat.items():
+        a = np.asarray(arr)
+        if not np.issubdtype(a.dtype, np.floating):
+            out[key] = a
+            continue
+        size = int(a.size)
+        depth, width = sketch_geometry(size, density)
+        if depth <= 0:
+            out[key] = a
+            continue
+        vals = a.astype(np.float32, copy=False).ravel().astype(np.float64)
+        table = np.zeros((depth, width), np.float64)
+        for r in range(depth):
+            bucket, sign = _sketch_hashes(key, r, size, width)
+            table[r] = np.bincount(bucket, weights=sign * vals,
+                                   minlength=width)
+        out[key] = table.astype(np.float32).ravel()
+        out[key + SKETCH_SUFFIX] = np.asarray(
+            [a.ndim] + list(a.shape) + [depth, width], np.uint32)
+    return out
+
+
+def _densify_sketch(tkey: str, rec: np.ndarray,
+                    vals: np.ndarray) -> Tuple[np.ndarray, Tuple[int, ...]]:
+    """Decode one validated #sketch record + table into the dense
+    median-of-rows estimate (float32).  Caller validated geometry."""
+    ndim = int(rec[0])
+    shape = tuple(int(d) for d in rec[1:1 + ndim])
+    depth, width = int(rec[1 + ndim]), int(rec[2 + ndim])
+    size = 1
+    for d in shape:
+        size *= d
+    table = vals.astype(np.float32, copy=False).reshape(depth, width)
+    est = np.empty((depth, size), np.float32)
+    for r in range(depth):
+        bucket, sign = _sketch_hashes(tkey[:-len(SKETCH_SUFFIX)], r,
+                                      size, width)
+        est[r] = sign.astype(np.float32) * table[r, bucket]
+    return np.median(est, axis=0).astype(np.float32).reshape(shape), shape
+
+
 def densify_entries(flat: Dict[str, np.ndarray]
                     ) -> Dict[str, np.ndarray]:
     """The ONE deterministic inverse of `sparsify_entries`, shared by
     admission schema checks, committee scorers, the aggregator and BFT
     validator re-execution (module docstring).
 
-    An identity on dense entries (no `#topk` keys).  For each `#topk`
-    record the paired (k,) float vector scatters into a float32 zeros
-    tensor of the recorded shape.  Raises ValueError on ANY malformed
+    An identity on dense entries (no `#topk`/`#sketch` keys).  For
+    each `#topk` record the paired (k,) float vector scatters into a
+    float32 zeros tensor of the recorded shape; for each `#sketch`
+    record the paired (depth*width,) table decodes to the
+    median-of-rows estimate.  Raises ValueError on ANY malformed
     record — wrong dtype, impossible ndim, value-count mismatch,
-    out-of-bounds / duplicate / unsorted indices, or an orphan record
+    out-of-bounds / duplicate / unsorted indices, impossible sketch
+    geometry, a leaf claimed by BOTH record types, or an orphan record
     without its values leaf — so a hostile blob dies at admission as a
     schema error instead of corrupting an aggregate.  Run AFTER
-    `dequantize_entries` (f16/i8 k-vectors decode to float32 first)."""
+    `dequantize_entries` (f16/i8 value vectors decode to float32
+    first)."""
     topks = {k: v for k, v in flat.items() if k.endswith(TOPK_SUFFIX)}
-    if not topks:
+    sketches = {k: v for k, v in flat.items()
+                if k.endswith(SKETCH_SUFFIX)}
+    if not topks and not sketches:
         return dict(flat)
     out: Dict[str, np.ndarray] = {}
     seen = set()
     claimed_total = 0
+    for skey, rec in sketches.items():
+        base = skey[:-len(SKETCH_SUFFIX)]
+        if base + TOPK_SUFFIX in topks:
+            raise ValueError(f"{base}: claimed by both #topk and "
+                             f"#sketch records")
+        seen.add(base)
+        rec = np.asarray(rec)
+        if rec.dtype != np.uint32 or rec.ndim != 1 or rec.size < 3:
+            raise ValueError(f"{skey}: malformed record (want a 1-D "
+                             f"uint32 vector [ndim, *shape, depth, "
+                             f"width])")
+        ndim = int(rec[0])
+        if ndim > _TOPK_MAX_NDIM or rec.size != 3 + ndim:
+            raise ValueError(f"{skey}: impossible ndim {ndim}")
+        shape = tuple(int(d) for d in rec[1:1 + ndim])
+        size = 1
+        for d in shape:
+            size *= d
+        depth, width = int(rec[1 + ndim]), int(rec[2 + ndim])
+        if not 1 <= depth <= _SKETCH_MAX_DEPTH or width < 1:
+            raise ValueError(f"{skey}: impossible sketch geometry "
+                             f"depth={depth} width={width}")
+        # the decode working set is (depth+1) x size floats plus the
+        # table — bound it CUMULATIVELY before any allocation, the
+        # same hostile-blob argument as the #topk bound below
+        claimed_total += size * (depth + 1) + depth * width
+        if claimed_total > _TOPK_MAX_ELEMS:
+            raise ValueError(f"{skey}: claimed decode sizes total "
+                             f"{claimed_total}, exceeding "
+                             f"{_TOPK_MAX_ELEMS} elements")
+        if base not in flat:
+            raise ValueError(f"{skey}: record without its table leaf")
+        vals = np.asarray(flat[base])
+        if not np.issubdtype(vals.dtype, np.floating) or vals.ndim != 1:
+            raise ValueError(f"{base}: sketch table must be a 1-D "
+                             f"float vector, got {vals.dtype} "
+                             f"rank {vals.ndim}")
+        if int(vals.size) != depth * width:
+            raise ValueError(f"{skey}: table size {vals.size} != "
+                             f"depth*width {depth * width}")
+        if size < 1:
+            raise ValueError(f"{skey}: empty dense shape {shape}")
+        out[base], _ = _densify_sketch(skey, rec, vals)
     for tkey, rec in topks.items():
         base = tkey[:-len(TOPK_SUFFIX)]
         seen.add(base)
@@ -419,18 +610,27 @@ def densify_entries(flat: Dict[str, np.ndarray]
         dense[idx] = vals.astype(np.float32, copy=False)
         out[base] = dense.reshape(shape)
     for key, arr in flat.items():
-        if key.endswith(TOPK_SUFFIX) or key in seen:
+        if key.endswith(TOPK_SUFFIX) or key.endswith(SKETCH_SUFFIX) \
+                or key in seen:
             continue
         out[key] = np.asarray(arr)
     return out
 
 
 def pack_sparse(tree: Pytree, density: float,
-                dtype: str = "f32") -> bytes:
+                dtype: str = "f32", codec: str = "topk") -> bytes:
     """Canonical bytes of `tree`'s sparsified (then quantized) entries —
-    what a density-armed client uploads, hashes and SIGNS.  Sparsify
-    runs first so the surviving k-vectors ride the existing value
-    pipeline; at density >= 1 and dtype 'f32' this is byte-identical to
-    `pack_pytree` (the dense pin holds by construction)."""
-    entries = sparsify_entries(dict(_leaf_entries(tree)), density)
+    what a density-armed client uploads, hashes and SIGNS.  `codec`
+    picks the sparse encoder ('topk' top-k scatter records, 'sketch'
+    count-sketch tables — `delta_codec(cfg)` is the genome decision);
+    both run first so the surviving value vectors ride the existing
+    quantization pipeline, and both decode through the ONE
+    `densify_entries` inverse.  At density >= 1 and dtype 'f32' this is
+    byte-identical to `pack_pytree` (the dense pin holds by
+    construction, for either codec)."""
+    if codec not in DELTA_CODECS:
+        raise ValueError(f"delta codec must be one of {DELTA_CODECS}, "
+                         f"got {codec!r}")
+    encode = sketch_entries if codec == "sketch" else sparsify_entries
+    entries = encode(dict(_leaf_entries(tree)), density)
     return pack_entries(quantize_entries(entries, dtype))

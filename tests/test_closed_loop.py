@@ -1,0 +1,434 @@
+"""Closed-loop compression: error-feedback residual lifecycle, the
+certified genome-update op (opcode 13), and the adaptive-density drill.
+
+Three planes under test:
+
+1. `client.process_runtime._DeltaEncoder` — the client-LOCAL error-
+   feedback accumulator (Seide et al. 2014 / Karimireddy et al. 2019,
+   PAPERS.md).  It is deliberately NOT part of the protocol genome:
+   armed or not, wire bytes are the plain sparse/quantized protocol, so
+   the tests pin (a) disarmed == stateless byte-for-byte, (b) residual
+   lifecycle resets on every model-lineage discontinuity (rejoin,
+   async base-epoch jump, cell re-home — all of which surface as a
+   base-epoch gap at the encoder), (c) determinism of the full
+   EF + i8 + density-0.01 composition.
+
+2. The genome-update op itself: proposed by the writer on the fixed
+   decision rule (control.loop.decide), re-derived by every replica,
+   refused BAD_ARG on any mismatch — so the effective-knob schedule is
+   certified state, not writer fiat.
+
+3. The closed loop end to end: a scripted multi-round federation where
+   density actually moves mid-run with ZERO honest-path refusals, a
+   fresh replica replays the whole op stream to the same head, and a
+   lying writer is refused at the quorum (ValidatorNode drill).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+from bflc_demo_tpu.ledger import LedgerStatus, make_ledger
+from bflc_demo_tpu.ledger.base import (OP_AUPLOAD, OP_GENOME, OP_UPLOAD,
+                                       encode_genome_op)
+from bflc_demo_tpu.protocol.constants import ProtocolConfig
+from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                               dequantize_entries,
+                                               pack_pytree, pack_sparse,
+                                               restore_pytree,
+                                               unpack_pytree)
+
+
+def _tree(rng, scale=1.0):
+    return {"W1": (scale * rng.standard_normal((24, 16))
+                   ).astype(np.float32),
+            "b1": (scale * rng.standard_normal(16)).astype(np.float32),
+            "W2": (scale * rng.standard_normal((16, 3))
+                   ).astype(np.float32)}
+
+
+def _decode(template, blob):
+    return restore_pytree(template, densify_entries(
+        dequantize_entries(unpack_pytree(blob))))
+
+
+# ------------------------------------------------ error-feedback encoder
+class TestErrorFeedbackEncoder:
+    def _encoder(self, cfg, template, monkeypatch, armed=True):
+        monkeypatch.setenv("BFLC_ERROR_FEEDBACK", "1" if armed else "0")
+        from bflc_demo_tpu.client.process_runtime import _DeltaEncoder
+        return _DeltaEncoder(cfg, template)
+
+    def test_disarmed_is_stateless_passthrough(self, monkeypatch):
+        """EF off (the default) pins the static trajectory byte-for-
+        byte: every encode equals the stateless encoder's output and no
+        residual state accumulates."""
+        from bflc_demo_tpu.client.process_runtime import _encode_delta
+        cfg = ProtocolConfig(delta_density=0.05).validate()
+        rng = np.random.default_rng(0)
+        t = _tree(rng, 0.0)
+        enc = self._encoder(cfg, t, monkeypatch, armed=False)
+        for ep in range(3):
+            d = _tree(rng)
+            assert enc.encode(d, base_epoch=ep) == _encode_delta(d, cfg)
+        assert enc._residual is None
+
+    def test_first_encode_matches_stateless(self, monkeypatch):
+        from bflc_demo_tpu.client.process_runtime import _encode_delta
+        cfg = ProtocolConfig(delta_density=0.05,
+                             delta_dtype="i8").validate()
+        rng = np.random.default_rng(1)
+        t = _tree(rng, 0.0)
+        enc = self._encoder(cfg, t, monkeypatch)
+        d = _tree(rng)
+        assert enc.encode(d, base_epoch=0) == _encode_delta(d, cfg)
+
+    def test_residual_recurrence_is_exact(self, monkeypatch):
+        """residual_t = compensated_t - decode(encode(compensated_t)),
+        with compensated_t = delta_t + residual_{t-1} — the EF-SGD
+        memory recursion, checked bit-level against the ONE decode
+        chain the admission path runs."""
+        cfg = ProtocolConfig(delta_density=0.05).validate()
+        rng = np.random.default_rng(2)
+        t = _tree(rng, 0.0)
+        enc = self._encoder(cfg, t, monkeypatch)
+        residual = {k: np.zeros_like(v) for k, v in t.items()}
+        for ep in range(4):
+            d = _tree(rng)
+            comp = {k: d[k] + residual[k] for k in d}
+            blob = enc.encode(d, base_epoch=ep)
+            got = _decode(t, blob)
+            residual = {k: comp[k].astype(np.float32)
+                        - np.asarray(got[k], np.float32) for k in d}
+            for k in d:
+                np.testing.assert_array_equal(enc._residual[k],
+                                              residual[k])
+
+    def test_reset_on_base_epoch_jump(self, monkeypatch):
+        """Any lineage discontinuity — crash + rejoin, committee-duty
+        epoch gap, async base-epoch jump, cell re-home — surfaces as
+        base_epoch != last_base + 1, and the residual MUST die with the
+        old lineage: the post-jump encode is byte-identical to a fresh
+        encoder's (no stale-model correction leaks into the new one)."""
+        cfg = ProtocolConfig(delta_density=0.05).validate()
+        rng = np.random.default_rng(3)
+        t = _tree(rng, 0.0)
+        enc = self._encoder(cfg, t, monkeypatch)
+        deltas = [_tree(rng) for _ in range(4)]
+        enc.encode(deltas[0], base_epoch=0)
+        enc.encode(deltas[1], base_epoch=1)
+        assert enc._residual is not None
+        # epoch 2..4 missed (rejoin at 5): residual resets
+        fresh = self._encoder(cfg, t, monkeypatch)
+        assert enc.encode(deltas[2], base_epoch=5) == \
+            fresh.encode(deltas[2], base_epoch=5)
+        # ...and the NEW lineage accumulates normally from there
+        assert enc.encode(deltas[3], base_epoch=6) == \
+            fresh.encode(deltas[3], base_epoch=6)
+        assert enc._residual is not None
+
+    def test_contiguous_epochs_keep_residual(self, monkeypatch):
+        cfg = ProtocolConfig(delta_density=0.05).validate()
+        rng = np.random.default_rng(4)
+        t = _tree(rng, 0.0)
+        enc = self._encoder(cfg, t, monkeypatch)
+        d = _tree(rng)
+        b0 = enc.encode(d, base_epoch=0)
+        b1 = enc.encode(d, base_epoch=1)      # contiguous: compensated
+        fresh = self._encoder(cfg, t, monkeypatch)
+        fresh.encode(d, base_epoch=0)
+        assert b1 == fresh.encode(d, base_epoch=1)
+        assert b0 != b1  # the residual actually changed the encode
+
+    def test_ef_catches_up_on_persistent_signal(self, monkeypatch):
+        """The point of EF: under a persistent gradient direction, the
+        accumulated reconstruction (sum of decoded deltas) converges to
+        the true sum — the residual carries everything top-k dropped
+        into later rounds.  The stateless encoder's error grows
+        linearly; EF's stays bounded."""
+        cfg = ProtocolConfig(delta_density=0.05).validate()
+        rng = np.random.default_rng(5)
+        t = _tree(rng, 0.0)
+        signal = _tree(rng)                   # fixed direction
+        enc = self._encoder(cfg, t, monkeypatch)
+        from bflc_demo_tpu.client.process_runtime import _encode_delta
+        got_ef = {k: np.zeros_like(v) for k, v in t.items()}
+        got_sl = {k: np.zeros_like(v) for k, v in t.items()}
+        rounds = 32                           # > 1/density: the residual
+        for ep in range(rounds):              # cycle flushes every coord
+            de = _decode(t, enc.encode(signal, base_epoch=ep))
+            ds = _decode(t, _encode_delta(signal, cfg))
+            for k in t:
+                got_ef[k] += np.asarray(de[k], np.float32)
+                got_sl[k] += np.asarray(ds[k], np.float32)
+        err = lambda got: sum(  # noqa: E731
+            float(np.linalg.norm(rounds * signal[k] - got[k]))
+            for k in t)
+        # measured: EF error plateaus (~0.28x at 32 rounds and still
+        # falling) while the stateless error grows linearly forever
+        assert err(got_ef) < 0.35 * err(got_sl)
+
+    def test_ef_i8_density_001_composition_byte_stable(self, monkeypatch):
+        """The headline composition (EF + i8 + density 0.01) is fully
+        deterministic: two encoders fed the same delta stream emit
+        identical byte sequences, and every blob admits through the one
+        decode chain."""
+        cfg = ProtocolConfig(delta_density=0.01,
+                             delta_dtype="i8").validate()
+        rng = np.random.default_rng(6)
+        t = {"W": np.zeros((64, 40), np.float32),
+             "b": np.zeros(40, np.float32)}
+        deltas = [{"W": rng.standard_normal((64, 40)).astype(np.float32),
+                   "b": rng.standard_normal(40).astype(np.float32)}
+                  for _ in range(3)]
+        a = self._encoder(cfg, t, monkeypatch)
+        b = self._encoder(cfg, t, monkeypatch)
+        for ep, d in enumerate(deltas):
+            ba = a.encode(d, base_epoch=ep)
+            assert ba == b.encode({k: v.copy() for k, v in d.items()},
+                                  base_epoch=ep)
+            _decode(t, ba)                    # admissible
+
+    def test_density_override_tracks_effective_knob(self, monkeypatch):
+        """The encoder takes the round's served eff_density (the
+        adaptive loop's output) per call — a knob change between rounds
+        changes the blob geometry without touching residual state."""
+        cfg = ProtocolConfig(delta_density=0.08).validate()
+        rng = np.random.default_rng(7)
+        t = {"W": np.zeros(4000, np.float32)}
+        enc = self._encoder(cfg, t, monkeypatch)
+        d = {"W": rng.standard_normal(4000).astype(np.float32)}
+        b_hi = enc.encode(d, base_epoch=0, density=0.08)
+        b_lo = enc.encode(d, base_epoch=1, density=0.02)
+        assert len(b_lo) < len(b_hi)
+        assert enc._residual is not None
+
+
+# --------------------------------------------- genome op / replica rules
+class TestGenomeOp:
+    def _armed_cfg(self, **kw):
+        base = dict(delta_density=0.05, adapt_every=2,
+                    density_floor=0.01)
+        base.update(kw)
+        return ProtocolConfig(**base).validate()
+
+    def test_adapt_requires_sparse_genome(self):
+        with pytest.raises(ValueError, match="SPARSE"):
+            ProtocolConfig(adapt_every=2).validate()
+
+    def test_genome_op_refused_unless_armed(self):
+        led = make_ledger(ProtocolConfig(delta_density=0.05).validate(),
+                          backend="python")
+        op = encode_genome_op(1, 0.025, 0, 1.0, 0.0, 0.01)
+        assert led.apply_op(op) == LedgerStatus.BAD_ARG
+
+    def test_legacy_pin_disarms_loop(self, monkeypatch):
+        monkeypatch.setenv("BFLC_ADAPT_LEGACY", "1")
+        from bflc_demo_tpu.ledger.base import adapt_enabled
+        assert not adapt_enabled(self._armed_cfg())
+
+    def test_decision_rule_is_pure_and_clamped(self):
+        from bflc_demo_tpu.control.loop import decide
+        cfg = self._armed_cfg()
+        kw = dict(density_floor=cfg.density_floor,
+                  density_cap=cfg.delta_density, staleness_cap=0)
+        # converging (low disagreement): density halves toward floor
+        d, _ = decide(0.05, 0, 1.0, 0.5, 0.01, **kw)
+        assert d == pytest.approx(0.025)
+        # unhealthy: density doubles, clamped at the genome's cap
+        d2, _ = decide(0.04, 0, 1.0, 0.5, 0.5, **kw)
+        assert d2 == pytest.approx(cfg.delta_density)
+        # floor clamp
+        d3, _ = decide(cfg.density_floor, 0, 1.0, 0.5, 0.01, **kw)
+        assert d3 == pytest.approx(cfg.density_floor)
+
+    def test_genome_f32_fields_roundtrip_replay(self):
+        """The op stores f32; a replica re-encoding from parsed fields
+        must reproduce the writer's bytes exactly (else honest replay
+        would diverge on x87/f64 drift)."""
+        op = encode_genome_op(7, 0.012500000186264515, 3,
+                              1.2345678, 0.87654321, 0.111111111)
+        ep = struct.unpack_from("<q", op, 1)[0]
+        nd, = struct.unpack_from("<f", op, 9)
+        ns, = struct.unpack_from("<q", op, 13)
+        un, dr, di = struct.unpack_from("<fff", op, 21)
+        assert encode_genome_op(ep, nd, ns, un, dr, di) == op
+
+
+# ----------------------------------------------- the closed loop, end-to-end
+def _run_closed_loop_drill(adapt_every=1, rounds=4, dim=240, seed=11):
+    """Scripted multi-round federation over server._dispatch (no
+    sockets, no auth — the certification logic under test is identical;
+    see tests/test_sparse.py for the pattern).  Clients encode at the
+    SERVED eff_density each round, exactly as process_runtime does.
+    Returns (server, per-epoch densities, blob_by_hash)."""
+    from bflc_demo_tpu.comm.ledger_service import LedgerServer
+    cfg = ProtocolConfig(client_num=8, comm_count=2, aggregate_count=4,
+                         needed_update_count=4, delta_density=0.08,
+                         adapt_every=adapt_every,
+                         density_floor=0.01).validate()
+    base = np.random.default_rng(seed).standard_normal(dim) \
+        .astype(np.float32)
+    server = LedgerServer(cfg, pack_pytree({"W": np.zeros(dim,
+                                                          np.float32)}),
+                          require_auth=False, stall_timeout_s=3600.0,
+                          verbose=False)
+    addrs = [f"c{i:02d}" for i in range(cfg.client_num)]
+    for a in addrs:
+        assert server._dispatch("register", {"addr": a})["ok"]
+    densities, blob_by_hash = [], {}
+    for _ in range(rounds):
+        ep = server.ledger.epoch
+        st = server._dispatch("state", {"addr": addrs[0]})
+        # exactly what process_runtime does: encode at the served knob,
+        # genome config when the loop is disarmed (legacy pin drill)
+        eff = st.get("eff_density", cfg.delta_density)
+        densities.append((ep, eff))
+        committee = server._dispatch("committee", {})["committee"]
+        trainers = sorted(a for a in addrs if a not in committee)
+        for a in trainers[:cfg.needed_update_count]:
+            d = (base + 0.3 * np.random.default_rng(
+                [addrs.index(a), ep, seed]).standard_normal(dim)
+                 ).astype(np.float32)
+            blob = pack_sparse({"W": d}, eff)
+            h = hashlib.sha256(blob)
+            blob_by_hash[h.digest()] = blob
+            r = server._dispatch("upload", {
+                "addr": a, "blob": blob, "hash": h.hexdigest(),
+                "n": 10, "cost": 1.0, "epoch": ep})
+            assert r["ok"], (a, ep, r)       # ZERO honest-path refusals
+        row = [1.0 - 0.05 * j
+               for j in range(cfg.needed_update_count)]
+        for a in committee:
+            r = server._dispatch("scores", {"addr": a, "epoch": ep,
+                                            "scores": row})
+            assert r["ok"], (a, ep, r)
+        assert server.ledger.epoch == ep + 1
+    return server, densities, blob_by_hash
+
+
+class TestClosedLoopDrill:
+    def test_density_moves_with_zero_refusals_and_replays(self):
+        server, densities, _ = _run_closed_loop_drill()
+        try:
+            led = server.ledger
+            assert led.genome_epoch is not None
+            moved = {e for _, e in densities}
+            assert len(moved) >= 2, densities  # knob changed mid-run
+            assert min(moved) < 0.08
+            # the NEXT round's state poll serves the post-commit knob
+            # (a genome op lands atomically with its round's commit, so
+            # the last in-loop poll lags it by one transition)
+            st = server._dispatch("state", {"addr": "c00"})
+            assert st["eff_density"] == pytest.approx(
+                led.effective_density)
+            # a fresh replica replays the FULL stream (incl. opcode 13)
+            rep = make_ledger(server.cfg, backend="python")
+            for j in range(led.log_size()):
+                assert rep.apply_op(led.log_op(j)) == LedgerStatus.OK, j
+            assert rep.log_head() == led.log_head()
+            assert rep.effective_density == led.effective_density
+            assert rep.effective_staleness == led.effective_staleness
+            # info reply surfaces the live knobs for the tools plane
+            info = server._dispatch("info", {})
+            assert info["eff_density"] == pytest.approx(
+                led.effective_density)
+            assert info["genome_epoch"] == led.genome_epoch
+        finally:
+            server.close()
+
+    def test_adapt_legacy_pins_static_knobs(self, monkeypatch):
+        monkeypatch.setenv("BFLC_ADAPT_LEGACY", "1")
+        server, densities, _ = _run_closed_loop_drill(rounds=3)
+        try:
+            assert all(e == pytest.approx(0.08) for _, e in densities)
+            for j in range(server.ledger.log_size()):
+                assert server.ledger.log_op(j)[0] != OP_GENOME
+        finally:
+            server.close()
+
+    def test_lying_writer_refused_at_quorum(self):
+        """A writer claiming a knob transition its certified telemetry
+        does not support is refused by the validator quorum: the
+        validator replays the honest prefix, then refuses BOTH a wrong-
+        output lie (density the rule never produced) and a wrong-input
+        lie (disagreement that mismatches its own re-derivation) —
+        while the honest op at the same position still passes."""
+        from bflc_demo_tpu.comm.bft import ValidatorNode
+        from bflc_demo_tpu.comm.identity import Wallet
+        server, _, blob_by_hash = _run_closed_loop_drill()
+        node = None
+        try:
+            led = server.ledger
+            node = ValidatorNode(server.cfg,
+                                 Wallet.from_seed(b"closed-loop-vtest"),
+                                 0, require_auth=False)
+            gpos = None
+            for j in range(led.log_size()):
+                op = led.log_op(j)
+                if op[0] == OP_GENOME and gpos is None:
+                    gpos = j
+                    break
+                auth = {}
+                if op[0] in (OP_UPLOAD, OP_AUPLOAD):
+                    (slen,) = struct.unpack_from("<q", op, 1)
+                    h = op[1 + 8 + slen:1 + 8 + slen + 32]
+                    auth = {"blob": blob_by_hash[h].hex()}
+                r = node._validate({"i": j, "op": op.hex(),
+                                    "auth": auth})
+                assert r["ok"], (j, r)
+            assert gpos is not None
+            op = led.log_op(gpos)
+            ep = struct.unpack_from("<q", op, 1)[0]
+            nd, = struct.unpack_from("<f", op, 9)
+            ns, = struct.unpack_from("<q", op, 13)
+            un, dr, di = struct.unpack_from("<fff", op, 21)
+            lie_out = encode_genome_op(ep, nd * 2.0, ns, un, dr, di)
+            r = node._validate({"i": gpos, "op": lie_out.hex()})
+            assert not r["ok"], r
+            lie_in = encode_genome_op(ep, nd, ns, un, dr, di + 0.5)
+            r = node._validate({"i": gpos, "op": lie_in.hex()})
+            assert not r["ok"], r
+            r = node._validate({"i": gpos, "op": op.hex()})
+            assert r["ok"], r
+        finally:
+            if node is not None:
+                node.close()
+            server.close()
+
+    def test_snapshot_state_roundtrips_genome_tail(self):
+        """Canonical state (what snapshots certify and rejoiners state-
+        sync from) carries the effective knobs: a ledger restored from
+        mid-run state continues on the SAME schedule."""
+        from bflc_demo_tpu.ledger.snapshot import restore_snapshot
+        server, _, _ = _run_closed_loop_drill()
+        try:
+            led = server.ledger
+            rep = restore_snapshot(led.encode_state(), server.cfg,
+                                   led.log_size(), led.log_head())
+            assert rep.effective_density == led.effective_density
+            assert rep.effective_staleness == led.effective_staleness
+            assert rep.genome_epoch == led.genome_epoch
+            assert rep.encode_state() == led.encode_state()
+        finally:
+            server.close()
+
+
+# ------------------------------------- mid-run knob-change differential
+class TestDensityTransition:
+    def test_mixed_density_round_rederives_byte_identical(self):
+        """tools/check_reduction_spec.py's closed-loop leg, tier-1
+        sized: one aggregation holding blobs encoded at different
+        densities/codecs (the mid-run genome transition) must re-derive
+        to the writer's committed hash on both validator paths."""
+        import sys
+        sys.path.insert(0, "tools")
+        from check_reduction_spec import \
+            run_density_transition_differential
+        out = run_density_transition_differential(trials=4, seed=5,
+                                                  max_n=10)
+        assert out["mismatches"] == [], out

@@ -107,6 +107,55 @@ METRICS: List[Tuple[str, str, str, str]] = [
     ("device_gate_fresh_compiles",
      "extra.device.steady_state_gate.fresh_after_warmup", "lower",
      "abs"),
+    # closed-loop compression (eval.benchmarks.closed_loop_config1,
+    # bench.py extra.closed_loop, ISSUE 20): EF egress reduction at
+    # matched accuracy (only populated when the EF leg stayed within
+    # 0.02 of dense — a drop to '-' IS the flag that accuracy parity
+    # broke), the EF-vs-dense accuracy gap (signed near-zero: "abs"),
+    # the catch-up vs the stateless-sparse trail, and rounds-to-0.85
+    # under EF (time-to-quality; fewer is better).
+    ("closed_loop_egress_matched_x",
+     "extra.closed_loop.egress_reduction_at_matched_acc_x", "higher",
+     "rel"),
+    ("closed_loop_acc_gap_ef",
+     "extra.closed_loop.acc_gap_ef", "lower", "abs"),
+    ("closed_loop_acc_catch_up",
+     "extra.closed_loop.acc_catch_up", "higher", "abs"),
+    ("closed_loop_rounds_to_085_ef",
+     "extra.closed_loop.rounds_to_085_ef", "lower", "rel"),
+]
+
+# Derived axes: computed by a function over the parsed record instead
+# of a dotted path — for terminal keys a dotted path cannot address
+# (leg names like "d0.01_f32" contain dots) or values derived from
+# several fields.  Same (label, extractor, direction, mode) semantics.
+def _sparse_acc_catch_up(rec: Dict[str, Any]) -> Optional[float]:
+    """The accuracy-catch-up axis over the EXISTING extra.sparse
+    artifacts: how far the sparsest stateless top-k leg trails the
+    dense-f32 leg (extra.sparse.acc_gap_vs_dense_f32 is keyed by leg
+    name).  This is the trail error feedback exists to close — once
+    extra.closed_loop lands, closed_loop_acc_catch_up shows how much
+    of THIS number EF recovered."""
+    gaps = rec.get("extra", {}).get("sparse", {}) \
+        .get("acc_gap_vs_dense_f32")
+    if not isinstance(gaps, dict):
+        return None
+    sparse = {k: v for k, v in gaps.items()
+              if k.startswith("d") and not k.startswith("d1_")
+              and isinstance(v, (int, float))}
+    if not sparse:
+        return None
+    # the sparsest f32 leg (smallest density) — the headline trail
+    def _dens(k: str) -> float:
+        try:
+            return float(k[1:].rsplit("_", 1)[0])
+        except ValueError:
+            return 1.0
+    return float(sparse[min(sparse, key=_dens)])
+
+
+DERIVED: List[Tuple[str, Any, str, str]] = [
+    ("sparse_acc_gap_sparsest", _sparse_acc_catch_up, "lower", "abs"),
 ]
 
 
@@ -153,8 +202,11 @@ def trend(series: List[Tuple[int, Dict[str, Any]]],
     than `threshold` (relative)."""
     metrics: Dict[str, List[Tuple[int, float]]] = {}
     regressions: List[Dict[str, Any]] = []
-    for label, path, direction, mode in METRICS:
-        pts = [(n, _dig(rec, path)) for n, rec in series]
+    axes = [(lb, (lambda rec, p=path: _dig(rec, p)), d, m)
+            for lb, path, d, m in METRICS]
+    axes += [(lb, fn, d, m) for lb, fn, d, m in DERIVED]
+    for label, extract, direction, mode in axes:
+        pts = [(n, extract(rec)) for n, rec in series]
         pts = [(n, v) for n, v in pts if v is not None]
         if not pts:
             continue

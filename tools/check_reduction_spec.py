@@ -10,11 +10,16 @@ randomized weights (integer n_samples and FedBuff ``n/sqrt(1+s)``
 staleness discounts), randomized selections (including empty and
 full), and every decode image the data plane admits — delta dtypes
 (plain f32, f16-decoded, i8-decoded) CROSSED with upload densities
-(dense, top-k sparsified at 0.1 / 0.01 through the one
-sparsify -> quantize -> dequantize -> densify chain) — each scenario
-reduced by BOTH legs and compared with exact byte equality, plus the
-full ``aggregate_flat`` writer merge against the certified
-canonical-bytes hash.
+(dense, 0.1 / 0.01) CROSSED with sparse codecs (`#topk` scatter
+records, `#sketch` count-sketch tables, both through the one
+sparse-encode -> quantize -> dequantize -> densify chain) — each
+scenario reduced by BOTH legs and compared with exact byte equality,
+plus the full ``aggregate_flat`` writer merge against the certified
+canonical-bytes hash.  A closed-loop sweep
+(`run_density_transition_differential`) additionally mixes pre/post
+genome-op densities and codecs WITHIN one aggregation — the mid-run
+knob change an adaptive fleet commits — and requires the writer and
+validator re-derivation hashes to stay byte-identical across it.
 
 REDUCTION SPEC v2 rides the same sweep: every scenario is additionally
 reduced under ``reduce_blocks`` in {1, 2, 8, 64} (clamped to the
@@ -45,12 +50,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import numpy as np  # noqa: E402
 
 
-def _random_flat(rng, shapes, quant, density=1.0):
+def _sparse_image(flat, density, codec):
+    """The sparse encoder image of `flat` under the chosen codec —
+    `#topk` scatter records or `#sketch` tables, the two wire forms
+    `densify_entries` inverts."""
+    from bflc_demo_tpu.utils.serialization import (sketch_entries,
+                                                   sparsify_entries)
+    if codec == "sketch":
+        return sketch_entries(flat, density)
+    return sparsify_entries(flat, density)
+
+
+def _random_flat(rng, shapes, quant, density=1.0, codec="topk"):
     """One delta in a randomly chosen admitted decode image."""
     from bflc_demo_tpu.utils.serialization import (densify_entries,
                                                    dequantize_entries,
-                                                   quantize_entries,
-                                                   sparsify_entries)
+                                                   quantize_entries)
     flat = {}
     for k, shp in shapes.items():
         scale = 10.0 ** float(rng.integers(-8, 8))
@@ -59,10 +74,10 @@ def _random_flat(rng, shapes, quant, density=1.0):
         return flat
     # what admission/scoring/aggregation actually see for a sparse
     # and/or quantized upload: the ONE deterministic decode chain of
-    # the exact bytes the client signed (sparsify runs BEFORE
+    # the exact bytes the client signed (sparsify/sketch runs BEFORE
     # quantize, densify AFTER dequantize — the wire order)
     return densify_entries(dequantize_entries(
-        quantize_entries(sparsify_entries(flat, density), quant)))
+        quantize_entries(_sparse_image(flat, density, codec), quant)))
 
 
 def _scenario(rng, max_n):
@@ -76,7 +91,8 @@ def _scenario(rng, max_n):
             int(d) for d in rng.integers(1, 9, size=rank))
     quant = ("f32", "f16", "i8")[int(rng.integers(0, 3))]
     density = (1.0, 0.1, 0.01)[int(rng.integers(0, 3))]
-    deltas = [_random_flat(rng, shapes, quant, density)
+    codec = ("topk", "sketch")[int(rng.integers(0, 2))]
+    deltas = [_random_flat(rng, shapes, quant, density, codec)
               for _ in range(n)]
     if deltas and "/leaf0" in deltas[0] and deltas[0]["/leaf0"].size:
         deltas[0]["/leaf0"].flat[0] = np.float32(1e-42)      # denormal
@@ -94,7 +110,7 @@ def _scenario(rng, max_n):
     lr = float(rng.random()) * 0.5
     g = {k: rng.standard_normal(shp).astype(np.float32)
          for k, shp in shapes.items()}
-    return g, deltas, weights, selected, lr, quant, density
+    return g, deltas, weights, selected, lr, quant, density, codec
 
 
 BLOCKS_SWEEP = (1, 2, 8, 64)
@@ -122,7 +138,7 @@ def run_differential(trials: int = 20, seed: int = 0,
     # legs must agree on those bytes too, so the warnings are noise
     with np.errstate(over="ignore", invalid="ignore"):
         for t in range(trials):
-            g, deltas, weights, selected, lr, quant, density = \
+            g, deltas, weights, selected, lr, quant, density, codec = \
                 _scenario(rng, max_n)
             keys = sorted(g.keys())
             w = spec.merge_weight_vector(weights, selected, len(deltas))
@@ -168,7 +184,7 @@ def run_differential(trials: int = 20, seed: int = 0,
             if bad:
                 mismatches.append({
                     "trial": t, "n": len(deltas), "quant": quant,
-                    "density": density,
+                    "density": density, "codec": codec,
                     "selected": len(selected), "leaves": bad})
     return {"trials": trials, "seed": seed, "max_n": max_n,
             "mismatches": mismatches,
@@ -189,7 +205,7 @@ def run_steady_state_check(repeats: int = 3, seed: int = 0,
     from bflc_demo_tpu.meshagg.engine import ENGINE
 
     rng = np.random.default_rng(seed)
-    g, deltas, weights, selected, lr, _, _ = _scenario(rng, max_n)
+    g, deltas, weights, selected, lr, _, _, _ = _scenario(rng, max_n)
     keys = sorted(g.keys())
     w = spec.merge_weight_vector(weights, selected, len(deltas))
     wsum = max(float(w.sum()), 1e-12)
@@ -232,14 +248,13 @@ def run_rederive_differential(trials: int = 12, seed: int = 1,
                                                    dequantize_entries,
                                                    pack_entries,
                                                    quantize_entries,
-                                                   sparsify_entries,
                                                    unpack_pytree)
 
     rng = np.random.default_rng(seed)
     mismatches = []
     with np.errstate(over="ignore", invalid="ignore"):
         for t in range(trials):
-            g, _, weights, selected, lr, quant, density = \
+            g, _, weights, selected, lr, quant, density, codec = \
                 _scenario(rng, max_n)
             n = len(weights)
             shapes = {k: np.asarray(v).shape for k, v in g.items()}
@@ -251,7 +266,7 @@ def run_rederive_differential(trials: int = 12, seed: int = 1,
                             ).astype(np.float32)
                         for k, shp in shapes.items()}
                 blobs.append(pack_entries(quantize_entries(
-                    sparsify_entries(flat, density), quant)))
+                    _sparse_image(flat, density, codec), quant)))
             prev_blob = pack_entries(g)
             # writer path: decode all, one engine merge, pack, hash
             decoded = [densify_entries(dequantize_entries(
@@ -298,9 +313,86 @@ def run_rederive_differential(trials: int = 12, seed: int = 1,
                 bad.append("#shard-coverage")
             if bad:
                 mismatches.append({"trial": t, "n": n, "quant": quant,
-                                   "density": density, "leaves": bad})
+                                   "density": density, "codec": codec,
+                                   "leaves": bad})
     return {"trials": trials, "seed": seed, "max_n": max_n,
             "n_validators": n_validators, "mismatches": mismatches}
+
+
+def run_density_transition_differential(trials: int = 8, seed: int = 2,
+                                        max_n: int = 24) -> dict:
+    """The mid-run knob-change differential (closed-loop compression):
+    a certified genome-update op can retune `delta_density` BETWEEN a
+    round's uploads being encoded and admitted, so one aggregation may
+    legitimately hold blobs encoded at DIFFERENT densities (and, on a
+    codec change, different sparse record types).  Both consumers —
+    the WRITER path (decode every blob through the one inverse, one
+    engine merge) and the VALIDATOR path (`rederive_model_flat` over
+    the raw wire blobs, plain and blocked) — are density-agnostic at
+    admission by construction; this check is the standing proof: mixed
+    pre/post-transition blobs must re-derive to byte-identical
+    committed model hashes.  Empty `mismatches` = an adaptive fleet
+    never needs a flag day to move the knob."""
+    from bflc_demo_tpu.meshagg.engine import ENGINE
+    from bflc_demo_tpu.rederive.core import rederive_model_flat
+    from bflc_demo_tpu.utils.serialization import (densify_entries,
+                                                   dequantize_entries,
+                                                   pack_entries,
+                                                   quantize_entries,
+                                                   unpack_pytree)
+
+    rng = np.random.default_rng(seed)
+    mismatches = []
+    with np.errstate(over="ignore", invalid="ignore"):
+        for t in range(trials):
+            g, _, weights, selected, lr, quant, _, _ = \
+                _scenario(rng, max_n)
+            n = len(weights)
+            shapes = {k: np.asarray(v).shape for k, v in g.items()}
+            # the knob transition: uploads encoded before the genome op
+            # ride the old density/codec, uploads after ride the new
+            d_pre = (1.0, 0.1)[int(rng.integers(0, 2))]
+            d_post = (0.1, 0.05, 0.01)[int(rng.integers(0, 3))]
+            c_pre = ("topk", "sketch")[int(rng.integers(0, 2))]
+            c_post = ("topk", "sketch")[int(rng.integers(0, 2))]
+            cut = int(rng.integers(0, n + 1))
+            blobs = []
+            for i in range(n):
+                flat = {k: (rng.standard_normal(shp)
+                            * 10.0 ** float(rng.integers(-6, 6))
+                            ).astype(np.float32)
+                        for k, shp in shapes.items()}
+                d, c = (d_pre, c_pre) if i < cut else (d_post, c_post)
+                blobs.append(pack_entries(quantize_entries(
+                    _sparse_image(flat, d, c), quant)))
+            prev_blob = pack_entries(g)
+            decoded = [densify_entries(dequantize_entries(
+                           unpack_pytree(b))) for b in blobs]
+            w_out = ENGINE.aggregate_flat(g, decoded, weights, selected,
+                                          lr)
+            w_hash = hashlib.sha256(pack_entries(w_out)).digest()
+            bad = []
+            v_out = rederive_model_flat(prev_blob, blobs, weights,
+                                        selected, lr, sparse=True)
+            if hashlib.sha256(
+                    pack_entries(v_out)).digest() != w_hash:
+                bad.append("#transition-full-hash")
+            p_total = sum(int(np.asarray(v).size) for v in g.values())
+            blk = min(int(BLOCKS_SWEEP[t % len(BLOCKS_SWEEP)]),
+                      max(p_total, 1))
+            vb_out = rederive_model_flat(prev_blob, blobs, weights,
+                                         selected, lr, sparse=True,
+                                         blocks=blk)
+            if hashlib.sha256(
+                    pack_entries(vb_out)).digest() != w_hash:
+                bad.append(f"#transition-blocked-hash-b{blk}")
+            if bad:
+                mismatches.append({
+                    "trial": t, "n": n, "quant": quant, "cut": cut,
+                    "pre": [d_pre, c_pre], "post": [d_post, c_post],
+                    "leaves": bad})
+    return {"trials": trials, "seed": seed, "max_n": max_n,
+            "mismatches": mismatches}
 
 
 def main(argv=None) -> int:
@@ -345,6 +437,20 @@ def main(argv=None) -> int:
         return 1
     print("OK: writer path and validator re-derivation path "
           "byte-identical on every scenario")
+    dt = run_density_transition_differential(max(args.trials // 2, 6),
+                                             args.seed + 2)
+    print(f"density-transition differential: {dt['trials']} trials "
+          f"(mixed pre/post-genome densities and codecs per round)")
+    if dt["mismatches"]:
+        for m in dt["mismatches"]:
+            print(f"  DIVERGED: {m}")
+        print("FAIL: a mid-run density/codec change produced "
+              "writer-vs-validator hash divergence — the adaptive "
+              "genome loop must stay disarmed (adapt_every=0) until "
+              "resolved")
+        return 1
+    print("OK: writer and validator paths byte-identical across "
+          "mid-run density/codec transitions")
     return 0
 
 

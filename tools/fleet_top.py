@@ -117,6 +117,24 @@ def _sparse_cell(snap):
             + (f"  decode {n_sd}x{m_sd * 1e3:.1f}ms" if n_sd else ""))
 
 
+def _adaptive_cell(snap):
+    """The closed-loop compression panel (--adapt-every): which
+    certified genome epoch pins the current effective knobs, how many
+    genome-update ops this chain has applied, and the effective
+    staleness bound (async fleets).  None when the loop is disarmed —
+    the gauge only exists on adapt-armed writers."""
+    ge = _gauge_value(snap, "genome_epoch")
+    if ge is None:
+        return None
+    n = _sum_counter(snap, "genome_updates_total")
+    stale = _gauge_value(snap, "effective_staleness", 0)
+    cell = ("adapt genome@-" if ge < 0 else f"adapt genome@{int(ge)}")
+    cell += f"  updates {n:.0f}"
+    if stale:
+        cell += f"  stale<={int(stale)}"
+    return cell
+
+
 def _role_row(role, snap):
     """One table row: the per-role-class key numbers."""
     costs = snap.get("trace_costs") or {}
@@ -262,6 +280,14 @@ def _role_row(role, snap):
         sp = _sparse_cell(snap)
         if sp is not None:
             cells.append(sp)
+        # closed-loop compression (--adapt-every, ledger.OP_GENOME):
+        # the LIVE effective knobs the certified schedule pins right
+        # now — the density above is already the effective one; this
+        # names the schedule driving it (last genome epoch + applied
+        # count + the staleness bound on async fleets)
+        ad = _adaptive_cell(snap)
+        if ad is not None:
+            cells.append(ad)
         # model-quality health plane (obs.health): last round's
         # verdict, flagged senders, update norm, committee disagreement
         hc = _health_cell(snap)
@@ -381,6 +407,25 @@ def _reseat_events(art_dir: str) -> list:
             and e.get("name") == "committee_reseat"]
 
 
+def _genome_events(art_dir: str) -> list:
+    """``genome_update`` flight events off the writer's flight dump
+    (closed-loop compression, ledger.OP_GENOME) — the artifact that
+    names each certified knob transition and the telemetry the fixed
+    rule decided on."""
+    if not art_dir:
+        return []
+    path = os.path.join(art_dir, "writer.flight.jsonl")
+    if not os.path.exists(path):
+        return []
+    try:
+        from bflc_demo_tpu.obs.flight import load_flight
+        evs = load_flight(path).get("events", [])
+    except (OSError, ValueError):
+        return []
+    return [e for e in evs if isinstance(e, dict)
+            and e.get("name") == "genome_update"]
+
+
 def _committee_panel(art_dir: str) -> list:
     """Current seating per the newest reseat event; empty on frozen-
     committee (R=0 / sync) fleets."""
@@ -489,6 +534,11 @@ def render_timeline(timeline, spans_dir: str = "") -> str:
         # seating change is read next to the drain that carried it
         recs.extend({"type": "reseat", **e}
                     for e in _reseat_events(spans_dir))
+        # certified genome updates (closed-loop compression)
+        # interleave as well: the knob transition is read next to the
+        # commit and telemetry that decided it
+        recs.extend({"type": "genome", **e}
+                    for e in _genome_events(spans_dir))
     if not recs:
         return "empty timeline"
     t0 = min(r.get("t", 0.0) for r in recs)
@@ -505,6 +555,21 @@ def render_timeline(timeline, spans_dir: str = "") -> str:
                 f"+{dt:7.1f}s  RESEAT  epoch {r.get('epoch')}: "
                 f"{','.join(r.get('seats') or []) or '?'}"
                 + (f" (in: {','.join(changed)})" if changed else ""))
+        elif r["type"] == "genome":
+            bits = []
+            if r.get("old_density") != r.get("new_density"):
+                bits.append(f"density {r.get('old_density'):g}->"
+                            f"{r.get('new_density'):g}")
+            if r.get("old_staleness") != r.get("new_staleness"):
+                bits.append(f"staleness {r.get('old_staleness')}->"
+                            f"{r.get('new_staleness')}")
+            lines.append(
+                f"+{dt:7.1f}s  GENOME  commit "
+                f"{r.get('commit_epoch')}: "
+                + (" ".join(bits) or "knobs held")
+                + f" (disagree={r.get('disagreement'):.3g} "
+                  f"drift={r.get('drift'):.3g} "
+                  f"norm={r.get('update_norm'):.3g})")
         elif r["type"] == "slo_alert":
             lines.append(
                 f"+{dt:7.1f}s  ALERT   {r.get('slo')} round "

@@ -56,21 +56,30 @@ def render_summary(tl: RoundTimeline, recs: List[dict]) -> str:
                  f"{verdicts['crit']}; {len(tl.alerts)} SLO alert(s); "
                  f"{len(tl.faults)} fault record(s)")
     lines += ["", "| round | wall | health | flagged | faults | "
-                  "coverage | acc | alerts |",
-              "|---|---|---|---|---|---|---|---|"]
+                  "coverage | acc | genome | alerts |",
+              "|---|---|---|---|---|---|---|---|---|"]
     for rec in recs:
         flagged = sum(h.get("flagged", 0)
                       for h in rec["health"].values())
         acc = (rec["commit"] or {}).get("acc")
         cov = rec.get("scrape_coverage")
         alerts = ", ".join(a["slo"] for a in rec["alerts"]) or "-"
+        # closed-loop knob transitions this round committed
+        genome = "-"
+        gs = rec.get("genome_updates") or []
+        if gs:
+            g = gs[-1]
+            genome = (f"d {g.get('old_density'):g}->"
+                      f"{g.get('new_density'):g}"
+                      if g.get("old_density") != g.get("new_density")
+                      else "held")
         lines.append(
             f"| {rec['epoch']} | {_fmt_s(rec.get('wall_s'))} "
             f"| {(rec.get('health_verdict') or '-').upper()} "
             f"| {flagged} | {len(rec['faults'])} "
             f"| {f'{cov:.0%}' if cov is not None else '-'} "
             f"| {f'{acc:.4f}' if acc is not None else '-'} "
-            f"| {alerts} |")
+            f"| {genome} | {alerts} |")
     return "\n".join(lines)
 
 
@@ -90,6 +99,23 @@ def render_round(rec: dict) -> str:
         lines.append(
             "committee: " + ", ".join(rec["committee"])
             + ("  (reseated this round)" if rec.get("reseat") else ""))
+    # closed-loop compression: the certified genome-update op(s) this
+    # round's commit proposed — old -> new knobs plus the telemetry
+    # the fixed rule decided on (what every validator re-derived)
+    for g in rec.get("genome_updates", []) or ():
+        parts = [f"genome update @ commit {g.get('commit_epoch')}:"]
+        if g.get("old_density") != g.get("new_density"):
+            parts.append(f"density {g.get('old_density'):g} -> "
+                         f"{g.get('new_density'):g}")
+        if g.get("old_staleness") != g.get("new_staleness"):
+            parts.append(f"staleness {g.get('old_staleness')} -> "
+                         f"{g.get('new_staleness')}")
+        if len(parts) == 1:
+            parts.append("knobs held")
+        parts.append(f"(disagree={g.get('disagreement'):.3g} "
+                     f"drift={g.get('drift'):.3g} "
+                     f"norm={g.get('update_norm'):.3g})")
+        lines.append(" ".join(parts))
     tr = rec.get("trace")
     if tr:
         lines += ["", "## Critical path (partition of round wall)", ""]
